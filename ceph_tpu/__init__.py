@@ -1,0 +1,18 @@
+"""ceph_tpu — a TPU-native distributed-storage framework.
+
+A from-scratch re-design of the capabilities of Ceph (reference:
+liu-chunmei/ceph v13.1.0) around TPU-first math:
+
+- Erasure coding (``ceph_tpu.ec``): GF(2^8) Reed-Solomon and the full
+  reference plugin family (jerasure / isa / lrc / shec / clay semantics)
+  implemented as batched GF(2) bit-sliced matmuls on the MXU via Pallas
+  (``ceph_tpu.ops``), behind an ``ErasureCodeInterface``-equivalent API
+  (reference: src/erasure-code/ErasureCodeInterface.h:170).
+- Placement (``ceph_tpu.crush``): CRUSH straw2 + rjenkins as vmapped JAX
+  kernels; full-cluster PG sweeps are one jitted data-parallel call
+  (reference: src/crush/mapper.c:900).
+- An OSDMap/PG/object-store runtime (``ceph_tpu.osd``, ``ceph_tpu.rados``,
+  ``ceph_tpu.mon``, ``ceph_tpu.msg``) playing the role of Ceph's daemons.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,167 @@
+"""ctypes bindings to the native core (csrc/ -> libceph_tpu_native.so).
+
+The native library provides the scalar conformance oracles (GF(2^8) RS,
+rjenkins, crush_ln, crush_do_rule over the flattened map) and the CPU
+baseline kernels the benchmarks compare the TPU path against.
+
+Build with ``make -C csrc`` (done automatically by tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libceph_tpu_native.so")
+_lib = None
+
+
+def build():
+    csrc = os.path.join(os.path.dirname(__file__), os.pardir, "csrc")
+    subprocess.run(["make", "-C", csrc, "-s"], check=True)
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        if not os.path.exists(_LIB_PATH):
+            build()
+        _lib = ctypes.CDLL(_LIB_PATH)
+        _configure(_lib)
+    return _lib
+
+
+def _configure(L: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+
+    L.gf256_mul.restype = ctypes.c_uint8
+    L.gf256_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
+    L.gf256_inv.restype = ctypes.c_uint8
+    L.gf256_inv.argtypes = [ctypes.c_uint8]
+    L.gf256_rs_encode.restype = None
+    L.gf256_rs_encode.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p, u8p,
+                                  ctypes.c_int64]
+    L.gf256_mat_invert.restype = ctypes.c_int
+    L.gf256_mat_invert.argtypes = [u8p, u8p, ctypes.c_int]
+    L.gf256_rs_decode_data.restype = ctypes.c_int
+    L.gf256_rs_decode_data.argtypes = [u8p, ctypes.c_int, ctypes.c_int, i32p,
+                                       u8p, u8p, ctypes.c_int64]
+    L.crush_oracle_ln.restype = ctypes.c_int64
+    L.crush_oracle_ln.argtypes = [ctypes.c_uint32]
+    L.crush_oracle_hash3.restype = ctypes.c_uint32
+    L.crush_oracle_hash3.argtypes = [ctypes.c_uint32] * 3
+    L.crush_oracle_hash2.restype = ctypes.c_uint32
+    L.crush_oracle_hash2.argtypes = [ctypes.c_uint32] * 2
+    L.crush_oracle_straw2_choose.restype = ctypes.c_int
+    L.crush_oracle_straw2_choose.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, i32p, u32p, i32p, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32,
+    ]
+    L.crush_oracle_do_rule.restype = ctypes.c_int
+    L.crush_oracle_do_rule.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # n_buckets, max_size, max_devices
+        i32p, u32p, i32p, i32p, i32p,                    # items, weights, sizes, algs, types
+        u32p, ctypes.c_int32,                            # device_weights, weight_max
+        i32p, ctypes.c_int32, ctypes.c_int32,            # steps, n_steps, x
+        i32p, ctypes.c_int32,                            # result, result_max
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # tunables...
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _u32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+def gf256_mul(a: int, b: int) -> int:
+    return lib().gf256_mul(a, b)
+
+
+def gf256_inv(a: int) -> int:
+    return lib().gf256_inv(a)
+
+
+def rs_encode(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """matrix (m,k) uint8; data (k, len) uint8 -> coding (m, len)."""
+    m, k = matrix.shape
+    length = data.shape[1]
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    coding = np.zeros((m, length), dtype=np.uint8)
+    lib().gf256_rs_encode(_u8(matrix), k, m, _u8(data), _u8(coding), length)
+    return coding
+
+
+def rs_decode_data(full_gen: np.ndarray, k: int, m: int,
+                   survivors: np.ndarray, avail: np.ndarray) -> np.ndarray:
+    """Reconstruct the k data rows from k surviving chunk rows."""
+    length = avail.shape[1]
+    full_gen = np.ascontiguousarray(full_gen, dtype=np.uint8)
+    survivors = np.ascontiguousarray(survivors, dtype=np.int32)
+    avail = np.ascontiguousarray(avail, dtype=np.uint8)
+    out = np.zeros((k, length), dtype=np.uint8)
+    rc = lib().gf256_rs_decode_data(_u8(full_gen), k, m, _i32(survivors),
+                                    _u8(avail), _u8(out), length)
+    if rc:
+        raise ValueError("native decode failed (singular submatrix)")
+    return out
+
+
+def crush_ln(x: int) -> int:
+    return lib().crush_oracle_ln(x)
+
+
+def hash3(a: int, b: int, c: int) -> int:
+    return lib().crush_oracle_hash3(a, b, c)
+
+
+def hash2(a: int, b: int) -> int:
+    return lib().crush_oracle_hash2(a, b)
+
+
+def straw2_choose(items: np.ndarray, weights: np.ndarray, sizes: np.ndarray,
+                  bno: int, x: int, r: int) -> int:
+    n_buckets, max_size = items.shape
+    items = np.ascontiguousarray(items, dtype=np.int32)
+    weights = np.ascontiguousarray(weights, dtype=np.uint32)
+    sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+    return lib().crush_oracle_straw2_choose(
+        n_buckets, max_size, _i32(items), _u32(weights), _i32(sizes), bno, x, r
+    )
+
+
+def do_rule(flat, steps: np.ndarray, x: int, result_max: int,
+            device_weights: np.ndarray) -> np.ndarray:
+    """Run a rule on the flattened map `flat` (see ceph_tpu.crush.map)."""
+    steps = np.ascontiguousarray(steps, dtype=np.int32)
+    device_weights = np.ascontiguousarray(device_weights, dtype=np.uint32)
+    result = np.full(result_max, 0x7FFFFFFF, dtype=np.int32)
+    items = np.ascontiguousarray(flat.items, dtype=np.int32)
+    weights = np.ascontiguousarray(flat.weights, dtype=np.uint32)
+    sizes = np.ascontiguousarray(flat.sizes, dtype=np.int32)
+    algs = np.ascontiguousarray(flat.algs, dtype=np.int32)
+    types = np.ascontiguousarray(flat.types, dtype=np.int32)
+    n = lib().crush_oracle_do_rule(
+        items.shape[0], items.shape[1], flat.max_devices,
+        _i32(items), _u32(weights), _i32(sizes), _i32(algs), _i32(types),
+        _u32(device_weights), len(device_weights),
+        _i32(steps), len(steps), x, _i32(result), result_max,
+        flat.tunables.choose_total_tries, flat.tunables.choose_local_tries,
+        flat.tunables.choose_local_fallback_tries,
+        flat.tunables.chooseleaf_descend_once, flat.tunables.chooseleaf_vary_r,
+        flat.tunables.chooseleaf_stable,
+    )
+    return result[:n]

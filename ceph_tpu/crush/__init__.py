@@ -1,0 +1,10 @@
+"""CRUSH placement math, TPU-native.
+
+The reference's scalar C walk (crush_do_rule, reference: src/crush/mapper.c:900)
+becomes a vmapped functional interpreter over a flattened, padded map
+representation; straw2 draws are computed for all bucket items at once and
+argmax-selected.  Bit-exactness with the kernel-frozen C is the contract:
+rjenkins1 (hashes.py), the fixed-point crush_ln (ln.py + ln_table.py), and
+the retry/collision semantics (mapper.py) are all pinned against the
+native oracle in csrc/.
+"""

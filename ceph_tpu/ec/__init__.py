@@ -1,0 +1,8 @@
+"""Erasure-code engine: GF math, generator matrices, plugin family.
+
+Mirrors the capability surface of the reference plugin tree
+(reference: src/erasure-code/) — jerasure, isa, lrc, shec plus the
+sub-chunk clay code — with encode/decode lowered to batched GF(2)
+bit-sliced matmuls (see ceph_tpu.ops.gf2_matmul).
+"""
+

@@ -1,0 +1,218 @@
+"""Galois-field GF(2^w) arithmetic — the numpy conformance reference.
+
+This is the ground-truth scalar/vectorized implementation that every TPU
+kernel is pinned against.  The field definitions match the public
+gf-complete / ISA-L conventions used by the reference's codecs
+(reference: src/erasure-code/jerasure/CMakeLists.txt:50-70 enumerates the
+gf-complete sources; src/erasure-code/isa/ErasureCodeIsa.cc:128 calls
+ISA-L's ec_encode_data):
+
+- w=4  : poly x^4+x+1                 (0x13)
+- w=8  : poly x^8+x^4+x^3+x^2+1      (0x11d)  — the RS workhorse
+- w=16 : poly x^16+x^12+x^3+x+1      (0x1100b)
+- w=32 : poly x^32+x^22+x^2+x+1      (0x100400007)
+
+All byte-shaped APIs are vectorized over numpy uint arrays so the same
+functions serve as oracle for batched kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Primitive polynomials (full form including the x^w term), matching
+# gf-complete's defaults for each word size.
+GF_POLY = {
+    4: 0x13,
+    8: 0x11D,
+    16: 0x1100B,
+    32: 0x100400007,
+}
+
+
+@functools.lru_cache(maxsize=None)
+def tables(w: int = 8):
+    """(log, antilog) tables for GF(2^w), w <= 16.
+
+    antilog has length 2*(2^w - 1) so that ``antilog[log[a] + log[b]]``
+    needs no modular reduction.  log[0] is set to a sentinel (2^w - 1
+    doubled) that callers must branch around (a==0 or b==0 => 0).
+    """
+    if w not in GF_POLY or w > 16:
+        raise ValueError(f"unsupported w={w} for table generation")
+    n = (1 << w) - 1
+    poly = GF_POLY[w]
+    log = np.zeros(1 << w, dtype=np.int32)
+    antilog = np.zeros(2 * n + 1, dtype=np.int64 if w > 8 else np.int32)
+    x = 1
+    for i in range(n):
+        antilog[i] = x
+        antilog[i + n] = x
+        log[x] = i
+        x <<= 1
+        if x & (1 << w):
+            x ^= poly
+    log[0] = 2 * n  # sentinel: out of the duplicated antilog range on purpose
+    antilog = antilog.astype(np.uint32)
+    return log, antilog
+
+
+def mul(a, b, w: int = 8):
+    """Element-wise GF(2^w) multiply of uint arrays (or scalars)."""
+    if w <= 16:
+        log, antilog = tables(w)
+        a = np.asarray(a, dtype=np.uint32)
+        b = np.asarray(b, dtype=np.uint32)
+        out = antilog[(log[a] + log[b]) % (2 * ((1 << w) - 1))]
+        # The modular wrap above maps the log[0] sentinel into range, so
+        # explicitly zero products with a zero operand.
+        out = np.where((a == 0) | (b == 0), 0, out)
+        return out.astype(np.uint32)
+    # w == 32: carryless shift-and-add (slow scalar path, oracle only).
+    return _mul_slow(a, b, w)
+
+
+def _mul_slow(a, b, w: int):
+    a = np.asarray(a, dtype=np.uint64)
+    b = np.asarray(b, dtype=np.uint64)
+    poly = np.uint64(GF_POLY[w] & ((1 << w) - 1))
+    top = np.uint64(1 << (w - 1))
+    prod = np.zeros(np.broadcast(a, b).shape, dtype=np.uint64)
+    aa = np.broadcast_to(a, prod.shape).copy()
+    bb = np.broadcast_to(b, prod.shape).copy()
+    for _ in range(w):
+        prod ^= np.where(bb & np.uint64(1), aa, np.uint64(0))
+        bb >>= np.uint64(1)
+        carry = (aa & top) != 0
+        aa = (aa << np.uint64(1)) & np.uint64((1 << w) - 1)
+        aa ^= np.where(carry, poly, np.uint64(0))
+    return prod.astype(np.uint64 if w > 32 else np.uint32)
+
+
+def inv(a, w: int = 8):
+    """Element-wise multiplicative inverse (inv(0) raises)."""
+    log, antilog = tables(w)
+    a = np.asarray(a, dtype=np.uint32)
+    if np.any(a == 0):
+        raise ZeroDivisionError("gf.inv(0)")
+    n = (1 << w) - 1
+    return antilog[(n - log[a]) % n].astype(np.uint32)
+
+
+def div(a, b, w: int = 8):
+    return mul(a, inv(b, w), w)
+
+
+def pow_(a: int, e: int, w: int = 8) -> int:
+    out = 1
+    for _ in range(e):
+        out = int(mul(out, a, w))
+    return out
+
+
+def matmul(A: np.ndarray, B: np.ndarray, w: int = 8) -> np.ndarray:
+    """GF(2^w) matrix product (XOR-accumulated)."""
+    A = np.asarray(A, dtype=np.uint32)
+    B = np.asarray(B, dtype=np.uint32)
+    out = np.zeros((A.shape[0], B.shape[1]), dtype=np.uint32)
+    for j in range(A.shape[1]):
+        out ^= mul(A[:, j : j + 1], B[j : j + 1, :], w)
+    return out
+
+
+def mat_inv(A: np.ndarray, w: int = 8) -> np.ndarray:
+    """Invert a square GF(2^w) matrix by Gauss-Jordan elimination.
+
+    Mirrors the role of ISA-L's gf_invert_matrix in the decode path
+    (reference: src/erasure-code/isa/ErasureCodeIsa.cc:274).
+    Raises ValueError on singular input.
+    """
+    A = np.array(A, dtype=np.uint32)
+    n = A.shape[0]
+    if A.shape != (n, n):
+        raise ValueError("mat_inv needs a square matrix")
+    aug = np.concatenate([A, np.eye(n, dtype=np.uint32)], axis=1)
+    for col in range(n):
+        pivot = col + int(np.argmax(aug[col:, col] != 0))
+        if aug[pivot, col] == 0:
+            raise ValueError("singular matrix over GF(2^%d)" % w)
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        aug[col] = mul(aug[col], inv(aug[col, col], w), w)
+        for row in range(n):
+            if row != col and aug[row, col]:
+                aug[row] ^= mul(aug[row, col], aug[col], w)
+    return aug[:, n:].copy()
+
+
+def mul_bytes(c: int, data: np.ndarray, w: int = 8) -> np.ndarray:
+    """Multiply a uint8 byte array by constant c in GF(2^8)."""
+    assert w == 8
+    log, antilog = tables(8)
+    if c == 0:
+        return np.zeros_like(data)
+    idx = np.minimum(log[data.astype(np.uint32)] + log[c], 2 * 255 - 2)
+    return np.where(data == 0, 0, antilog[idx]).astype(np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# GF(2) bit-matrix views: every multiply-by-constant in GF(2^w) is linear
+# over GF(2); a w x w binary matrix whose column x holds the bits of
+# c * 2^x.  This is the same companion-matrix expansion jerasure uses for
+# its bit-matrix techniques (jerasure_matrix_to_bitmatrix) and is the
+# representation our MXU kernels consume (one big GF(2) matmul).
+# ---------------------------------------------------------------------------
+
+
+def const_to_bitmatrix(c: int, w: int = 8) -> np.ndarray:
+    """w x w GF(2) matrix B with B[l, x] = bit l of (c * 2^x).
+
+    For x viewed as a bit-column vector, (B @ bits(x)) mod 2 == bits(c*x).
+    """
+    B = np.zeros((w, w), dtype=np.uint8)
+    elt = c
+    for x in range(w):
+        for l in range(w):
+            B[l, x] = (elt >> l) & 1
+        elt = int(mul(elt, 2, w))
+    return B
+
+
+def matrix_to_bitmatrix(M: np.ndarray, w: int = 8) -> np.ndarray:
+    """Expand an (r x c) GF(2^w) matrix into an (r*w x c*w) GF(2) matrix.
+
+    Layout matches jerasure_matrix_to_bitmatrix: block (i, j) is
+    const_to_bitmatrix(M[i, j]).
+    """
+    M = np.asarray(M)
+    r, c = M.shape
+    out = np.zeros((r * w, c * w), dtype=np.uint8)
+    for i in range(r):
+        for j in range(c):
+            out[i * w : (i + 1) * w, j * w : (j + 1) * w] = const_to_bitmatrix(
+                int(M[i, j]), w
+            )
+    return out
+
+
+def bytes_to_bitplanes(data: np.ndarray) -> np.ndarray:
+    """uint8 [..., k, n] -> bit-plane uint8 [..., k*8, n] (bit b of byte).
+
+    Row j*8+b of the output is bit b of data row j — the layout consumed by
+    GF(2) bit-matrix matmuls built with matrix_to_bitmatrix(w=8).
+    """
+    data = np.asarray(data, dtype=np.uint8)
+    bits = ((data[..., :, None, :] >> np.arange(8, dtype=np.uint8)[None, :, None]) & 1)
+    shape = data.shape[:-2] + (data.shape[-2] * 8, data.shape[-1])
+    return bits.reshape(shape).astype(np.uint8)
+
+
+def bitplanes_to_bytes(planes: np.ndarray) -> np.ndarray:
+    """Inverse of bytes_to_bitplanes."""
+    planes = np.asarray(planes, dtype=np.uint8)
+    shape = planes.shape[:-2] + (planes.shape[-2] // 8, 8, planes.shape[-1])
+    grouped = planes.reshape(shape)
+    weights = (1 << np.arange(8, dtype=np.uint16))[None, :, None]
+    return (grouped.astype(np.uint16) * weights).sum(axis=-2).astype(np.uint8)

@@ -1,0 +1,181 @@
+"""Reed-Solomon / Cauchy generator-matrix constructions.
+
+Reproduces the matrix-building semantics of the reference's codec family:
+
+- ``isa_rs_vandermonde`` / ``isa_cauchy``: ISA-L's gf_gen_rs_matrix /
+  gf_gen_cauchy1_matrix, selected by the isa plugin's matrixtype
+  (reference: src/erasure-code/isa/ErasureCodeIsa.cc:380-388,
+  ErasureCodeIsa.h:106-124).
+- ``jerasure_rs_vandermonde``: jerasure's reed_sol_van technique —
+  extended-Vandermonde distribution matrix reduced to systematic form
+  (reference: src/erasure-code/jerasure/ErasureCodeJerasure.h:82,
+  ErasureCodeJerasure.cc:155 calls jerasure_matrix_encode with it).
+- ``jerasure_rs_r6``: reed_sol_r6_op RAID-6 matrix (ones row + powers of 2)
+  (reference: src/erasure-code/jerasure/ErasureCodeJerasure.h:112).
+- ``cauchy_original``: jerasure cauchy_orig technique
+  (reference: src/erasure-code/jerasure/ErasureCodeJerasure.h:174).
+- ``cauchy_good``: cauchy_orig improved by row/column scaling to minimize
+  bit-matrix density (reference: ErasureCodeJerasure.h:183).
+
+All matrices are returned as the (m x k) *coding* block; encode appends
+these m parity rows under an implicit k x k identity (systematic code).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.ec import gf
+
+
+def isa_rs_vandermonde(k: int, m: int, w: int = 8) -> np.ndarray:
+    """ISA-L gf_gen_rs_matrix coding block: row i = powers of 2^i.
+
+    a[k+i][j] = (2^i)^j for i in [0, m).  Row 0 is all-ones, row 1 powers
+    of 2, etc.  Only guaranteed MDS for the k/m ranges the isa plugin
+    enforces (k<=21 for m=4; reference: ErasureCodeIsa.cc:330-360).
+    """
+    coding = np.zeros((m, k), dtype=np.uint32)
+    gen = 1
+    for i in range(m):
+        p = 1
+        for j in range(k):
+            coding[i, j] = p
+            p = int(gf.mul(p, gen, w))
+        gen = int(gf.mul(gen, 2, w))
+    return coding
+
+
+def isa_cauchy(k: int, m: int, w: int = 8) -> np.ndarray:
+    """ISA-L gf_gen_cauchy1_matrix coding block: a[k+i][j] = inv(i ^ (k+j))...
+
+    Precisely: for rows i in [k, k+m) entries are inv(i XOR j) with j in
+    [0, k); i>=k and j<k guarantees i != j so the inverse exists.
+    """
+    coding = np.zeros((m, k), dtype=np.uint32)
+    for i in range(k, k + m):
+        for j in range(k):
+            coding[i - k, j] = int(gf.inv(i ^ j, w))
+    return coding
+
+
+def _extended_vandermonde(rows: int, cols: int, w: int) -> np.ndarray:
+    """jerasure reed_sol_extended_vandermonde_matrix.
+
+    Row 0 = e_0, last row = e_{cols-1}, middle rows i = [i^0 .. i^(cols-1)].
+    Every cols x cols row-submatrix is nonsingular for rows <= 2^w + 1.
+    """
+    if rows > (1 << w) + 1:
+        raise ValueError("extended Vandermonde needs rows <= 2^w + 1")
+    V = np.zeros((rows, cols), dtype=np.uint32)
+    V[0, 0] = 1
+    for i in range(1, rows - 1):
+        p = 1
+        for j in range(cols):
+            V[i, j] = p
+            p = int(gf.mul(p, i, w))
+    V[rows - 1, cols - 1] = 1
+    return V
+
+
+def jerasure_rs_vandermonde(k: int, m: int, w: int = 8) -> np.ndarray:
+    """jerasure reed_sol_vandermonde_coding_matrix.
+
+    Builds the extended Vandermonde distribution matrix and reduces the top
+    k x k block to identity using row swaps + *column* operations (which
+    preserve the all-row-submatrices-nonsingular property), then returns
+    the bottom m rows.
+    """
+    rows, cols = k + m, k
+    D = _extended_vandermonde(rows, cols, w)
+    for i in range(1, cols):
+        # find a row at or below i with a nonzero entry in column i
+        j = i
+        while j < rows and D[j, i] == 0:
+            j += 1
+        if j >= rows:
+            raise ValueError("vandermonde reduction failed")
+        if j != i:
+            D[[i, j]] = D[[j, i]]
+        # scale column i so D[i, i] == 1
+        if D[i, i] != 1:
+            scale = int(gf.inv(int(D[i, i]), w))
+            D[:, i] = gf.mul(D[:, i], scale, w)
+        # eliminate the rest of row i via column ops
+        for j in range(cols):
+            t = int(D[i, j])
+            if j != i and t != 0:
+                D[:, j] ^= gf.mul(t, D[:, i], w)
+    assert np.array_equal(D[:k], np.eye(k, dtype=np.uint32)), "not systematic"
+    return D[k:].copy()
+
+
+def jerasure_rs_r6(k: int, w: int = 8) -> np.ndarray:
+    """reed_sol_r6_coding_matrix: m=2; row0 all ones, row1 powers of 2."""
+    coding = np.ones((2, k), dtype=np.uint32)
+    p = 1
+    for j in range(k):
+        coding[1, j] = p
+        p = int(gf.mul(p, 2, w))
+    return coding
+
+
+def cauchy_original(k: int, m: int, w: int = 8) -> np.ndarray:
+    """jerasure cauchy_original_coding_matrix: entry = inv(i ^ (m + j))."""
+    if k + m > (1 << w):
+        raise ValueError("cauchy needs k + m <= 2^w")
+    coding = np.zeros((m, k), dtype=np.uint32)
+    for i in range(m):
+        for j in range(k):
+            coding[i, j] = int(gf.inv(i ^ (m + j), w))
+    return coding
+
+
+def _bitmatrix_ones(c: int, w: int) -> int:
+    return int(gf.const_to_bitmatrix(c, w).sum())
+
+
+def cauchy_good(k: int, m: int, w: int = 8) -> np.ndarray:
+    """jerasure's cauchy_good technique: cauchy_original improved.
+
+    Mirrors cauchy_improve_coding_matrix: divide every column by its row-0
+    entry (making row 0 all ones), then for each subsequent row try
+    dividing the whole row by each of its elements and keep the scaling
+    that minimizes the total bit-matrix density.
+    """
+    M = cauchy_original(k, m, w)
+    # make row 0 all ones by scaling columns
+    for j in range(k):
+        if M[0, j] != 1:
+            M[:, j] = gf.div(M[:, j], int(M[0, j]), w)
+    for i in range(1, m):
+        best_ones = sum(_bitmatrix_ones(int(c), w) for c in M[i])
+        best_div = 1
+        for j in range(k):
+            d = int(M[i, j])
+            if d in (0, 1):
+                continue
+            cand = gf.div(M[i], d, w)
+            ones = sum(_bitmatrix_ones(int(c), w) for c in cand)
+            if ones < best_ones:
+                best_ones, best_div = ones, d
+        if best_div != 1:
+            M[i] = gf.div(M[i], best_div, w)
+    return M
+
+
+def decode_matrix(generator_full: np.ndarray, survivors: list[int], w: int = 8) -> np.ndarray:
+    """Rows of the full (k+m x k) generator for `survivors`, inverted.
+
+    Returns the k x k matrix R with data = R @ surviving_chunks — the core
+    of every RS decode (reference: ErasureCodeIsa.cc:226-302 builds the
+    same per-erasure-signature matrix and caches it).
+    """
+    sub = generator_full[np.asarray(survivors, dtype=np.int64)]
+    return gf.mat_inv(sub, w)
+
+
+def full_generator(coding: np.ndarray, w: int = 8) -> np.ndarray:
+    """Stack identity over the (m x k) coding block -> (k+m x k)."""
+    k = coding.shape[1]
+    return np.concatenate([np.eye(k, dtype=np.uint32), coding.astype(np.uint32)])

@@ -1,0 +1,25 @@
+"""Root pytest bootstrap: force a clean CPU-only JAX environment.
+
+The host environment registers a TPU PJRT plugin from sitecustomize (via
+PYTHONPATH) at *interpreter startup*, which claims the single TPU tunnel
+for every python process and serializes/blocks concurrent runs.  Tests
+never need the real chip — they run on a virtual 8-device CPU mesh — so
+before pytest proper starts we re-exec once with the TPU plumbing
+scrubbed from the environment.
+"""
+
+import os
+import sys
+
+if os.environ.get("CEPH_TPU_TEST_REEXEC") != "1" and os.environ.get(
+    "PALLAS_AXON_POOL_IPS"
+):
+    env = dict(os.environ)
+    env["CEPH_TPU_TEST_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""  # drops the TPU sitecustomize
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)

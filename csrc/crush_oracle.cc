@@ -1,0 +1,553 @@
+// CRUSH placement oracle — independent scalar implementation.
+//
+// Re-derives the semantics of the reference's kernel-frozen C walk
+// (reference: src/crush/mapper.c:900 crush_do_rule, :460 choose_firstn,
+// :655 choose_indep, :361 straw2, :73 perm/uniform) over a *flattened*
+// map layout (dense padded arrays) — the same layout the vmapped JAX
+// mapper consumes, so the two implementations can be diffed input-for-
+// input.  Clarity over speed: this is the conformance oracle and the CPU
+// baseline for the placement bench.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+// fwd
+
+constexpr int kAlgUniform = 1;
+constexpr int kAlgList = 2;
+constexpr int kAlgTree = 3;
+constexpr int kAlgStraw = 4;
+constexpr int kAlgStraw2 = 5;
+
+constexpr int32_t kItemUndef = 0x7ffffffe;  // CRUSH_ITEM_UNDEF
+constexpr int32_t kItemNone = 0x7fffffff;   // CRUSH_ITEM_NONE
+
+constexpr uint32_t kHashSeed = 1315423911u;
+
+inline void hashmix(uint32_t& a, uint32_t& b, uint32_t& c) {
+  a = a - b; a = a - c; a = a ^ (c >> 13);
+  b = b - c; b = b - a; b = b ^ (a << 8);
+  c = c - a; c = c - b; c = c ^ (b >> 13);
+  a = a - b; a = a - c; a = a ^ (c >> 12);
+  b = b - c; b = b - a; b = b ^ (a << 16);
+  c = c - a; c = c - b; c = c ^ (b >> 5);
+  a = a - b; a = a - c; a = a ^ (c >> 3);
+  b = b - c; b = b - a; b = b ^ (a << 10);
+  c = c - a; c = c - b; c = c ^ (b >> 15);
+}
+
+uint32_t hash3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t h = kHashSeed ^ a ^ b ^ c;
+  uint32_t x = 231232, y = 1232;
+  hashmix(a, b, h);
+  hashmix(c, x, h);
+  hashmix(y, a, h);
+  hashmix(b, x, h);
+  hashmix(y, c, h);
+  return h;
+}
+
+uint32_t hash2(uint32_t a, uint32_t b) {
+  uint32_t h = kHashSeed ^ a ^ b;
+  uint32_t x = 231232, y = 1232;
+  hashmix(a, b, h);
+  hashmix(x, a, h);
+  hashmix(b, y, h);
+  return h;
+}
+
+// 2^44 * log2(x+1), fixed point, via the shared interpolation tables.
+extern "C" int64_t crush_oracle_ln(uint32_t xin);
+
+#include "crush_ln_tables.inc"
+
+int64_t fixed_ln(uint32_t xin) {
+  uint32_t x = xin + 1;
+  int iexpon = 15;
+  if (!(x & 0x18000)) {
+    int bits = __builtin_clz(x & 0x1FFFF) - 16;
+    x <<= bits;
+    iexpon = 15 - bits;
+  }
+  int index1 = (x >> 8) << 1;
+  uint64_t RH = kRhLhTbl[index1 - 256];
+  uint64_t LH = kRhLhTbl[index1 + 1 - 256];
+  uint64_t xl64 = (uint64_t)x * RH;
+  xl64 >>= 48;
+  uint64_t result = (uint64_t)iexpon << (12 + 32);
+  uint64_t LL = kLlTbl[xl64 & 0xff];
+  LH = (LH + LL) >> (48 - 12 - 32);
+  return (int64_t)(result + LH);
+}
+
+struct FlatMap {
+  int32_t n_buckets = 0;
+  int32_t max_size = 0;
+  int32_t max_devices = 0;
+  const int32_t* items = nullptr;     // [n_buckets * max_size]
+  const uint32_t* weights = nullptr;  // [n_buckets * max_size], 16.16
+  const int32_t* sizes = nullptr;     // [n_buckets]
+  const int32_t* algs = nullptr;      // [n_buckets]
+  const int32_t* types = nullptr;     // [n_buckets]
+  const uint32_t* device_weights = nullptr;  // [weight_max], 16.16
+  int32_t weight_max = 0;
+  // tunables
+  int32_t choose_total_tries = 50;
+  int32_t choose_local_tries = 0;
+  int32_t choose_local_fallback_tries = 0;
+  int32_t chooseleaf_descend_once = 1;
+  int32_t chooseleaf_vary_r = 1;
+  int32_t chooseleaf_stable = 1;
+};
+
+struct PermState {
+  uint32_t perm_x = 0;
+  uint32_t perm_n = 0;
+  std::vector<uint32_t> perm;
+};
+
+struct Work {
+  std::vector<PermState> perm;  // one per bucket
+};
+
+int64_t straw2_draw(const FlatMap& m, int bno, int32_t item_id, int x, int r,
+                    uint32_t weight) {
+  if (weight == 0) return INT64_MIN;
+  (void)m; (void)bno;
+  uint32_t u = hash3((uint32_t)x, (uint32_t)item_id, (uint32_t)r) & 0xffff;
+  int64_t ln = fixed_ln(u) - 0x1000000000000ll;
+  // div64_s64 truncates toward zero; ln <= 0, weight > 0.
+  return -((-ln) / (int64_t)weight);
+}
+
+int bucket_straw2_choose(const FlatMap& m, int bno, int x, int r) {
+  const int32_t* items = m.items + (int64_t)bno * m.max_size;
+  const uint32_t* w = m.weights + (int64_t)bno * m.max_size;
+  int size = m.sizes[bno];
+  int high = 0;
+  int64_t high_draw = 0;
+  for (int i = 0; i < size; ++i) {
+    int64_t draw = straw2_draw(m, bno, items[i], x, r, w[i]);
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return items[high];
+}
+
+int bucket_perm_choose(const FlatMap& m, Work& work, int bno, int x, int r) {
+  const int32_t* items = m.items + (int64_t)bno * m.max_size;
+  uint32_t size = (uint32_t)m.sizes[bno];
+  int32_t bucket_id = -1 - bno;
+  PermState& st = work.perm[bno];
+  uint32_t pr = (uint32_t)r % size;
+  uint32_t s;
+  if (st.perm.empty()) st.perm.resize(size);
+
+  if (st.perm_x != (uint32_t)x || st.perm_n == 0) {
+    st.perm_x = (uint32_t)x;
+    if (pr == 0) {
+      s = hash3((uint32_t)x, (uint32_t)bucket_id, 0) % size;
+      st.perm[0] = s;
+      st.perm_n = 0xffff;
+      return items[s];
+    }
+    for (uint32_t i = 0; i < size; ++i) st.perm[i] = i;
+    st.perm_n = 0;
+  } else if (st.perm_n == 0xffff) {
+    for (uint32_t i = 1; i < size; ++i) st.perm[i] = i;
+    st.perm[st.perm[0]] = 0;
+    st.perm_n = 1;
+  }
+  while (st.perm_n <= pr) {
+    uint32_t p = st.perm_n;
+    if (p < size - 1) {
+      uint32_t i = hash3((uint32_t)x, (uint32_t)bucket_id, p) % (size - p);
+      if (i) {
+        uint32_t t = st.perm[p + i];
+        st.perm[p + i] = st.perm[p];
+        st.perm[p] = t;
+      }
+    }
+    st.perm_n++;
+  }
+  s = st.perm[pr];
+  return items[s];
+}
+
+int bucket_choose(const FlatMap& m, Work& work, int bno, int x, int r) {
+  switch (m.algs[bno]) {
+    case kAlgUniform:
+      return bucket_perm_choose(m, work, bno, x, r);
+    case kAlgStraw2:
+      return bucket_straw2_choose(m, bno, x, r);
+    default:
+      // list/tree/straw not yet flattened; fall back to first item.
+      return m.items[(int64_t)bno * m.max_size];
+  }
+}
+
+bool is_out(const FlatMap& m, int item, int x) {
+  if (item >= m.weight_max) return true;
+  uint32_t w = m.device_weights[item];
+  if (w >= 0x10000) return false;
+  if (w == 0) return true;
+  return (hash2((uint32_t)x, (uint32_t)item) & 0xffff) >= w;
+}
+
+int choose_firstn(const FlatMap& m, Work& work, int bucket_bno, int x,
+                  int numrep, int type, int32_t* out, int outpos, int out_size,
+                  int tries, int recurse_tries, int local_retries,
+                  int local_fallback_retries, bool recurse_to_leaf, int vary_r,
+                  int stable, int32_t* out2, int parent_r) {
+  int rep;
+  int count = out_size;
+  for (rep = stable ? 0 : outpos; rep < numrep && count > 0; ++rep) {
+    unsigned ftotal = 0, flocal = 0;
+    bool retry_descent, skip_rep = false;
+    int item = 0;
+    do {
+      retry_descent = false;
+      int in_bno = bucket_bno;
+      flocal = 0;
+      bool retry_bucket;
+      do {
+        retry_bucket = false;
+        int r = rep + parent_r + (int)ftotal;
+        bool collide = false, reject;
+
+        if (m.sizes[in_bno] == 0) {
+          reject = true;
+          goto rejected;
+        }
+        if (local_fallback_retries > 0 &&
+            (int)flocal >= (m.sizes[in_bno] >> 1) &&
+            (int)flocal > local_fallback_retries)
+          item = bucket_perm_choose(m, work, in_bno, x, r);
+        else
+          item = bucket_choose(m, work, in_bno, x, r);
+        if (item >= m.max_devices) {
+          skip_rep = true;
+          break;
+        }
+        {
+          int itemtype = (item < 0) ? m.types[-1 - item] : 0;
+          if (itemtype != type) {
+            if (item >= 0 || (-1 - item) >= m.n_buckets) {
+              skip_rep = true;
+              break;
+            }
+            in_bno = -1 - item;
+            retry_bucket = true;
+            continue;
+          }
+          for (int i = 0; i < outpos; ++i)
+            if (out[i] == item) {
+              collide = true;
+              break;
+            }
+          reject = false;
+          if (!collide && recurse_to_leaf) {
+            if (item < 0) {
+              int sub_r = vary_r ? (r >> (vary_r - 1)) : 0;
+              if (choose_firstn(m, work, -1 - item, x,
+                                stable ? 1 : outpos + 1, 0, out2, outpos,
+                                count, recurse_tries, 0, local_retries,
+                                local_fallback_retries, false, vary_r, stable,
+                                nullptr, sub_r) <= outpos)
+                reject = true;
+            } else {
+              out2[outpos] = item;
+            }
+          }
+          if (!reject && !collide && itemtype == 0)
+            reject = is_out(m, item, x);
+        }
+      rejected:
+        if (reject || collide) {
+          ftotal++;
+          flocal++;
+          if (collide && (int)flocal <= local_retries)
+            retry_bucket = true;
+          else if (local_fallback_retries > 0 &&
+                   (int)flocal <= m.sizes[in_bno] + local_fallback_retries)
+            retry_bucket = true;
+          else if ((int)ftotal < tries)
+            retry_descent = true;
+          else
+            skip_rep = true;
+        }
+      } while (retry_bucket);
+    } while (retry_descent);
+    if (skip_rep) continue;
+    out[outpos] = item;
+    outpos++;
+    count--;
+  }
+  return outpos;
+}
+
+void choose_indep(const FlatMap& m, Work& work, int bucket_bno, int x,
+                  int left, int numrep, int type, int32_t* out, int outpos,
+                  int tries, int recurse_tries, bool recurse_to_leaf,
+                  int32_t* out2, int parent_r) {
+  int endpos = outpos + left;
+  for (int rep = outpos; rep < endpos; ++rep) {
+    out[rep] = kItemUndef;
+    if (out2) out2[rep] = kItemUndef;
+  }
+  for (unsigned ftotal = 0; left > 0 && (int)ftotal < tries; ++ftotal) {
+    for (int rep = outpos; rep < endpos; ++rep) {
+      if (out[rep] != kItemUndef) continue;
+      int in_bno = bucket_bno;
+      for (;;) {
+        int r = rep + parent_r;
+        if (m.algs[in_bno] == kAlgUniform && m.sizes[in_bno] % numrep == 0)
+          r += (numrep + 1) * ftotal;
+        else
+          r += numrep * ftotal;
+        if (m.sizes[in_bno] == 0) break;
+        int item = bucket_choose(m, work, in_bno, x, r);
+        if (item >= m.max_devices) {
+          out[rep] = kItemNone;
+          if (out2) out2[rep] = kItemNone;
+          left--;
+          break;
+        }
+        int itemtype = (item < 0) ? m.types[-1 - item] : 0;
+        if (itemtype != type) {
+          if (item >= 0 || (-1 - item) >= m.n_buckets) {
+            out[rep] = kItemNone;
+            if (out2) out2[rep] = kItemNone;
+            left--;
+            break;
+          }
+          in_bno = -1 - item;
+          continue;
+        }
+        bool collide = false;
+        for (int i = outpos; i < endpos; ++i)
+          if (out[i] == item) {
+            collide = true;
+            break;
+          }
+        if (collide) break;
+        if (recurse_to_leaf) {
+          if (item < 0) {
+            choose_indep(m, work, -1 - item, x, 1, numrep, 0, out2, rep,
+                         recurse_tries, 0, false, nullptr, r);
+            if (out2[rep] == kItemNone) break;
+          } else {
+            out2[rep] = item;
+          }
+        }
+        if (itemtype == 0 && is_out(m, item, x)) break;
+        out[rep] = item;
+        left--;
+        break;
+      }
+    }
+  }
+  for (int rep = outpos; rep < endpos; ++rep) {
+    if (out[rep] == kItemUndef) out[rep] = kItemNone;
+    if (out2 && out2[rep] == kItemUndef) out2[rep] = kItemNone;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t crush_oracle_ln(uint32_t xin) { return fixed_ln(xin); }
+
+uint32_t crush_oracle_hash3(uint32_t a, uint32_t b, uint32_t c) {
+  return hash3(a, b, c);
+}
+
+uint32_t crush_oracle_hash2(uint32_t a, uint32_t b) { return hash2(a, b); }
+
+int crush_oracle_straw2_choose(int32_t n_buckets, int32_t max_size,
+                               const int32_t* items, const uint32_t* weights,
+                               const int32_t* sizes, int32_t bno, int32_t x,
+                               int32_t r) {
+  FlatMap m;
+  m.n_buckets = n_buckets;
+  m.max_size = max_size;
+  m.items = items;
+  m.weights = weights;
+  m.sizes = sizes;
+  return bucket_straw2_choose(m, bno, x, r);
+}
+
+// Rule steps flattened as (op, arg1, arg2) triples.  Ops use the
+// reference numbering: 1=take, 2=choose_firstn, 3=choose_indep,
+// 4=emit, 6=chooseleaf_firstn, 7=chooseleaf_indep, 8..13 = set_*.
+int crush_oracle_do_rule(
+    int32_t n_buckets, int32_t max_size, int32_t max_devices,
+    const int32_t* items, const uint32_t* weights, const int32_t* sizes,
+    const int32_t* algs, const int32_t* types, const uint32_t* device_weights,
+    int32_t weight_max, const int32_t* steps, int32_t n_steps, int32_t x,
+    int32_t* result, int32_t result_max, int32_t choose_total_tries,
+    int32_t choose_local_tries, int32_t choose_local_fallback_tries,
+    int32_t chooseleaf_descend_once, int32_t chooseleaf_vary_r,
+    int32_t chooseleaf_stable) {
+  FlatMap m;
+  m.n_buckets = n_buckets;
+  m.max_size = max_size;
+  m.max_devices = max_devices;
+  m.items = items;
+  m.weights = weights;
+  m.sizes = sizes;
+  m.algs = algs;
+  m.types = types;
+  m.device_weights = device_weights;
+  m.weight_max = weight_max;
+  m.choose_total_tries = choose_total_tries;
+  m.choose_local_tries = choose_local_tries;
+  m.choose_local_fallback_tries = choose_local_fallback_tries;
+  m.chooseleaf_descend_once = chooseleaf_descend_once;
+  m.chooseleaf_vary_r = chooseleaf_vary_r;
+  m.chooseleaf_stable = chooseleaf_stable;
+
+  Work work;
+  work.perm.resize(n_buckets);
+
+  std::vector<int32_t> a(result_max), b(result_max), c(result_max);
+  int32_t* w = a.data();
+  int32_t* o = b.data();
+  int wsize = 0, osize = 0, result_len = 0;
+
+  int choose_tries = m.choose_total_tries + 1;
+  int choose_leaf_tries = 0;
+  int local_retries = m.choose_local_tries;
+  int local_fallback = m.choose_local_fallback_tries;
+  int vary_r = m.chooseleaf_vary_r;
+  int stable = m.chooseleaf_stable;
+
+  for (int s = 0; s < n_steps; ++s) {
+    int op = steps[s * 3], arg1 = steps[s * 3 + 1], arg2 = steps[s * 3 + 2];
+    bool firstn = false;
+    switch (op) {
+      case 1:  // take
+        if ((arg1 >= 0 && arg1 < max_devices) ||
+            (-1 - arg1 >= 0 && -1 - arg1 < n_buckets)) {
+          w[0] = arg1;
+          wsize = 1;
+        }
+        break;
+      case 8:  // set_choose_tries
+        if (arg1 > 0) choose_tries = arg1;
+        break;
+      case 9:  // set_chooseleaf_tries
+        if (arg1 > 0) choose_leaf_tries = arg1;
+        break;
+      case 10:
+        if (arg1 >= 0) local_retries = arg1;
+        break;
+      case 11:
+        if (arg1 >= 0) local_fallback = arg1;
+        break;
+      case 12:
+        if (arg1 >= 0) vary_r = arg1;
+        break;
+      case 13:
+        if (arg1 >= 0) stable = arg1;
+        break;
+      case 2:   // choose_firstn
+      case 6:   // chooseleaf_firstn
+        firstn = true;
+        [[fallthrough]];
+      case 3:   // choose_indep
+      case 7: {  // chooseleaf_indep
+        if (wsize == 0) break;
+        bool recurse_to_leaf = (op == 6 || op == 7);
+        osize = 0;
+        for (int i = 0; i < wsize; ++i) {
+          int numrep = arg1;
+          if (numrep <= 0) {
+            numrep += result_max;
+            if (numrep <= 0) continue;
+          }
+          int bno = -1 - w[i];
+          if (bno < 0 || bno >= n_buckets) continue;
+          if (firstn) {
+            int recurse_tries =
+                choose_leaf_tries
+                    ? choose_leaf_tries
+                    : (m.chooseleaf_descend_once ? 1 : choose_tries);
+            osize += choose_firstn(m, work, bno, x, numrep, arg2, o + osize, 0,
+                                   result_max - osize, choose_tries,
+                                   recurse_tries, local_retries, local_fallback,
+                                   recurse_to_leaf, vary_r, stable,
+                                   c.data() + osize, 0);
+          } else {
+            int out_size = numrep < (result_max - osize) ? numrep
+                                                         : (result_max - osize);
+            choose_indep(m, work, bno, x, out_size, numrep, arg2, o + osize, 0,
+                         choose_tries, choose_leaf_tries ? choose_leaf_tries : 1,
+                         recurse_to_leaf, c.data() + osize, 0);
+            osize += out_size;
+          }
+        }
+        if (recurse_to_leaf) memcpy(o, c.data(), osize * sizeof(int32_t));
+        int32_t* tmp = o;
+        o = w;
+        w = tmp;
+        wsize = osize;
+        break;
+      }
+      case 4:  // emit
+        for (int i = 0; i < wsize && result_len < result_max; ++i)
+          result[result_len++] = w[i];
+        wsize = 0;
+        break;
+      default:
+        break;
+    }
+  }
+  return result_len;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Bob Jenkins 96-bit-block string hash, the object-name hash behind
+// pg selection (reference: src/common/ceph_hash.cc:22).
+uint32_t ceph_oracle_str_hash(const unsigned char* str, uint32_t length) {
+  uint32_t a = 0x9e3779b9, b = 0x9e3779b9, c = 0;
+  uint32_t len = length;
+  const unsigned char* k = str;
+  while (len >= 12) {
+    a += k[0] + ((uint32_t)k[1] << 8) + ((uint32_t)k[2] << 16) +
+         ((uint32_t)k[3] << 24);
+    b += k[4] + ((uint32_t)k[5] << 8) + ((uint32_t)k[6] << 16) +
+         ((uint32_t)k[7] << 24);
+    c += k[8] + ((uint32_t)k[9] << 8) + ((uint32_t)k[10] << 16) +
+         ((uint32_t)k[11] << 24);
+    hashmix(a, b, c);
+    k += 12;
+    len -= 12;
+  }
+  c += length;
+  switch (len) {
+    case 11: c += (uint32_t)k[10] << 24; [[fallthrough]];
+    case 10: c += (uint32_t)k[9] << 16; [[fallthrough]];
+    case 9: c += (uint32_t)k[8] << 8; [[fallthrough]];
+    case 8: b += (uint32_t)k[7] << 24; [[fallthrough]];
+    case 7: b += (uint32_t)k[6] << 16; [[fallthrough]];
+    case 6: b += (uint32_t)k[5] << 8; [[fallthrough]];
+    case 5: b += k[4]; [[fallthrough]];
+    case 4: a += (uint32_t)k[3] << 24; [[fallthrough]];
+    case 3: a += (uint32_t)k[2] << 16; [[fallthrough]];
+    case 2: a += (uint32_t)k[1] << 8; [[fallthrough]];
+    case 1: a += k[0];
+  }
+  hashmix(a, b, c);
+  return c;
+}
+
+}  // extern "C"

@@ -1,0 +1,131 @@
+// GF(2^8) Reed-Solomon scalar engine — native oracle + CPU bench baseline.
+//
+// Independent reimplementation of the arithmetic the reference gets from
+// gf-complete / ISA-L (reference: src/erasure-code/isa/ErasureCodeIsa.cc:128
+// ec_encode_data; src/erasure-code/jerasure/ErasureCodeJerasure.cc:155
+// jerasure_matrix_encode).  Field: poly 0x11d, the gf-complete/ISA-L default.
+//
+// Exposed as C symbols for ctypes.  Also used by the bench as the
+// "what a straightforward native CPU implementation achieves" baseline.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr unsigned kPoly = 0x11d;
+
+struct Tables {
+  uint8_t log[256];
+  uint8_t antilog[512];
+  uint8_t mul[256][256];
+  Tables() {
+    unsigned x = 1;
+    for (int i = 0; i < 255; ++i) {
+      antilog[i] = antilog[i + 255] = static_cast<uint8_t>(x);
+      log[x] = static_cast<uint8_t>(i);
+      x <<= 1;
+      if (x & 0x100) x ^= kPoly;
+    }
+    log[0] = 0;
+    for (int a = 0; a < 256; ++a)
+      for (int b = 0; b < 256; ++b)
+        mul[a][b] = (a && b) ? antilog[log[a] + log[b]] : 0;
+  }
+};
+
+const Tables& tables() {
+  static Tables t;
+  return t;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint8_t gf256_mul(uint8_t a, uint8_t b) { return tables().mul[a][b]; }
+
+uint8_t gf256_inv(uint8_t a) {
+  if (!a) return 0;
+  const Tables& t = tables();
+  return t.antilog[255 - t.log[a]];
+}
+
+// out[i] ^= c * in[i] over n bytes — the axpy kernel of RS coding.
+void gf256_muladd_region(uint8_t c, const uint8_t* in, uint8_t* out,
+                         int64_t n) {
+  const uint8_t* row = tables().mul[c];
+  for (int64_t i = 0; i < n; ++i) out[i] ^= row[in[i]];
+}
+
+// Systematic encode: data = k rows of `len` bytes (row-major, contiguous),
+// coding = m rows; matrix = m*k coding coefficients.
+void gf256_rs_encode(const uint8_t* matrix, int k, int m, const uint8_t* data,
+                     uint8_t* coding, int64_t len) {
+  memset(coding, 0, static_cast<size_t>(m) * len);
+  for (int i = 0; i < m; ++i)
+    for (int j = 0; j < k; ++j)
+      gf256_muladd_region(matrix[i * k + j], data + j * len, coding + i * len,
+                          len);
+}
+
+// Invert a k x k matrix over GF(2^8); returns 0 on success, -1 if singular.
+int gf256_mat_invert(const uint8_t* in, uint8_t* out, int k) {
+  const Tables& t = tables();
+  uint8_t a[64 * 64], b[64 * 64];
+  if (k > 64) return -1;
+  memcpy(a, in, static_cast<size_t>(k) * k);
+  memset(b, 0, static_cast<size_t>(k) * k);
+  for (int i = 0; i < k; ++i) b[i * k + i] = 1;
+  for (int col = 0; col < k; ++col) {
+    int pivot = -1;
+    for (int r = col; r < k; ++r)
+      if (a[r * k + col]) { pivot = r; break; }
+    if (pivot < 0) return -1;
+    if (pivot != col) {
+      for (int j = 0; j < k; ++j) {
+        uint8_t tmp = a[col * k + j]; a[col * k + j] = a[pivot * k + j]; a[pivot * k + j] = tmp;
+        tmp = b[col * k + j]; b[col * k + j] = b[pivot * k + j]; b[pivot * k + j] = tmp;
+      }
+    }
+    uint8_t invp = gf256_inv(a[col * k + col]);
+    for (int j = 0; j < k; ++j) {
+      a[col * k + j] = t.mul[a[col * k + j]][invp];
+      b[col * k + j] = t.mul[b[col * k + j]][invp];
+    }
+    for (int r = 0; r < k; ++r) {
+      if (r == col) continue;
+      uint8_t f = a[r * k + col];
+      if (!f) continue;
+      for (int j = 0; j < k; ++j) {
+        a[r * k + j] ^= t.mul[f][a[col * k + j]];
+        b[r * k + j] ^= t.mul[f][b[col * k + j]];
+      }
+    }
+  }
+  memcpy(out, b, static_cast<size_t>(k) * k);
+  return 0;
+}
+
+// Reconstruct missing rows: survivors = indices (into the k+m generator
+// rows) of the k chunks provided in `avail` (k rows x len).  full_gen is
+// the (k+m) x k generator (identity stacked over coding block).
+// Writes the reconstructed k data rows into out_data.
+int gf256_rs_decode_data(const uint8_t* full_gen, int k, int m,
+                         const int32_t* survivors, const uint8_t* avail,
+                         uint8_t* out_data, int64_t len) {
+  (void)m;
+  uint8_t sub[64 * 64], invm[64 * 64];
+  if (k > 64) return -1;
+  for (int r = 0; r < k; ++r)
+    memcpy(sub + r * k, full_gen + survivors[r] * k, k);
+  if (gf256_mat_invert(sub, invm, k)) return -1;
+  memset(out_data, 0, static_cast<size_t>(k) * len);
+  for (int i = 0; i < k; ++i)
+    for (int j = 0; j < k; ++j)
+      gf256_muladd_region(invm[i * k + j], avail + j * len, out_data + i * len,
+                          len);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,22 @@
+"""Test harness: 8-device virtual CPU mesh + x64, native lib autobuild.
+
+Tests always run on CPU (fast, deterministic, and multi-device via
+xla_force_host_platform_device_count) regardless of any attached TPU;
+bench.py is the TPU entry point.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from ceph_tpu import _native
+
+_native.lib()  # build csrc/ once up front

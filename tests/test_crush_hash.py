@@ -1,0 +1,79 @@
+"""rjenkins + crush_ln + straw2 draw: numpy and jax vs the native oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu import _native
+from ceph_tpu.crush import hashes, ln
+
+
+def test_hash3_matches_native():
+    rng = np.random.default_rng(0)
+    a, b, c = (rng.integers(0, 2**32, size=512, dtype=np.uint32) for _ in range(3))
+    ours = hashes.hash32_3(a, b, c)
+    theirs = np.array(
+        [_native.hash3(int(x), int(y), int(z)) for x, y, z in zip(a, b, c)],
+        dtype=np.uint32,
+    )
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_hash2_matches_native():
+    rng = np.random.default_rng(1)
+    a, b = (rng.integers(0, 2**32, size=512, dtype=np.uint32) for _ in range(2))
+    np.testing.assert_array_equal(
+        hashes.hash32_2(a, b),
+        np.array([_native.hash2(int(x), int(y)) for x, y in zip(a, b)],
+                 dtype=np.uint32),
+    )
+
+
+def test_jnp_hash_matches_numpy():
+    rng = np.random.default_rng(2)
+    a, b, c = (rng.integers(0, 2**32, size=256, dtype=np.uint32) for _ in range(3))
+    np.testing.assert_array_equal(
+        np.asarray(hashes.hash32_3(a, b, c, xp=jnp)), hashes.hash32_3(a, b, c)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(hashes.hash32_2(a, b, xp=jnp)), hashes.hash32_2(a, b)
+    )
+
+
+def test_crush_ln_exact_all_16bit():
+    u = np.arange(0x10000, dtype=np.uint32)
+    ours = ln.crush_ln(u)
+    theirs = np.array([_native.crush_ln(int(x)) for x in u], dtype=np.int64)
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_crush_ln_jnp_matches():
+    u = np.arange(0, 0x10000, 17, dtype=np.uint32)
+    np.testing.assert_array_equal(np.asarray(ln.crush_ln(u, xp=jnp)), ln.crush_ln(u))
+
+
+def test_straw2_draw_matches_scalar_formula():
+    rng = np.random.default_rng(3)
+    h = rng.integers(0, 0x10000, size=1000).astype(np.uint32)
+    w = rng.integers(1, 2**20, size=1000).astype(np.uint32)
+    draws = ln.straw2_draw(h, w)
+    for i in range(0, 1000, 97):
+        lnv = _native.crush_ln(int(h[i])) - 0x1000000000000
+        expect = -((-lnv) // int(w[i]))
+        assert draws[i] == expect
+    # zero weight => S64_MIN
+    assert ln.straw2_draw(np.uint32(5), np.uint32(0)) == -(2**63)
+
+
+def test_str_hash_rjenkins_matches_native():
+    names = [
+        b"",
+        b"x",
+        b"foo",
+        b"rbd_data.123.00000000000000ff",
+        b"a-much-longer-object-name-exceeding-twelve-bytes",
+        bytes(range(256)),
+    ]
+    for name in names:
+        ours = hashes.str_hash_rjenkins(name)
+        theirs = _native.lib().ceph_oracle_str_hash(name, len(name))
+        assert ours == theirs & 0xFFFFFFFF, name

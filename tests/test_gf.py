@@ -1,0 +1,77 @@
+"""GF(2^w) field math: numpy reference vs native oracle vs algebraic laws."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu import _native
+from ceph_tpu.ec import gf, matrices
+
+
+def test_gf256_tables_match_native():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 256, size=2048).astype(np.uint32)
+    b = rng.integers(0, 256, size=2048).astype(np.uint32)
+    ours = gf.mul(a, b, 8)
+    theirs = np.array([_native.gf256_mul(int(x), int(y)) for x, y in zip(a, b)])
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_gf256_inverse():
+    a = np.arange(1, 256, dtype=np.uint32)
+    assert np.all(gf.mul(a, gf.inv(a, 8), 8) == 1)
+    for x in range(1, 256):
+        assert _native.gf256_inv(x) == int(gf.inv(x, 8))
+
+
+@pytest.mark.parametrize("w", [4, 8, 16])
+def test_field_laws(w):
+    rng = np.random.default_rng(w)
+    n = 1 << w
+    a = rng.integers(0, n, size=256).astype(np.uint32)
+    b = rng.integers(0, n, size=256).astype(np.uint32)
+    c = rng.integers(0, n, size=256).astype(np.uint32)
+    assert np.all(gf.mul(a, b, w) == gf.mul(b, a, w))
+    assert np.all(
+        gf.mul(a, b ^ c, w) == (gf.mul(a, b, w) ^ gf.mul(a, c, w))
+    )
+    assert np.all(gf.mul(gf.mul(a, b, w), c, w) == gf.mul(a, gf.mul(b, c, w), w))
+
+
+def test_mat_inv_roundtrip():
+    rng = np.random.default_rng(1)
+    for k in (2, 4, 8):
+        M = matrices.full_generator(matrices.isa_cauchy(k, 3))[: k + 3]
+        sub = M[rng.permutation(k + 3)[:k]]
+        inv = gf.mat_inv(sub, 8)
+        assert np.array_equal(gf.matmul(inv, sub, 8), np.eye(k, dtype=np.uint32))
+
+
+def test_native_mat_invert_agrees():
+    rng = np.random.default_rng(2)
+    k = 8
+    M = matrices.full_generator(matrices.isa_rs_vandermonde(k, 4))
+    rows = np.sort(rng.permutation(k + 4)[:k])
+    sub = np.ascontiguousarray(M[rows], dtype=np.uint8)
+    out = np.zeros((k, k), dtype=np.uint8)
+    rc = _native.lib().gf256_mat_invert(_native._u8(sub), _native._u8(out), k)
+    assert rc == 0
+    np.testing.assert_array_equal(out, gf.mat_inv(M[rows], 8).astype(np.uint8))
+
+
+def test_bitmatrix_is_multiplication():
+    rng = np.random.default_rng(3)
+    for c in [0, 1, 2, 3, 0x1D, 0xFF, 0x80]:
+        B = gf.const_to_bitmatrix(c, 8)
+        x = rng.integers(0, 256, size=64).astype(np.uint8)
+        xbits = gf.bytes_to_bitplanes(x[None, :])
+        ybits = (B.astype(np.uint32) @ xbits.astype(np.uint32)) % 2
+        y = gf.bitplanes_to_bytes(ybits.astype(np.uint8))[0]
+        np.testing.assert_array_equal(y, gf.mul(c, x, 8).astype(np.uint8))
+
+
+def test_bitplane_roundtrip():
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, size=(3, 5, 32), dtype=np.uint8)
+    np.testing.assert_array_equal(
+        gf.bitplanes_to_bytes(gf.bytes_to_bitplanes(data)), data
+    )
