@@ -6,3 +6,5 @@ sub-chunk clay code — with encode/decode lowered to batched GF(2)
 bit-sliced matmuls (see ceph_tpu.ops.gf2_matmul).
 """
 
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry, instance  # noqa: F401
+

@@ -1,0 +1,24 @@
+"""clay — coupled-layer MSR code (sub-chunk API), work in progress.
+
+The reference checkout predates the clay plugin (it landed in Nautilus),
+but its interface already anticipates array codes via sub-chunks
+(reference: src/erasure-code/ErasureCodeInterface.h:259
+get_sub_chunk_count, :297-340 sub-chunk minimum_to_decode), and
+BASELINE.md metric 3 names clay repair-decode.  This module will carry
+the TPU implementation: q = d - k + 1, t = (k+m)/q, q^t sub-chunks per
+chunk, pairwise coupling transforms around an MDS base code, with the
+repair path reading only a 1/q fraction of surviving chunks.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.ec.interface import ErasureCodeError
+
+
+class ErasureCodeClay:
+    @staticmethod
+    def create(profile: dict):
+        raise ErasureCodeError(
+            "clay plugin is not implemented yet in ceph_tpu; "
+            "use isa/jerasure/lrc/shec (clay is tracked for this build)"
+        )

@@ -147,6 +147,41 @@ def mat_inv(A: np.ndarray, w: int = 8) -> np.ndarray:
     return aug[:, n:].copy()
 
 
+def solve(A: np.ndarray, B: np.ndarray, w: int = 8) -> np.ndarray:
+    """Solve A @ X = B over GF(2^w) for (r x c) A with rank c, r >= c.
+
+    Used by non-MDS codes (shec) whose recovery systems are rectangular:
+    pick c independent rows by elimination, back-substitute.
+    Raises ValueError if A is rank-deficient.
+    """
+    A = np.array(A, dtype=np.uint32)
+    B = np.array(B, dtype=np.uint32)
+    if B.ndim == 1:
+        B = B[:, None]
+    r, c = A.shape
+    aug = np.concatenate([A, B], axis=1)
+    row = 0
+    pivots = []
+    for col in range(c):
+        nz = np.nonzero(aug[row:, col])[0]
+        if len(nz) == 0:
+            raise ValueError("rank-deficient system over GF(2^%d)" % w)
+        p = row + int(nz[0])
+        if p != row:
+            aug[[row, p]] = aug[[p, row]]
+        aug[row] = mul(aug[row], inv(aug[row, col], w), w)
+        others = [i for i in range(r) if i != row and aug[i, col]]
+        for i in others:
+            aug[i] ^= mul(aug[i, col], aug[row], w)
+        pivots.append(col)
+        row += 1
+        if row == r:
+            break
+    if len(pivots) < c:
+        raise ValueError("rank-deficient system over GF(2^%d)" % w)
+    return aug[:c, c:].copy()
+
+
 def mul_bytes(c: int, data: np.ndarray, w: int = 8) -> np.ndarray:
     """Multiply a uint8 byte array by constant c in GF(2^8)."""
     assert w == 8

@@ -1,0 +1,51 @@
+"""isa-equivalent plugin: ISA-L matrix semantics on the TPU engine.
+
+Mirrors the reference's isa plugin surface (reference:
+src/erasure-code/isa/ErasureCodeIsa.h:106-124, ErasureCodeIsa.cc):
+
+- matrixtype vandermonde (gf_gen_rs_matrix) or cauchy
+  (gf_gen_cauchy1_matrix), chosen by the ``technique`` profile key
+- the same k/m sanity ranges the reference enforces for the Vandermonde
+  matrix (k<=32, m<=4, k<=21 when m=4; ErasureCodeIsa.cc:330-360)
+- per-erasure-signature cached decode matrices (the TPU analog of the
+  isa table cache) come from RSMatrixCodec
+- the single-erasure XOR fast path (ErasureCodeIsa.cc:198-209) is the
+  all-ones GF(2) row in the same matmul engine — no special case needed
+  on device.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.ec import matrices
+from ceph_tpu.ec.codec import RSMatrixCodec
+from ceph_tpu.ec.interface import ErasureCodeError, to_int
+
+DEFAULT_K = 7
+DEFAULT_M = 3
+
+
+class ErasureCodeIsa:
+    TECHNIQUES = ("reed_sol_van", "cauchy")
+
+    @staticmethod
+    def create(profile: dict) -> RSMatrixCodec:
+        technique = profile.get("technique", "reed_sol_van")
+        k = to_int(profile, "k", DEFAULT_K)
+        m = to_int(profile, "m", DEFAULT_M)
+        if k < 2:
+            raise ErasureCodeError("k must be >= 2")
+        if technique == "reed_sol_van":
+            if k > 32:
+                raise ErasureCodeError("isa vandermonde: k must be <= 32")
+            if m > 4:
+                raise ErasureCodeError("isa vandermonde: m must be <= 4")
+            if m == 4 and k > 21:
+                raise ErasureCodeError("isa vandermonde: k<=21 when m=4")
+            coding = matrices.isa_rs_vandermonde(k, m)
+        elif technique == "cauchy":
+            coding = matrices.isa_cauchy(k, m)
+        else:
+            raise ErasureCodeError(f"unknown isa technique {technique!r}")
+        codec = RSMatrixCodec(k, m, coding)
+        codec.init(profile)
+        return codec

@@ -1,0 +1,310 @@
+"""Locally-repairable layered code (LRC).
+
+Re-implements the reference lrc plugin's semantics (reference:
+src/erasure-code/lrc/ErasureCodeLrc.{h,cc}):
+
+- ``layers`` profile: JSON array of [chunks_map, layer_profile]; each
+  layer applies an inner codec to the chunk positions its map covers
+  ('D' data, any other non-'_' letter coding, '_' skip)
+- k/m/l shorthand generates the global + local layers and the mapping
+  string exactly like parse_kml (ErasureCodeLrc.cc:295-365)
+- decode walks layers bottom-up, preferring local repair; recovered
+  chunks feed upper layers (decode_chunks, reference logic mirrored)
+- ``_minimum_to_decode`` implements the same three-case search that
+  prefers reading the local group over a global decode.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, Iterable, List, Mapping, Sequence, Set, Tuple
+
+import numpy as np
+
+from ceph_tpu.ec.interface import ErasureCode, ErasureCodeError, to_int
+
+
+class _Layer:
+    def __init__(self, chunks_map: str, codec: ErasureCode):
+        self.chunks_map = chunks_map
+        self.codec = codec
+        self.chunks: List[int] = [
+            i for i, c in enumerate(chunks_map) if c != "_"
+        ]
+        self.data: List[int] = [i for i, c in enumerate(chunks_map) if c == "D"]
+        self.coding: List[int] = [
+            i for i, c in enumerate(chunks_map) if c not in ("_", "D")
+        ]
+        self.chunks_set: Set[int] = set(self.chunks)
+
+
+def _parse_layer_profile(spec) -> dict:
+    if isinstance(spec, dict):
+        return dict(spec)
+    spec = (spec or "").strip()
+    if not spec:
+        return {}
+    out = {}
+    for tok in spec.split():
+        if "=" not in tok:
+            raise ErasureCodeError(f"bad layer profile token {tok!r}")
+        key, val = tok.split("=", 1)
+        out[key] = val
+    return out
+
+
+class ErasureCodeLrc(ErasureCode):
+    DEFAULT_KML = -1
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.layers: List[_Layer] = []
+        self._chunk_count = 0
+        self._data_chunk_count = 0
+        self.rule_steps: List[Tuple[str, str, int]] = [("chooseleaf", "host", 0)]
+
+    @property
+    def k(self) -> int:
+        return self._data_chunk_count
+
+    @property
+    def m(self) -> int:
+        return self._chunk_count - self._data_chunk_count
+
+    @classmethod
+    def create(cls, profile: dict) -> "ErasureCodeLrc":
+        self = cls()
+        self.init(profile)
+        return self
+
+    # -- profile ----------------------------------------------------------
+    def parse(self, profile: dict) -> None:
+        self._parse_kml(profile)
+        mapping = profile.get("mapping")
+        if not mapping:
+            raise ErasureCodeError("lrc profile needs mapping (or k/m/l)")
+        self._chunk_count = len(mapping)
+        self._data_chunk_count = mapping.count("D")
+        super().parse(profile)
+
+        layers_spec = profile.get("layers")
+        if not layers_spec:
+            raise ErasureCodeError("lrc profile needs layers (or k/m/l)")
+        try:
+            desc = json.loads(layers_spec)
+        except json.JSONDecodeError as e:
+            raise ErasureCodeError(f"lrc layers is not valid JSON: {e}")
+        if not isinstance(desc, list) or not desc:
+            raise ErasureCodeError("lrc layers must be a non-empty array")
+
+        from ceph_tpu.ec.registry import instance
+
+        self.layers = []
+        for entry in desc:
+            if not isinstance(entry, list) or not 1 <= len(entry) <= 2:
+                raise ErasureCodeError(f"bad lrc layer entry {entry!r}")
+            chunks_map = entry[0]
+            if len(chunks_map) != self._chunk_count:
+                raise ErasureCodeError(
+                    f"layer map {chunks_map!r} length != mapping length "
+                    f"{self._chunk_count}"
+                )
+            lp = _parse_layer_profile(entry[1] if len(entry) == 2 else "")
+            plugin = lp.pop("plugin", "jerasure")
+            lp.setdefault("technique", "reed_sol_van")
+            k_l = chunks_map.count("D")
+            m_l = sum(1 for c in chunks_map if c not in ("_", "D"))
+            lp["k"] = str(k_l)
+            lp["m"] = str(m_l)
+            codec = instance().factory(plugin, lp)
+            self.layers.append(_Layer(chunks_map, codec))
+        self._sanity_checks(mapping)
+
+    def _parse_kml(self, profile: dict) -> None:
+        k = to_int(profile, "k", self.DEFAULT_KML)
+        m = to_int(profile, "m", self.DEFAULT_KML)
+        l = to_int(profile, "l", self.DEFAULT_KML)
+        if k == -1 and m == -1 and l == -1:
+            for key in ("k", "m", "l"):
+                profile.pop(key, None)
+            return
+        if -1 in (k, m, l):
+            raise ErasureCodeError("all of k, m, l must be set or none")
+        for key in ("mapping", "layers"):
+            if profile.get(key):
+                raise ErasureCodeError(
+                    f"{key} cannot be set when k/m/l are set"
+                )
+        if (k + m) % l:
+            raise ErasureCodeError("k + m must be a multiple of l")
+        groups = (k + m) // l
+        if k % groups or m % groups:
+            raise ErasureCodeError("k and m must be multiples of (k+m)/l")
+
+        mapping = ""
+        for _ in range(groups):
+            mapping += "D" * (k // groups) + "_" * (m // groups) + "_"
+        profile["mapping"] = mapping
+
+        layers = []
+        glob = ""
+        for _ in range(groups):
+            glob += "D" * (k // groups) + "c" * (m // groups) + "_"
+        layers.append([glob, ""])
+        for i in range(groups):
+            local = ""
+            for j in range(groups):
+                local += ("D" * l + "c") if i == j else "_" * (l + 1)
+            layers.append([local, ""])
+        profile["layers"] = json.dumps(layers)
+
+        locality = profile.get("crush-locality", "")
+        failure_domain = profile.get("crush-failure-domain", "host")
+        if locality:
+            self.rule_steps = [
+                ("choose", locality, groups),
+                ("chooseleaf", failure_domain, l + 1),
+            ]
+        elif failure_domain:
+            self.rule_steps = [("chooseleaf", failure_domain, 0)]
+
+    def _sanity_checks(self, mapping: str) -> None:
+        # every chunk position must be covered by at least one layer
+        covered: Set[int] = set()
+        for layer in self.layers:
+            covered |= layer.chunks_set
+        if covered != set(range(self._chunk_count)):
+            raise ErasureCodeError(
+                "lrc layers leave chunks uncovered: "
+                f"{sorted(set(range(self._chunk_count)) - covered)}"
+            )
+
+    # -- shape ------------------------------------------------------------
+    def get_chunk_count(self) -> int:
+        return self._chunk_count
+
+    def get_data_chunk_count(self) -> int:
+        return self._data_chunk_count
+
+    def get_alignment(self) -> int:
+        return math.lcm(*(l.codec.get_alignment() for l in self.layers))
+
+    def get_chunk_size(self, object_size: int) -> int:
+        alignment = self.get_alignment()
+        tail = object_size % alignment
+        padded = object_size + (alignment - tail if tail else 0)
+        kd = self._data_chunk_count
+        if padded % kd:
+            padded += kd * alignment - (padded % (kd * alignment))
+        return padded // kd
+
+    # -- coding -----------------------------------------------------------
+    def encode(self, want_to_encode, data: bytes):
+        planes, blocksize = self.encode_prepare(data)
+        full = np.zeros((self._chunk_count, blocksize), dtype=np.uint8)
+        for i in range(self._data_chunk_count):
+            full[self.chunk_index(i)] = planes[i]
+        self._encode_layers(full)
+        return {i: full[i] for i in want_to_encode}
+
+    def _encode_layers(self, full: np.ndarray) -> None:
+        for layer in self.layers:
+            sub_data = full[layer.data]
+            coding = np.asarray(layer.codec.encode_array(sub_data))
+            for pos, cid in enumerate(layer.coding):
+                full[cid] = coding[pos]
+
+    def decode(
+        self,
+        want_to_read: Iterable[int],
+        chunks: Mapping[int, np.ndarray],
+        chunk_size: int | None = None,
+    ) -> Dict[int, np.ndarray]:
+        want = sorted(set(want_to_read))
+        if set(want) <= set(chunks.keys()):
+            return {i: np.asarray(chunks[i]) for i in want}
+        n = len(next(iter(chunks.values())))
+        decoded: Dict[int, np.ndarray] = {
+            i: np.asarray(c, dtype=np.uint8) for i, c in chunks.items()
+        }
+        erasures = {
+            i for i in range(self._chunk_count) if i not in chunks
+        }
+        want_erasures = set(want) & erasures
+        for layer in reversed(self.layers):
+            layer_erasures = layer.chunks_set & erasures
+            if not layer_erasures:
+                continue
+            if len(layer_erasures) > layer.codec.get_coding_chunk_count():
+                continue  # too many for this layer; hope an upper layer helps
+            # Sub-codec chunk ids are data-first: encode feeds it
+            # full[layer.data] as chunks 0..k_l-1 and writes its coding
+            # output to layer.coding (= ids k_l..), so decode must use the
+            # same data-first numbering, not chunks_map order.
+            sub_ids = layer.data + layer.coding
+            sub_avail = {}
+            for pos, cid in enumerate(sub_ids):
+                if cid not in erasures:
+                    sub_avail[pos] = decoded[cid]
+            sub_want = list(range(len(sub_ids)))
+            sub_out = layer.codec.decode(sub_want, sub_avail)
+            for pos, cid in enumerate(sub_ids):
+                decoded[cid] = np.asarray(sub_out[pos])
+                erasures.discard(cid)
+            want_erasures = set(want) & erasures
+            if not want_erasures:
+                break
+        if want_erasures:
+            raise ErasureCodeError(
+                f"lrc cannot recover chunks {sorted(want_erasures)}"
+            )
+        return {i: decoded[i] for i in want}
+
+    # -- minimum_to_decode (3-case local-repair-first search) --------------
+    def _minimum_to_decode(
+        self, want_to_read: Iterable[int], available: Iterable[int]
+    ) -> List[int]:
+        want = set(want_to_read)
+        avail = set(available)
+        erasures_total = set(range(self._chunk_count)) - avail
+        erasures_not_recovered = set(erasures_total)
+        erasures_want = want & erasures_total
+
+        if not erasures_want:
+            return sorted(want)
+
+        minimum: Set[int] = set()
+        for layer in reversed(self.layers):
+            layer_want = want & layer.chunks_set
+            if not layer_want:
+                continue
+            layer_erasures = layer_want & erasures_want
+            if not layer_erasures:
+                layer_minimum = layer_want
+            else:
+                erasures = layer.chunks_set & erasures_not_recovered
+                if len(erasures) > layer.codec.get_coding_chunk_count():
+                    continue
+                layer_minimum = layer.chunks_set - erasures_not_recovered
+                erasures_not_recovered -= erasures
+                erasures_want -= erasures
+            minimum |= layer_minimum
+        if not erasures_want:
+            minimum |= want
+            minimum -= erasures_total
+            return sorted(minimum)
+
+        # case 3: recover chunks we do not want to help upper layers
+        erasures_total = set(range(self._chunk_count)) - avail
+        for layer in reversed(self.layers):
+            layer_erasures = layer.chunks_set & erasures_total
+            if not layer_erasures:
+                continue
+            if len(layer_erasures) <= layer.codec.get_coding_chunk_count():
+                erasures_total -= layer_erasures
+        if not erasures_total:
+            return sorted(avail)
+        raise ErasureCodeError(
+            f"not enough chunks in {sorted(avail)} to read {sorted(want)}"
+        )

@@ -1,0 +1,248 @@
+"""SHEC — shingled erasure code (recovery-efficiency / durability tradeoff).
+
+Re-implements the reference shec plugin's construction (reference:
+src/erasure-code/shec/ErasureCodeShec.cc):
+
+- generator = jerasure Vandermonde coding matrix with a rotating window
+  of zeros per parity row (shec_reedsolomon_coding_matrix); the (c1, m1)
+  split for multiple-shec is chosen by minimizing the same
+  recovery-efficiency functional (shec_calc_recovery_efficiency1)
+- because the code is non-MDS, decode solves the rectangular system of
+  available parity equations over the erased columns (the role of
+  shec_make_decoding_matrix's search), and ``minimum_to_decode``
+  searches parity subsets for the cheapest recovery set — shec's whole
+  point is that a single lost chunk only needs its shingle window read.
+
+Defaults (k, m, c, w) = (4, 3, 2, 8) match the reference
+(ErasureCodeShec.h:51-57).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Iterable, List, Mapping, Sequence, Set, Tuple
+
+import numpy as np
+
+from ceph_tpu.ec import gf, matrices
+from ceph_tpu.ec.codec import RSMatrixCodec
+from ceph_tpu.ec.interface import ErasureCodeError, to_int
+from ceph_tpu.ops import gf2_matmul
+
+DEFAULT_K, DEFAULT_M, DEFAULT_C, DEFAULT_W = 4, 3, 2, 8
+
+
+def _recovery_efficiency1(k: int, m1: int, m2: int, c1: int, c2: int) -> float:
+    if m1 < c1 or m2 < c2:
+        return -1.0
+    if (m1 == 0 and c1 != 0) or (m2 == 0 and c2 != 0):
+        return -1.0
+    r_eff_k = [10**8] * k
+    r_e1 = 0.0
+    for m_part, c_part, _base in ((m1, c1, 0), (m2, c2, m1)):
+        for rr in range(m_part):
+            start = (rr * k) // m_part % k
+            end = ((rr + c_part) * k) // m_part % k
+            cc = start
+            first = True
+            while first or cc != end:
+                first = False
+                r_eff_k[cc] = min(
+                    r_eff_k[cc],
+                    ((rr + c_part) * k) // m_part - (rr * k) // m_part,
+                )
+                cc = (cc + 1) % k
+            r_e1 += ((rr + c_part) * k) // m_part - (rr * k) // m_part
+    r_e1 += sum(r_eff_k)
+    return r_e1 / (k + m1 + m2)
+
+
+def shec_coding_matrix(k: int, m: int, c: int, w: int = 8) -> np.ndarray:
+    """Vandermonde matrix with shingle windows zeroed out."""
+    if c > m:
+        raise ErasureCodeError("shec needs c <= m")
+    single = (m == 1) or (c == 1) or (k <= 1)
+    if not single:
+        best = None
+        for c1 in range(0, c // 2 + 1):
+            for m1 in range(0, m + 1):
+                c2, m2 = c - c1, m - m1
+                if m1 < c1 or m2 < c2:
+                    continue
+                if (m1 == 0) != (c1 == 0) or (m2 == 0) != (c2 == 0):
+                    continue
+                r = _recovery_efficiency1(k, m1, m2, c1, c2)
+                if r >= 0 and (best is None or r < best[0] - 1e-12):
+                    best = (r, c1, m1)
+        if best is None:
+            raise ErasureCodeError(f"no valid shec split for k={k} m={m} c={c}")
+        _, c1, m1 = best
+        c2, m2 = c - c1, m - m1
+    else:
+        c1 = m1 = 0
+        c2, m2 = c, m
+
+    M = matrices.jerasure_rs_vandermonde(k, m, w).copy()
+    for m_part, c_part, base in ((m1, c1, 0), (m2, c2, m1)):
+        for rr in range(m_part):
+            end = (rr * k) // m_part % k
+            start = ((rr + c_part) * k) // m_part % k
+            cc = start
+            while cc != end:
+                M[base + rr, cc] = 0
+                cc = (cc + 1) % k
+    return M
+
+
+class ErasureCodeShec(RSMatrixCodec):
+    @classmethod
+    def create(cls, profile: dict) -> "ErasureCodeShec":
+        k = to_int(profile, "k", DEFAULT_K)
+        m = to_int(profile, "m", DEFAULT_M)
+        c = to_int(profile, "c", DEFAULT_C)
+        w = to_int(profile, "w", DEFAULT_W)
+        if w != 8:
+            raise ErasureCodeError("tpu shec currently supports w=8")
+        if not (0 < c <= m):
+            raise ErasureCodeError("shec needs 0 < c <= m")
+        self = cls(k, m, shec_coding_matrix(k, m, c, w))
+        self.c = c
+        self._plan_cache = {}
+        self._solve_cache = {}
+        self.init(profile)
+        return self
+
+    # -- non-MDS decode: solve parity equations over erased columns -------
+    def _recovery_plan(
+        self, erased_data: Tuple[int, ...], avail: Tuple[int, ...]
+    ) -> Tuple[List[int], np.ndarray, List[int]]:
+        """Pick a minimal set of parity rows that can solve the erased
+        data columns; returns (parity_ids, None, data_ids_used).
+
+        Cached per (erased, avail) signature — steady-state recovery
+        replays the same signature for every stripe (the shec analog of
+        the isa decode-table cache).
+        """
+        cache_key = (erased_data, avail)
+        cached = self._plan_cache.get(cache_key)
+        if cached is not None:
+            return cached
+        avail_set = set(avail)
+        parities = [i for i in avail if i >= self.k]
+        best = None
+        for r in range(len(erased_data), len(parities) + 1):
+            for combo in itertools.combinations(parities, r):
+                rows = [self.coding[p - self.k] for p in combo]
+                A = np.stack(rows)[:, list(erased_data)]
+                try:
+                    gf.solve(A, np.zeros((len(combo), 1)), 8)
+                except ValueError:
+                    continue
+                # data chunks these parity equations touch
+                used = set()
+                for p in combo:
+                    row = self.coding[p - self.k]
+                    for j in range(self.k):
+                        if row[j] and j not in erased_data:
+                            used.add(j)
+                if not used <= avail_set:
+                    continue
+                cost = len(combo) + len(used)
+                if best is None or cost < best[0]:
+                    best = (cost, list(combo), sorted(used))
+            if best is not None:
+                break
+        if best is None:
+            raise ErasureCodeError("shec: erasures not recoverable")
+        _, parity_ids, data_used = best
+        plan = (parity_ids, None, data_used)
+        self._plan_cache[cache_key] = plan
+        return plan
+
+    def _minimum_to_decode(
+        self, want_to_read: Iterable[int], available: Iterable[int]
+    ) -> List[int]:
+        want = set(want_to_read)
+        avail = set(available)
+        if want <= avail:
+            return sorted(want)
+        erased_want_data = tuple(sorted(i for i in want - avail if i < self.k))
+        erased_want_coding = [i for i in want - avail if i >= self.k]
+        minimum = set(want & avail)
+        if erased_want_data or erased_want_coding:
+            # recover all erased data columns needed (coding chunks are
+            # re-encoded from full data, so they need all data columns)
+            need = set(erased_want_data)
+            if erased_want_coding:
+                need |= set(range(self.k)) - avail
+            if need:
+                parity_ids, _, data_used = self._recovery_plan(
+                    tuple(sorted(need)), tuple(sorted(avail))
+                )
+                minimum |= set(parity_ids) | set(data_used)
+                if erased_want_coding:
+                    minimum |= set(i for i in range(self.k) if i in avail)
+        return sorted(minimum)
+
+    def decode_array(
+        self, available: Mapping[int, np.ndarray], want: Sequence[int], n: int
+    ) -> Dict[int, np.ndarray]:
+        avail_ids = sorted(available.keys())
+        avail_set = set(avail_ids)
+        want_missing = [i for i in want if i not in avail_set]
+        out = {i: np.asarray(available[i]) for i in want if i in avail_set}
+        if not want_missing:
+            return out
+        erased_data = sorted(
+            i for i in range(self.k) if i not in avail_set
+        )
+        need_coding = [i for i in want_missing if i >= self.k]
+        need_data = sorted(
+            set(i for i in want_missing if i < self.k)
+            | (set(erased_data) if need_coding else set())
+        )
+        data_full = np.zeros((self.k, n), dtype=np.uint8)
+        for i in range(self.k):
+            if i in avail_set:
+                data_full[i] = np.asarray(available[i], dtype=np.uint8)
+        if need_data:
+            parity_ids, _, _ = self._recovery_plan(
+                tuple(erased_data), tuple(avail_ids)
+            )
+            skey = (tuple(erased_data), tuple(parity_ids))
+            cached = self._solve_cache.get(skey)
+            if cached is None:
+                A = np.stack(
+                    [self.coding[p - self.k] for p in parity_ids]
+                )[:, erased_data]
+                s_bits = gf2_matmul.prepare_bitmatrix(
+                    gf.solve(A, np.eye(len(parity_ids), dtype=np.uint32), 8)
+                )
+                rows = np.stack(
+                    [self.coding[p - self.k] for p in parity_ids]
+                ).copy()
+                rows[:, erased_data] = 0
+                contrib_bits = gf2_matmul.prepare_bitmatrix(rows)
+                cached = (s_bits, contrib_bits)
+                self._solve_cache[skey] = cached
+            s_bits, contrib_bits = cached
+            # residual = parity chunks XOR contribution of known data
+            contrib = np.asarray(
+                gf2_matmul.gf2_matmul_bytes(contrib_bits, data_full)
+            )
+            R = contrib ^ np.stack(
+                [np.asarray(available[p], dtype=np.uint8) for p in parity_ids]
+            )
+            X = np.asarray(gf2_matmul.gf2_matmul_bytes(s_bits, R))
+            for pos, col in enumerate(erased_data):
+                data_full[col] = X[pos]
+        for i in want_missing:
+            if i < self.k:
+                out[i] = data_full[i]
+        if need_coding:
+            coding = np.asarray(self.encode_array(data_full))
+            for i in need_coding:
+                out[i] = coding[i - self.k]
+        return out
+
+

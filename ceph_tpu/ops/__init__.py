@@ -1,0 +1,8 @@
+"""Device kernels (Pallas + jnp) for the hot math.
+
+- gf2_matmul: GF(2) bit-sliced matrix multiply over byte streams — the
+  single engine behind every erasure-code technique (RS over GF(2^w),
+  Cauchy bit-matrices, XOR parity).
+- crush kernels live in ceph_tpu.crush (they are placement math, not
+  byte-stream codecs).
+"""
