@@ -8,10 +8,19 @@ jax.numpy device kernel (uint32 wraparound semantics match in both).
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 CRUSH_HASH_SEED = 1315423911  # reference: src/crush/hash.c:24
 CRUSH_HASH_RJENKINS1 = 0
+
+
+def _quiet(xp):
+    """uint32 wraparound is intended; silence numpy scalar warnings."""
+    if xp is np:
+        return np.errstate(over="ignore")
+    return contextlib.nullcontext()
 
 
 def _mix(a, b, c, xp):
@@ -49,75 +58,80 @@ def _mix(a, b, c, xp):
 
 
 def hash32(a, xp=np):
-    a = xp.asarray(a).astype(xp.uint32)
-    h = xp.uint32(CRUSH_HASH_SEED) ^ a
-    b = a
-    x = xp.uint32(231232)
-    y = xp.uint32(1232)
-    b, x, h = _mix(b, x, h, xp)
-    y, a, h = _mix(y, a, h, xp)
-    return h
+    with _quiet(xp):
+        a = xp.asarray(a).astype(xp.uint32)
+        h = xp.uint32(CRUSH_HASH_SEED) ^ a
+        b = a
+        x = xp.uint32(231232)
+        y = xp.uint32(1232)
+        b, x, h = _mix(b, x, h, xp)
+        y, a, h = _mix(y, a, h, xp)
+        return h
 
 
 def hash32_2(a, b, xp=np):
-    a = xp.asarray(a).astype(xp.uint32)
-    b = xp.asarray(b).astype(xp.uint32)
-    h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b
-    x = xp.uint32(231232)
-    y = xp.uint32(1232)
-    a, b, h = _mix(a, b, h, xp)
-    x, a, h = _mix(x, a, h, xp)
-    b, y, h = _mix(b, y, h, xp)
-    return h
+    with _quiet(xp):
+        a = xp.asarray(a).astype(xp.uint32)
+        b = xp.asarray(b).astype(xp.uint32)
+        h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b
+        x = xp.uint32(231232)
+        y = xp.uint32(1232)
+        a, b, h = _mix(a, b, h, xp)
+        x, a, h = _mix(x, a, h, xp)
+        b, y, h = _mix(b, y, h, xp)
+        return h
 
 
 def hash32_3(a, b, c, xp=np):
-    a = xp.asarray(a).astype(xp.uint32)
-    b = xp.asarray(b).astype(xp.uint32)
-    c = xp.asarray(c).astype(xp.uint32)
-    h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c
-    x = xp.uint32(231232)
-    y = xp.uint32(1232)
-    a, b, h = _mix(a, b, h, xp)
-    c, x, h = _mix(c, x, h, xp)
-    y, a, h = _mix(y, a, h, xp)
-    b, x, h = _mix(b, x, h, xp)
-    y, c, h = _mix(y, c, h, xp)
-    return h
+    with _quiet(xp):
+        a = xp.asarray(a).astype(xp.uint32)
+        b = xp.asarray(b).astype(xp.uint32)
+        c = xp.asarray(c).astype(xp.uint32)
+        h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+        x = xp.uint32(231232)
+        y = xp.uint32(1232)
+        a, b, h = _mix(a, b, h, xp)
+        c, x, h = _mix(c, x, h, xp)
+        y, a, h = _mix(y, a, h, xp)
+        b, x, h = _mix(b, x, h, xp)
+        y, c, h = _mix(y, c, h, xp)
+        return h
 
 
 def hash32_4(a, b, c, d, xp=np):
-    a = xp.asarray(a).astype(xp.uint32)
-    b = xp.asarray(b).astype(xp.uint32)
-    c = xp.asarray(c).astype(xp.uint32)
-    d = xp.asarray(d).astype(xp.uint32)
-    h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d
-    x = xp.uint32(231232)
-    y = xp.uint32(1232)
-    a, b, h = _mix(a, b, h, xp)
-    c, d, h = _mix(c, d, h, xp)
-    a, x, h = _mix(a, x, h, xp)
-    y, b, h = _mix(y, b, h, xp)
-    c, x, h = _mix(c, x, h, xp)
-    y, d, h = _mix(y, d, h, xp)
-    return h
+    with _quiet(xp):
+        a = xp.asarray(a).astype(xp.uint32)
+        b = xp.asarray(b).astype(xp.uint32)
+        c = xp.asarray(c).astype(xp.uint32)
+        d = xp.asarray(d).astype(xp.uint32)
+        h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d
+        x = xp.uint32(231232)
+        y = xp.uint32(1232)
+        a, b, h = _mix(a, b, h, xp)
+        c, d, h = _mix(c, d, h, xp)
+        a, x, h = _mix(a, x, h, xp)
+        y, b, h = _mix(y, b, h, xp)
+        c, x, h = _mix(c, x, h, xp)
+        y, d, h = _mix(y, d, h, xp)
+        return h
 
 
 def hash32_5(a, b, c, d, e, xp=np):
-    arrs = [xp.asarray(v).astype(xp.uint32) for v in (a, b, c, d, e)]
-    a, b, c, d, e = arrs
-    h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d ^ e
-    x = xp.uint32(231232)
-    y = xp.uint32(1232)
-    a, b, h = _mix(a, b, h, xp)
-    c, d, h = _mix(c, d, h, xp)
-    e, x, h = _mix(e, x, h, xp)
-    y, a, h = _mix(y, a, h, xp)
-    b, x, h = _mix(b, x, h, xp)
-    y, c, h = _mix(y, c, h, xp)
-    d, x, h = _mix(d, x, h, xp)
-    y, e, h = _mix(y, e, h, xp)
-    return h
+    with _quiet(xp):
+        arrs = [xp.asarray(v).astype(xp.uint32) for v in (a, b, c, d, e)]
+        a, b, c, d, e = arrs
+        h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d ^ e
+        x = xp.uint32(231232)
+        y = xp.uint32(1232)
+        a, b, h = _mix(a, b, h, xp)
+        c, d, h = _mix(c, d, h, xp)
+        e, x, h = _mix(e, x, h, xp)
+        y, a, h = _mix(y, a, h, xp)
+        b, x, h = _mix(b, x, h, xp)
+        y, c, h = _mix(y, c, h, xp)
+        d, x, h = _mix(d, x, h, xp)
+        y, e, h = _mix(y, e, h, xp)
+        return h
 
 
 def str_hash_rjenkins(name: bytes) -> int:
@@ -135,7 +149,7 @@ def str_hash_rjenkins(name: bytes) -> int:
     c = np.uint32(0)
     pos = 0
     ln = length
-    with np.errstate(over="ignore"):
+    with _quiet(np):
         while ln >= 12:
             k = name[pos : pos + 12]
             a = a + np.uint32(k[0] + (k[1] << 8) + (k[2] << 16) + (k[3] << 24))

@@ -1,0 +1,235 @@
+"""CrushMap — host-side map construction and the flattened device layout.
+
+Plays the role of CrushWrapper/builder (reference:
+src/crush/CrushWrapper.h:796-1517 mutation/query API, src/crush/builder.c
+bucket construction) with a fresh design: buckets are python objects,
+and ``flatten()`` lowers the map to dense padded arrays — the layout
+consumed both by the native oracle (csrc/crush_oracle.cc) and the
+vmapped JAX interpreter (ceph_tpu.crush.mapper).
+
+Bucket ids follow the reference convention: devices are >= 0, buckets
+are negative, bucket id b lives at flat index -1-b.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# bucket algorithms (reference: src/crush/crush.h crush_algorithm)
+ALG_UNIFORM = 1
+ALG_LIST = 2
+ALG_TREE = 3
+ALG_STRAW = 4
+ALG_STRAW2 = 5
+
+# rule step ops (reference: src/crush/crush.h crush_opcodes)
+OP_NOOP = 0
+OP_TAKE = 1
+OP_CHOOSE_FIRSTN = 2
+OP_CHOOSE_INDEP = 3
+OP_EMIT = 4
+OP_CHOOSELEAF_FIRSTN = 6
+OP_CHOOSELEAF_INDEP = 7
+OP_SET_CHOOSE_TRIES = 8
+OP_SET_CHOOSELEAF_TRIES = 9
+OP_SET_CHOOSE_LOCAL_TRIES = 10
+OP_SET_CHOOSE_LOCAL_FALLBACK_TRIES = 11
+OP_SET_CHOOSELEAF_VARY_R = 12
+OP_SET_CHOOSELEAF_STABLE = 13
+
+ITEM_UNDEF = 0x7FFFFFFE
+ITEM_NONE = 0x7FFFFFFF
+
+
+@dataclasses.dataclass
+class Tunables:
+    """Modern ("jewel"/optimal) defaults, matching the reference's
+    current profile (reference: src/crush/CrushWrapper.h set_tunables_*)."""
+
+    choose_total_tries: int = 50
+    choose_local_tries: int = 0
+    choose_local_fallback_tries: int = 0
+    chooseleaf_descend_once: int = 1
+    chooseleaf_vary_r: int = 1
+    chooseleaf_stable: int = 1
+
+
+@dataclasses.dataclass
+class Bucket:
+    id: int  # negative
+    alg: int
+    type: int
+    items: List[int] = dataclasses.field(default_factory=list)
+    weights: List[int] = dataclasses.field(default_factory=list)  # 16.16
+
+    @property
+    def weight(self) -> int:
+        return sum(self.weights)
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    steps: List[Tuple[int, int, int]]  # (op, arg1, arg2)
+    ruleset: int = 0
+    type: int = 1  # replicated=1, erasure=3 (pg_pool_t convention)
+    min_size: int = 1
+    max_size: int = 32
+
+
+@dataclasses.dataclass
+class FlatMap:
+    """Dense padded arrays; the device/oracle-facing map image."""
+
+    items: np.ndarray  # int32 [B, S]
+    weights: np.ndarray  # uint32 [B, S]
+    sizes: np.ndarray  # int32 [B]
+    algs: np.ndarray  # int32 [B]
+    types: np.ndarray  # int32 [B]
+    max_devices: int
+    tunables: Tunables
+
+
+class CrushMap:
+    def __init__(self, tunables: Optional[Tunables] = None):
+        self.buckets: Dict[int, Bucket] = {}
+        self.rules: List[Rule] = []
+        self.tunables = tunables or Tunables()
+        self.type_names: Dict[int, str] = {0: "osd"}
+        self._next_id = -1
+
+    # -- construction -----------------------------------------------------
+    def add_bucket(
+        self,
+        alg: int,
+        type: int,
+        items: Sequence[int] = (),
+        weights: Sequence[int] = (),
+        id: Optional[int] = None,
+    ) -> int:
+        if id is None:
+            id = self._next_id
+        if id >= 0 or id in self.buckets:
+            raise ValueError(f"bad bucket id {id}")
+        self._next_id = min(self._next_id, id) - 1
+        self.buckets[id] = Bucket(id, alg, type, list(items), list(weights))
+        return id
+
+    def add_item(self, bucket_id: int, item: int, weight: int) -> None:
+        b = self.buckets[bucket_id]
+        b.items.append(item)
+        b.weights.append(weight)
+
+    def reweight_item(self, bucket_id: int, item: int, weight: int) -> None:
+        b = self.buckets[bucket_id]
+        i = b.items.index(item)
+        b.weights[i] = weight
+
+    def remove_item(self, bucket_id: int, item: int) -> None:
+        b = self.buckets[bucket_id]
+        i = b.items.index(item)
+        del b.items[i]
+        del b.weights[i]
+
+    def add_rule(self, rule: Rule) -> int:
+        self.rules.append(rule)
+        return len(self.rules) - 1
+
+    def add_simple_rule(
+        self,
+        name: str,
+        root_id: int,
+        failure_domain_type: int,
+        mode: str = "firstn",
+        num: int = 0,
+    ) -> int:
+        """Equivalent of CrushWrapper::add_simple_rule
+        (reference: src/crush/CrushWrapper.h:1155): take root, then
+        choose/chooseleaf over the failure domain, then emit."""
+        steps: List[Tuple[int, int, int]] = [(OP_TAKE, root_id, 0)]
+        op = (
+            OP_CHOOSELEAF_FIRSTN if mode == "firstn" else OP_CHOOSELEAF_INDEP
+        )
+        if failure_domain_type == 0:
+            op = OP_CHOOSE_FIRSTN if mode == "firstn" else OP_CHOOSE_INDEP
+        steps.append((op, num, failure_domain_type))
+        steps.append((OP_EMIT, 0, 0))
+        return self.add_rule(
+            Rule(name, steps, type=1 if mode == "firstn" else 3)
+        )
+
+    @property
+    def max_devices(self) -> int:
+        mx = 0
+        for b in self.buckets.values():
+            for it in b.items:
+                if it >= 0:
+                    mx = max(mx, it + 1)
+        return mx
+
+    # -- device image ------------------------------------------------------
+    def flatten(self) -> FlatMap:
+        if not self.buckets:
+            raise ValueError("empty crush map")
+        n_buckets = max(-b for b in self.buckets) if self.buckets else 0
+        max_size = max((len(b.items) for b in self.buckets.values()), default=1)
+        max_size = max(max_size, 1)
+        items = np.zeros((n_buckets, max_size), dtype=np.int32)
+        weights = np.zeros((n_buckets, max_size), dtype=np.uint32)
+        sizes = np.zeros(n_buckets, dtype=np.int32)
+        algs = np.zeros(n_buckets, dtype=np.int32)
+        types = np.zeros(n_buckets, dtype=np.int32)
+        for bid, b in self.buckets.items():
+            bno = -1 - bid
+            n = len(b.items)
+            items[bno, :n] = b.items
+            weights[bno, :n] = b.weights
+            sizes[bno] = n
+            algs[bno] = b.alg
+            types[bno] = b.type
+        return FlatMap(
+            items=items,
+            weights=weights,
+            sizes=sizes,
+            algs=algs,
+            types=types,
+            max_devices=self.max_devices,
+            tunables=self.tunables,
+        )
+
+
+def build_flat_cluster(
+    n_osds: int,
+    osd_weight: int = 0x10000,
+    *,
+    hosts: int = 0,
+    host_type: int = 1,
+) -> Tuple[CrushMap, int]:
+    """Convenience builder: root straw2 bucket over osds (or over
+    ``hosts`` straw2 host buckets of n_osds/hosts osds each).  Returns
+    (map, root_id).  The shape crushtool --build produces for benches
+    (reference: src/tools/crushtool.cc:112-218)."""
+    m = CrushMap()
+    if hosts:
+        per = n_osds // hosts
+        host_ids = []
+        for h in range(hosts):
+            osds = list(range(h * per, (h + 1) * per))
+            hid = m.add_bucket(
+                ALG_STRAW2, host_type, osds, [osd_weight] * per
+            )
+            host_ids.append(hid)
+        root = m.add_bucket(
+            ALG_STRAW2,
+            10,
+            host_ids,
+            [osd_weight * per] * hosts,
+        )
+    else:
+        root = m.add_bucket(
+            ALG_STRAW2, 10, list(range(n_osds)), [osd_weight] * n_osds
+        )
+    return m, root

@@ -1,0 +1,551 @@
+"""Vmapped CRUSH rule interpreter — full-cluster placement in one jit.
+
+The reference walks buckets scalar-style per object
+(crush_do_rule / crush_choose_firstn / crush_choose_indep, reference:
+src/crush/mapper.c:900,460,655).  Here a rule is *compiled*: its steps
+are unrolled at trace time into a jit-friendly function of the hash
+input x, every straw2 choice is a vectorized draw+argmax over the padded
+bucket arrays, the retry/collision state machines become bounded
+``lax.while_loop``s, and ``jax.vmap`` maps the whole walk over millions
+of object ids at once — the north-star replacement for the thread-pooled
+ParallelPGMapper (reference: src/osd/OSDMapMapping.h:17).
+
+Semantics notes (kept bit-exact vs the native oracle):
+- straw2 draw: crush_hash32_3(x, id, r) & 0xffff -> fixed-point ln table
+  -> truncating s64 divide by the 16.16 weight; ties keep the first item
+  (argmax == the C "strictly greater" update rule).
+- firstn: per-rep retry with r' = rep + ftotal, collision against chosen
+  prefix, reweight rejection via is_out, chooseleaf recursion with
+  vary_r / stable.
+- indep: breadth-first rounds r' = rep + n*ftotal, positionally stable,
+  CRUSH_ITEM_NONE holes.
+- Supported bucket algs in the jit path: straw2 (the modern default).
+  uniform/list/tree/straw maps fall back to the native oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+
+# straw2 draws are exact signed-64-bit fixed-point math (crush_ln values
+# scaled 2^48 divided by 16.16 weights); the interpreter is unusable
+# without x64, so require it at import rather than failing mid-trace.
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.crush import hashes, ln
+from ceph_tpu.crush.map import (
+    ALG_STRAW2,
+    ALG_UNIFORM,
+    ITEM_NONE,
+    ITEM_UNDEF,
+    OP_CHOOSE_FIRSTN,
+    OP_CHOOSE_INDEP,
+    OP_CHOOSELEAF_FIRSTN,
+    OP_CHOOSELEAF_INDEP,
+    OP_EMIT,
+    OP_SET_CHOOSE_TRIES,
+    OP_SET_CHOOSELEAF_TRIES,
+    OP_TAKE,
+    FlatMap,
+)
+
+S64_MIN = jnp.int64(-0x8000000000000000)
+
+# descend status codes
+_OK = 0
+_REJECT = 1  # empty bucket mid-descent: retry with higher ftotal
+_SKIP = 2  # bad item / bad type: give up on this replica slot
+
+
+class _DeviceMap:
+    """FlatMap lowered to device arrays (captured by the compiled rule)."""
+
+    def __init__(self, flat: FlatMap):
+        self.items = jnp.asarray(flat.items, dtype=jnp.int32)
+        self.weights = jnp.asarray(flat.weights, dtype=jnp.uint32)
+        self.sizes = jnp.asarray(flat.sizes, dtype=jnp.int32)
+        self.algs = jnp.asarray(flat.algs, dtype=jnp.int32)
+        self.types = jnp.asarray(flat.types, dtype=jnp.int32)
+        self.n_buckets = int(flat.items.shape[0])
+        self.max_size = int(flat.items.shape[1])
+        self.max_devices = int(flat.max_devices)
+        self.ln16 = jnp.asarray(ln.ln16_table())
+
+
+def _straw2_choose(dm: _DeviceMap, bno, x, r):
+    """Vectorized bucket_straw2_choose (reference: mapper.c:361-384)."""
+    items = dm.items[bno]
+    wts = dm.weights[bno].astype(jnp.int64)
+    size = dm.sizes[bno]
+    u = hashes.hash32_3(
+        x.astype(jnp.uint32), items.astype(jnp.uint32), r.astype(jnp.uint32),
+        xp=jnp,
+    ) & jnp.uint32(0xFFFF)
+    lnv = dm.ln16[u.astype(jnp.int64)]
+    draw = -((-lnv) // jnp.maximum(wts, 1))
+    valid = (jnp.arange(dm.max_size) < size) & (wts > 0)
+    draw = jnp.where(valid, draw, S64_MIN)
+    return items[jnp.argmax(draw)]
+
+
+def _is_out(dev_weights, max_devices, item, x):
+    """Reweight rejection (reference: mapper.c:424-438)."""
+    wmax = dev_weights.shape[0]
+    idx = jnp.clip(item, 0, wmax - 1)
+    w = dev_weights[idx].astype(jnp.uint32)
+    h = hashes.hash32_2(
+        x.astype(jnp.uint32), item.astype(jnp.uint32), xp=jnp
+    ) & jnp.uint32(0xFFFF)
+    out = jnp.where(
+        w >= 0x10000, False, jnp.where(w == 0, True, h >= w)
+    )
+    return jnp.where(item >= wmax, True, out)
+
+
+def _descend(
+    dm: _DeviceMap,
+    start_bno,
+    x,
+    r_base,
+    want_type: int,
+    *,
+    indep_numrep: Optional[object] = None,
+    ftotal=None,
+    max_depth: int = 16,
+):
+    """Walk intervening buckets until an item of want_type is chosen.
+
+    For indep, r is recomputed per level from the current bucket's alg
+    (reference: mapper.c:719-728); for firstn r_base is final.
+    Returns (item, status).
+    """
+
+    def r_for(bno):
+        if indep_numrep is None:
+            return r_base
+        numrep = indep_numrep
+        uniform = (dm.algs[bno] == ALG_UNIFORM) & (
+            dm.sizes[bno] % jnp.maximum(numrep, 1) == 0
+        )
+        mult = jnp.where(uniform, numrep + 1, numrep)
+        return r_base + mult * ftotal
+
+    def cond(c):
+        _, _, done, _, depth = c
+        return (~done) & (depth < max_depth)
+
+    def body(c):
+        bno, item, done, status, depth = c
+        empty = dm.sizes[bno] == 0
+        it = _straw2_choose(dm, bno, x, r_for(bno))
+        bad_item = it >= dm.max_devices
+        sub_bno = -1 - it
+        valid_sub = (it < 0) & (sub_bno < dm.n_buckets)
+        itemtype = jnp.where(
+            valid_sub, dm.types[jnp.clip(sub_bno, 0, dm.n_buckets - 1)], 0
+        )
+        is_target = itemtype == want_type
+        # resolution order mirrors the C walk
+        new_status = jnp.where(
+            empty,
+            jnp.int32(_REJECT),
+            jnp.where(
+                bad_item,
+                jnp.int32(_SKIP),
+                jnp.where(
+                    is_target,
+                    jnp.int32(_OK),
+                    jnp.where(valid_sub, jnp.int32(_OK), jnp.int32(_SKIP)),
+                ),
+            ),
+        )
+        keep_going = (~empty) & (~bad_item) & (~is_target) & valid_sub
+        new_done = ~keep_going
+        new_bno = jnp.where(keep_going, sub_bno, bno)
+        new_item = jnp.where(empty, item, it)
+        # if we fell out via keep_going exhaustion, status stays OK but
+        # done flips at depth limit -> treat as SKIP there
+        return new_bno, new_item, new_done, new_status, depth + 1
+
+    bno0 = jnp.asarray(start_bno, dtype=jnp.int32)
+    init = (
+        bno0,
+        jnp.int32(0),
+        jnp.asarray(False),
+        jnp.int32(_OK),
+        jnp.int32(0),
+    )
+    _, item, done, status, _ = jax.lax.while_loop(cond, body, init)
+    status = jnp.where(done, status, _SKIP)  # depth exhausted
+    return item, status
+
+
+def _leaf_firstn(
+    dm: _DeviceMap,
+    dev_weights,
+    bucket_item,
+    x,
+    outpos,
+    out2,
+    sub_r,
+    recurse_tries: int,
+    stable: int,
+):
+    """The chooseleaf recursion: pick ONE device under bucket_item.
+
+    Mirrors the recursive crush_choose_firstn call at mapper.c:573-588:
+    numrep = 1 (stable) / outpos+1 (legacy), collision checked against
+    the leaves chosen so far (out2[:outpos]).
+    Returns (leaf_item, ok).
+    """
+    bno = -1 - bucket_item
+    rep = jnp.where(jnp.bool_(stable), 0, outpos)
+    nslots = out2.shape[0]
+
+    def cond(c):
+        ftotal, _, placed, give_up = c
+        return (~placed) & (~give_up)
+
+    def body(c):
+        ftotal, _, placed, give_up = c
+        r = rep + sub_r + ftotal
+        item, status = _descend(dm, bno, x, r, 0)
+        collide = jnp.any(
+            (jnp.arange(nslots) < outpos) & (out2 == item)
+        )
+        reject = (status == _REJECT) | _is_out(
+            dev_weights, dm.max_devices, item, x
+        )
+        skip = status == _SKIP
+        fail = reject | collide
+        nf = ftotal + 1
+        return (
+            nf,
+            item,
+            (~fail) & (~skip),
+            skip | (fail & (nf >= recurse_tries)),
+        )
+
+    init = (jnp.int32(0), jnp.int32(0), jnp.asarray(False), jnp.asarray(False))
+    _, item, placed, _ = jax.lax.while_loop(cond, body, init)
+    return item, placed
+
+
+def _choose_firstn(
+    dm: _DeviceMap,
+    dev_weights,
+    bucket_bno,
+    x,
+    numrep: int,
+    want_type: int,
+    tries: int,
+    recurse_tries: int,
+    recurse_to_leaf: bool,
+    vary_r: int,
+    stable: int,
+):
+    """crush_choose_firstn for one source bucket (outpos starts at 0).
+
+    Returns (values[numrep], count): values are leaves when
+    recurse_to_leaf else items; only the first `count` are valid.
+    """
+    out = jnp.full((numrep,), ITEM_NONE, dtype=jnp.int32)
+    out2 = jnp.full((numrep,), ITEM_NONE, dtype=jnp.int32)
+    outpos = jnp.int32(0)
+
+    for rep in range(numrep):
+        def cond(c):
+            ftotal, _, _, placed, give_up = c
+            return (~placed) & (~give_up)
+
+        def body(c, rep=rep):
+            ftotal, item_prev, leaf_prev, placed, give_up = c
+            r = rep + ftotal
+            item, status = _descend(dm, bucket_bno, x, r, want_type)
+            collide = jnp.any((jnp.arange(numrep) < outpos) & (out == item))
+            reject = status == _REJECT
+            skip = status == _SKIP
+            leaf = item
+            if recurse_to_leaf:
+                sub_r = (r >> (vary_r - 1)) if vary_r else jnp.int32(0)
+                is_bucket = item < 0
+                leaf_item, leaf_ok = _leaf_firstn(
+                    dm, dev_weights, jnp.minimum(item, -1), x, outpos,
+                    out2, sub_r, recurse_tries, stable,
+                )
+                leaf = jnp.where(is_bucket, leaf_item, item)
+                leaf_fail = is_bucket & (~leaf_ok) & (~collide) & (status == _OK)
+                reject = reject | leaf_fail
+            if want_type == 0:
+                reject = reject | (
+                    (status == _OK)
+                    & (~collide)
+                    & _is_out(dev_weights, dm.max_devices, item, x)
+                )
+            fail = reject | collide
+            nf = ftotal + 1
+            return (
+                nf,
+                item,
+                leaf,
+                (status == _OK) & (~fail) & (~skip),
+                skip | (fail & (nf >= tries)),
+            )
+
+        init = (
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.asarray(False),
+            jnp.asarray(False),
+        )
+        _, item, leaf, placed, _ = jax.lax.while_loop(cond, body, init)
+        out = jnp.where(placed, out.at[outpos].set(item), out)
+        out2 = jnp.where(placed, out2.at[outpos].set(leaf), out2)
+        outpos = outpos + placed.astype(jnp.int32)
+
+    values = out2 if recurse_to_leaf else out
+    return values, outpos
+
+
+def _leaf_indep(dm, dev_weights, bucket_item, x, numrep, parent_r,
+                recurse_tries: int):
+    """Recursive indep leaf choice: one slot, r' = parent_r + n*ftotal."""
+    bno = -1 - bucket_item
+
+    def body(ftotal, got):
+        def attempt(_):
+            item, status = _descend(
+                dm, bno, x, parent_r, 0,
+                indep_numrep=jnp.int32(numrep), ftotal=ftotal,
+            )
+            bad = status != _OK
+            outed = _is_out(dev_weights, dm.max_devices, item, x)
+            return jnp.where(bad | outed, ITEM_UNDEF, item)
+
+        return jax.lax.cond(got == ITEM_UNDEF, attempt, lambda _: got, None)
+
+    got = jax.lax.fori_loop(0, recurse_tries, body, jnp.int32(ITEM_UNDEF))
+    return jnp.where(got == ITEM_UNDEF, ITEM_NONE, got)
+
+
+def _choose_indep(
+    dm: _DeviceMap,
+    dev_weights,
+    bucket_bno,
+    x,
+    left0: int,
+    numrep: int,
+    want_type: int,
+    tries: int,
+    recurse_tries: int,
+    recurse_to_leaf: bool,
+):
+    """crush_choose_indep for one source bucket (positional, out_size
+    slots).  Returns values[left0] with CRUSH_ITEM_NONE holes."""
+    nslots = left0
+    out = jnp.full((nslots,), ITEM_UNDEF, dtype=jnp.int32)
+    out2 = jnp.full((nslots,), ITEM_UNDEF, dtype=jnp.int32)
+
+    def round_body(c):
+        ftotal, out, out2, left = c
+        for rep in range(nslots):
+            def fill(args):
+                out, out2, left = args
+                item, status = _descend(
+                    dm, bucket_bno, x, jnp.int32(rep), want_type,
+                    indep_numrep=jnp.int32(numrep), ftotal=ftotal,
+                )
+                collide = jnp.any(out == item)
+                hard_fail = status == _SKIP
+                soft_fail = (status == _REJECT) | collide
+                leaf = item
+                if recurse_to_leaf:
+                    is_bucket = item < 0
+                    # the recursion's slot r is rep + parent_r where
+                    # parent_r is the r at which this bucket was chosen
+                    # (straw2-only => the per-level multiplier is always
+                    # numrep, so r_parent is the top-level r')
+                    r_parent = jnp.int32(rep) + jnp.int32(numrep) * ftotal
+                    leaf_val = _leaf_indep(
+                        dm, dev_weights, jnp.minimum(item, -1), x,
+                        numrep, jnp.int32(rep) + r_parent, recurse_tries,
+                    )
+                    leaf = jnp.where(is_bucket, leaf_val, item)
+                    soft_fail = soft_fail | (
+                        is_bucket & (leaf == ITEM_NONE) & (status == _OK)
+                    )
+                outed = jnp.where(
+                    want_type == 0,
+                    (status == _OK)
+                    & _is_out(dev_weights, dm.max_devices, item, x),
+                    False,
+                )
+                soft_fail = soft_fail | outed
+                ok = (status == _OK) & (~soft_fail) & (~hard_fail)
+                new_item = jnp.where(
+                    hard_fail, ITEM_NONE, jnp.where(ok, item, ITEM_UNDEF)
+                )
+                new_leaf = jnp.where(
+                    hard_fail, ITEM_NONE, jnp.where(ok, leaf, ITEM_UNDEF)
+                )
+                placed = ok | hard_fail
+                out_n = jnp.where(
+                    placed, out.at[rep].set(new_item), out
+                )
+                out2_n = jnp.where(
+                    placed, out2.at[rep].set(new_leaf), out2
+                )
+                return out_n, out2_n, left - placed.astype(jnp.int32)
+
+            out, out2, left = jax.lax.cond(
+                out[rep] == ITEM_UNDEF,
+                fill,
+                lambda args: args,
+                (out, out2, left),
+            )
+        return ftotal + 1, out, out2, left
+
+    def round_cond(c):
+        ftotal, _, _, left = c
+        return (left > 0) & (ftotal < tries)
+
+    _, out, out2, _ = jax.lax.while_loop(
+        round_cond, round_body, (jnp.int32(0), out, out2, jnp.int32(nslots))
+    )
+    out = jnp.where(out == ITEM_UNDEF, ITEM_NONE, out)
+    out2 = jnp.where(out2 == ITEM_UNDEF, ITEM_NONE, out2)
+    return (out2 if recurse_to_leaf else out), jnp.int32(nslots)
+
+
+def compile_rule(
+    flat: FlatMap,
+    steps: Sequence[Tuple[int, int, int]],
+    result_max: int,
+):
+    """Build fn(xs[int32 N], device_weights[uint32 D]) -> int32 [N, result_max].
+
+    Steps are unrolled at trace time (rules are tiny and static); holes
+    are CRUSH_ITEM_NONE.  The returned callable is jitted and vmapped.
+    """
+    if not np.all(
+        (np.asarray(flat.algs) == ALG_STRAW2) | (np.asarray(flat.sizes) == 0)
+    ):
+        raise NotImplementedError(
+            "jit mapper supports straw2 buckets; use the native oracle for "
+            "legacy uniform/list/tree/straw maps"
+        )
+    dm = _DeviceMap(flat)
+    tun = flat.tunables
+    steps = [tuple(int(v) for v in s) for s in steps]
+
+    def one_x(x, dev_weights):
+        x = x.astype(jnp.int32)
+        w_buf = jnp.full((result_max,), ITEM_NONE, dtype=jnp.int32)
+        wsize = jnp.int32(0)
+        result = jnp.full((result_max,), ITEM_NONE, dtype=jnp.int32)
+        result_len = jnp.int32(0)
+
+        choose_tries = tun.choose_total_tries + 1
+        choose_leaf_tries = 0
+        vary_r = tun.chooseleaf_vary_r
+        stable = tun.chooseleaf_stable
+        wsize_bound = 0  # static upper bound on wsize, tracked at trace time
+
+        for op, arg1, arg2 in steps:
+            if op == OP_TAKE:
+                w_buf = w_buf.at[0].set(arg1)
+                wsize = jnp.int32(1)
+                wsize_bound = 1
+            elif op == OP_SET_CHOOSE_TRIES:
+                if arg1 > 0:
+                    choose_tries = arg1
+            elif op == OP_SET_CHOOSELEAF_TRIES:
+                if arg1 > 0:
+                    choose_leaf_tries = arg1
+            elif op in (
+                OP_CHOOSE_FIRSTN,
+                OP_CHOOSELEAF_FIRSTN,
+                OP_CHOOSE_INDEP,
+                OP_CHOOSELEAF_INDEP,
+            ):
+                firstn = op in (OP_CHOOSE_FIRSTN, OP_CHOOSELEAF_FIRSTN)
+                recurse = op in (OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP)
+                numrep = arg1 if arg1 > 0 else result_max + arg1
+                if numrep <= 0:
+                    continue
+                numrep = min(numrep, result_max)
+                if firstn:
+                    recurse_tries = (
+                        choose_leaf_tries
+                        or (1 if tun.chooseleaf_descend_once else choose_tries)
+                    )
+                else:
+                    recurse_tries = choose_leaf_tries or 1
+
+                o_buf = jnp.full((result_max,), ITEM_NONE, dtype=jnp.int32)
+                osize = jnp.int32(0)
+                # sources are w_buf[:wsize]; wsize_bound keeps the unroll
+                # tight for the common take->choose->emit shape (1 source)
+                for i in range(min(wsize_bound, result_max)):
+                    src_active = jnp.int32(i) < wsize
+                    bno = -1 - w_buf[i]
+                    bno_ok = (bno >= 0) & (bno < dm.n_buckets)
+                    active = src_active & bno_ok
+                    bno_safe = jnp.clip(bno, 0, dm.n_buckets - 1)
+                    if firstn:
+                        vals, cnt = _choose_firstn(
+                            dm, dev_weights, bno_safe, x, numrep, arg2,
+                            choose_tries, recurse_tries, recurse, vary_r,
+                            stable,
+                        )
+                    else:
+                        vals, cnt = _choose_indep(
+                            dm, dev_weights, bno_safe, x, numrep, numrep,
+                            arg2, choose_tries, recurse_tries, recurse,
+                        )
+                    cnt = jnp.where(active, cnt, 0)
+                    # append vals[:cnt] at o_buf[osize:]
+                    for jj in range(vals.shape[0]):
+                        valid = (jnp.int32(jj) < cnt) & (osize < result_max)
+                        o_buf = jnp.where(
+                            valid,
+                            o_buf.at[jnp.clip(osize, 0, result_max - 1)].set(
+                                vals[jj]
+                            ),
+                            o_buf,
+                        )
+                        osize = osize + valid.astype(jnp.int32)
+                w_buf = o_buf
+                wsize = osize
+                wsize_bound = min(result_max, wsize_bound * numrep)
+            elif op == OP_EMIT:
+                for i in range(min(wsize_bound, result_max)):
+                    valid = (jnp.int32(i) < wsize) & (result_len < result_max)
+                    result = jnp.where(
+                        valid,
+                        result.at[
+                            jnp.clip(result_len, 0, result_max - 1)
+                        ].set(w_buf[i]),
+                        result,
+                    )
+                    result_len = result_len + valid.astype(jnp.int32)
+                wsize = jnp.int32(0)
+        return result
+
+    mapped = jax.vmap(one_x, in_axes=(0, None))
+
+    @jax.jit
+    def run(xs, dev_weights):
+        return mapped(
+            jnp.asarray(xs, dtype=jnp.int32),
+            jnp.asarray(dev_weights, dtype=jnp.uint32),
+        )
+
+    return run

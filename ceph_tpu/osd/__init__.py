@@ -1,0 +1,2 @@
+"""OSD-side runtime: OSDMap, placement groups, EC/replicated backends,
+object stores — the server half of the framework."""

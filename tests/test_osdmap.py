@@ -1,0 +1,157 @@
+"""OSDMap placement pipeline: sweep vs scalar path, exception tables.
+
+Mirrors src/test/osd/TestOSDMap.cc's core assertions: pipeline
+consistency, upmap application, pg_temp override, primary affinity.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import map as cmap
+from ceph_tpu.osd.osdmap import (
+    CRUSH_ITEM_NONE,
+    OSDMap,
+    PGPool,
+    POOL_ERASURE,
+    pg_num_mask,
+    stable_mod,
+)
+
+
+def _mk_map(n_osds=32, hosts=8, pg_num=64, pool_type=1, size=3):
+    m, root = cmap.build_flat_cluster(n_osds, hosts=hosts)
+    mode = "firstn" if pool_type == 1 else "indep"
+    rid = m.add_simple_rule("data", root, 1, mode=mode, num=size)
+    osdmap = OSDMap(m)
+    osdmap.add_pool(
+        PGPool(pool_id=1, pool_type=pool_type, size=size, pg_num=pg_num,
+               pgp_num=pg_num, crush_rule=rid)
+    )
+    return osdmap
+
+
+def test_stable_mod_and_mask():
+    assert pg_num_mask(12) == 15
+    assert pg_num_mask(123) == 127
+    assert pg_num_mask(64) == 63
+    for x in range(200):
+        b, mask = 12, 15
+        expect = x & mask if (x & mask) < b else x & (mask >> 1)
+        assert stable_mod(x, b, mask) == expect
+
+
+def test_sweep_matches_scalar_path():
+    osdmap = _mk_map()
+    sweep = osdmap.map_pgs(1)
+    for ps in range(osdmap.pools[1].pg_num):
+        up, upp, acting, actp = osdmap.pg_to_up_acting((1, ps))
+        row = sweep["up"][ps]
+        row = [int(v) for v in row if v != CRUSH_ITEM_NONE]
+        assert row == up, f"pg {ps}"
+        assert sweep["up_primary"][ps] == upp
+        assert sweep["acting_primary"][ps] == actp
+
+
+def test_sweep_matches_scalar_path_erasure():
+    osdmap = _mk_map(pool_type=POOL_ERASURE, size=6, n_osds=48, hosts=8)
+    sweep = osdmap.map_pgs(1)
+    for ps in range(osdmap.pools[1].pg_num):
+        up, upp, acting, actp = osdmap.pg_to_up_acting((1, ps))
+        row = [int(v) for v in sweep["up"][ps]]
+        assert row == up, f"pg {ps}"
+        assert sweep["up_primary"][ps] == upp
+
+
+def test_down_osd_filtered():
+    osdmap = _mk_map()
+    sweep0 = osdmap.map_pgs(1)
+    victim = int(sweep0["up"][0][0])
+    osdmap.set_osd_down(victim)
+    sweep1 = osdmap.map_pgs(1)
+    assert not np.isin(sweep1["up"], victim).any()
+    # erasure pools keep positional holes instead of shifting
+    em = _mk_map(pool_type=POOL_ERASURE, size=6, n_osds=48, hosts=8)
+    es0 = em.map_pgs(1)
+    v = int(es0["up"][0][0])
+    em.set_osd_down(v)
+    es1 = em.map_pgs(1)
+    assert (es1["up"][es0["up"] == v] == CRUSH_ITEM_NONE).all()
+
+
+def test_out_osd_remapped():
+    osdmap = _mk_map()
+    sweep0 = osdmap.map_pgs(1)
+    victim = int(sweep0["up"][0][0])
+    osdmap.set_osd_out(victim)
+    sweep1 = osdmap.map_pgs(1)
+    # out => crush rejects it entirely (weight 0), remapped not holed
+    assert not np.isin(sweep1["up"], victim).any()
+    assert (sweep1["up"] != CRUSH_ITEM_NONE).all()
+
+
+def test_pg_upmap_and_items():
+    osdmap = _mk_map()
+    up0, *_ = osdmap.pg_to_up_acting((1, 5))
+    # full remap
+    target = [o for o in range(3)]
+    osdmap.pg_upmap[(1, 5)] = target
+    up1, *_ = osdmap.pg_to_up_acting((1, 5))
+    assert up1 == target
+    sweep = osdmap.map_pgs(1)
+    assert [int(v) for v in sweep["up"][5]] == target
+    # pairwise remap on another pg
+    up7, *_ = osdmap.pg_to_up_acting((1, 7))
+    frm = up7[0]
+    to = next(o for o in range(osdmap.max_osd) if o not in up7)
+    osdmap.pg_upmap_items[(1, 7)] = [(frm, to)]
+    up7b, *_ = osdmap.pg_to_up_acting((1, 7))
+    assert up7b[0] == to
+    # upmap to an OUT osd is ignored
+    osdmap.set_osd_out(2)
+    up5c, *_ = osdmap.pg_to_up_acting((1, 5))
+    assert up5c != target
+
+
+def test_pg_temp_overrides_acting():
+    osdmap = _mk_map()
+    up, upp, acting, actp = osdmap.pg_to_up_acting((1, 3))
+    temp = [o for o in range(3, 6)]
+    osdmap.pg_temp[(1, 3)] = temp
+    up2, upp2, acting2, actp2 = osdmap.pg_to_up_acting((1, 3))
+    assert up2 == up  # up unchanged
+    assert acting2 == temp
+    assert actp2 == temp[0]
+    osdmap.primary_temp[(1, 3)] = temp[2]
+    *_, actp3 = osdmap.pg_to_up_acting((1, 3))
+    assert actp3 == temp[2]
+    sweep = osdmap.map_pgs(1)
+    assert [int(v) for v in sweep["acting"][3]] == temp
+
+
+def test_primary_affinity():
+    osdmap = _mk_map()
+    sweep0 = osdmap.map_pgs(1)
+    # zero affinity on a common primary: it should stop being primary
+    primaries0 = sweep0["up_primary"]
+    victim = int(np.bincount(primaries0[primaries0 >= 0]).argmax())
+    osdmap.set_primary_affinity(victim, 0)
+    sweep1 = osdmap.map_pgs(1)
+    assert not np.isin(sweep1["up_primary"], victim).any()
+    # scalar path agrees
+    for ps in range(osdmap.pools[1].pg_num):
+        up, upp, _, _ = osdmap.pg_to_up_acting((1, ps))
+        assert sweep1["up_primary"][ps] == upp
+        row = [int(v) for v in sweep1["up"][ps] if v != CRUSH_ITEM_NONE]
+        assert row == up
+
+
+def test_object_to_pg():
+    osdmap = _mk_map()
+    pool = osdmap.pools[1]
+    pgid = osdmap.object_to_pg(1, "myobject")
+    assert pgid[0] == 1 and 0 <= pgid[1] < pool.pg_num
+    assert osdmap.object_to_pg(1, "myobject") == pgid  # deterministic
+    # namespace separates
+    assert osdmap.object_to_pg(1, "x", "ns1") != osdmap.object_to_pg(
+        1, "x", "ns2"
+    ) or True  # may collide; just exercise the path
