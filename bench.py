@@ -1,0 +1,118 @@
+"""Round benchmark: EC encode+decode GB/s at k=8,m=4 on the attached TPU.
+
+Mirrors the reference's benchmark semantics
+(src/test/erasure-code/ceph_erasure_code_benchmark.cc:151-190 encode,
+:255-328 decode: GB/s = iterations x object_size / seconds, decode
+pre-encodes once then reconstructs erased chunks and verifies equality)
+for the BASELINE.md headline config: isa-equivalent RS k=8 m=4, 1 MiB
+chunks.  The baseline divisor is the native C++ GF(2^8) scalar oracle
+(csrc/gf256.cc) measured on this host's CPU, standing in for the
+reference's table-based plugins (ISA-L itself is x86-asm and absent).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, ...}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _bench(fn, warmup=2, iters=10):
+    for _ in range(warmup):
+        out = fn()
+    _block(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    _block(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _block(out):
+    if hasattr(out, "block_until_ready"):
+        out.block_until_ready()
+
+
+def main():
+    import jax
+
+    from ceph_tpu import _native
+    from ceph_tpu.ec import matrices
+    from ceph_tpu.ops import gf2_matmul
+
+    k, m = 8, 4
+    n = 1 << 20  # 1 MiB chunks -> 8 MiB object per encode
+    rng = np.random.default_rng(0)
+    coding = matrices.isa_cauchy(k, m)
+    mbits = gf2_matmul.prepare_bitmatrix(coding)
+    x = rng.integers(0, 256, size=(k, n), dtype=np.uint8)
+
+    backend = jax.default_backend()
+    xd = jax.device_put(x)
+    md = jax.device_put(mbits)
+
+    def encode():
+        return gf2_matmul.gf2_matmul_bytes(md, xd)
+
+    # correctness pin vs the native oracle before timing anything
+    native_coding = _native.rs_encode(coding.astype(np.uint8), x[:, :4096])
+    got = np.asarray(encode())[:, :4096]
+    assert np.array_equal(got, native_coding), "TPU encode != native oracle"
+
+    enc_dt = _bench(encode)
+    enc_gbps = k * n / enc_dt / 1e9
+
+    # decode: erase m chunks (2 data + 2 coding), rebuild data rows from
+    # the k survivors via the cached recovery matrix (one bit-matmul)
+    from ceph_tpu.ec.codec import RSMatrixCodec
+
+    codec = RSMatrixCodec(k, m, coding)
+    coding_rows = np.asarray(encode())
+    survivors = [0, 1, 2, 3, 4, 5, 8, 9]  # lost data 6,7 and coding 10,11
+    _, rec_bits = codec.recovery_matrix(survivors)
+    stacked = np.concatenate([x[:6], coding_rows[:2]])
+    sd = jax.device_put(stacked)
+    rd = jax.device_put(rec_bits)
+
+    def decode():
+        return gf2_matmul.gf2_matmul_bytes(rd, sd)
+
+    dec = np.asarray(decode())
+    assert np.array_equal(dec, x), "TPU decode != original data"
+    dec_dt = _bench(decode)
+    dec_gbps = k * n / dec_dt / 1e9
+
+    # CPU baseline: the same encode through the scalar native oracle
+    base_n = 1 << 22  # 4 MiB total is plenty for a stable scalar rate
+    xb = x[:, : base_n // k]
+    cm = coding.astype(np.uint8)
+    base_dt = _bench(lambda: _native.rs_encode(cm, xb), warmup=1, iters=3)
+    base_gbps = xb.size / base_dt / 1e9
+
+    value = 2 * k * n / (enc_dt + dec_dt) / 1e9  # combined encode+decode
+    print(
+        json.dumps(
+            {
+                "metric": f"EC encode+decode GB/s (RS k={k},m={m}, 1MiB chunks, {backend})",
+                "value": round(value, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(value / base_gbps, 3),
+                "encode_gbps": round(enc_gbps, 3),
+                "decode_gbps": round(dec_gbps, 3),
+                "baseline_cpu_native_gbps": round(base_gbps, 3),
+                "backend": backend,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # one line, always
+        print(json.dumps({"metric": "bench-error", "value": 0, "unit": "GB/s",
+                          "vs_baseline": 0, "error": repr(e)}))
+        sys.exit(1)

@@ -54,9 +54,10 @@ def bitplanes_to_bytes(planes: jax.Array) -> jax.Array:
     """int32/int8 bitplanes [8m, n] -> uint8 [m, n]."""
     m8, n = planes.shape
     m = m8 // 8
-    grouped = planes.reshape(m, 8, n).astype(jnp.uint8)
-    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :, None]
-    return (grouped * weights).sum(axis=1, dtype=jnp.uint32).astype(jnp.uint8)
+    grouped = planes.reshape(m, 8, n).astype(jnp.int32)
+    weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32))[None, :, None]
+    # int32 accumulation: Mosaic/Pallas doesn't lower unsigned reductions
+    return (grouped * weights).sum(axis=1, dtype=jnp.int32).astype(jnp.uint8)
 
 
 def gf2_matmul_bytes_ref(mbits: jax.Array, x: jax.Array) -> jax.Array:
@@ -84,8 +85,10 @@ def _gf2_kernel(mbits_ref, x_ref, out_ref):
     """One (k, TN) tile: expand -> int8 matmul -> mod 2 -> pack."""
     x = x_ref[:]  # uint8 [k, TN]
     k, tn = x.shape
-    shifts = jax.lax.broadcasted_iota(jnp.uint8, (1, 8, 1), 1)
-    bits = ((x[:, None, :] >> shifts) & jnp.uint8(1)).astype(jnp.int8)
+    # Mosaic only legalizes 32-bit iota/shifts: extract bits in int32
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
+    xi = x.astype(jnp.int32)
+    bits = ((xi[:, None, :] >> shifts) & 1).astype(jnp.int8)
     planes = bits.reshape(k * 8, tn)
     acc = jax.lax.dot_general(
         mbits_ref[:],
@@ -93,13 +96,11 @@ def _gf2_kernel(mbits_ref, x_ref, out_ref):
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )
-    acc = (acc & 1).astype(jnp.uint8)
+    acc = acc & 1
     m8 = acc.shape[0]
-    weights = jnp.uint8(1) << jax.lax.broadcasted_iota(
-        jnp.uint8, (1, 8, 1), 1
-    )
+    weights = jnp.int32(1) << jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
     packed = (acc.reshape(m8 // 8, 8, tn) * weights).sum(
-        axis=1, dtype=jnp.uint32
+        axis=1, dtype=jnp.int32
     )
     out_ref[:] = packed.astype(jnp.uint8)
 
