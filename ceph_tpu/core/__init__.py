@@ -1,0 +1,9 @@
+"""Core host runtime (L0/L1): encoding, crc, config, log, perf, throttle.
+
+The infrastructure layer every daemon and client shares, mirroring the
+reference's `src/include/` + `src/common/` + `src/log/` + `src/global/`
+(reference: SURVEY.md L0/L1 rows): versioned wire encoding, crc32c,
+typed config with hot reload, leveled subsystem logging with a crash
+ring, perf counters, throttles, the admin socket, thread liveness, and
+sharded work queues.
+"""

@@ -1,0 +1,208 @@
+"""Typed config schema + runtime config with observers and hot reload.
+
+Mirrors the reference's option system (reference: src/common/options.cc
+— typed schema with levels/defaults/min-max/enum/runtime-updatability —
+and md_config_t at src/common/config.h:66 with md_config_obs_t
+observers applied via apply_changes).  The monitor's centralized config
+service (src/mon/ConfigMonitor.cc) maps to MonService config commands
+layered on top of this.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+
+@dataclass
+class Option:
+    name: str
+    type: type  # int, float, str, bool
+    default: Any
+    desc: str = ""
+    level: str = LEVEL_ADVANCED
+    minval: Optional[float] = None
+    maxval: Optional[float] = None
+    enum: Optional[Sequence[str]] = None
+    runtime: bool = True  # updatable without restart
+
+    def validate(self, value: Any) -> Any:
+        if self.type is bool and isinstance(value, str):
+            value = value.lower() in ("true", "yes", "1", "on")
+        try:
+            value = self.type(value)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"{self.name}: cannot cast {value!r}: {e}")
+        if self.minval is not None and value < self.minval:
+            raise ValueError(f"{self.name}: {value} < min {self.minval}")
+        if self.maxval is not None and value > self.maxval:
+            raise ValueError(f"{self.name}: {value} > max {self.maxval}")
+        if self.enum is not None and value not in self.enum:
+            raise ValueError(f"{self.name}: {value!r} not in {self.enum}")
+        return value
+
+
+def _opts() -> List[Option]:
+    O = Option
+    return [
+        # -- global ---------------------------------------------------------
+        O("name", str, "client.admin", "entity name", LEVEL_BASIC, runtime=False),
+        O("fsid", str, "", "cluster id", LEVEL_BASIC, runtime=False),
+        O("log_level", int, 1, "default log verbosity", LEVEL_BASIC),
+        O("log_file", str, "", "log output path ('' = stderr)"),
+        O("log_ring_size", int, 10000, "crash-dump ring entries"),
+        O("admin_socket", str, "", "admin socket path ('' = disabled)"),
+        O("heartbeat_interval", float, 5.0, "internal liveness check period"),
+        # -- messenger ------------------------------------------------------
+        O("ms_bind_ip", str, "127.0.0.1", "listen address", runtime=False),
+        O("ms_connect_timeout", float, 10.0, "dial timeout seconds"),
+        O("ms_retry_interval", float, 0.2, "session reconnect backoff"),
+        O("ms_dispatch_throttle_bytes", int, 100 << 20,
+          "max bytes of queued undispatched messages"),
+        O("ms_crc_data", bool, True, "checksum message payloads"),
+        # -- monitor --------------------------------------------------------
+        O("mon_lease", float, 5.0, "paxos lease seconds"),
+        O("mon_tick_interval", float, 1.0, "monitor tick period"),
+        O("mon_osd_down_out_interval", float, 600.0,
+          "seconds down before auto-out"),
+        O("mon_osd_min_down_reporters", int, 2,
+          "distinct failure reporters required to mark an osd down"),
+        O("mon_osd_adjust_heartbeat_grace", bool, True,
+          "scale grace by reporter history"),
+        O("osd_heartbeat_grace", float, 20.0,
+          "seconds without a ping before reporting failure"),
+        O("osd_heartbeat_interval", float, 2.0, "osd peer ping period"),
+        # -- osd ------------------------------------------------------------
+        O("osd_op_num_shards", int, 4, "sharded op queue shards", runtime=False),
+        O("osd_max_write_size", int, 90 << 20, "largest single write"),
+        O("osd_pool_default_size", int, 3, "replica count"),
+        O("osd_pool_default_min_size", int, 0, "0 = size - size/2"),
+        O("osd_pool_default_pg_num", int, 32, "pgs per new pool"),
+        O("osd_pool_default_erasure_code_profile", str,
+          "plugin=isa k=8 m=4 technique=reed_sol_van",
+          "default EC profile"),
+        O("osd_recovery_max_active", int, 3, "concurrent recovery ops"),
+        O("osd_scrub_interval", float, 86400.0, "seconds between scrubs"),
+        O("osd_client_op_priority", int, 63, "client op priority"),
+        O("osd_recovery_op_priority", int, 3, "recovery op priority"),
+        # -- erasure code / device -----------------------------------------
+        O("erasure_code_batch_cols", int, 1 << 20,
+          "stripe-batch queue target columns per device dispatch"),
+        O("erasure_code_tile_n", int, 2048, "pallas column tile"),
+        O("tpu_stripe_queue_depth", int, 4, "in-flight device batches"),
+        # -- objectstore ----------------------------------------------------
+        O("objectstore", str, "memstore", "backend", enum=("memstore", "filestore")),
+        O("objectstore_path", str, "", "data directory for filestore"),
+        O("objectstore_wal_sync", bool, False, "fsync the WAL per txn"),
+        O("filestore_debug_inject_read_err", bool, False,
+          "fault injection: EIO on reads marked bad"),
+        # -- client ---------------------------------------------------------
+        O("objecter_timeout", float, 30.0, "op resend timeout"),
+        O("objecter_inflight_ops", int, 1024, "op throttle"),
+        O("rados_osd_op_timeout", float, 0.0, "0 = no timeout"),
+    ]
+
+
+SCHEMA: Dict[str, Option] = {o.name: o for o in _opts()}
+
+
+class Config:
+    """md_config_t equivalent: values + observers + apply_changes."""
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None) -> None:
+        self._lock = threading.Lock()
+        self._values: Dict[str, Any] = {
+            n: o.default for n, o in SCHEMA.items()
+        }
+        self._observers: List[Tuple[Sequence[str], Callable]] = []
+        self._dirty: List[str] = []
+        for key, val in os.environ.items():
+            if key.startswith("CEPH_TPU_"):
+                name = key[len("CEPH_TPU_"):].lower()
+                if name in SCHEMA:
+                    self._values[name] = SCHEMA[name].validate(val)
+        if overrides:
+            for k, v in overrides.items():
+                self.set_val(k, v, apply=False)
+            self._dirty.clear()
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            return self._values[name]
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self.get(name)
+        except KeyError:
+            raise AttributeError(name)
+
+    def set_val(self, name: str, value: Any, apply: bool = True) -> None:
+        opt = SCHEMA.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name!r}")
+        value = opt.validate(value)
+        with self._lock:
+            if self._values[name] != value:
+                self._values[name] = value
+                self._dirty.append(name)
+        if apply:
+            self.apply_changes()
+
+    def add_observer(
+        self, keys: Sequence[str], fn: Callable[[str, Any], None]
+    ) -> None:
+        """fn(name, new_value) fires on apply_changes for watched keys."""
+        self._observers.append((tuple(keys), fn))
+
+    def apply_changes(self) -> None:
+        with self._lock:
+            dirty, self._dirty = self._dirty, []
+            values = dict(self._values)
+        for name in dirty:
+            for keys, fn in self._observers:
+                if name in keys:
+                    fn(name, values[name])
+
+    def parse_argv(self, argv: Sequence[str]) -> List[str]:
+        """Consume --conf-<name>=<v> / --conf-<name> <v>; returns the rest."""
+        rest: List[str] = []
+        i = 0
+        while i < len(argv):
+            a = argv[i]
+            if a.startswith("--conf-"):
+                body = a[len("--conf-"):]
+                if "=" in body:
+                    name, val = body.split("=", 1)
+                else:
+                    name = body
+                    i += 1
+                    if i >= len(argv):
+                        raise ValueError(f"missing value for --conf-{name}")
+                    val = argv[i]
+                self.set_val(name.replace("-", "_"), val, apply=False)
+            else:
+                rest.append(a)
+            i += 1
+        self.apply_changes()
+        return rest
+
+    def diff(self) -> Dict[str, Any]:
+        """Options changed from schema defaults (admin `config diff`)."""
+        with self._lock:
+            return {
+                n: v
+                for n, v in self._values.items()
+                if v != SCHEMA[n].default
+            }
+
+    def dump(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._values)

@@ -1,0 +1,58 @@
+"""CRC-32C (Castagnoli) — message footers, store checksums, scrub digests.
+
+Native C++ slicing-by-8 kernel (csrc/crc32c.cc) via ctypes, with a
+numpy table fallback.  Reference role: src/common/crc32c.h (messenger
+footer crcs, BlueStore csums, ECUtil HashInfo per-shard running crc at
+src/osd/ECUtil.h:101-122).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+_POLY = np.uint32(0x82F63B78)
+
+
+def _make_table() -> np.ndarray:
+    t = np.arange(256, dtype=np.uint32)
+    for _ in range(8):
+        t = np.where(t & 1, (t >> 1) ^ _POLY, t >> 1)
+    return t
+
+
+_TABLE = _make_table()
+_native = None
+
+
+def _load_native():
+    global _native
+    if _native is None:
+        try:
+            from ceph_tpu import _native as nat
+
+            L = nat.lib()
+            fn = L.ceph_tpu_crc32c
+            fn.restype = ctypes.c_uint32
+            # c_char_p: immutable bytes pass zero-copy (no buffer dup)
+            fn.argtypes = [
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+                ctypes.c_int64,
+            ]
+            _native = fn
+        except Exception:
+            _native = False
+    return _native
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """Running crc32c; chain by passing the previous value as `crc`."""
+    fn = _load_native()
+    if fn:
+        return int(fn(crc, bytes(data), len(data)))
+    c = np.uint32(crc) ^ np.uint32(0xFFFFFFFF)
+    for b in data:
+        c = _TABLE[(c ^ b) & 0xFF] ^ (c >> np.uint32(8))
+    return int(c ^ np.uint32(0xFFFFFFFF))

@@ -1,0 +1,68 @@
+"""Internal thread-liveness map with grace/suicide timeouts.
+
+Reference: HeartbeatMap (src/common/HeartbeatMap.h:54) — worker threads
+touch a handle inside their loop; a checker flags handles past their
+grace (unhealthy → daemon reports itself) or suicide timeout (reference
+aborts; here we raise via callback so tests can assert on it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class Handle:
+    __slots__ = ("name", "grace", "suicide_grace", "last_touch", "suicided")
+
+    def __init__(self, name: str, grace: float, suicide_grace: float) -> None:
+        self.name = name
+        self.grace = grace
+        self.suicide_grace = suicide_grace
+        self.last_touch = time.monotonic()
+        self.suicided = False
+
+    def touch(self) -> None:
+        self.last_touch = time.monotonic()
+        self.suicided = False
+
+
+class HeartbeatMap:
+    def __init__(
+        self, on_suicide: Optional[Callable[[str], None]] = None
+    ) -> None:
+        self._lock = threading.Lock()
+        self._handles: Dict[str, Handle] = {}
+        self.on_suicide = on_suicide
+
+    def add_worker(
+        self, name: str, grace: float = 15.0, suicide_grace: float = 150.0
+    ) -> Handle:
+        h = Handle(name, grace, suicide_grace)
+        with self._lock:
+            self._handles[name] = h
+        return h
+
+    def remove_worker(self, name: str) -> None:
+        with self._lock:
+            self._handles.pop(name, None)
+
+    def is_healthy(self) -> bool:
+        return not self.unhealthy_workers()
+
+    def unhealthy_workers(self) -> List[str]:
+        now = time.monotonic()
+        bad: List[str] = []
+        with self._lock:
+            handles = list(self._handles.values())
+        for h in handles:
+            age = now - h.last_touch
+            # latch: the abort callback fires once per stall (reference
+            # suicide is terminal; touch() re-arms after recovery)
+            if age > h.suicide_grace and not h.suicided and self.on_suicide:
+                h.suicided = True
+                self.on_suicide(h.name)
+            if age > h.grace:
+                bad.append(h.name)
+        return bad

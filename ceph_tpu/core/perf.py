@@ -1,0 +1,129 @@
+"""Perf counters: counter / gauge / long-run-avg / histogram.
+
+Reference: PerfCounters (src/common/perf_counters.h:59-99 — u64
+counters, gauges, avgcount+sum pairs, power-of-2 histograms) built via
+PerfCountersBuilder, registered in a per-context collection, and dumped
+over the admin socket (`perf dump`).  Daemons push these to the mgr
+(src/mgr/DaemonServer.cc); here the mgr service polls `dump()`.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional
+
+TYPE_U64 = "u64"          # monotonically increasing counter
+TYPE_GAUGE = "gauge"      # settable level
+TYPE_AVG = "avg"          # (count, sum) pair, e.g. latencies
+TYPE_HIST = "histogram"   # log2-bucketed values
+
+
+class _Counter:
+    __slots__ = ("name", "type", "desc", "value", "count", "sum", "buckets")
+
+    def __init__(self, name: str, type_: str, desc: str) -> None:
+        self.name = name
+        self.type = type_
+        self.desc = desc
+        self.value = 0
+        self.count = 0
+        self.sum = 0.0
+        self.buckets: List[int] = [0] * 64 if type_ == TYPE_HIST else []
+
+
+class PerfCounters:
+    """One subsystem's counter set (e.g. 'osd', 'ec', 'msgr')."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: Dict[str, _Counter] = {}
+
+    # -- builder ----------------------------------------------------------
+    def add_u64_counter(self, name: str, desc: str = "") -> None:
+        self._counters[name] = _Counter(name, TYPE_U64, desc)
+
+    def add_u64_gauge(self, name: str, desc: str = "") -> None:
+        self._counters[name] = _Counter(name, TYPE_GAUGE, desc)
+
+    def add_time_avg(self, name: str, desc: str = "") -> None:
+        self._counters[name] = _Counter(name, TYPE_AVG, desc)
+
+    def add_histogram(self, name: str, desc: str = "") -> None:
+        self._counters[name] = _Counter(name, TYPE_HIST, desc)
+
+    # -- updates ----------------------------------------------------------
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name].value += by
+
+    def dec(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name].value -= by
+
+    def set(self, name: str, v: int) -> None:
+        with self._lock:
+            self._counters[name].value = v
+
+    def tinc(self, name: str, seconds: float) -> None:
+        with self._lock:
+            c = self._counters[name]
+            c.count += 1
+            c.sum += seconds
+
+    def hinc(self, name: str, value: float) -> None:
+        with self._lock:
+            c = self._counters[name]
+            b = 0 if value < 1 else min(63, int(math.log2(value)) + 1)
+            c.buckets[b] += 1
+            c.count += 1
+            c.sum += value
+
+    # -- output -----------------------------------------------------------
+    def dump(self) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        with self._lock:
+            for n, c in self._counters.items():
+                if c.type in (TYPE_U64, TYPE_GAUGE):
+                    out[n] = c.value
+                elif c.type == TYPE_AVG:
+                    out[n] = {
+                        "avgcount": c.count,
+                        "sum": c.sum,
+                        "avgtime": c.sum / c.count if c.count else 0.0,
+                    }
+                else:
+                    top = max(
+                        (i for i, v in enumerate(c.buckets) if v), default=-1
+                    )
+                    out[n] = {
+                        "count": c.count,
+                        "sum": c.sum,
+                        "buckets": c.buckets[: top + 1],
+                    }
+        return out
+
+
+class PerfCountersCollection:
+    """All counter sets of one context; admin `perf dump` target."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._loggers: Dict[str, PerfCounters] = {}
+
+    def create(self, name: str) -> PerfCounters:
+        with self._lock:
+            pc = self._loggers.get(name)
+            if pc is None:
+                pc = self._loggers[name] = PerfCounters(name)
+            return pc
+
+    def get(self, name: str) -> Optional[PerfCounters]:
+        with self._lock:
+            return self._loggers.get(name)
+
+    def dump(self) -> Dict[str, Dict[str, object]]:
+        with self._lock:
+            loggers = list(self._loggers.items())
+        return {n: pc.dump() for n, pc in loggers}

@@ -1,0 +1,95 @@
+"""Sharded work queues — ordered parallel dispatch for the OSD op path.
+
+Reference: ThreadPool/WorkQueue (src/common/WorkQueue.h:28,266) and the
+OSD's sharded op queue (src/osd/OSD.cc:2030 op_shardedwq, OSDShard at
+:2065): items hash to a shard by ordering token (pg id), each shard is
+a thread draining a priority queue, so per-PG ordering is preserved
+while PGs run in parallel.  mClock/WPQ scheduling reduces here to a
+(priority, seq) heap per shard — QoS class weights can be layered on
+the priority without changing the structure.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Any, Callable, Hashable, List, Optional, Tuple
+
+
+class ShardedWorkQueue:
+    def __init__(
+        self,
+        name: str,
+        num_shards: int,
+        process: Callable[[Any], None],
+        on_error: Optional[Callable[[Any, BaseException], None]] = None,
+    ) -> None:
+        self.name = name
+        self.process = process
+        self.on_error = on_error
+        self._shards: List[List[Tuple[int, int, Any]]] = [
+            [] for _ in range(num_shards)
+        ]
+        self._conds = [threading.Condition() for _ in range(num_shards)]
+        self._seq = itertools.count()
+        self._stop = False
+        self._threads = [
+            threading.Thread(
+                target=self._worker, args=(i,), name=f"{name}-{i}", daemon=True
+            )
+            for i in range(num_shards)
+        ]
+        self._inflight = 0
+        self._drain_cond = threading.Condition()
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def queue(self, token: Hashable, item: Any, priority: int = 63) -> None:
+        """Higher priority dispatches first; same token stays ordered."""
+        if self._stop:
+            raise RuntimeError(f"work queue {self.name} is stopped")
+        shard = hash(token) % len(self._shards)
+        with self._drain_cond:
+            self._inflight += 1
+        with self._conds[shard]:
+            heapq.heappush(
+                self._shards[shard], (-priority, next(self._seq), item)
+            )
+            self._conds[shard].notify()
+
+    def _worker(self, i: int) -> None:
+        cond = self._conds[i]
+        q = self._shards[i]
+        while True:
+            with cond:
+                cond.wait_for(lambda: q or self._stop)
+                if self._stop and not q:
+                    return
+                _, _, item = heapq.heappop(q)
+            try:
+                self.process(item)
+            except BaseException as e:  # noqa: BLE001 — worker must survive
+                if self.on_error:
+                    self.on_error(item, e)
+            finally:
+                with self._drain_cond:
+                    self._inflight -= 1
+                    if self._inflight == 0:
+                        self._drain_cond.notify_all()
+
+    def drain(self, timeout: float | None = None) -> bool:
+        with self._drain_cond:
+            return self._drain_cond.wait_for(
+                lambda: self._inflight == 0, timeout
+            )
+
+    def stop(self) -> None:
+        self._stop = True
+        for c in self._conds:
+            with c:
+                c.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)

@@ -1,0 +1,59 @@
+// CRC-32C (Castagnoli) — the checksum the reference uses for message
+// footers, BlueStore data, and EC shard HashInfo (reference:
+// src/common/crc32c.cc dispatching to sctp/intel kernels;
+// src/osd/ECUtil.h:101 HashInfo per-shard running crc).
+//
+// Slicing-by-8 table-driven implementation; ~1 byte/cycle scalar, which
+// is plenty for the host control path (bulk data integrity on TPU goes
+// through the device-side xor-fold digests instead).
+
+#include <cstddef>
+#include <cstdint>
+
+namespace {
+
+constexpr uint32_t kPoly = 0x82f63b78u;  // reflected Castagnoli
+
+struct Tables {
+  uint32_t t[8][256];
+  Tables() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int j = 0; j < 8; ++j) c = (c & 1) ? (c >> 1) ^ kPoly : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int s = 1; s < 8; ++s)
+        t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xff];
+  }
+};
+
+const Tables& tabs() {
+  static Tables g;
+  return g;
+}
+
+}  // namespace
+
+extern "C" uint32_t ceph_tpu_crc32c(uint32_t crc, const uint8_t* data,
+                                    int64_t len) {
+  const Tables& T = tabs();
+  crc = ~crc;
+  while (len > 0 && (reinterpret_cast<uintptr_t>(data) & 7)) {
+    crc = (crc >> 8) ^ T.t[0][(crc ^ *data++) & 0xff];
+    --len;
+  }
+  while (len >= 8) {
+    uint64_t word;
+    __builtin_memcpy(&word, data, 8);
+    word ^= crc;
+    crc = T.t[7][word & 0xff] ^ T.t[6][(word >> 8) & 0xff] ^
+          T.t[5][(word >> 16) & 0xff] ^ T.t[4][(word >> 24) & 0xff] ^
+          T.t[3][(word >> 32) & 0xff] ^ T.t[2][(word >> 40) & 0xff] ^
+          T.t[1][(word >> 48) & 0xff] ^ T.t[0][(word >> 56) & 0xff];
+    data += 8;
+    len -= 8;
+  }
+  while (len-- > 0) crc = (crc >> 8) ^ T.t[0][(crc ^ *data++) & 0xff];
+  return ~crc;
+}
