@@ -58,16 +58,21 @@ class AdminSocket:
             except socket.timeout:
                 continue
             except OSError:
-                return
+                if self._stop:
+                    return
+                continue  # transient accept error; keep serving
             try:
                 data = b""
                 conn.settimeout(5.0)
-                while b"\n" not in data:
-                    chunk = conn.recv(65536)
-                    if not chunk:
-                        break
-                    data += chunk
-                reply = self._handle(data.split(b"\n", 1)[0])
+                try:
+                    while b"\n" not in data:
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            break
+                        data += chunk
+                    reply = self._handle(data.split(b"\n", 1)[0])
+                except socket.timeout:
+                    reply = b'{"error": "request timed out"}\n'
                 conn.sendall(reply)
             except OSError:
                 pass

@@ -34,7 +34,13 @@ class Option:
 
     def validate(self, value: Any) -> Any:
         if self.type is bool and isinstance(value, str):
-            value = value.lower() in ("true", "yes", "1", "on")
+            low = value.lower()
+            if low in ("true", "yes", "1", "on"):
+                value = True
+            elif low in ("false", "no", "0", "off"):
+                value = False
+            else:
+                raise ValueError(f"{self.name}: {value!r} is not a boolean")
         try:
             value = self.type(value)
         except (TypeError, ValueError) as e:
@@ -117,6 +123,7 @@ class Config:
 
     def __init__(self, overrides: Optional[Dict[str, Any]] = None) -> None:
         self._lock = threading.Lock()
+        self._started = False  # until startup_done(), non-runtime opts settable
         self._values: Dict[str, Any] = {
             n: o.default for n, o in SCHEMA.items()
         }
@@ -132,6 +139,10 @@ class Config:
                 self.set_val(k, v, apply=False)
             self._dirty.clear()
 
+    def startup_done(self) -> None:
+        """After this, options with runtime=False refuse set_val."""
+        self._started = True
+
     def get(self, name: str) -> Any:
         with self._lock:
             return self._values[name]
@@ -144,10 +155,18 @@ class Config:
         except KeyError:
             raise AttributeError(name)
 
-    def set_val(self, name: str, value: Any, apply: bool = True) -> None:
+    def set_val(self, name: str, value: Any, apply: bool = True,
+                force: bool = False) -> None:
+        """force=True bypasses the runtime-updatability guard (startup
+        parsing); admin-path callers leave it False so non-runtime
+        options reject instead of silently not taking effect."""
         opt = SCHEMA.get(name)
         if opt is None:
             raise KeyError(f"unknown option {name!r}")
+        if not opt.runtime and not force and self._started:
+            raise ValueError(
+                f"{name} is not updatable at runtime (restart required)"
+            )
         value = opt.validate(value)
         with self._lock:
             if self._values[name] != value:

@@ -77,4 +77,5 @@ def global_init(
     """Config-parse + context construction (global_init equivalent)."""
     ctx = Context(name, overrides)
     rest = ctx.conf.parse_argv(argv) if argv else []
+    ctx.conf.startup_done()  # non-runtime options frozen from here on
     return ctx, rest

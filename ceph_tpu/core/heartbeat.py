@@ -54,15 +54,19 @@ class HeartbeatMap:
     def unhealthy_workers(self) -> List[str]:
         now = time.monotonic()
         bad: List[str] = []
+        to_fire: List[str] = []
         with self._lock:
-            handles = list(self._handles.values())
-        for h in handles:
-            age = now - h.last_touch
-            # latch: the abort callback fires once per stall (reference
-            # suicide is terminal; touch() re-arms after recovery)
-            if age > h.suicide_grace and not h.suicided and self.on_suicide:
-                h.suicided = True
-                self.on_suicide(h.name)
-            if age > h.grace:
-                bad.append(h.name)
+            for h in self._handles.values():
+                age = now - h.last_touch
+                # latch under the lock: the abort callback fires once per
+                # stall even with concurrent health queries (touch()
+                # re-arms after recovery)
+                if age > h.suicide_grace and not h.suicided:
+                    h.suicided = True
+                    to_fire.append(h.name)
+                if age > h.grace:
+                    bad.append(h.name)
+        if self.on_suicide:
+            for name in to_fire:
+                self.on_suicide(name)
         return bad

@@ -22,9 +22,7 @@ SUBSYS = (
     "compressor", "scrub", "recovery", "test",
 )
 
-
-class LogEntry(Tuple[float, str, int, str, str]):
-    pass
+# ring entry shape: (unix_ts, context_name, subsys, level, message)
 
 
 class Log:

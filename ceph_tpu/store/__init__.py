@@ -1,0 +1,29 @@
+"""Local storage layer (L5): transactional object stores + KV abstraction.
+
+Reference roles: ObjectStore/Transaction (src/os/ObjectStore.h,
+src/os/Transaction.cc), MemStore (src/os/memstore/ — the test-tier fake
+backend), a journaled file-backed store standing in for
+FileStore/BlueStore (src/os/filestore/, src/os/bluestore/), and the
+pluggable KeyValueDB (src/kv/KeyValueDB.h) the metadata path rides on.
+"""
+
+from ceph_tpu.store.objectstore import (  # noqa: F401
+    Collection,
+    GHObject,
+    ObjectStore,
+    StoreError,
+    Transaction,
+)
+
+
+def create(kind: str, path: str = "", **kw):
+    """ObjectStore::create equivalent (reference: src/os/ObjectStore.cc)."""
+    if kind == "memstore":
+        from ceph_tpu.store.memstore import MemStore
+
+        return MemStore(**kw)
+    if kind == "filestore":
+        from ceph_tpu.store.filestore import FileStore
+
+        return FileStore(path, **kw)
+    raise ValueError(f"unknown objectstore {kind!r}")

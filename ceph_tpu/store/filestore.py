@@ -1,0 +1,390 @@
+"""FileStore — durable file-backed ObjectStore with a write-ahead log.
+
+Plays the reference's FileStore/BlueStore role (src/os/filestore/,
+src/os/bluestore/) with the BlueStore split: object *data* lives in
+flat files (one per object, the "block device"), object *metadata*
+(existence, xattrs, omap, collection membership) lives in a LogKV
+(the RocksDB role).  Atomicity follows the FileJournal discipline
+(src/os/filestore/FileJournal.cc): every Transaction is appended to a
+WAL with seq + crc before any apply; on mount, WAL entries newer than
+the KV's `applied_seq` are replayed (apply is replay-tolerant), then
+the WAL is trimmed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+from typing import Dict, List, Optional
+
+from ceph_tpu.core.crc import crc32c
+from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.store import objectstore as os_
+from ceph_tpu.store.kv import LogKV, WriteBatch
+from ceph_tpu.store.objectstore import (
+    Collection,
+    GHObject,
+    NoSuchCollection,
+    NoSuchObject,
+    ObjectStore,
+    StoreError,
+    Transaction,
+)
+
+# KV prefixes
+P_COLL = "C"    # coll name -> b"1"
+P_OBJ = "O"     # objkey -> b"1" (existence)
+P_XATTR = "X"   # objkey/attr -> value
+P_OMAP = "M"    # objkey/key -> value
+P_META = "S"    # store metadata (applied_seq)
+
+_WAL_HDR = struct.Struct("<QII")  # seq, body_len, crc
+
+
+def _objkey(cid: Collection, oid: GHObject) -> str:
+    return f"{cid.name}/{oid.name}/{oid.snap}/{oid.shard}"
+
+
+class FileStore(ObjectStore):
+    def __init__(self, path: str, wal_sync: bool = False) -> None:
+        self.path = path
+        self.wal_sync = wal_sync
+        self._kv = LogKV(os.path.join(path, "meta.kv"))
+        self._wal_path = os.path.join(path, "wal.log")
+        self._wal_fh = None
+        self._seq = 0
+        self._lock = threading.RLock()
+        self._mounted = False
+        # in-flight existence deltas, populated only inside _apply
+        self._pend_coll: Dict[str, bool] = {}
+        self._pend_obj: Dict[str, bool] = {}
+
+    # -- layout -----------------------------------------------------------
+    def _datafile(self, cid: Collection, oid: GHObject) -> str:
+        h = hashlib.sha1(_objkey(cid, oid).encode()).hexdigest()
+        return os.path.join(self.path, "objects", h[:2], h)
+
+    # -- lifecycle --------------------------------------------------------
+    def mkfs(self) -> None:
+        os.makedirs(os.path.join(self.path, "objects"), exist_ok=True)
+        open(self._wal_path, "wb").close()
+        self._kv.open()
+        b = WriteBatch()
+        b.set(P_META, "applied_seq", b"0")
+        self._kv.submit(b, sync=True)
+        self._kv.close()
+
+    def mount(self) -> None:
+        with self._lock:
+            self._kv.open()
+            applied = int(self._kv.get(P_META, "applied_seq") or b"0")
+            self._seq = applied
+            self._replay_wal(applied)
+            self._wal_fh = open(self._wal_path, "ab")
+            self._mounted = True
+
+    def umount(self) -> None:
+        with self._lock:
+            if self._wal_fh:
+                self._wal_fh.close()
+                self._wal_fh = None
+            self._trim_wal()
+            self._kv.close()
+            self._mounted = False
+
+    def _replay_wal(self, applied: int) -> None:
+        if not os.path.exists(self._wal_path):
+            return
+        with open(self._wal_path, "rb") as f:
+            raw = f.read()
+        off = 0
+        while off + _WAL_HDR.size <= len(raw):
+            seq, blen, want = _WAL_HDR.unpack_from(raw, off)
+            body = raw[off + _WAL_HDR.size: off + _WAL_HDR.size + blen]
+            if len(body) < blen or crc32c(body) != want:
+                break  # torn tail
+            if seq > applied:
+                t = Transaction.from_bytes(body)
+                self._apply(t, seq, replay=True)
+            self._seq = max(self._seq, seq)
+            off += _WAL_HDR.size + blen
+
+    def _trim_wal(self) -> None:
+        open(self._wal_path, "wb").close()
+
+    # -- transaction apply ------------------------------------------------
+    def queue_transaction(self, t: Transaction) -> None:
+        with self._lock:
+            assert self._mounted, "not mounted"
+            self._seq += 1
+            seq = self._seq
+            body = t.to_bytes()
+            self._wal_fh.write(_WAL_HDR.pack(seq, len(body), crc32c(body)))
+            self._wal_fh.write(body)
+            self._wal_fh.flush()
+            if self.wal_sync:
+                os.fsync(self._wal_fh.fileno())
+            self._apply(t, seq, replay=False)
+
+    def _apply(self, t: Transaction, seq: int, replay: bool) -> None:
+        b = WriteBatch()
+        # ops within one transaction must see each other's effects before
+        # the KV batch lands (e.g. mkcoll + write in the same txn), so
+        # track in-flight existence deltas alongside the batch
+        self._pend_coll.clear()
+        self._pend_obj.clear()
+        try:
+            for op in t.ops:
+                self._apply_op(op, b, replay)
+            b.set(P_META, "applied_seq", str(seq).encode())
+            self._kv.submit(b)
+        finally:
+            self._pend_coll.clear()
+            self._pend_obj.clear()
+
+    def _coll_exists_pending(self, cid: Collection) -> bool:
+        p = self._pend_coll.get(cid.name)
+        if p is not None:
+            return p
+        return self._kv.get(P_COLL, cid.name) is not None
+
+    def _exists_kv(self, cid: Collection, oid: GHObject) -> bool:
+        key = _objkey(cid, oid)
+        p = self._pend_obj.get(key)
+        if p is not None:
+            return p
+        return self._kv.get(P_OBJ, key) is not None
+
+    def _require(self, cid: Collection, oid: GHObject, replay: bool) -> bool:
+        """True if present; on replay missing objects are tolerated."""
+        if not self._coll_exists_pending(cid):
+            if replay:
+                return False
+            raise NoSuchCollection(cid.name)
+        if not self._exists_kv(cid, oid):
+            if replay:
+                return False
+            raise NoSuchObject(f"{cid.name}/{oid.name}")
+        return True
+
+    def _apply_op(self, op: os_.Op, b: WriteBatch, replay: bool) -> None:
+        code = op.op
+        key = _objkey(op.cid, op.oid) if op.oid else ""
+        if code == os_.OP_NOP:
+            return
+        if code == os_.OP_MKCOLL:
+            if self._coll_exists_pending(op.cid) and not replay:
+                raise StoreError(f"collection exists: {op.cid.name}")
+            b.set(P_COLL, op.cid.name, b"1")
+            self._pend_coll[op.cid.name] = True
+            return
+        if code == os_.OP_RMCOLL:
+            b.rmkey(P_COLL, op.cid.name)
+            self._pend_coll[op.cid.name] = False
+            return
+        if code in (os_.OP_TOUCH, os_.OP_WRITE, os_.OP_ZERO, os_.OP_TRUNCATE,
+                    os_.OP_SETATTRS, os_.OP_OMAP_SETKEYS):
+            if not self._coll_exists_pending(op.cid):
+                if replay:
+                    return
+                raise NoSuchCollection(op.cid.name)
+            b.set(P_OBJ, key, b"1")
+            self._pend_obj[key] = True
+        if code == os_.OP_TOUCH:
+            self._data_write(op.cid, op.oid, 0, b"")
+            return
+        if code == os_.OP_WRITE:
+            self._data_write(op.cid, op.oid, op.off, op.data)
+            return
+        if code == os_.OP_ZERO:
+            self._data_write(op.cid, op.oid, op.off, b"\0" * op.length)
+            return
+        if code == os_.OP_TRUNCATE:
+            path = self._datafile(op.cid, op.oid)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "ab") as f:
+                pass
+            size = op.off
+            with open(path, "r+b") as f:
+                f.truncate(size)
+            return
+        if code == os_.OP_REMOVE:
+            if not self._require(op.cid, op.oid, replay):
+                return
+            b.rmkey(P_OBJ, key)
+            self._pend_obj[key] = False
+            for k, _ in list(self._kv.iterate(P_XATTR)):
+                if k.startswith(key + "/"):
+                    b.rmkey(P_XATTR, k)
+            for k, _ in list(self._kv.iterate(P_OMAP)):
+                if k.startswith(key + "/"):
+                    b.rmkey(P_OMAP, k)
+            try:
+                os.unlink(self._datafile(op.cid, op.oid))
+            except FileNotFoundError:
+                pass
+            return
+        if code == os_.OP_SETATTRS:
+            for name, val in op.attrs.items():
+                b.set(P_XATTR, f"{key}/{name}", val)
+            return
+        if code == os_.OP_RMATTR:
+            if not self._require(op.cid, op.oid, replay):
+                return
+            b.rmkey(P_XATTR, f"{key}/{op.keys[0]}")
+            return
+        if code == os_.OP_CLONE:
+            if not self._require(op.cid, op.oid, replay):
+                return
+            dkey = _objkey(op.cid, op.dest_oid)
+            b.set(P_OBJ, dkey, b"1")
+            self._pend_obj[dkey] = True
+            src_file = self._datafile(op.cid, op.oid)
+            dst_file = self._datafile(op.cid, op.dest_oid)
+            os.makedirs(os.path.dirname(dst_file), exist_ok=True)
+            data = b""
+            if os.path.exists(src_file):
+                with open(src_file, "rb") as f:
+                    data = f.read()
+            with open(dst_file, "wb") as f:
+                f.write(data)
+            for k, v in list(self._kv.iterate(P_XATTR)):
+                if k.startswith(key + "/"):
+                    b.set(P_XATTR, dkey + k[len(key):], v)
+            for k, v in list(self._kv.iterate(P_OMAP)):
+                if k.startswith(key + "/"):
+                    b.set(P_OMAP, dkey + k[len(key):], v)
+            return
+        if code == os_.OP_OMAP_SETKEYS:
+            for name, val in op.attrs.items():
+                b.set(P_OMAP, f"{key}/{name}", val)
+            return
+        if code == os_.OP_OMAP_RMKEYS:
+            if not self._require(op.cid, op.oid, replay):
+                return
+            for name in op.keys:
+                b.rmkey(P_OMAP, f"{key}/{name}")
+            return
+        if code == os_.OP_OMAP_CLEAR:
+            if not self._require(op.cid, op.oid, replay):
+                return
+            for k, _ in list(self._kv.iterate(P_OMAP)):
+                if k.startswith(key + "/"):
+                    b.rmkey(P_OMAP, k)
+            return
+        if code == os_.OP_COLL_MOVE_RENAME:
+            if not self._require(op.cid, op.oid, replay):
+                return
+            dkey = _objkey(op.dest_cid, op.dest_oid)
+            b.rmkey(P_OBJ, key)
+            b.set(P_OBJ, dkey, b"1")
+            self._pend_obj[key] = False
+            self._pend_obj[dkey] = True
+            src_file = self._datafile(op.cid, op.oid)
+            dst_file = self._datafile(op.dest_cid, op.dest_oid)
+            os.makedirs(os.path.dirname(dst_file), exist_ok=True)
+            if os.path.exists(src_file):
+                os.replace(src_file, dst_file)
+            for k, v in list(self._kv.iterate(P_XATTR)):
+                if k.startswith(key + "/"):
+                    b.set(P_XATTR, dkey + k[len(key):], v)
+                    b.rmkey(P_XATTR, k)
+            for k, v in list(self._kv.iterate(P_OMAP)):
+                if k.startswith(key + "/"):
+                    b.set(P_OMAP, dkey + k[len(key):], v)
+                    b.rmkey(P_OMAP, k)
+            return
+        raise StoreError(f"unknown op {code}")
+
+    def _data_write(self, cid: Collection, oid: GHObject, off: int,
+                    data: bytes) -> None:
+        path = self._datafile(cid, oid)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "ab"):
+            pass
+        with open(path, "r+b") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            if size < off:
+                f.write(b"\0" * (off - size))
+            f.seek(off)
+            f.write(data)
+
+    # -- reads ------------------------------------------------------------
+    def _check(self, cid: Collection, oid: GHObject) -> None:
+        if self._kv.get(P_COLL, cid.name) is None:
+            raise NoSuchCollection(cid.name)
+        if not self._exists_kv(cid, oid):
+            raise NoSuchObject(f"{cid.name}/{oid.name}")
+
+    def exists(self, cid: Collection, oid: GHObject) -> bool:
+        with self._lock:
+            return (self._kv.get(P_COLL, cid.name) is not None
+                    and self._exists_kv(cid, oid))
+
+    def read(self, cid: Collection, oid: GHObject, off: int = 0,
+             length: int = 0) -> bytes:
+        with self._lock:
+            self._check(cid, oid)
+            path = self._datafile(cid, oid)
+            if not os.path.exists(path):
+                return b""
+            with open(path, "rb") as f:
+                f.seek(off)
+                return f.read() if length == 0 else f.read(length)
+
+    def stat(self, cid: Collection, oid: GHObject) -> int:
+        with self._lock:
+            self._check(cid, oid)
+            path = self._datafile(cid, oid)
+            return os.path.getsize(path) if os.path.exists(path) else 0
+
+    def getattr(self, cid: Collection, oid: GHObject, name: str) -> bytes:
+        with self._lock:
+            self._check(cid, oid)
+            v = self._kv.get(P_XATTR, f"{_objkey(cid, oid)}/{name}")
+            if v is None:
+                raise StoreError(f"no attr {name!r} on {oid.name}")
+            return v
+
+    def getattrs(self, cid: Collection, oid: GHObject) -> Dict[str, bytes]:
+        with self._lock:
+            self._check(cid, oid)
+            key = _objkey(cid, oid) + "/"
+            return {
+                k[len(key):]: v
+                for k, v in self._kv.iterate(P_XATTR)
+                if k.startswith(key)
+            }
+
+    def omap_get(self, cid: Collection, oid: GHObject) -> Dict[str, bytes]:
+        with self._lock:
+            self._check(cid, oid)
+            key = _objkey(cid, oid) + "/"
+            return {
+                k[len(key):]: v
+                for k, v in self._kv.iterate(P_OMAP)
+                if k.startswith(key)
+            }
+
+    def list_collections(self) -> List[Collection]:
+        with self._lock:
+            return [Collection(k) for k, _ in self._kv.iterate(P_COLL)]
+
+    def collection_exists(self, cid: Collection) -> bool:
+        with self._lock:
+            return self._kv.get(P_COLL, cid.name) is not None
+
+    def collection_list(self, cid: Collection) -> List[GHObject]:
+        with self._lock:
+            if self._kv.get(P_COLL, cid.name) is None:
+                raise NoSuchCollection(cid.name)
+            out = []
+            pre = cid.name + "/"
+            for k, _ in self._kv.iterate(P_OBJ):
+                if k.startswith(pre):
+                    name, snap, shard = k[len(pre):].rsplit("/", 2)
+                    out.append(GHObject(name, int(snap), int(shard)))
+            return sorted(out)

@@ -1,0 +1,200 @@
+"""MemStore — the in-RAM ObjectStore for tests and in-process clusters.
+
+Reference: src/os/memstore/ (SURVEY.md §2.1 "MemStore = in-RAM fake
+backend used by tests"); same role here, plus it is the default backend
+of the tier-2 in-process mini-cluster.  Transactions apply atomically
+under one lock with all-or-nothing semantics (ops are validated before
+any mutation).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from ceph_tpu.store import objectstore as os_
+from ceph_tpu.store.objectstore import (
+    Collection,
+    GHObject,
+    NoSuchCollection,
+    NoSuchObject,
+    ObjectStore,
+    StoreError,
+    Transaction,
+)
+
+
+class _Obj:
+    __slots__ = ("data", "xattrs", "omap")
+
+    def __init__(self) -> None:
+        self.data = bytearray()
+        self.xattrs: Dict[str, bytes] = {}
+        self.omap: Dict[str, bytes] = {}
+
+    def clone(self) -> "_Obj":
+        o = _Obj()
+        o.data = bytearray(self.data)
+        o.xattrs = dict(self.xattrs)
+        o.omap = dict(self.omap)
+        return o
+
+
+class MemStore(ObjectStore):
+    def __init__(self) -> None:
+        self._colls: Dict[Collection, Dict[GHObject, _Obj]] = {}
+        self._lock = threading.RLock()
+        self._mounted = False
+
+    # -- lifecycle --------------------------------------------------------
+    def mkfs(self) -> None:
+        with self._lock:
+            self._colls = {}
+
+    def mount(self) -> None:
+        self._mounted = True
+
+    def umount(self) -> None:
+        self._mounted = False
+
+    # -- transaction apply ------------------------------------------------
+    def queue_transaction(self, t: Transaction) -> None:
+        with self._lock:
+            for op in t.ops:
+                self._apply(op)
+
+    def _coll(self, cid: Collection) -> Dict[GHObject, _Obj]:
+        c = self._colls.get(cid)
+        if c is None:
+            raise NoSuchCollection(str(cid))
+        return c
+
+    def _obj(self, cid: Collection, oid: GHObject, create: bool = False) -> _Obj:
+        c = self._coll(cid)
+        o = c.get(oid)
+        if o is None:
+            if not create:
+                raise NoSuchObject(f"{cid.name}/{oid.name}")
+            o = c[oid] = _Obj()
+        return o
+
+    def _apply(self, op: os_.Op) -> None:
+        code = op.op
+        if code == os_.OP_NOP:
+            return
+        if code == os_.OP_MKCOLL:
+            if op.cid in self._colls:
+                raise StoreError(f"collection exists: {op.cid.name}")
+            self._colls[op.cid] = {}
+            return
+        if code == os_.OP_RMCOLL:
+            c = self._coll(op.cid)
+            if c:
+                raise StoreError(f"collection not empty: {op.cid.name}")
+            del self._colls[op.cid]
+            return
+        if code == os_.OP_TOUCH:
+            self._obj(op.cid, op.oid, create=True)
+            return
+        if code == os_.OP_WRITE:
+            o = self._obj(op.cid, op.oid, create=True)
+            end = op.off + len(op.data)
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[op.off:end] = op.data
+            return
+        if code == os_.OP_ZERO:
+            o = self._obj(op.cid, op.oid)
+            end = op.off + op.length
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[op.off:end] = b"\0" * op.length
+            return
+        if code == os_.OP_TRUNCATE:
+            o = self._obj(op.cid, op.oid, create=True)
+            size = op.off
+            if len(o.data) > size:
+                del o.data[size:]
+            else:
+                o.data.extend(b"\0" * (size - len(o.data)))
+            return
+        if code == os_.OP_REMOVE:
+            c = self._coll(op.cid)
+            if op.oid not in c:
+                raise NoSuchObject(op.oid.name)
+            del c[op.oid]
+            return
+        if code == os_.OP_SETATTRS:
+            self._obj(op.cid, op.oid, create=True).xattrs.update(op.attrs)
+            return
+        if code == os_.OP_RMATTR:
+            self._obj(op.cid, op.oid).xattrs.pop(op.keys[0], None)
+            return
+        if code == os_.OP_CLONE:
+            src = self._obj(op.cid, op.oid)
+            self._coll(op.cid)[op.dest_oid] = src.clone()
+            return
+        if code == os_.OP_OMAP_SETKEYS:
+            self._obj(op.cid, op.oid, create=True).omap.update(op.attrs)
+            return
+        if code == os_.OP_OMAP_RMKEYS:
+            o = self._obj(op.cid, op.oid)
+            for k in op.keys:
+                o.omap.pop(k, None)
+            return
+        if code == os_.OP_OMAP_CLEAR:
+            self._obj(op.cid, op.oid).omap.clear()
+            return
+        if code == os_.OP_COLL_MOVE_RENAME:
+            src_c = self._coll(op.cid)
+            if op.oid not in src_c:
+                raise NoSuchObject(op.oid.name)
+            dst_c = self._coll(op.dest_cid)
+            dst_c[op.dest_oid] = src_c.pop(op.oid)
+            return
+        raise StoreError(f"unknown op {code}")
+
+    # -- reads ------------------------------------------------------------
+    def exists(self, cid: Collection, oid: GHObject) -> bool:
+        with self._lock:
+            c = self._colls.get(cid)
+            return c is not None and oid in c
+
+    def read(self, cid: Collection, oid: GHObject, off: int = 0,
+             length: int = 0) -> bytes:
+        with self._lock:
+            o = self._obj(cid, oid)
+            if length == 0:
+                return bytes(o.data[off:])
+            return bytes(o.data[off:off + length])
+
+    def stat(self, cid: Collection, oid: GHObject) -> int:
+        with self._lock:
+            return len(self._obj(cid, oid).data)
+
+    def getattr(self, cid: Collection, oid: GHObject, name: str) -> bytes:
+        with self._lock:
+            o = self._obj(cid, oid)
+            if name not in o.xattrs:
+                raise StoreError(f"no attr {name!r} on {oid.name}")
+            return o.xattrs[name]
+
+    def getattrs(self, cid: Collection, oid: GHObject) -> Dict[str, bytes]:
+        with self._lock:
+            return dict(self._obj(cid, oid).xattrs)
+
+    def omap_get(self, cid: Collection, oid: GHObject) -> Dict[str, bytes]:
+        with self._lock:
+            return dict(self._obj(cid, oid).omap)
+
+    def list_collections(self) -> List[Collection]:
+        with self._lock:
+            return sorted(self._colls.keys())
+
+    def collection_exists(self, cid: Collection) -> bool:
+        with self._lock:
+            return cid in self._colls
+
+    def collection_list(self, cid: Collection) -> List[GHObject]:
+        with self._lock:
+            return sorted(self._coll(cid).keys())

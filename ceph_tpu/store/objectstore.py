@@ -1,0 +1,268 @@
+"""ObjectStore — the abstract transactional object API.
+
+Reference: src/os/ObjectStore.h + src/os/Transaction.cc. The contract
+the OSD's PG engine is written against: named collections (one per PG)
+holding objects with byte extents, xattrs, and an omap; all mutations
+batched into atomic, ordered Transactions; reads are unordered.
+
+A Transaction is an encodable op list (the reference's op codes at
+src/os/ObjectStore.h Transaction::OP_*) so the same bytes can be
+carried inside replication messages (the EC sub-write payload) and
+replayed from the journal — exactly how the reference ships
+transactions to replica shards.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ceph_tpu.core.encoding import Decoder, Encoder
+
+
+class StoreError(Exception):
+    pass
+
+
+class NoSuchObject(StoreError):
+    pass
+
+
+class NoSuchCollection(StoreError):
+    pass
+
+
+@dataclass(frozen=True, order=True)
+class GHObject:
+    """Object id within a collection (hobject_t/ghobject_t analog:
+    reference src/common/hobject.h — name + key hash + snap + shard)."""
+
+    name: str
+    snap: int = -2  # -2 = head (CEPH_NOSNAP analog)
+    shard: int = -1  # -1 = no shard (replicated); >=0 = EC shard id
+
+    def encode(self, e: Encoder) -> None:
+        e.string(self.name).s64(self.snap).s32(self.shard)
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "GHObject":
+        return cls(d.string(), d.s64(), d.s32())
+
+
+@dataclass(frozen=True, order=True)
+class Collection:
+    """Collection id — one per PG (+ metadata col), e.g. '2.1f_head'."""
+
+    name: str
+
+    def encode(self, e: Encoder) -> None:
+        e.string(self.name)
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "Collection":
+        return cls(d.string())
+
+
+META_COLL = Collection("meta")
+
+# Transaction op codes (subset of reference OP_* that the PG engine uses)
+OP_NOP = 0
+OP_TOUCH = 1
+OP_WRITE = 2
+OP_ZERO = 3
+OP_TRUNCATE = 4
+OP_REMOVE = 5
+OP_SETATTRS = 6
+OP_RMATTR = 7
+OP_CLONE = 8
+OP_MKCOLL = 9
+OP_RMCOLL = 10
+OP_OMAP_SETKEYS = 11
+OP_OMAP_RMKEYS = 12
+OP_OMAP_CLEAR = 13
+OP_COLL_MOVE_RENAME = 14
+
+
+@dataclass
+class Op:
+    op: int
+    cid: Collection
+    oid: Optional[GHObject] = None
+    off: int = 0
+    length: int = 0
+    data: bytes = b""
+    attrs: Dict[str, bytes] = field(default_factory=dict)
+    keys: List[str] = field(default_factory=list)
+    dest_cid: Optional[Collection] = None
+    dest_oid: Optional[GHObject] = None
+
+    def encode(self, e: Encoder) -> None:
+        e.start(1, 1)
+        e.u8(self.op)
+        self.cid.encode(e)
+        e.optional(self.oid, lambda enc, o: o.encode(enc))
+        e.u64(self.off).u64(self.length).blob(self.data)
+        e.mapping(self.attrs, lambda enc, k: enc.string(k),
+                  lambda enc, v: enc.blob(v))
+        e.seq(self.keys, lambda enc, k: enc.string(k))
+        e.optional(self.dest_cid, lambda enc, c: c.encode(enc))
+        e.optional(self.dest_oid, lambda enc, o: o.encode(enc))
+        e.finish()
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "Op":
+        d.start(1)
+        out = cls(
+            op=d.u8(),
+            cid=Collection.decode(d),
+            oid=d.optional(GHObject.decode),
+            off=d.u64(),
+            length=d.u64(),
+            data=d.blob(),
+            attrs=d.mapping(lambda dd: dd.string(), lambda dd: dd.blob()),
+            keys=d.seq(lambda dd: dd.string()),
+            dest_cid=d.optional(Collection.decode),
+            dest_oid=d.optional(GHObject.decode),
+        )
+        d.end()
+        return out
+
+
+class Transaction:
+    """Atomic batch of mutations; encodable for journal + replication."""
+
+    def __init__(self) -> None:
+        self.ops: List[Op] = []
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    def append(self, other: "Transaction") -> None:
+        self.ops.extend(other.ops)
+
+    # -- builders ---------------------------------------------------------
+    def touch(self, cid: Collection, oid: GHObject) -> None:
+        self.ops.append(Op(OP_TOUCH, cid, oid))
+
+    def write(self, cid: Collection, oid: GHObject, off: int, data: bytes) -> None:
+        self.ops.append(Op(OP_WRITE, cid, oid, off=off, length=len(data),
+                           data=bytes(data)))
+
+    def zero(self, cid: Collection, oid: GHObject, off: int, length: int) -> None:
+        self.ops.append(Op(OP_ZERO, cid, oid, off=off, length=length))
+
+    def truncate(self, cid: Collection, oid: GHObject, size: int) -> None:
+        self.ops.append(Op(OP_TRUNCATE, cid, oid, off=size))
+
+    def remove(self, cid: Collection, oid: GHObject) -> None:
+        self.ops.append(Op(OP_REMOVE, cid, oid))
+
+    def setattrs(self, cid: Collection, oid: GHObject, attrs: Dict[str, bytes]) -> None:
+        self.ops.append(Op(OP_SETATTRS, cid, oid, attrs=dict(attrs)))
+
+    def rmattr(self, cid: Collection, oid: GHObject, name: str) -> None:
+        self.ops.append(Op(OP_RMATTR, cid, oid, keys=[name]))
+
+    def clone(self, cid: Collection, src: GHObject, dst: GHObject) -> None:
+        self.ops.append(Op(OP_CLONE, cid, src, dest_oid=dst))
+
+    def create_collection(self, cid: Collection) -> None:
+        self.ops.append(Op(OP_MKCOLL, cid))
+
+    def remove_collection(self, cid: Collection) -> None:
+        self.ops.append(Op(OP_RMCOLL, cid))
+
+    def omap_setkeys(self, cid: Collection, oid: GHObject,
+                     kv: Dict[str, bytes]) -> None:
+        self.ops.append(Op(OP_OMAP_SETKEYS, cid, oid, attrs=dict(kv)))
+
+    def omap_rmkeys(self, cid: Collection, oid: GHObject, keys: List[str]) -> None:
+        self.ops.append(Op(OP_OMAP_RMKEYS, cid, oid, keys=list(keys)))
+
+    def omap_clear(self, cid: Collection, oid: GHObject) -> None:
+        self.ops.append(Op(OP_OMAP_CLEAR, cid, oid))
+
+    def coll_move_rename(self, src_cid: Collection, src: GHObject,
+                         dst_cid: Collection, dst: GHObject) -> None:
+        self.ops.append(Op(OP_COLL_MOVE_RENAME, src_cid, src,
+                           dest_cid=dst_cid, dest_oid=dst))
+
+    # -- wire -------------------------------------------------------------
+    def encode(self, e: Encoder) -> None:
+        e.start(1, 1)
+        e.seq(self.ops, lambda enc, op: op.encode(enc))
+        e.finish()
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "Transaction":
+        d.start(1)
+        t = cls()
+        t.ops = d.seq(Op.decode)
+        d.end()
+        return t
+
+    def to_bytes(self) -> bytes:
+        e = Encoder()
+        self.encode(e)
+        return e.bytes()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Transaction":
+        return cls.decode(Decoder(data))
+
+
+class ObjectStore:
+    """Abstract backend. Writes go through queue_transaction; reads are
+    direct.  `queue_transaction` is synchronous-apply here (the
+    reference's commit callback collapses to the return), but backends
+    must make the batch atomic & durable as a unit."""
+
+    # -- lifecycle --------------------------------------------------------
+    def mkfs(self) -> None:
+        raise NotImplementedError
+
+    def mount(self) -> None:
+        raise NotImplementedError
+
+    def umount(self) -> None:
+        raise NotImplementedError
+
+    # -- writes -----------------------------------------------------------
+    def queue_transaction(self, t: Transaction) -> None:
+        raise NotImplementedError
+
+    # -- reads ------------------------------------------------------------
+    def exists(self, cid: Collection, oid: GHObject) -> bool:
+        raise NotImplementedError
+
+    def read(self, cid: Collection, oid: GHObject, off: int = 0,
+             length: int = 0) -> bytes:
+        """length==0 → read to end."""
+        raise NotImplementedError
+
+    def stat(self, cid: Collection, oid: GHObject) -> int:
+        """Returns size; raises NoSuchObject."""
+        raise NotImplementedError
+
+    def getattr(self, cid: Collection, oid: GHObject, name: str) -> bytes:
+        raise NotImplementedError
+
+    def getattrs(self, cid: Collection, oid: GHObject) -> Dict[str, bytes]:
+        raise NotImplementedError
+
+    def omap_get(self, cid: Collection, oid: GHObject) -> Dict[str, bytes]:
+        raise NotImplementedError
+
+    def omap_get_values(self, cid: Collection, oid: GHObject,
+                        keys: List[str]) -> Dict[str, bytes]:
+        omap = self.omap_get(cid, oid)
+        return {k: omap[k] for k in keys if k in omap}
+
+    def list_collections(self) -> List[Collection]:
+        raise NotImplementedError
+
+    def collection_exists(self, cid: Collection) -> bool:
+        raise NotImplementedError
+
+    def collection_list(self, cid: Collection) -> List[GHObject]:
+        raise NotImplementedError
