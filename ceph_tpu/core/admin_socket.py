@@ -60,7 +60,12 @@ class AdminSocket:
             except OSError:
                 if self._stop:
                     return
-                continue  # transient accept error; keep serving
+                # transient accept error (e.g. EMFILE): back off instead
+                # of spinning a core while the condition persists
+                import time
+
+                time.sleep(0.25)
+                continue
             try:
                 data = b""
                 conn.settimeout(5.0)

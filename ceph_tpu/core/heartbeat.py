@@ -60,8 +60,10 @@ class HeartbeatMap:
                 age = now - h.last_touch
                 # latch under the lock: the abort callback fires once per
                 # stall even with concurrent health queries (touch()
-                # re-arms after recovery)
-                if age > h.suicide_grace and not h.suicided:
+                # re-arms after recovery); only latch when a callback is
+                # installed so one registered later still sees the stall
+                if (age > h.suicide_grace and not h.suicided
+                        and self.on_suicide is not None):
                     h.suicided = True
                     to_fire.append(h.name)
                 if age > h.grace:

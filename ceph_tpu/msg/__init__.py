@@ -1,0 +1,14 @@
+"""Wire layer (L2): typed messages + async messenger.
+
+Reference roles: Messenger/Dispatcher/Message (src/msg/Messenger.h,
+src/msg/Dispatcher.h, src/msg/Message.h) and the AsyncMessenger event
+loop with ordered lossless sessions (src/msg/async/AsyncConnection.h:49
+state machine, src/msg/async/Event.h:87 EventCenter).  The transport
+here is asyncio TCP (one loop thread per messenger, the single-reactor
+shape the reference's crimson prototype was moving toward); bulk shard
+payloads between TPU-resident peers ride jax collectives instead
+(SURVEY.md §2.4) — this layer carries control and host-resident data.
+"""
+
+from ceph_tpu.msg.message import Message, EntityName, register  # noqa: F401
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger  # noqa: F401

@@ -1,0 +1,126 @@
+"""Typed messages with versioned encode/decode and a type registry.
+
+Reference: src/msg/Message.h (header: type/seq/tid/priority/src;
+footer crc; decode_message dispatch by header.type over ~200 types in
+src/messages/).  Subclasses register a type code and implement
+encode_payload/decode_payload via ceph_tpu.core.encoding; the messenger
+frames them with length + crc32c (the reference footer's data crc,
+gated by ms_crc_data).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Type
+
+from ceph_tpu.core.encoding import Decoder, Encoder
+
+
+@dataclass(frozen=True)
+class EntityName:
+    """osd.3 / mon.0 / client.4123 (reference entity_name_t)."""
+
+    kind: str
+    num: int
+
+    def __str__(self) -> str:
+        return f"{self.kind}.{self.num}"
+
+    @classmethod
+    def parse(cls, s: str) -> "EntityName":
+        kind, num = s.rsplit(".", 1)
+        return cls(kind, int(num))
+
+    def encode(self, e: Encoder) -> None:
+        e.string(self.kind).s64(self.num)
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "EntityName":
+        return cls(d.string(), d.s64())
+
+
+MSG_REGISTRY: Dict[int, Type["Message"]] = {}
+
+
+def register(cls: Type["Message"]) -> Type["Message"]:
+    code = cls.TYPE
+    assert code not in MSG_REGISTRY, f"duplicate message type {code}"
+    MSG_REGISTRY[code] = cls
+    return cls
+
+
+class Message:
+    """Base message. Subclasses: TYPE (int), VERSION/COMPAT, payload codec."""
+
+    TYPE = 0
+    VERSION = 1
+    COMPAT = 1
+
+    def __init__(self) -> None:
+        self.seq = 0          # per-session ordering, set by the connection
+        self.tid = 0          # transaction id, set by the sender
+        self.priority = 63
+        self.src: Optional[EntityName] = None
+        self.ack_seq = 0      # piggybacked cumulative ack
+        self.nonce = 0        # sender incarnation (reference addr nonce):
+                              # receivers key dup-suppression state by
+                              # (src, nonce) so a restarted peer's fresh
+                              # seq space isn't confused with the old one
+
+    # -- subclass hooks ---------------------------------------------------
+    def encode_payload(self, e: Encoder) -> None:
+        pass
+
+    def decode_payload(self, d: Decoder) -> None:
+        pass
+
+    # -- framing ----------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        e = Encoder()
+        e.u16(self.TYPE)
+        e.start(self.VERSION, self.COMPAT)
+        e.u64(self.seq).u64(self.tid).u8(self.priority).u64(self.ack_seq)
+        e.u64(self.nonce)
+        e.optional(self.src, lambda enc, s: s.encode(enc))
+        self.encode_payload(e)
+        e.finish()
+        return e.bytes()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "Message":
+        d = Decoder(data)
+        code = d.u16()
+        cls = MSG_REGISTRY.get(code)
+        if cls is None:
+            raise ValueError(f"unknown message type {code}")
+        msg = cls.__new__(cls)
+        Message.__init__(msg)
+        d.start(cls.VERSION)  # we understand encodings up to our VERSION
+        msg.seq = d.u64()
+        msg.tid = d.u64()
+        msg.priority = d.u8()
+        msg.ack_seq = d.u64()
+        msg.nonce = d.u64()
+        msg.src = d.optional(EntityName.decode)
+        msg.decode_payload(d)
+        d.end()
+        return msg
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}(seq={self.seq} tid={self.tid} "
+                f"src={self.src})")
+
+
+@register
+class MPing(Message):
+    """Liveness probe (reference: src/messages/MPing.h)."""
+
+    TYPE = 1
+
+
+@register
+class MAck(Message):
+    """Explicit ack carrier when there's no reverse traffic to piggyback
+    on (reference: the ack tag in the wire protocol)."""
+
+    TYPE = 2

@@ -1,0 +1,381 @@
+"""Async messenger: ordered, lossless, reconnecting TCP sessions.
+
+Reference: AsyncMessenger (src/msg/async/) — an event loop owning all
+connections, with session policies and throttle-based flow control:
+
+- ordered delivery per session (header.seq; duplicates after reconnect
+  are dropped by in_seq, the AsyncConnection resend discipline)
+- lossless-peer policy: unacked messages are replayed on reconnect
+  (acks piggyback on reverse traffic, MAck otherwise)
+- dispatch throttle: ms_dispatch_throttle_bytes of queued undispatched
+  bytes apply backpressure to the socket (reference policy throttles,
+  src/msg/Policy.h)
+- fast-dispatch analog: dispatchers run on a per-connection ordered
+  task, so one slow peer never stalls others
+
+One asyncio loop runs in a background thread per Messenger; public
+send/stop APIs are thread-safe, so daemon code stays synchronous.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.core.crc import crc32c
+from ceph_tpu.msg.message import MAck, Message
+
+_FRAME = struct.Struct("<II")  # body_len, crc32c(body)
+
+Addr = Tuple[str, int]
+
+
+class Dispatcher:
+    """Reference src/msg/Dispatcher.h."""
+
+    def ms_dispatch(self, conn: "Connection", msg: Message) -> bool:
+        """Return True if handled; first dispatcher to claim it wins."""
+        raise NotImplementedError
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        """Session dropped and could not be restored."""
+
+
+class Connection:
+    """One ordered session to a peer address."""
+
+    def __init__(self, msgr: "Messenger", addr: Addr) -> None:
+        self.msgr = msgr
+        self.peer_addr = addr
+        self.out_seq = 0
+        self.in_seq = 0
+        self.acked = 0
+        self._unacked: List[Tuple[int, bytes]] = []  # (seq, frame)
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._send_q: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+        self._lock = threading.Lock()
+
+    # -- sender side ------------------------------------------------------
+    def send(self, msg: Message) -> None:
+        """Thread-safe enqueue; ordering = call order."""
+        self.msgr._loop_call(self._enqueue, msg)
+
+    def _enqueue(self, msg: Message) -> None:
+        if self._closed:
+            return
+        self.out_seq += 1
+        msg.seq = self.out_seq
+        msg.ack_seq = self.in_seq  # piggyback
+        msg.nonce = self.msgr.nonce
+        if msg.src is None:
+            msg.src = self.msgr.entity
+        body = msg.to_bytes()
+        frame = _FRAME.pack(len(body),
+                            crc32c(body) if self.msgr.crc_data else 0) + body
+        self._unacked.append((msg.seq, frame))
+        self._send_q.put_nowait(frame)
+
+    def _handle_ack(self, ack_seq: int) -> None:
+        if ack_seq > self.acked:
+            self.acked = ack_seq
+            self._unacked = [(s, f) for s, f in self._unacked if s > ack_seq]
+
+    def close(self) -> None:
+        self.msgr._loop_call(self._close)
+
+    def _close(self) -> None:
+        self._closed = True
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._send_q.put_nowait(None)  # wake the writer task
+
+    def __repr__(self) -> str:
+        return f"Connection(to={self.peer_addr})"
+
+
+class Messenger:
+    def __init__(
+        self,
+        ctx,
+        entity,
+        bind_ip: str = "127.0.0.1",
+        bind_port: int = 0,
+    ) -> None:
+        self.ctx = ctx
+        self.entity = entity
+        # incarnation nonce: dup-suppression state on peers is keyed by
+        # (src entity, nonce) so a restarted messenger starts a fresh
+        # seq space (reference: entity_addr_t nonce)
+        import random
+
+        self.nonce = random.getrandbits(63) | 1
+        self.crc_data = bool(ctx.conf.get("ms_crc_data")) if ctx else True
+        self._retry = ctx.conf.get("ms_retry_interval") if ctx else 0.2
+        self._dispatchers: List[Dispatcher] = []
+        self._conns: Dict[Addr, Connection] = {}
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name=f"msgr-{entity}", daemon=True
+        )
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.addr: Optional[Addr] = None
+        self._bind = (bind_ip, bind_port)
+        self._stopped = False
+        throttle_bytes = (
+            ctx.conf.get("ms_dispatch_throttle_bytes") if ctx else 100 << 20
+        )
+        self._dispatch_budget = throttle_bytes
+        self._budget_free: Optional[asyncio.Event] = None  # made on loop
+        self._conn_lock = threading.Lock()
+        self._accepted: set = set()  # live accepted-side connections
+        # per-peer-incarnation cumulative dispatch seq, shared across the
+        # sockets of one logical session so replays after reconnect are
+        # suppressed (the reference's in_seq survives in the Connection
+        # found by peer addr; here the accepted socket is recreated, so
+        # the state lives on the messenger keyed by (src, nonce))
+        self._peer_in_seq: Dict[Tuple[str, int], int] = {}
+        self._log = ctx.log.dout("ms") if ctx else (lambda lvl, s: None)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+        fut = asyncio.run_coroutine_threadsafe(self._start_server(), self._loop)
+        fut.result(timeout=10)
+
+    async def _start_server(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_accept, self._bind[0], self._bind[1]
+        )
+        sock = self._server.sockets[0]
+        self.addr = sock.getsockname()[:2]
+
+    def shutdown(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+
+        async def _stop():
+            for c in list(self._conns.values()):
+                c._close()
+            for c in list(self._accepted):
+                c._close()
+            if self._server is not None:
+                self._server.close()
+
+        asyncio.run_coroutine_threadsafe(_stop(), self._loop).result(timeout=10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self._dispatchers.append(d)
+
+    # -- connection management -------------------------------------------
+    def connect(self, addr: Addr) -> Connection:
+        addr = (addr[0], addr[1])
+        with self._conn_lock:
+            conn = self._conns.get(addr)
+            if conn is None or conn._closed:
+                conn = Connection(self, addr)
+                self._conns[addr] = conn
+                self._loop_call(self._spawn_outgoing, conn)
+            return conn
+
+    def send_message(self, msg: Message, addr: Addr) -> None:
+        self.connect(addr).send(msg)
+
+    def _loop_call(self, fn, *args) -> None:
+        self._loop.call_soon_threadsafe(fn, *args)
+
+    def _spawn_outgoing(self, conn: Connection) -> None:
+        self._loop.create_task(self._run_outgoing(conn))
+
+    async def _run_outgoing(self, conn: Connection) -> None:
+        """Dial, replay unacked, then pump frames; reconnect on error."""
+        while not conn._closed and not self._stopped:
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(*conn.peer_addr), timeout=10
+                )
+            except (OSError, asyncio.TimeoutError):
+                await asyncio.sleep(self._retry)
+                continue
+            # guard against TCP self-connect: dialing a dead localhost
+            # port can land on our own ephemeral source port and
+            # "succeed" against ourselves, wedging reconnect forever
+            if (writer.get_extra_info("sockname")[:2]
+                    == writer.get_extra_info("peername")[:2]):
+                writer.close()
+                await asyncio.sleep(self._retry)
+                continue
+            conn._writer = writer
+            # lossless-peer: resend everything the peer hasn't acked
+            for _, frame in conn._unacked:
+                writer.write(frame)
+
+            async def _send_loop():
+                while True:
+                    frame = await conn._send_q.get()
+                    if frame is None:
+                        raise ConnectionResetError
+                    writer.write(frame)
+                    await writer.drain()
+
+            # a dead reader (peer EOF/reset) must also tear the session
+            # down, or buffered writes mask the death and resend never
+            # happens — run both and fold when either side fails
+            # ack_writer also on the dialing side: replies the peer pushes
+            # over this session get acked so its _unacked list drains
+            reader_task = asyncio.create_task(
+                self._read_frames(conn, reader, ack_writer=writer)
+            )
+            sender_task = asyncio.create_task(_send_loop())
+            try:
+                done, pending = await asyncio.wait(
+                    {reader_task, sender_task},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                for t in pending:
+                    t.cancel()
+                for t in done:
+                    exc = t.exception()
+                    if exc is not None and not isinstance(
+                        exc, (ConnectionError, OSError)
+                    ):
+                        raise exc
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            if conn._closed or self._stopped:
+                break
+            await asyncio.sleep(self._retry)
+        conn._closed = True
+
+    # -- incoming ---------------------------------------------------------
+    async def _on_accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")[:2]
+        # sessions are bidirectional: replies from dispatchers go back
+        # over this same socket (conn.send), so the accepted side pumps
+        # a send queue too; if the socket drops, the dialing peer owns
+        # reconnect and we just fold
+        conn = Connection(self, peer)
+        conn._writer = writer
+        self._accepted.add(conn)
+
+        async def _pump():
+            try:
+                while True:
+                    frame = await conn._send_q.get()
+                    if frame is None:
+                        return
+                    writer.write(frame)
+                    await writer.drain()
+            except (ConnectionError, OSError, asyncio.CancelledError):
+                pass
+
+        pump_task = asyncio.create_task(_pump())
+        try:
+            await self._read_frames(conn, reader, ack_writer=writer)
+        finally:
+            conn._closed = True
+            self._accepted.discard(conn)
+            pump_task.cancel()
+            try:
+                writer.close()
+            except Exception:
+                pass
+            if conn.in_seq > 0 and not self._stopped:
+                for d in self._dispatchers:
+                    d.ms_handle_reset(conn)
+
+    async def _read_frames(
+        self,
+        conn: Connection,
+        reader: asyncio.StreamReader,
+        ack_writer: Optional[asyncio.StreamWriter] = None,
+    ) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(_FRAME.size)
+                blen, want = _FRAME.unpack(hdr)
+                body = await reader.readexactly(blen)
+                if self.crc_data and want and crc32c(body) != want:
+                    self._log(0, f"crc mismatch from {conn.peer_addr}, "
+                              "dropping session")
+                    return
+                msg = Message.from_bytes(body)
+                conn._handle_ack(msg.ack_seq)
+                if isinstance(msg, MAck):
+                    continue
+                # dup suppression must survive socket turnover: key the
+                # cumulative dispatched-seq by (src, nonce), one logical
+                # lossless session per peer incarnation
+                if msg.src is not None and msg.nonce:
+                    skey = (str(msg.src), msg.nonce)
+                    last = self._peer_in_seq.get(skey, 0)
+                    if msg.seq <= last:
+                        # already dispatched in this or a prior socket of
+                        # the session; re-ack so the replayer trims
+                        self._send_ack(conn, ack_writer, last)
+                        continue
+                    self._peer_in_seq[skey] = msg.seq
+                elif msg.seq <= conn.in_seq:
+                    continue  # duplicate within this socket
+                conn.in_seq = msg.seq
+                await self._dispatch(conn, msg, len(body))
+                self._send_ack(conn, ack_writer, conn.in_seq)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError):
+            pass
+
+    def _send_ack(self, conn: Connection, ack_writer, ack_seq: int) -> None:
+        if ack_writer is None or not ack_seq:
+            return
+        ack = MAck()
+        ack.ack_seq = ack_seq
+        ack.src = self.entity
+        ack.nonce = self.nonce
+        body = ack.to_bytes()
+        try:
+            ack_writer.write(
+                _FRAME.pack(len(body),
+                            crc32c(body) if self.crc_data else 0) + body
+            )
+        except (ConnectionError, OSError):
+            pass
+
+    async def _dispatch(self, conn: Connection, msg: Message,
+                        size: int) -> None:
+        """Byte-budgeted: when ms_dispatch_throttle_bytes of payload are
+        in flight to dispatchers, stop reading this socket (TCP then
+        backpressures the peer — the reference policy throttle)."""
+        if self._budget_free is None:
+            self._budget_free = asyncio.Event()
+            self._budget_free.set()
+        while self._dispatch_budget <= 0:
+            self._budget_free.clear()
+            await self._budget_free.wait()
+        self._dispatch_budget -= size
+        try:
+            handled = await asyncio.to_thread(self._dispatch_sync, conn, msg)
+            if not handled:
+                self._log(0, f"unhandled message {msg!r}")
+        finally:
+            self._dispatch_budget += size
+            if self._dispatch_budget > 0 and self._budget_free is not None:
+                self._budget_free.set()
+
+    def _dispatch_sync(self, conn: Connection, msg: Message) -> bool:
+        for d in self._dispatchers:
+            if d.ms_dispatch(conn, msg):
+                return True
+        return False

@@ -31,6 +31,7 @@ from ceph_tpu.store.objectstore import (
     ObjectStore,
     StoreError,
     Transaction,
+    validate_op,
 )
 
 # KV prefixes
@@ -57,9 +58,6 @@ class FileStore(ObjectStore):
         self._seq = 0
         self._lock = threading.RLock()
         self._mounted = False
-        # in-flight existence deltas, populated only inside _apply
-        self._pend_coll: Dict[str, bool] = {}
-        self._pend_obj: Dict[str, bool] = {}
 
     # -- layout -----------------------------------------------------------
     def _datafile(self, cid: Collection, oid: GHObject) -> str:
@@ -82,6 +80,7 @@ class FileStore(ObjectStore):
             applied = int(self._kv.get(P_META, "applied_seq") or b"0")
             self._seq = applied
             self._replay_wal(applied)
+            self._trim_wal()  # replay is fully applied + KV flushed
             self._wal_fh = open(self._wal_path, "ab")
             self._mounted = True
 
@@ -116,8 +115,13 @@ class FileStore(ObjectStore):
 
     # -- transaction apply ------------------------------------------------
     def queue_transaction(self, t: Transaction) -> None:
+        """All-or-nothing: validate against lazy KV-backed overlays
+        BEFORE the WAL append, so a failing op neither logs nor mutates
+        anything; the mutation pass then cannot fail (crash mid-apply is
+        healed by full WAL replay on the next mount)."""
         with self._lock:
             assert self._mounted, "not mounted"
+            self._validate(t)
             self._seq += 1
             seq = self._seq
             body = t.to_bytes()
@@ -127,39 +131,83 @@ class FileStore(ObjectStore):
             if self.wal_sync:
                 os.fsync(self._wal_fh.fileno())
             self._apply(t, seq, replay=False)
+            # everything through seq is applied and the KV flushed, so
+            # the log before here is dead weight — bound its growth
+            if self._wal_fh.tell() > (64 << 20):
+                self._wal_fh.close()
+                self._trim_wal()
+                self._wal_fh = open(self._wal_path, "ab")
+
+    def _validate(self, t: Transaction) -> None:
+        kv = self._kv
+        store = self
+
+        class LazyColls:
+            def __init__(self):
+                self.over = {}
+
+            def __contains__(self, name):
+                if name in self.over:
+                    return self.over[name]
+                return kv.get(P_COLL, name) is not None
+
+            def add(self, name):
+                self.over[name] = True
+
+            def discard(self, name):
+                self.over[name] = False
+
+        class LazyObjs(dict):
+            def get(self, key, default=None):
+                if key in self:
+                    return dict.get(self, key)
+                cname, oid = key
+                return (
+                    kv.get(P_OBJ, _objkey(Collection(cname), oid)) is not None
+                    or default
+                )
+
+        class LazyCounts(dict):
+            def _base(self, name):
+                pre = name + "/"
+                return sum(
+                    1 for k, _ in kv.iterate(P_OBJ) if k.startswith(pre)
+                )
+
+            def get(self, name, default=0):
+                if name in self:
+                    return dict.get(self, name)
+                return self._base(name)
+
+            def __missing__(self, name):
+                return self._base(name)
+
+        colls, objs, counts = LazyColls(), LazyObjs(), LazyCounts()
+        for op in t.ops:
+            validate_op(op, colls, objs, counts)
 
     def _apply(self, t: Transaction, seq: int, replay: bool) -> None:
+        # one KV submit per op: later ops in the same transaction (clone,
+        # remove, rename) must see metadata written by earlier ones
+        for op in t.ops:
+            b = WriteBatch()
+            self._apply_op(op, b, replay)
+            if b.ops:
+                self._kv.submit(b)
         b = WriteBatch()
-        # ops within one transaction must see each other's effects before
-        # the KV batch lands (e.g. mkcoll + write in the same txn), so
-        # track in-flight existence deltas alongside the batch
-        self._pend_coll.clear()
-        self._pend_obj.clear()
-        try:
-            for op in t.ops:
-                self._apply_op(op, b, replay)
-            b.set(P_META, "applied_seq", str(seq).encode())
-            self._kv.submit(b)
-        finally:
-            self._pend_coll.clear()
-            self._pend_obj.clear()
+        b.set(P_META, "applied_seq", str(seq).encode())
+        self._kv.submit(b)
 
-    def _coll_exists_pending(self, cid: Collection) -> bool:
-        p = self._pend_coll.get(cid.name)
-        if p is not None:
-            return p
+    def _coll_exists(self, cid: Collection) -> bool:
         return self._kv.get(P_COLL, cid.name) is not None
 
     def _exists_kv(self, cid: Collection, oid: GHObject) -> bool:
-        key = _objkey(cid, oid)
-        p = self._pend_obj.get(key)
-        if p is not None:
-            return p
-        return self._kv.get(P_OBJ, key) is not None
+        return self._kv.get(P_OBJ, _objkey(cid, oid)) is not None
 
     def _require(self, cid: Collection, oid: GHObject, replay: bool) -> bool:
-        """True if present; on replay missing objects are tolerated."""
-        if not self._coll_exists_pending(cid):
+        """True if present; on replay missing objects are tolerated.
+        Non-replay misses can't happen (validated), but raise anyway."""
+        if not self._coll_exists(cid):
             if replay:
                 return False
             raise NoSuchCollection(cid.name)
@@ -175,23 +223,21 @@ class FileStore(ObjectStore):
         if code == os_.OP_NOP:
             return
         if code == os_.OP_MKCOLL:
-            if self._coll_exists_pending(op.cid) and not replay:
+            if self._coll_exists(op.cid) and not replay:
                 raise StoreError(f"collection exists: {op.cid.name}")
             b.set(P_COLL, op.cid.name, b"1")
-            self._pend_coll[op.cid.name] = True
             return
         if code == os_.OP_RMCOLL:
+            # emptiness enforced by _validate (parity with MemStore)
             b.rmkey(P_COLL, op.cid.name)
-            self._pend_coll[op.cid.name] = False
             return
         if code in (os_.OP_TOUCH, os_.OP_WRITE, os_.OP_ZERO, os_.OP_TRUNCATE,
                     os_.OP_SETATTRS, os_.OP_OMAP_SETKEYS):
-            if not self._coll_exists_pending(op.cid):
+            if not self._coll_exists(op.cid):
                 if replay:
                     return
                 raise NoSuchCollection(op.cid.name)
             b.set(P_OBJ, key, b"1")
-            self._pend_obj[key] = True
         if code == os_.OP_TOUCH:
             self._data_write(op.cid, op.oid, 0, b"")
             return
@@ -214,7 +260,6 @@ class FileStore(ObjectStore):
             if not self._require(op.cid, op.oid, replay):
                 return
             b.rmkey(P_OBJ, key)
-            self._pend_obj[key] = False
             for k, _ in list(self._kv.iterate(P_XATTR)):
                 if k.startswith(key + "/"):
                     b.rmkey(P_XATTR, k)
@@ -240,7 +285,6 @@ class FileStore(ObjectStore):
                 return
             dkey = _objkey(op.cid, op.dest_oid)
             b.set(P_OBJ, dkey, b"1")
-            self._pend_obj[dkey] = True
             src_file = self._datafile(op.cid, op.oid)
             dst_file = self._datafile(op.cid, op.dest_oid)
             os.makedirs(os.path.dirname(dst_file), exist_ok=True)
@@ -280,8 +324,6 @@ class FileStore(ObjectStore):
             dkey = _objkey(op.dest_cid, op.dest_oid)
             b.rmkey(P_OBJ, key)
             b.set(P_OBJ, dkey, b"1")
-            self._pend_obj[key] = False
-            self._pend_obj[dkey] = True
             src_file = self._datafile(op.cid, op.oid)
             dst_file = self._datafile(op.dest_cid, op.dest_oid)
             os.makedirs(os.path.dirname(dst_file), exist_ok=True)

@@ -21,6 +21,7 @@ from ceph_tpu.store.objectstore import (
     ObjectStore,
     StoreError,
     Transaction,
+    validate_op,
 )
 
 
@@ -59,9 +60,22 @@ class MemStore(ObjectStore):
 
     # -- transaction apply ------------------------------------------------
     def queue_transaction(self, t: Transaction) -> None:
+        """All-or-nothing: a validation pass over an existence overlay
+        raises before any mutation, so a failing op leaves no partial
+        effects (the mutation pass itself cannot fail)."""
         with self._lock:
+            self._validate(t)
             for op in t.ops:
                 self._apply(op)
+
+    def _validate(self, t: Transaction) -> None:
+        colls = {c.name for c in self._colls}
+        objs = {
+            (c.name, o): True for c, d in self._colls.items() for o in d
+        }
+        counts = {c.name: len(d) for c, d in self._colls.items()}
+        for op in t.ops:
+            validate_op(op, colls, objs, counts)
 
     def _coll(self, cid: Collection) -> Dict[GHObject, _Obj]:
         c = self._colls.get(cid)
@@ -104,7 +118,7 @@ class MemStore(ObjectStore):
             o.data[op.off:end] = op.data
             return
         if code == os_.OP_ZERO:
-            o = self._obj(op.cid, op.oid)
+            o = self._obj(op.cid, op.oid, create=True)
             end = op.off + op.length
             if len(o.data) < end:
                 o.data.extend(b"\0" * (end - len(o.data)))

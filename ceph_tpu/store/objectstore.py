@@ -211,6 +211,70 @@ class Transaction:
         return cls.decode(Decoder(data))
 
 
+def validate_op(op: Op, colls: set, objs: dict, counts: dict) -> None:
+    """Shared validation pass giving queue_transaction all-or-nothing
+    semantics: simulate existence effects over an overlay (colls: set of
+    names; objs: {(coll, oid): True}; counts: {coll: n_objects}) and
+    raise exactly the errors apply would, before any backend mutates."""
+    code = op.op
+    cname = op.cid.name
+
+    def need_coll():
+        if cname not in colls:
+            raise NoSuchCollection(cname)
+
+    def need_obj():
+        need_coll()
+        if not objs.get((cname, op.oid)):
+            raise NoSuchObject(f"{cname}/{op.oid.name}")
+
+    def create_obj(cid_name, oid):
+        if not objs.get((cid_name, oid)):
+            objs[(cid_name, oid)] = True
+            counts[cid_name] = counts.get(cid_name, 0) + 1
+
+    if code == OP_NOP:
+        return
+    if code == OP_MKCOLL:
+        if cname in colls:
+            raise StoreError(f"collection exists: {cname}")
+        colls.add(cname)
+        counts[cname] = 0
+        return
+    if code == OP_RMCOLL:
+        need_coll()
+        if counts.get(cname, 0):
+            raise StoreError(f"collection not empty: {cname}")
+        colls.discard(cname)
+        return
+    if code in (OP_TOUCH, OP_WRITE, OP_ZERO, OP_TRUNCATE, OP_SETATTRS,
+                OP_OMAP_SETKEYS):
+        need_coll()
+        create_obj(cname, op.oid)
+        return
+    if code in (OP_REMOVE,):
+        need_obj()
+        objs[(cname, op.oid)] = False  # tombstone (overlay-friendly)
+        counts[cname] = counts.get(cname, 0) - 1
+        return
+    if code in (OP_RMATTR, OP_OMAP_RMKEYS, OP_OMAP_CLEAR):
+        need_obj()
+        return
+    if code == OP_CLONE:
+        need_obj()
+        create_obj(cname, op.dest_oid)
+        return
+    if code == OP_COLL_MOVE_RENAME:
+        need_obj()
+        if op.dest_cid.name not in colls:
+            raise NoSuchCollection(op.dest_cid.name)
+        objs[(cname, op.oid)] = False
+        counts[cname] = counts.get(cname, 0) - 1
+        create_obj(op.dest_cid.name, op.dest_oid)
+        return
+    raise StoreError(f"unknown op {code}")
+
+
 class ObjectStore:
     """Abstract backend. Writes go through queue_transaction; reads are
     direct.  `queue_transaction` is synchronous-apply here (the

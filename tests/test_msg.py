@@ -1,0 +1,224 @@
+"""Messenger tests: roundtrip, ordering, reply-over-session, reconnect
+resend (reference tier: src/test/msgr/ style, localhost sockets).
+"""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.core.context import Context
+from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.msg.message import EntityName, Message, register
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+
+
+@register
+class MEcho(Message):
+    TYPE = 9001
+
+    def __init__(self, text: str = "") -> None:
+        super().__init__()
+        self.text = text
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.text)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.text = d.string()
+
+
+@register
+class MEchoReply(Message):
+    TYPE = 9002
+
+    def __init__(self, text: str = "") -> None:
+        super().__init__()
+        self.text = text
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.text)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.text = d.string()
+
+
+class Collector(Dispatcher):
+    def __init__(self, reply: bool = False) -> None:
+        self.got = []
+        self.resets = []
+        self.reply = reply
+        self.cond = threading.Condition()
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        with self.cond:
+            self.got.append(msg)
+            self.cond.notify_all()
+        if self.reply and isinstance(msg, MEcho):
+            conn.send(MEchoReply(msg.text.upper()))
+        return True
+
+    def wait_for(self, n: int, timeout: float = 10.0) -> bool:
+        with self.cond:
+            return self.cond.wait_for(lambda: len(self.got) >= n, timeout)
+
+    def wait_for_text(self, text: str, timeout: float = 10.0) -> bool:
+        with self.cond:
+            return self.cond.wait_for(
+                lambda: any(getattr(m, "text", None) == text
+                            for m in self.got),
+                timeout,
+            )
+
+
+@pytest.fixture
+def ctx():
+    return Context("client.1")
+
+
+def _mk(ctx, name):
+    m = Messenger(ctx, EntityName.parse(name))
+    m.start()
+    return m
+
+
+def test_message_registry_roundtrip():
+    m = MEcho("hello")
+    m.tid = 42
+    m.src = EntityName("osd", 3)
+    m2 = Message.from_bytes(m.to_bytes())
+    assert isinstance(m2, MEcho)
+    assert m2.text == "hello" and m2.tid == 42
+    assert m2.src == EntityName("osd", 3)
+
+
+def test_send_and_dispatch(ctx):
+    a = _mk(ctx, "client.1")
+    b = _mk(ctx, "osd.0")
+    coll = Collector()
+    b.add_dispatcher(coll)
+    try:
+        for i in range(10):
+            a.send_message(MEcho(f"m{i}"), b.addr)
+        assert coll.wait_for(10)
+        texts = [m.text for m in coll.got]
+        assert texts == [f"m{i}" for i in range(10)]  # ordered
+        assert coll.got[0].src == EntityName("client", 1)
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_reply_over_same_session(ctx):
+    a = _mk(ctx, "client.1")
+    b = _mk(ctx, "osd.0")
+    server = Collector(reply=True)
+    client = Collector()
+    b.add_dispatcher(server)
+    a.add_dispatcher(client)
+    try:
+        conn = a.connect(b.addr)
+        conn.send(MEcho("ping"))
+        assert client.wait_for(1)
+        assert isinstance(client.got[0], MEchoReply)
+        assert client.got[0].text == "PING"
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_reconnect_resends_unacked(ctx):
+    """Lossless-peer: kill the receiver, restart on the same port, and
+    unacked messages must be replayed (reference AsyncConnection
+    requeue_sent / resend on reconnect)."""
+    a = _mk(ctx, "osd.1")
+    b = _mk(ctx, "osd.2")
+    coll = Collector()
+    b.add_dispatcher(coll)
+    addr = b.addr
+    try:
+        a.send_message(MEcho("before"), addr)
+        assert coll.wait_for(1)
+        b.shutdown()  # peer dies with the session open
+
+        a.send_message(MEcho("while-down"), addr)  # queued + unacked
+        time.sleep(0.3)
+
+        b2 = Messenger(ctx, EntityName.parse("osd.2"),
+                       bind_ip=addr[0], bind_port=addr[1])
+        coll2 = Collector()
+        b2.add_dispatcher(coll2)
+        b2.start()
+        try:
+            # both the replayed 'before' (unacked) and the queued
+            # 'while-down' must arrive; arrival order is session order
+            assert coll2.wait_for_text("while-down", timeout=15)
+        finally:
+            b2.shutdown()
+    finally:
+        a.shutdown()
+
+
+def test_duplicate_suppression_after_replay(ctx):
+    """Replayed frames the peer already dispatched must be dropped by
+    in_seq (at-most-once dispatch per session seq)."""
+    a = _mk(ctx, "osd.1")
+    b = _mk(ctx, "osd.2")
+    coll = Collector()
+    b.add_dispatcher(coll)
+    try:
+        conn = a.connect(b.addr)
+        conn.send(MEcho("x"))
+        assert coll.wait_for(1)
+        # forge: replay the same seq by resetting out_seq and resending
+        # (simulates a retransmit racing an ack)
+        conn2 = a.connect(b.addr)
+        assert conn2 is conn
+        before = len(coll.got)
+        m = MEcho("x")
+
+        def resend_same_seq():
+            conn.out_seq -= 1  # will reuse the seq just sent
+            conn._enqueue(m)
+
+        a._loop.call_soon_threadsafe(resend_same_seq)
+        time.sleep(0.5)
+        assert len(coll.got) == before  # duplicate dropped
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_dup_suppression_across_reconnect(ctx):
+    """A replayed frame already dispatched before the session dropped
+    must NOT dispatch twice on the new socket (state keyed by src+nonce
+    survives socket turnover)."""
+    a = _mk(ctx, "osd.3")
+    b = _mk(ctx, "osd.4")
+    coll = Collector()
+    b.add_dispatcher(coll)
+    try:
+        conn = a.connect(b.addr)
+        conn.send(MEcho("only-once"))
+        assert coll.wait_for_text("only-once")
+        # simulate ack loss + reconnect: reconstruct the original frame
+        # and force the dialer to drop + redial with it still unacked
+        import struct as _s
+        from ceph_tpu.core.crc import crc32c as _crc
+        m = MEcho("only-once")
+        m.seq = 1
+        m.nonce = a.nonce
+        m.src = a.entity
+        body = m.to_bytes()
+        frame = _s.pack("<II", len(body), _crc(body)) + body
+        def forge2():
+            conn.acked = 0
+            conn._unacked = [(1, frame)]
+            if conn._writer:
+                conn._writer.close()  # triggers reconnect + replay
+        a._loop.call_soon_threadsafe(forge2)
+        time.sleep(1.0)  # reconnect + replay happens
+        assert [m.text for m in coll.got].count("only-once") == 1
+    finally:
+        a.shutdown()
+        b.shutdown()

@@ -255,3 +255,54 @@ def test_memdb_batch():
     assert db.get("a", "x") == b"1"
     assert db.get("b", "x") == b"2"
     assert list(db.iterate("a")) == [("x", b"1")]
+
+
+def test_transaction_atomicity_all_or_nothing(store):
+    """A failing op mid-transaction must leave NO partial effects."""
+    _mkcoll(store)
+    t = Transaction()
+    t.write(CID, OID, 0, b"partial")
+    t.remove(CID, GHObject("does-not-exist"))
+    with pytest.raises(NoSuchObject):
+        store.queue_transaction(t)
+    assert not store.exists(CID, OID)  # the write did not land
+
+
+def test_rmcoll_nonempty_refused(store):
+    _mkcoll(store)
+    t = Transaction()
+    t.touch(CID, OID)
+    store.queue_transaction(t)
+    t = Transaction()
+    t.remove_collection(CID)
+    with pytest.raises(StoreError):
+        store.queue_transaction(t)
+    assert store.collection_exists(CID)
+
+
+def test_same_txn_setattr_then_clone(store):
+    """Metadata written earlier in a txn is visible to clone later in it."""
+    _mkcoll(store)
+    t = Transaction()
+    t.write(CID, OID, 0, b"d")
+    t.setattrs(CID, OID, {"hinfo": b"\x01"})
+    t.omap_setkeys(CID, OID, {"k": b"v"})
+    t.clone(CID, OID, GHObject("obj1", snap=7))
+    store.queue_transaction(t)
+    assert store.getattrs(CID, GHObject("obj1", snap=7)) == {"hinfo": b"\x01"}
+    assert store.omap_get(CID, GHObject("obj1", snap=7)) == {"k": b"v"}
+
+
+def test_same_txn_setattr_then_remove_no_resurrect(store):
+    _mkcoll(store)
+    t = Transaction()
+    t.touch(CID, OID)
+    store.queue_transaction(t)
+    t = Transaction()
+    t.setattrs(CID, OID, {"ghost": b"1"})
+    t.remove(CID, OID)
+    store.queue_transaction(t)
+    t = Transaction()
+    t.touch(CID, OID)  # re-create same name
+    store.queue_transaction(t)
+    assert store.getattrs(CID, OID) == {}  # no stale attr resurrects
