@@ -8,3 +8,17 @@ bit-sliced matmuls (see ceph_tpu.ops.gf2_matmul).
 
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry, instance  # noqa: F401
 
+
+
+def codec_from_profile(profile_str: str):
+    """Build a codec from a 'plugin=isa k=8 m=4 ...' profile string (the
+    form EC profiles take inside pool definitions; reference:
+    ErasureCodeProfile blobs stored in the OSDMap,
+    src/erasure-code/ErasureCodeInterface.h:155)."""
+    profile = {}
+    for part in profile_str.split():
+        if "=" in part:
+            key, val = part.split("=", 1)
+            profile[key] = val
+    plugin = profile.pop("plugin", "isa")
+    return instance().factory(plugin, profile)

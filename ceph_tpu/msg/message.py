@@ -62,10 +62,13 @@ class Message:
         self.priority = 63
         self.src: Optional[EntityName] = None
         self.ack_seq = 0      # piggybacked cumulative ack
-        self.nonce = 0        # sender incarnation (reference addr nonce):
-                              # receivers key dup-suppression state by
-                              # (src, nonce) so a restarted peer's fresh
-                              # seq space isn't confused with the old one
+        self.nonce = 0        # sender incarnation (reference addr nonce)
+        self.sid = 0          # sender session (one per Connection object):
+                              # seq spaces are per-session, so receivers key
+                              # dup-suppression by (src, nonce, sid) — a
+                              # restarted peer or a parallel connection gets
+                              # a fresh space, while reconnects of the SAME
+                              # logical session (same Connection) keep theirs
 
     # -- subclass hooks ---------------------------------------------------
     def encode_payload(self, e: Encoder) -> None:
@@ -80,7 +83,7 @@ class Message:
         e.u16(self.TYPE)
         e.start(self.VERSION, self.COMPAT)
         e.u64(self.seq).u64(self.tid).u8(self.priority).u64(self.ack_seq)
-        e.u64(self.nonce)
+        e.u64(self.nonce).u64(self.sid)
         e.optional(self.src, lambda enc, s: s.encode(enc))
         self.encode_payload(e)
         e.finish()
@@ -101,6 +104,7 @@ class Message:
         msg.priority = d.u8()
         msg.ack_seq = d.u64()
         msg.nonce = d.u64()
+        msg.sid = d.u64()
         msg.src = d.optional(EntityName.decode)
         msg.decode_payload(d)
         d.end()

@@ -47,8 +47,11 @@ class Connection:
     """One ordered session to a peer address."""
 
     def __init__(self, msgr: "Messenger", addr: Addr) -> None:
+        import random
+
         self.msgr = msgr
         self.peer_addr = addr
+        self.sid = random.getrandbits(63) | 1  # this session's seq space
         self.out_seq = 0
         self.in_seq = 0
         self.acked = 0
@@ -70,6 +73,7 @@ class Connection:
         msg.seq = self.out_seq
         msg.ack_seq = self.in_seq  # piggyback
         msg.nonce = self.msgr.nonce
+        msg.sid = self.sid
         if msg.src is None:
             msg.src = self.msgr.entity
         body = msg.to_bytes()
@@ -134,12 +138,16 @@ class Messenger:
         self._budget_free: Optional[asyncio.Event] = None  # made on loop
         self._conn_lock = threading.Lock()
         self._accepted: set = set()  # live accepted-side connections
-        # per-peer-incarnation cumulative dispatch seq, shared across the
-        # sockets of one logical session so replays after reconnect are
+        # per-session cumulative dispatch seq, shared across the sockets
+        # of one logical session so replays after reconnect are
         # suppressed (the reference's in_seq survives in the Connection
         # found by peer addr; here the accepted socket is recreated, so
-        # the state lives on the messenger keyed by (src, nonce))
-        self._peer_in_seq: Dict[Tuple[str, int], int] = {}
+        # the state lives on the messenger keyed by src ->
+        # (incarnation nonce, {session sid: seq})).  A new nonce from a
+        # src supersedes — and prunes — the old incarnation's state;
+        # sids within an incarnation are capped LRU-style
+        self._peer_in_seq: Dict[str, Tuple[int, Dict[int, int]]] = {}
+        self._max_sids_per_peer = 64
         self._log = ctx.log.dout("ms") if ctx else (lambda lvl, s: None)
 
     # -- lifecycle --------------------------------------------------------
@@ -320,14 +328,23 @@ class Messenger:
                 # cumulative dispatched-seq by (src, nonce), one logical
                 # lossless session per peer incarnation
                 if msg.src is not None and msg.nonce:
-                    skey = (str(msg.src), msg.nonce)
-                    last = self._peer_in_seq.get(skey, 0)
+                    src = str(msg.src)
+                    nonce, sids = self._peer_in_seq.get(src, (0, {}))
+                    if nonce != msg.nonce:  # new incarnation supersedes
+                        nonce, sids = msg.nonce, {}
+                        self._peer_in_seq[src] = (nonce, sids)
+                    last = sids.get(msg.sid, 0)
                     if msg.seq <= last:
                         # already dispatched in this or a prior socket of
                         # the session; re-ack so the replayer trims
                         self._send_ack(conn, ack_writer, last)
                         continue
-                    self._peer_in_seq[skey] = msg.seq
+                    if msg.sid not in sids and (
+                        len(sids) >= self._max_sids_per_peer
+                    ):
+                        sids.pop(next(iter(sids)))  # evict oldest session
+                    sids[msg.sid] = msg.seq
+                    self._peer_in_seq[src] = (nonce, sids)
                 elif msg.seq <= conn.in_seq:
                     continue  # duplicate within this socket
                 conn.in_seq = msg.seq

@@ -1,0 +1,347 @@
+"""PGBackend family: primary-copy replication and EC stripe fan-out.
+
+Reference seams: PGBackend (src/osd/PGBackend.h), ReplicatedBackend
+(src/osd/ReplicatedBackend.{h,cc}) and ECBackend
+(src/osd/ECBackend.{h,cc}).  The PG hands a backend the *full new
+object state* per write (an RMW discipline: the reference's EC pipeline
+likewise reads stripe remnants before encoding, ECBackend.cc:1817
+try_state_to_reads); the backend owns distribution:
+
+- ReplicatedBackend: one ObjectStore transaction carrying the object
+  state + pg log entries, applied locally and shipped verbatim to every
+  replica (MOSDRepOp; reference submit_transaction ->
+  issue_op -> sub_op_modify).
+- ECBackend: the object buffer is padded and split into k data chunks,
+  coding chunks come back from the stripe-batch queue (ONE device
+  matmul may serve many concurrent writes), and each of the k+m shards
+  gets its own transaction (chunk payload + per-shard HashInfo crc
+  xattr, reference ECUtil.h:101) shipped as MECSubWrite
+  (ECBackend.cc:1997-2035 fan-out, :880 handle_sub_write).
+
+Completion: an op commits when every shard/replica acked
+(all_commit discipline of try_finish_rmw, ECBackend.cc:2050).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ceph_tpu.core.crc import crc32c
+from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.osd import messages as m
+from ceph_tpu.osd.types import EVersion, LogEntry, PGId
+from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
+from ceph_tpu.tpu.queue import default_queue
+
+CRUSH_ITEM_NONE = 0x7FFFFFFF
+
+
+class ObjectState:
+    """Full logical object content (the RMW working copy)."""
+
+    __slots__ = ("data", "xattrs", "omap")
+
+    def __init__(self, data: bytes = b"",
+                 xattrs: Optional[Dict[str, bytes]] = None,
+                 omap: Optional[Dict[str, bytes]] = None) -> None:
+        self.data = data
+        self.xattrs = xattrs or {}
+        self.omap = omap or {}
+
+
+class InFlightOp:
+    """One replicated/EC write waiting on shard acks."""
+
+    __slots__ = ("waiting_on", "on_commit", "lock")
+
+    def __init__(self, waiting_on: set, on_commit: Callable[[], None]):
+        self.waiting_on = waiting_on
+        self.on_commit = on_commit
+        self.lock = threading.Lock()
+
+    def ack(self, who) -> None:
+        fire = False
+        with self.lock:
+            self.waiting_on.discard(who)
+            fire = not self.waiting_on
+        if fire:
+            self.on_commit()
+
+
+class PGBackend:
+    """Distribution policy under one PG.
+
+    `osd_send(osd_id, msg)` delivers a message to a peer OSD;
+    `whoami` is this OSD's id; `coll` the PG's collection.
+    """
+
+    def __init__(self, pgid: PGId, coll: Collection, store, whoami: int,
+                 osd_send: Callable[[int, object], None], epoch_fn) -> None:
+        self.pgid = pgid
+        self.coll = coll
+        self.store = store
+        self.whoami = whoami
+        self.osd_send = osd_send
+        self.epoch_fn = epoch_fn
+        self.tids = 0
+        self.in_flight: Dict[int, InFlightOp] = {}
+        self._lock = threading.Lock()
+
+    # -- common helpers ---------------------------------------------------
+    def _new_tid(self) -> int:
+        with self._lock:
+            self.tids += 1
+            return self.tids
+
+    def handle_reply(self, tid: int, who) -> None:
+        op = self.in_flight.get(tid)
+        if op is not None:
+            op.ack(who)
+
+    def _done(self, tid: int) -> None:
+        self.in_flight.pop(tid, None)
+
+    # -- interface --------------------------------------------------------
+    def submit(self, oid: str, state: Optional[ObjectState],
+               entries: List[LogEntry], log_omap: Dict[str, bytes],
+               acting: Sequence[int], on_commit: Callable[[], None]) -> None:
+        """state=None means delete. `log_omap` are pg-log omap updates to
+        persist in the same transaction (crash = replay consistency)."""
+        raise NotImplementedError
+
+    def read_object(self, oid: str, acting: Sequence[int],
+                    done: Callable[[Optional[ObjectState]], None]) -> None:
+        raise NotImplementedError
+
+    def object_names(self) -> List[str]:
+        raise NotImplementedError
+
+
+def _meta_oid() -> GHObject:
+    return GHObject("_pgmeta_")
+
+
+def pg_meta_txn(coll: Collection, entries_omap: Dict[str, bytes],
+                info_blob: bytes) -> Transaction:
+    t = Transaction()
+    t.touch(coll, _meta_oid())
+    if entries_omap:
+        t.omap_setkeys(coll, _meta_oid(), entries_omap)
+    t.setattrs(coll, _meta_oid(), {"info": info_blob})
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Replicated
+# ---------------------------------------------------------------------------
+
+
+class ReplicatedBackend(PGBackend):
+    def _object_txn(self, oid: str, state: Optional[ObjectState],
+                    log_omap: Dict[str, bytes]) -> Transaction:
+        t = Transaction()
+        g = GHObject(oid)
+        if state is None:
+            t.try_remove(self.coll, g)
+        else:
+            t.truncate(self.coll, g, 0)
+            t.write(self.coll, g, 0, state.data)
+            t.setattrs(self.coll, g, state.xattrs)
+            t.omap_clear(self.coll, g)
+            if state.omap:
+                t.omap_setkeys(self.coll, g, state.omap)
+        if log_omap:
+            t.touch(self.coll, _meta_oid())
+            t.omap_setkeys(self.coll, _meta_oid(), log_omap)
+        return t
+
+    def submit(self, oid, state, entries, log_omap, acting, on_commit):
+        txn = self._object_txn(oid, state, log_omap)
+        peers = [o for o in acting
+                 if o != self.whoami and o != CRUSH_ITEM_NONE and o >= 0]
+        tid = self._new_tid()
+        op = InFlightOp(set(peers) | {self.whoami},
+                        lambda: (self._done(tid), on_commit()))
+        self.in_flight[tid] = op
+        body = txn.to_bytes()
+        for peer in peers:
+            msg = m.MOSDRepOp(self.pgid, self.epoch_fn(), body, entries)
+            msg.tid = tid
+            self.osd_send(peer, msg)
+        # local apply last: the store raises on real corruption, and the
+        # self-ack completes the op when peers already answered
+        self.store.queue_transaction(txn)
+        op.ack(self.whoami)
+
+    def apply_rep_op(self, txn_bytes: bytes) -> None:
+        """Replica side of MOSDRepOp (sub_op_modify)."""
+        self.store.queue_transaction(Transaction.from_bytes(txn_bytes))
+
+    def read_object(self, oid, acting, done):
+        g = GHObject(oid)
+        if not self.store.exists(self.coll, g):
+            done(None)
+            return
+        done(ObjectState(
+            self.store.read(self.coll, g),
+            self.store.getattrs(self.coll, g),
+            self.store.omap_get(self.coll, g),
+        ))
+
+    def object_names(self) -> List[str]:
+        return [o.name for o in self.store.collection_list(self.coll)
+                if o.name != "_pgmeta_" and o.snap == -2]
+
+
+# ---------------------------------------------------------------------------
+# Erasure-coded
+# ---------------------------------------------------------------------------
+
+
+def _hinfo(chunk: bytes, total_size: int) -> bytes:
+    """Per-shard HashInfo xattr: (object logical size, chunk crc32c)
+    (reference ECUtil::HashInfo, src/osd/ECUtil.h:101-122)."""
+    e = Encoder()
+    e.u64(total_size).u32(crc32c(chunk))
+    return e.bytes()
+
+
+def hinfo_decode(blob: bytes) -> Tuple[int, int]:
+    d = Decoder(blob)
+    return d.u64(), d.u32()
+
+
+class ECBackend(PGBackend):
+    """EC distribution: shard i of the acting set stores chunk i."""
+
+    def __init__(self, pgid, coll, store, whoami, osd_send, epoch_fn,
+                 codec) -> None:
+        super().__init__(pgid, coll, store, whoami, osd_send, epoch_fn)
+        self.codec = codec
+        self.queue = default_queue()
+
+    @property
+    def k(self) -> int:
+        return self.codec.k
+
+    @property
+    def m(self) -> int:
+        return self.codec.m
+
+    def _encode_object(self, data: bytes) -> Tuple[List[bytes], int]:
+        """Object buffer -> k+m chunk payloads via the batch queue."""
+        planes, chunk = self.codec.encode_prepare(data)
+        coding = self.queue.encode(self.codec, planes)
+        chunks = [planes[i].tobytes() for i in range(self.k)]
+        chunks += [np.asarray(coding[j]).tobytes() for j in range(self.m)]
+        return chunks, chunk
+
+    def _shard_txn(self, oid: str, shard: int, chunk: Optional[bytes],
+                   state: Optional[ObjectState],
+                   log_omap: Dict[str, bytes]) -> Transaction:
+        t = Transaction()
+        g = GHObject(oid, shard=shard)
+        if state is None:
+            t.try_remove(self.coll, g)
+        else:
+            t.truncate(self.coll, g, 0)
+            t.write(self.coll, g, 0, chunk or b"")
+            attrs = dict(state.xattrs)
+            attrs["hinfo"] = _hinfo(chunk or b"", len(state.data))
+            t.setattrs(self.coll, g, attrs)
+            t.omap_clear(self.coll, g)
+            if state.omap:
+                t.omap_setkeys(self.coll, g, state.omap)
+        if log_omap:
+            t.touch(self.coll, _meta_oid())
+            t.omap_setkeys(self.coll, _meta_oid(), log_omap)
+        return t
+
+    def submit(self, oid, state, entries, log_omap, acting, on_commit):
+        n = self.k + self.m
+        chunks: List[Optional[bytes]] = [None] * n
+        if state is not None:
+            chunks, _ = self._encode_object(state.data)
+        tid = self._new_tid()
+        shard_osds = list(acting[:n]) + [CRUSH_ITEM_NONE] * (n - len(acting))
+        waiting = set()
+        for shard, osd in enumerate(shard_osds):
+            if osd == CRUSH_ITEM_NONE or osd < 0:
+                continue  # degraded write: missing shard skipped
+            waiting.add((shard, osd))
+        op = InFlightOp(waiting, lambda: (self._done(tid), on_commit()))
+        self.in_flight[tid] = op
+        for shard, osd in enumerate(shard_osds):
+            if osd == CRUSH_ITEM_NONE or osd < 0:
+                continue
+            txn = self._shard_txn(
+                oid, shard,
+                chunks[shard] if state is not None else None,
+                state, log_omap)
+            if osd == self.whoami:
+                self.store.queue_transaction(txn)
+                op.ack((shard, osd))
+            else:
+                msg = m.MECSubWrite(self.pgid, self.epoch_fn(), shard,
+                                    txn.to_bytes(), entries)
+                msg.tid = tid
+                self.osd_send(osd, msg)
+
+    def apply_sub_write(self, txn_bytes: bytes) -> None:
+        """Shard side of MECSubWrite (handle_sub_write,
+        ECBackend.cc:880): log + data in ONE transaction."""
+        self.store.queue_transaction(Transaction.from_bytes(txn_bytes))
+
+    # -- reads ------------------------------------------------------------
+    def read_local_chunk(self, oid: str, shard: int) -> Optional[bytes]:
+        g = GHObject(oid, shard=shard)
+        if not self.store.exists(self.coll, g):
+            return None
+        data = self.store.read(self.coll, g)
+        # verify the stored crc before serving (handle_sub_read's
+        # HashInfo check, ECBackend.cc:955)
+        try:
+            _, want = hinfo_decode(self.store.getattr(self.coll, g, "hinfo"))
+        except Exception:
+            return None
+        if crc32c(data) != want:
+            return None  # corrupt shard reads as missing -> reconstruct
+        return data
+
+    def local_shards(self, acting: Sequence[int]) -> List[int]:
+        return [i for i, o in enumerate(acting[: self.k + self.m])
+                if o == self.whoami]
+
+    def reconstruct(self, oid: str,
+                    avail: Dict[int, bytes]) -> Optional[ObjectState]:
+        """Decode the object from >=k chunk payloads."""
+        if not avail:
+            return None
+        n = len(next(iter(avail.values())))
+        arrs = {i: np.frombuffer(c, dtype=np.uint8) for i, c in avail.items()
+                if len(c) == n}
+        if len(arrs) < self.k:
+            return None
+        want = list(range(self.k))
+        data_chunks = self.codec.decode_array(arrs, want, n)
+        buf = b"".join(data_chunks[i].tobytes() for i in range(self.k))
+        # logical size + attrs come from any shard's metadata
+        some_shard = next(iter(avail))
+        g = GHObject(oid, shard=some_shard)
+        attrs = dict(self.store.getattrs(self.coll, g)) if (
+            self.store.exists(self.coll, g)) else {}
+        size = None
+        if "hinfo" in attrs:
+            size, _ = hinfo_decode(attrs["hinfo"])
+        attrs.pop("hinfo", None)
+        omap = self.store.omap_get(self.coll, g) if (
+            self.store.exists(self.coll, g)) else {}
+        return ObjectState(buf[: size if size is not None else len(buf)],
+                           attrs, omap)
+
+    def object_names(self) -> List[str]:
+        return sorted({o.name for o in self.store.collection_list(self.coll)
+                       if o.name != "_pgmeta_" and o.snap == -2})

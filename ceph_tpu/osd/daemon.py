@@ -1,0 +1,461 @@
+"""OSD daemon: dispatch shell around the PG engine.
+
+Reference: src/osd/OSD.{h,cc} — boot (OSD::init, OSD.cc:2506), fast
+dispatch (ms_fast_dispatch :6718) feeding a sharded, per-PG-ordered op
+queue (op_shardedwq, :2030/:9282), map handling (handle_osd_map
+:7643), OSD<->OSD heartbeats (:4513,:4636).  The mon dependency is a
+narrow interface: `epoch()` + `handle_osdmap(map)` + a failure-report
+callback, so tier-2 tests run OSDs against a shared static map and the
+mon service plugs in unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ceph_tpu.core.workqueue import ShardedWorkQueue
+from ceph_tpu.msg.message import EntityName, Message
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
+from ceph_tpu.osd import messages as m
+from ceph_tpu.osd import types as t_
+from ceph_tpu.osd.osdmap import OSDMap, POOL_ERASURE
+from ceph_tpu.osd.pg import PG
+from ceph_tpu.osd.types import EVersion, PGId, PGInfo
+
+Addr = Tuple[str, int]
+
+
+class _Waiter:
+    """Synchronous request/reply correlation by message tid."""
+
+    def __init__(self, expect: int) -> None:
+        self.expect = expect
+        self.replies: List[Message] = []
+        self.cond = threading.Condition()
+
+    def add(self, msg: Message) -> None:
+        with self.cond:
+            self.replies.append(msg)
+            self.cond.notify_all()
+
+    def wait(self, timeout: float) -> List[Message]:
+        with self.cond:
+            self.cond.wait_for(lambda: len(self.replies) >= self.expect,
+                               timeout)
+            return list(self.replies)
+
+
+class OSDService(Dispatcher):
+    def __init__(self, ctx, whoami: int, store, osdmap: OSDMap,
+                 codec_factory: Callable[[str], object]) -> None:
+        self.ctx = ctx
+        self.whoami = whoami
+        self.store = store
+        self.osdmap = osdmap
+        self.codec_factory = codec_factory
+        self.pgs: Dict[PGId, PG] = {}
+        self.msgr = Messenger(ctx, EntityName("osd", whoami))
+        self.msgr.add_dispatcher(self)
+        self.addr_book: Dict[int, Addr] = {}
+        self._tid = 0
+        self._tid_lock = threading.Lock()
+        self._waiters: Dict[int, _Waiter] = {}
+        self._read_cbs: Dict[int, Callable] = {}
+        self.wq = ShardedWorkQueue(
+            f"osd{whoami}-op", ctx.conf.get("osd_op_num_shards"),
+            process=lambda item: item())
+        self.up = False
+        self._log = ctx.log.dout("osd")
+        self.on_failure_report: Optional[Callable[[int], None]] = None
+        self.hb_stamps: Dict[int, float] = {}
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        pc = ctx.perf.create(f"osd.{whoami}")
+        pc.add_u64_counter("op_w", "client writes")
+        pc.add_u64_counter("op_r", "client reads")
+        pc.add_time_avg("op_w_latency")
+        pc.add_u64_counter("recovery_pushes")
+        self.perf = pc
+
+    # -- lifecycle --------------------------------------------------------
+    def init(self) -> None:
+        self.store.mount()
+        self.msgr.start()
+        self.wq.start()
+        self.up = True
+        self._load_pgs()
+
+    def start_heartbeats(self) -> None:
+        iv = self.ctx.conf.get("osd_heartbeat_interval")
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, args=(iv,), daemon=True,
+            name=f"osd{self.whoami}-hb")
+        self._hb_thread.start()
+
+    def shutdown(self) -> None:
+        self.up = False
+        self._hb_stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=5)
+        self.wq.stop()
+        self.msgr.shutdown()
+        self.store.umount()
+
+    @property
+    def addr(self) -> Addr:
+        return self.msgr.addr
+
+    def epoch(self) -> int:
+        return self.osdmap.epoch
+
+    # -- map handling -----------------------------------------------------
+    def _load_pgs(self) -> None:
+        """Instantiate PGs whose collections exist on this store, then
+        those the current map assigns us."""
+        for coll in self.store.list_collections():
+            name = coll.name
+            if not name.endswith("_head"):
+                continue
+            try:
+                pool_s, seed_s = name[:-5].split(".")
+                pgid = (int(pool_s), int(seed_s, 16))
+            except ValueError:
+                continue
+            if pgid[0] in self.osdmap.pools:
+                pg = self._make_pg(pgid)
+                pg.load_from_store()
+                self.pgs[pgid] = pg
+        self.handle_osdmap(self.osdmap)
+
+    def _make_pg(self, pgid: PGId) -> PG:
+        pool = self.osdmap.pools[pgid[0]]
+        codec = None
+        if pool.pool_type == POOL_ERASURE:
+            codec = self.codec_factory(pool.erasure_code_profile)
+        return PG(pgid, pool, self, codec)
+
+    def handle_osdmap(self, osdmap: OSDMap,
+                      addr_book: Optional[Dict[int, Addr]] = None) -> None:
+        """consume_map: adopt the epoch, re-derive PG membership."""
+        self.osdmap = osdmap
+        if addr_book:
+            self.addr_book.update(addr_book)
+        for pool_id, pool in osdmap.pools.items():
+            for seed in range(pool.pg_num):
+                pgid = (pool_id, seed)
+                up, up_p, acting, acting_p = osdmap.pg_to_up_acting(pgid)
+                member = self.whoami in acting
+                pg = self.pgs.get(pgid)
+                if member and pg is None:
+                    pg = self._make_pg(pgid)
+                    pg.update_acting(acting, acting_p)
+                    pg.create_onstore()
+                    pg.load_from_store()
+                    self.pgs[pgid] = pg
+                elif pg is not None:
+                    pg.update_acting(acting, acting_p)
+
+    def activate_pgs(self) -> None:
+        for pg in list(self.pgs.values()):
+            pg.activate()
+
+    # -- messaging --------------------------------------------------------
+    def send_to_osd(self, osd_id: int, msg: Message) -> None:
+        addr = self.addr_book.get(osd_id)
+        if addr is None:
+            self._log(0, f"no address for osd.{osd_id}, dropping {msg!r}")
+            return
+        self.msgr.send_message(msg, addr)
+
+    def new_tid(self) -> int:
+        with self._tid_lock:
+            self._tid += 1
+            return self._tid
+
+    def track_reads(self, pgid: PGId, cb: Callable, count: int) -> int:
+        tid = self.new_tid()
+        remaining = [count]
+
+        def wrapped(rep) -> None:
+            remaining[0] -= 1
+            if remaining[0] <= 0:
+                self._read_cbs.pop(tid, None)
+            cb(rep)
+
+        self._read_cbs[tid] = wrapped
+        return tid
+
+    # -- dispatch ---------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, m.MOSDPing):
+            return self._handle_ping(conn, msg)
+        if isinstance(msg, (m.MOSDRepOpReply, m.MECSubWriteReply)):
+            pg = self.pgs.get(msg.pgid)
+            if pg is not None:
+                who = ((msg.shard, self._osd_of(msg))
+                       if isinstance(msg, m.MECSubWriteReply)
+                       else self._osd_of(msg))
+                pg.backend.handle_reply(msg.tid, who)
+            return True
+        if isinstance(msg, m.MECSubReadReply):
+            cb = self._read_cbs.get(msg.tid)
+            if cb is not None:
+                cb(msg)
+            else:
+                w = self._waiters.get(msg.tid)
+                if w:
+                    w.add(msg)
+            return True
+        if isinstance(msg, (m.MPGInfo, m.MScrubMap, m.MPGPushReply)):
+            w = self._waiters.get(msg.tid)
+            if w:
+                w.add(msg)
+            return True
+        if isinstance(msg, m.MOSDOp):
+            pg = self.pgs.get(msg.pgid)
+            if pg is None:
+                rep = m.MOSDOpReply(msg.pgid, self.epoch(), msg.oid,
+                                    msg.ops, result=-2)
+                rep.tid = msg.tid
+                conn.send(rep)
+                return True
+            tid = msg.tid
+
+            def run(pg=pg, msg=msg, conn=conn, tid=tid) -> None:
+                t0 = time.perf_counter()
+                is_w = any(o.is_write() for o in msg.ops)
+
+                def reply(rep: m.MOSDOpReply) -> None:
+                    rep.tid = tid
+                    conn.send(rep)
+                    if is_w:
+                        self.perf.inc("op_w")
+                        self.perf.tinc("op_w_latency",
+                                       time.perf_counter() - t0)
+                    else:
+                        self.perf.inc("op_r")
+
+                pg.do_op(msg, reply)
+
+            self.wq.queue(msg.pgid, run,
+                          priority=self.ctx.conf.get("osd_client_op_priority"))
+            return True
+        # pg-targeted server-side messages run ordered on the same queue
+        if isinstance(msg, (m.MOSDRepOp, m.MECSubWrite, m.MECSubRead,
+                            m.MPGQuery, m.MPGPush, m.MPGPull, m.MScrub)):
+            pg = self.pgs.get(msg.pgid)
+            if pg is None:
+                return True
+
+            def run(pg=pg, msg=msg, conn=conn) -> None:
+                if isinstance(msg, m.MOSDRepOp):
+                    pg.handle_rep_op(msg, conn)
+                elif isinstance(msg, m.MECSubWrite):
+                    pg.handle_sub_write(msg, conn)
+                elif isinstance(msg, m.MECSubRead):
+                    pg.handle_sub_read(msg, conn)
+                elif isinstance(msg, m.MPGQuery):
+                    pg.handle_query(msg, conn)
+                elif isinstance(msg, m.MPGPush):
+                    pg.handle_push(msg, conn)
+                elif isinstance(msg, m.MPGPull):
+                    for oid in msg.oids:
+                        pg.push_object(oid, self._osd_of(msg))
+                    done = m.MPGPushReply(pg.pgid, self.epoch(), "", 0)
+                    done.tid = msg.tid
+                    conn.send(done)  # completion marker for the puller
+                elif isinstance(msg, m.MScrub):
+                    rep = m.MScrubMap(pg.pgid, self.epoch(),
+                                      pg.local_scrub_map())
+                    rep.tid = msg.tid
+                    conn.send(rep)
+
+            prio = (self.ctx.conf.get("osd_client_op_priority")
+                    if isinstance(msg, (m.MOSDRepOp, m.MECSubWrite,
+                                        m.MECSubRead))
+                    else self.ctx.conf.get("osd_recovery_op_priority"))
+            self.wq.queue(msg.pgid, run, priority=prio)
+            return True
+        return False
+
+    def _osd_of(self, msg: Message) -> int:
+        return msg.src.num if msg.src and msg.src.kind == "osd" else -1
+
+    # -- heartbeats -------------------------------------------------------
+    def _hb_loop(self, interval: float) -> None:
+        grace = self.ctx.conf.get("osd_heartbeat_grace")
+        while not self._hb_stop.wait(interval):
+            now = time.time()
+            for osd_id, addr in list(self.addr_book.items()):
+                if osd_id == self.whoami or not self.osdmap.is_up(osd_id):
+                    continue
+                ping = m.MOSDPing(m.MOSDPing.PING, now, self.epoch())
+                self.msgr.send_message(ping, addr)
+                last = self.hb_stamps.get(osd_id)
+                if last is not None and now - last > grace:
+                    if self.on_failure_report:
+                        self.on_failure_report(osd_id)
+
+    def _handle_ping(self, conn: Connection, msg: m.MOSDPing) -> bool:
+        if msg.op == m.MOSDPing.PING:
+            rep = m.MOSDPing(m.MOSDPing.PING_REPLY, msg.stamp, self.epoch())
+            conn.send(rep)
+        else:
+            osd_id = self._osd_of(msg)
+            if osd_id >= 0:
+                self.hb_stamps[osd_id] = time.time()
+        return True
+
+    # -- synchronous peer RPCs (peering/recovery/scrub helpers) -----------
+    def rpc(self, peers_msgs: List[Tuple[int, Message]],
+            timeout: float = 10.0) -> List[Message]:
+        return self._rpc(peers_msgs, timeout)
+
+    def _rpc(self, peers_msgs: List[Tuple[int, Message]],
+             timeout: float = 10.0) -> List[Message]:
+        tid = self.new_tid()
+        w = _Waiter(len(peers_msgs))
+        self._waiters[tid] = w
+        try:
+            for osd_id, msg in peers_msgs:
+                msg.tid = tid
+                self.send_to_osd(osd_id, msg)
+            return w.wait(timeout)
+        finally:
+            self._waiters.pop(tid, None)
+
+    def collect_pg_infos(self, pg: PG, peers: List[int]) -> Dict[int, PGInfo]:
+        if not peers:
+            return {}
+        reps = self._rpc([
+            (p, m.MPGQuery(pg.pgid, self.epoch(), EVersion()))
+            for p in peers
+        ])
+        out: Dict[int, PGInfo] = {}
+        for rep in reps:
+            if isinstance(rep, m.MPGInfo):
+                out[self._osd_of(rep)] = rep.info
+        return out
+
+    def pull_from_peer(self, pg: PG, best_osd: int, since: EVersion) -> None:
+        """Catch this (primary) osd up from a peer with a newer log."""
+        reps = self._rpc([(best_osd,
+                           m.MPGQuery(pg.pgid, self.epoch(), since))])
+        if not reps or not isinstance(reps[0], m.MPGInfo):
+            return
+        info_msg = reps[0]
+        latest: Dict[str, t_.LogEntry] = {}
+        for en in info_msg.entries:
+            latest[en.oid] = en
+        if not info_msg.entries and info_msg.info.last_update > since:
+            # fell behind the peer's log tail: backfill every object
+            latest = {}
+            if pg.is_ec():
+                names = set()
+                reps2 = self._rpc([(best_osd, m.MScrub(pg.pgid,
+                                                       self.epoch()))])
+                if reps2 and isinstance(reps2[0], m.MScrubMap):
+                    names = set(reps2[0].digests)
+            else:
+                reps2 = self._rpc([(best_osd, m.MScrub(pg.pgid,
+                                                       self.epoch()))])
+                names = (set(reps2[0].digests)
+                         if reps2 and isinstance(reps2[0], m.MScrubMap)
+                         else set())
+            for oid in names:
+                latest[oid] = t_.LogEntry(
+                    t_.LOG_MODIFY, oid, info_msg.info.last_update,
+                    EVersion())
+        if not latest:
+            return
+        if pg.is_ec():
+            # reconstruct my shard(s) from surviving peers
+            for oid, en in latest.items():
+                self._ec_self_recover(pg, oid, en)
+        else:
+            pulls = [oid for oid, en in latest.items()
+                     if en.op != t_.LOG_DELETE]
+            dels = [oid for oid, en in latest.items()
+                    if en.op == t_.LOG_DELETE]
+            from ceph_tpu.store.objectstore import GHObject, Transaction
+
+            for oid in dels:
+                t = Transaction()
+                t.try_remove(pg.coll, GHObject(oid))
+                self.store.queue_transaction(t)
+            if pulls:
+                self._rpc([(best_osd,
+                            m.MPGPull(pg.pgid, self.epoch(), pulls))],
+                          timeout=30.0)
+        with pg.lock:
+            for en in sorted(info_msg.entries, key=lambda e: e.version):
+                if en.version > pg.log.head:
+                    pg.log.append(en)
+            if info_msg.info.last_update > pg.info.last_update:
+                pg.info.last_update = info_msg.info.last_update
+                pg.info.last_complete = info_msg.info.last_update
+            pg._persist_meta(pg.log.omap_additions(pg.log.entries))
+
+    def _ec_self_recover(self, pg: PG, oid: str, en) -> None:
+        from ceph_tpu.osd.backend import ECBackend
+        from ceph_tpu.store.objectstore import GHObject, Transaction
+
+        be: ECBackend = pg.backend  # type: ignore[assignment]
+        my_shards = be.local_shards(pg.acting)
+        if en.op == t_.LOG_DELETE:
+            t = Transaction()
+            for shard in my_shards:
+                t.try_remove(pg.coll, GHObject(oid, shard=shard))
+            self.store.queue_transaction(t)
+            return
+        done = threading.Event()
+        box: List[Optional[object]] = [None]
+
+        def got(state) -> None:
+            box[0] = state
+            done.set()
+
+        pg._ec_read_object(oid, got)
+        done.wait(timeout=30.0)
+        state = box[0]
+        if state is None:
+            return
+        chunks, _ = be._encode_object(state.data)
+        from ceph_tpu.osd.backend import _hinfo
+
+        t = Transaction()
+        for shard in my_shards:
+            g = GHObject(oid, shard=shard)
+            t.truncate(pg.coll, g, 0)
+            t.write(pg.coll, g, 0, chunks[shard])
+            attrs = dict(state.xattrs)
+            attrs["hinfo"] = _hinfo(chunks[shard], len(state.data))
+            t.setattrs(pg.coll, g, attrs)
+            t.omap_clear(pg.coll, g)
+            if state.omap:
+                t.omap_setkeys(pg.coll, g, state.omap)
+        self.store.queue_transaction(t)
+        self.perf.inc("recovery_pushes")
+
+    def collect_scrub_maps(self, pg: PG) -> Dict[int, Dict[str, int]]:
+        peers = [o for o in set(pg.acting)
+                 if o not in (self.whoami, 0x7FFFFFFF) and o >= 0]
+        out = {self.whoami: pg.local_scrub_map()}
+        if peers:
+            reps = self._rpc([(p, m.MScrub(pg.pgid, self.epoch()))
+                              for p in peers])
+            for rep in reps:
+                if isinstance(rep, m.MScrubMap):
+                    out[self._osd_of(rep)] = rep.digests
+        return out
+
+    def fetch_remote_chunk(self, pg: PG, osd_id: int, shard: int,
+                           oid: str) -> Optional[bytes]:
+        reps = self._rpc([(osd_id, m.MECSubRead(pg.pgid, self.epoch(),
+                                                shard, oid, 0, 0))])
+        for rep in reps:
+            if isinstance(rep, m.MECSubReadReply) and rep.result == 0:
+                return rep.data
+        return None

@@ -1,0 +1,407 @@
+"""OSD wire messages (the src/messages/ family this framework needs).
+
+Reference message types mirrored here: MOSDOp/MOSDOpReply (client I/O),
+MOSDRepOp/Reply (replicated backend fan-out, src/messages/MOSDRepOp.h),
+MOSDECSubOpWrite/Read + replies (EC shard fan-out,
+src/messages/MOSDECSubOpWrite.h), MOSDPGQuery/Log/Info (peering),
+MOSDPGPush/PushReply (recovery), MOSDPing (heartbeats), MOSDBoot /
+MOSDFailure / MOSDMap (mon traffic, defined here for reuse by mon/).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.msg.message import Message, register
+from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp, PGId, PGInfo
+
+
+def _enc_pgid(e: Encoder, pgid: PGId) -> None:
+    e.s64(pgid[0]).u32(pgid[1])
+
+
+def _dec_pgid(d: Decoder) -> PGId:
+    return (d.s64(), d.u32())
+
+
+class _PGMessage(Message):
+    """Common pgid + map epoch header."""
+
+    def __init__(self, pgid: PGId = (0, 0), epoch: int = 0) -> None:
+        super().__init__()
+        self.pgid = pgid
+        self.epoch = epoch
+
+    def _enc_head(self, e: Encoder) -> None:
+        _enc_pgid(e, self.pgid)
+        e.u32(self.epoch)
+
+    def _dec_head(self, d: Decoder) -> None:
+        self.pgid = _dec_pgid(d)
+        self.epoch = d.u32()
+
+
+@register
+class MOSDOp(_PGMessage):
+    """Client -> primary: ops on one object (src/messages/MOSDOp.h)."""
+
+    TYPE = 10
+
+    def __init__(self, pgid=(0, 0), epoch=0, oid: str = "",
+                 ops: Optional[List[OSDOp]] = None) -> None:
+        super().__init__(pgid, epoch)
+        self.oid = oid
+        self.ops: List[OSDOp] = ops or []
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.string(self.oid)
+        e.seq(self.ops, lambda enc, o: o.encode(enc))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.oid = d.string()
+        self.ops = d.seq(OSDOp.decode)
+
+
+@register
+class MOSDOpReply(_PGMessage):
+    TYPE = 11
+
+    def __init__(self, pgid=(0, 0), epoch=0, oid: str = "",
+                 ops: Optional[List[OSDOp]] = None, result: int = 0,
+                 version: EVersion = EVersion()) -> None:
+        super().__init__(pgid, epoch)
+        self.oid = oid
+        self.ops: List[OSDOp] = ops or []
+        self.result = result
+        self.version = version
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.string(self.oid).s32(self.result)
+        self.version.encode(e)
+        e.seq(self.ops, lambda enc, o: o.encode(enc))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.oid = d.string()
+        self.result = d.s32()
+        self.version = EVersion.decode(d)
+        self.ops = d.seq(OSDOp.decode)
+
+
+@register
+class MOSDRepOp(_PGMessage):
+    """Primary -> replica: apply this transaction + log entries
+    (src/messages/MOSDRepOp.h)."""
+
+    TYPE = 12
+
+    def __init__(self, pgid=(0, 0), epoch=0, txn: bytes = b"",
+                 entries: Optional[List[LogEntry]] = None) -> None:
+        super().__init__(pgid, epoch)
+        self.txn = txn
+        self.entries = entries or []
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.blob(self.txn)
+        e.seq(self.entries, lambda enc, en: en.encode(enc))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.txn = d.blob()
+        self.entries = d.seq(LogEntry.decode)
+
+
+@register
+class MOSDRepOpReply(_PGMessage):
+    TYPE = 13
+
+    def __init__(self, pgid=(0, 0), epoch=0, result: int = 0) -> None:
+        super().__init__(pgid, epoch)
+        self.result = result
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.s32(self.result)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.result = d.s32()
+
+
+@register
+class MECSubWrite(_PGMessage):
+    """Primary -> EC shard: shard-local transaction + log entries
+    (src/messages/MOSDECSubOpWrite.h; handled at ECBackend.cc:880)."""
+
+    TYPE = 14
+
+    def __init__(self, pgid=(0, 0), epoch=0, shard: int = -1,
+                 txn: bytes = b"",
+                 entries: Optional[List[LogEntry]] = None) -> None:
+        super().__init__(pgid, epoch)
+        self.shard = shard
+        self.txn = txn
+        self.entries = entries or []
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.s32(self.shard).blob(self.txn)
+        e.seq(self.entries, lambda enc, en: en.encode(enc))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.shard = d.s32()
+        self.txn = d.blob()
+        self.entries = d.seq(LogEntry.decode)
+
+
+@register
+class MECSubWriteReply(_PGMessage):
+    TYPE = 15
+
+    def __init__(self, pgid=(0, 0), epoch=0, shard: int = -1,
+                 result: int = 0) -> None:
+        super().__init__(pgid, epoch)
+        self.shard = shard
+        self.result = result
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.s32(self.shard).s32(self.result)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.shard = d.s32()
+        self.result = d.s32()
+
+
+@register
+class MECSubRead(_PGMessage):
+    """Primary -> EC shard: read shard chunk extents
+    (src/messages/MOSDECSubOpRead.h; handled at ECBackend.cc:955)."""
+
+    TYPE = 16
+
+    def __init__(self, pgid=(0, 0), epoch=0, shard: int = -1,
+                 oid: str = "", off: int = 0, length: int = 0) -> None:
+        super().__init__(pgid, epoch)
+        self.shard = shard
+        self.oid = oid
+        self.off = off
+        self.length = length
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.s32(self.shard).string(self.oid).u64(self.off).u64(self.length)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.shard = d.s32()
+        self.oid = d.string()
+        self.off = d.u64()
+        self.length = d.u64()
+
+
+@register
+class MECSubReadReply(_PGMessage):
+    TYPE = 17
+
+    def __init__(self, pgid=(0, 0), epoch=0, shard: int = -1,
+                 oid: str = "", data: bytes = b"", result: int = 0) -> None:
+        super().__init__(pgid, epoch)
+        self.shard = shard
+        self.oid = oid
+        self.data = data
+        self.result = result
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.s32(self.shard).string(self.oid).blob(self.data).s32(self.result)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.shard = d.s32()
+        self.oid = d.string()
+        self.data = d.blob()
+        self.result = d.s32()
+
+
+@register
+class MPGQuery(_PGMessage):
+    """Primary -> peer: send me your pg_info (+log after `since`)."""
+
+    TYPE = 18
+
+    def __init__(self, pgid=(0, 0), epoch=0,
+                 since: EVersion = EVersion()) -> None:
+        super().__init__(pgid, epoch)
+        self.since = since
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        self.since.encode(e)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.since = EVersion.decode(d)
+
+
+@register
+class MPGInfo(_PGMessage):
+    TYPE = 19
+
+    def __init__(self, pgid=(0, 0), epoch=0,
+                 info: Optional[PGInfo] = None,
+                 entries: Optional[List[LogEntry]] = None) -> None:
+        super().__init__(pgid, epoch)
+        self.info = info or PGInfo()
+        self.entries = entries or []
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        self.info.encode(e)
+        e.seq(self.entries, lambda enc, en: en.encode(enc))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.info = PGInfo.decode(d)
+        self.entries = d.seq(LogEntry.decode)
+
+
+@register
+class MPGPush(_PGMessage):
+    """Recovery push: full object (replicated) or one shard chunk (EC)
+    with attrs+omap (reference PushOp, src/osd/osd_types.h)."""
+
+    TYPE = 20
+
+    def __init__(self, pgid=(0, 0), epoch=0, oid: str = "",
+                 version: EVersion = EVersion(), data: bytes = b"",
+                 attrs: Optional[Dict[str, bytes]] = None,
+                 omap: Optional[Dict[str, bytes]] = None,
+                 shard: int = -1, deleted: bool = False) -> None:
+        super().__init__(pgid, epoch)
+        self.oid = oid
+        self.version = version
+        self.data = data
+        self.attrs = attrs or {}
+        self.omap = omap or {}
+        self.shard = shard
+        self.deleted = deleted
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.string(self.oid)
+        self.version.encode(e)
+        e.blob(self.data).s32(self.shard).boolean(self.deleted)
+        e.mapping(self.attrs, lambda enc, k: enc.string(k),
+                  lambda enc, v: enc.blob(v))
+        e.mapping(self.omap, lambda enc, k: enc.string(k),
+                  lambda enc, v: enc.blob(v))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.oid = d.string()
+        self.version = EVersion.decode(d)
+        self.data = d.blob()
+        self.shard = d.s32()
+        self.deleted = d.boolean()
+        self.attrs = d.mapping(lambda dd: dd.string(), lambda dd: dd.blob())
+        self.omap = d.mapping(lambda dd: dd.string(), lambda dd: dd.blob())
+
+
+@register
+class MPGPushReply(_PGMessage):
+    TYPE = 21
+
+    def __init__(self, pgid=(0, 0), epoch=0, oid: str = "",
+                 result: int = 0) -> None:
+        super().__init__(pgid, epoch)
+        self.oid = oid
+        self.result = result
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.string(self.oid).s32(self.result)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.oid = d.string()
+        self.result = d.s32()
+
+
+@register
+class MOSDPing(Message):
+    """OSD<->OSD heartbeat (src/messages/MOSDPing.h)."""
+
+    TYPE = 22
+    PING = 0
+    PING_REPLY = 1
+
+    def __init__(self, op: int = 0, stamp: float = 0.0,
+                 epoch: int = 0) -> None:
+        super().__init__()
+        self.op = op
+        self.stamp = stamp
+        self.epoch = epoch
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.u8(self.op).f64(self.stamp).u32(self.epoch)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.op = d.u8()
+        self.stamp = d.f64()
+        self.epoch = d.u32()
+
+
+@register
+class MPGPull(_PGMessage):
+    """Recovering peer -> authoritative peer: push me these objects
+    (reference PullOp, src/osd/osd_types.h)."""
+
+    TYPE = 23
+
+    def __init__(self, pgid=(0, 0), epoch=0,
+                 oids: Optional[List[str]] = None) -> None:
+        super().__init__(pgid, epoch)
+        self.oids = oids or []
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.seq(self.oids, lambda enc, s: enc.string(s))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.oids = d.seq(lambda dd: dd.string())
+
+
+@register
+class MScrub(_PGMessage):
+    """Primary -> replica: send your scrub map (build_scrub_map_chunk
+    role, src/osd/PG.cc:4662)."""
+
+    TYPE = 24
+
+
+@register
+class MScrubMap(_PGMessage):
+    TYPE = 25
+
+    def __init__(self, pgid=(0, 0), epoch=0,
+                 digests: Optional[Dict[str, int]] = None) -> None:
+        super().__init__(pgid, epoch)
+        self.digests = digests or {}
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.mapping(self.digests, lambda enc, k: enc.string(k),
+                  lambda enc, v: enc.u32(v))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.digests = d.mapping(lambda dd: dd.string(), lambda dd: dd.u32())

@@ -1,0 +1,97 @@
+"""PGLog — the per-PG ordered mutation log driving replication & recovery.
+
+Reference: src/osd/PGLog.{h,cc} + the IndexedLog. Every write appends a
+LogEntry in the same ObjectStore transaction as the data (the reference
+log_operation discipline, src/osd/ECBackend.cc:924), so replay = log
+scan at mount.  Peers compare (log_tail, head] ranges: a replica whose
+last_update is within the primary's log range catches up by replaying
+the missing entries' objects (log-based recovery); one that fell behind
+the tail needs backfill (full object scan — here: push of every object).
+
+Persistence: entries live in the pg meta object's omap keyed by a
+zero-padded version string, mirroring the reference's omap log keys.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.osd.types import EVersion, LogEntry, LOG_DELETE
+
+MAX_LOG_ENTRIES = 3000  # osd_max_pg_log_entries role
+
+
+def _logkey(v: EVersion) -> str:
+    return f"{v.epoch:010d}.{v.version:020d}"
+
+
+class PGLog:
+    def __init__(self) -> None:
+        self.entries: List[LogEntry] = []
+        self.tail = EVersion()  # everything <= tail is pruned
+        self.head = EVersion()
+
+    # -- mutation ---------------------------------------------------------
+    def append(self, entry: LogEntry) -> None:
+        assert entry.version > self.head, (
+            f"log must advance: {entry.version} <= {self.head}"
+        )
+        self.entries.append(entry)
+        self.head = entry.version
+
+    def trim_to(self, keep: int = MAX_LOG_ENTRIES) -> List[LogEntry]:
+        """Prune oldest entries beyond `keep`; returns what was trimmed."""
+        if len(self.entries) <= keep:
+            return []
+        cut = len(self.entries) - keep
+        trimmed = self.entries[:cut]
+        self.entries = self.entries[cut:]
+        self.tail = trimmed[-1].version
+        return trimmed
+
+    # -- queries ----------------------------------------------------------
+    def entries_after(self, v: EVersion) -> Optional[List[LogEntry]]:
+        """Entries strictly newer than v, or None if v fell behind tail
+        (=> needs backfill)."""
+        if v < self.tail:
+            return None
+        return [en for en in self.entries if en.version > v]
+
+    def objects_changed_after(self, v: EVersion) -> Optional[Dict[str, LogEntry]]:
+        """Latest entry per object among entries after v (None => backfill)."""
+        ents = self.entries_after(v)
+        if ents is None:
+            return None
+        out: Dict[str, LogEntry] = {}
+        for en in ents:
+            out[en.oid] = en
+        return out
+
+    # -- persistence ------------------------------------------------------
+    def omap_additions(self, entries: List[LogEntry]) -> Dict[str, bytes]:
+        out = {}
+        for en in entries:
+            e = Encoder()
+            en.encode(e)
+            out[_logkey(en.version)] = e.bytes()
+        return out
+
+    def omap_removals(self, trimmed: List[LogEntry]) -> List[str]:
+        return [_logkey(en.version) for en in trimmed]
+
+    @classmethod
+    def from_omap(cls, omap: Dict[str, bytes]) -> "PGLog":
+        log = cls()
+        for key in sorted(k for k in omap if k[0].isdigit()):
+            log.entries.append(LogEntry.decode(Decoder(omap[key])))
+        if log.entries:
+            log.head = log.entries[-1].version
+            log.tail = EVersion(
+                log.entries[0].version.epoch,
+                max(0, log.entries[0].version.version - 1),
+            )
+        return log
+
+    def __len__(self) -> int:
+        return len(self.entries)

@@ -1,0 +1,187 @@
+"""OSD core types: pg ids, versions, object info, log entries, ops.
+
+Reference: src/osd/osd_types.{h,cc} — eversion_t (epoch, version),
+pg_info_t, pg_log_entry_t, object_info_t — plus the client op model
+(OSDOp / ceph_osd_op in src/include/rados.h; the opcode interpreter is
+PrimaryLogPG::do_osd_ops, src/osd/PrimaryLogPG.cc:5651).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.core.encoding import Decoder, Encoder
+
+PGId = Tuple[int, int]  # (pool, seed)
+
+
+def pgid_str(pgid: PGId) -> str:
+    return f"{pgid[0]}.{pgid[1]:x}"
+
+
+@dataclass(frozen=True, order=True)
+class EVersion:
+    """eversion_t: (map epoch, monotonically increasing version)."""
+
+    epoch: int = 0
+    version: int = 0
+
+    def encode(self, e: Encoder) -> None:
+        e.u32(self.epoch).u64(self.version)
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "EVersion":
+        return cls(d.u32(), d.u64())
+
+    def __str__(self) -> str:
+        return f"{self.epoch}'{self.version}"
+
+
+# log entry op kinds (reference pg_log_entry_t::op)
+LOG_MODIFY = 1
+LOG_DELETE = 3
+LOG_ERROR = 6
+
+
+@dataclass
+class LogEntry:
+    """pg_log_entry_t: one committed mutation of one object."""
+
+    op: int
+    oid: str
+    version: EVersion
+    prior_version: EVersion
+    mtime: float = 0.0
+    payload: bytes = b""  # opaque per-backend extra (e.g. EC shard info)
+
+    def encode(self, e: Encoder) -> None:
+        e.start(1, 1)
+        e.u8(self.op).string(self.oid)
+        self.version.encode(e)
+        self.prior_version.encode(e)
+        e.f64(self.mtime).blob(self.payload)
+        e.finish()
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "LogEntry":
+        d.start(1)
+        out = cls(
+            op=d.u8(),
+            oid=d.string(),
+            version=EVersion.decode(d),
+            prior_version=EVersion.decode(d),
+            mtime=d.f64(),
+            payload=d.blob(),
+        )
+        d.end()
+        return out
+
+
+@dataclass
+class PGInfo:
+    """pg_info_t: summary a peer needs to judge log-based recoverability."""
+
+    pgid: PGId = (0, 0)
+    last_update: EVersion = field(default_factory=EVersion)
+    last_complete: EVersion = field(default_factory=EVersion)
+    log_tail: EVersion = field(default_factory=EVersion)
+    epoch_created: int = 0
+
+    def encode(self, e: Encoder) -> None:
+        e.start(1, 1)
+        e.s64(self.pgid[0]).u32(self.pgid[1])
+        self.last_update.encode(e)
+        self.last_complete.encode(e)
+        self.log_tail.encode(e)
+        e.u32(self.epoch_created)
+        e.finish()
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "PGInfo":
+        d.start(1)
+        out = cls(
+            pgid=(d.s64(), d.u32()),
+            last_update=EVersion.decode(d),
+            last_complete=EVersion.decode(d),
+            log_tail=EVersion.decode(d),
+            epoch_created=d.u32(),
+        )
+        d.end()
+        return out
+
+
+# -- client op model --------------------------------------------------------
+
+OP_READ = 1
+OP_STAT = 2
+OP_WRITE = 3          # extent write
+OP_WRITEFULL = 4      # replace object content
+OP_APPEND = 5
+OP_DELETE = 6
+OP_TRUNCATE = 7
+OP_ZERO = 8
+OP_GETXATTR = 9
+OP_SETXATTR = 10
+OP_RMXATTR = 11
+OP_GETXATTRS = 12
+OP_OMAP_GET = 13
+OP_OMAP_SET = 14
+OP_OMAP_RM = 15
+OP_CREATE = 16
+OP_CALL = 17          # object class method (cls plugins)
+OP_NOTIFY = 18
+OP_WATCH = 19
+
+WRITE_OPS = {OP_WRITE, OP_WRITEFULL, OP_APPEND, OP_DELETE, OP_TRUNCATE,
+             OP_ZERO, OP_SETXATTR, OP_RMXATTR, OP_OMAP_SET, OP_OMAP_RM,
+             OP_CREATE}
+
+
+@dataclass
+class OSDOp:
+    """One sub-op of a client request (reference OSDOp)."""
+
+    op: int
+    off: int = 0
+    length: int = 0
+    data: bytes = b""
+    name: str = ""               # xattr name / cls "class.method"
+    kv: Dict[str, bytes] = field(default_factory=dict)
+    keys: List[str] = field(default_factory=list)
+
+    # filled on the reply path:
+    out_data: bytes = b""
+    out_kv: Dict[str, bytes] = field(default_factory=dict)
+    rval: int = 0
+
+    def encode(self, e: Encoder) -> None:
+        e.start(1, 1)
+        e.u8(self.op).u64(self.off).u64(self.length).blob(self.data)
+        e.string(self.name)
+        e.mapping(self.kv, lambda enc, k: enc.string(k),
+                  lambda enc, v: enc.blob(v))
+        e.seq(self.keys, lambda enc, k: enc.string(k))
+        e.blob(self.out_data)
+        e.mapping(self.out_kv, lambda enc, k: enc.string(k),
+                  lambda enc, v: enc.blob(v))
+        e.s32(self.rval)
+        e.finish()
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "OSDOp":
+        d.start(1)
+        out = cls(
+            op=d.u8(), off=d.u64(), length=d.u64(), data=d.blob(),
+            name=d.string(),
+            kv=d.mapping(lambda dd: dd.string(), lambda dd: dd.blob()),
+            keys=d.seq(lambda dd: dd.string()),
+        )
+        out.out_data = d.blob()
+        out.out_kv = d.mapping(lambda dd: dd.string(), lambda dd: dd.blob())
+        out.rval = d.s32()
+        d.end()
+        return out
+
+    def is_write(self) -> bool:
+        return self.op in WRITE_OPS

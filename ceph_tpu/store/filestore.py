@@ -80,7 +80,8 @@ class FileStore(ObjectStore):
             applied = int(self._kv.get(P_META, "applied_seq") or b"0")
             self._seq = applied
             self._replay_wal(applied)
-            self._trim_wal()  # replay is fully applied + KV flushed
+            self._sync_state()
+            self._trim_wal()  # replay is fully applied + state synced
             self._wal_fh = open(self._wal_path, "ab")
             self._mounted = True
 
@@ -89,6 +90,7 @@ class FileStore(ObjectStore):
             if self._wal_fh:
                 self._wal_fh.close()
                 self._wal_fh = None
+            self._sync_state()
             self._trim_wal()
             self._kv.close()
             self._mounted = False
@@ -131,60 +133,45 @@ class FileStore(ObjectStore):
             if self.wal_sync:
                 os.fsync(self._wal_fh.fileno())
             self._apply(t, seq, replay=False)
-            # everything through seq is applied and the KV flushed, so
-            # the log before here is dead weight — bound its growth
+            # everything through seq is applied, so the log before here
+            # is dead weight — but the WAL is the ONLY durable copy of
+            # unsynced KV/data pages, so make them durable before
+            # discarding it (else a post-trim power loss loses fsynced
+            # commits the journal was paid to protect)
             if self._wal_fh.tell() > (64 << 20):
+                self._sync_state()
                 self._wal_fh.close()
                 self._trim_wal()
                 self._wal_fh = open(self._wal_path, "ab")
 
+    def _sync_state(self) -> None:
+        if self._kv._fh is not None:
+            self._kv._fh.flush()
+            os.fsync(self._kv._fh.fileno())
+        if self.wal_sync and hasattr(os, "sync"):
+            os.sync()  # data files aren't individually tracked; flush all
+
     def _validate(self, t: Transaction) -> None:
         kv = self._kv
-        store = self
 
-        class LazyColls:
-            def __init__(self):
-                self.over = {}
-
-            def __contains__(self, name):
-                if name in self.over:
-                    return self.over[name]
+        class Overlay(os_.ValidationOverlay):
+            def _base_coll(self, name):
                 return kv.get(P_COLL, name) is not None
 
-            def add(self, name):
-                self.over[name] = True
+            def _base_obj(self, name, oid):
+                return kv.get(
+                    P_OBJ, _objkey(Collection(name), oid)) is not None
 
-            def discard(self, name):
-                self.over[name] = False
-
-        class LazyObjs(dict):
-            def get(self, key, default=None):
-                if key in self:
-                    return dict.get(self, key)
-                cname, oid = key
-                return (
-                    kv.get(P_OBJ, _objkey(Collection(cname), oid)) is not None
-                    or default
-                )
-
-        class LazyCounts(dict):
-            def _base(self, name):
+            def _base_count(self, name):
+                # paid only when the txn contains an RMCOLL
                 pre = name + "/"
                 return sum(
                     1 for k, _ in kv.iterate(P_OBJ) if k.startswith(pre)
                 )
 
-            def get(self, name, default=0):
-                if name in self:
-                    return dict.get(self, name)
-                return self._base(name)
-
-            def __missing__(self, name):
-                return self._base(name)
-
-        colls, objs, counts = LazyColls(), LazyObjs(), LazyCounts()
+        ov = Overlay()
         for op in t.ops:
-            validate_op(op, colls, objs, counts)
+            validate_op(op, ov)
 
     def _apply(self, t: Transaction, seq: int, replay: bool) -> None:
         # one KV submit per op: later ops in the same transaction (clone,
@@ -256,8 +243,12 @@ class FileStore(ObjectStore):
             with open(path, "r+b") as f:
                 f.truncate(size)
             return
-        if code == os_.OP_REMOVE:
-            if not self._require(op.cid, op.oid, replay):
+        if code in (os_.OP_REMOVE, os_.OP_TRY_REMOVE):
+            if code == os_.OP_TRY_REMOVE:
+                if not self._coll_exists(op.cid) or not self._exists_kv(
+                        op.cid, op.oid):
+                    return
+            elif not self._require(op.cid, op.oid, replay):
                 return
             b.rmkey(P_OBJ, key)
             for k, _ in list(self._kv.iterate(P_XATTR)):

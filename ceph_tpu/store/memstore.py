@@ -69,13 +69,23 @@ class MemStore(ObjectStore):
                 self._apply(op)
 
     def _validate(self, t: Transaction) -> None:
-        colls = {c.name for c in self._colls}
-        objs = {
-            (c.name, o): True for c, d in self._colls.items() for o in d
-        }
-        counts = {c.name: len(d) for c, d in self._colls.items()}
+        store = self
+
+        class Overlay(os_.ValidationOverlay):
+            def _base_coll(self, name):
+                return Collection(name) in store._colls
+
+            def _base_obj(self, name, oid):
+                c = store._colls.get(Collection(name))
+                return c is not None and oid in c
+
+            def _base_count(self, name):
+                c = store._colls.get(Collection(name))
+                return len(c) if c is not None else 0
+
+        ov = Overlay()
         for op in t.ops:
-            validate_op(op, colls, objs, counts)
+            validate_op(op, ov)
 
     def _coll(self, cid: Collection) -> Dict[GHObject, _Obj]:
         c = self._colls.get(cid)
@@ -137,6 +147,9 @@ class MemStore(ObjectStore):
             if op.oid not in c:
                 raise NoSuchObject(op.oid.name)
             del c[op.oid]
+            return
+        if code == os_.OP_TRY_REMOVE:
+            self._coll(op.cid).pop(op.oid, None)
             return
         if code == os_.OP_SETATTRS:
             self._obj(op.cid, op.oid, create=True).xattrs.update(op.attrs)

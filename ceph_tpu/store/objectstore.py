@@ -81,6 +81,7 @@ OP_OMAP_SETKEYS = 11
 OP_OMAP_RMKEYS = 12
 OP_OMAP_CLEAR = 13
 OP_COLL_MOVE_RENAME = 14
+OP_TRY_REMOVE = 15  # remove tolerating absence (for replica-shipped txns)
 
 
 @dataclass
@@ -157,6 +158,12 @@ class Transaction:
     def remove(self, cid: Collection, oid: GHObject) -> None:
         self.ops.append(Op(OP_REMOVE, cid, oid))
 
+    def try_remove(self, cid: Collection, oid: GHObject) -> None:
+        """Remove if present; no-op otherwise.  Replication ships
+        primary-built transactions to replicas whose local existence may
+        lag, so deletes must tolerate absence."""
+        self.ops.append(Op(OP_TRY_REMOVE, cid, oid))
+
     def setattrs(self, cid: Collection, oid: GHObject, attrs: Dict[str, bytes]) -> None:
         self.ops.append(Op(OP_SETATTRS, cid, oid, attrs=dict(attrs)))
 
@@ -211,66 +218,122 @@ class Transaction:
         return cls.decode(Decoder(data))
 
 
-def validate_op(op: Op, colls: set, objs: dict, counts: dict) -> None:
+class ValidationOverlay:
+    """Lazy existence overlay for validate-then-apply transactions.
+
+    Subclasses provide base-state lookups (`_base_coll`, `_base_obj`,
+    `_base_count`); the overlay layers this transaction's pending
+    effects on top WITHOUT materializing the store (each op validates in
+    O(1); only RMCOLL's emptiness check pays a per-collection count, and
+    only when an RMCOLL actually appears in the transaction)."""
+
+    def __init__(self) -> None:
+        self._colls: Dict[str, bool] = {}
+        self._objs: Dict[Tuple[str, GHObject], bool] = {}
+        self._count_delta: Dict[str, int] = {}
+        self._fresh: Dict[str, bool] = {}  # created in this txn => base 0
+
+    # -- base state hooks --------------------------------------------------
+    def _base_coll(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def _base_obj(self, name: str, oid: GHObject) -> bool:
+        raise NotImplementedError
+
+    def _base_count(self, name: str) -> int:
+        raise NotImplementedError
+
+    # -- overlay queries ---------------------------------------------------
+    def coll_exists(self, name: str) -> bool:
+        if name in self._colls:
+            return self._colls[name]
+        return self._base_coll(name)
+
+    def obj_exists(self, name: str, oid: GHObject) -> bool:
+        key = (name, oid)
+        if key in self._objs:
+            return self._objs[key]
+        return self._base_obj(name, oid)
+
+    def coll_empty(self, name: str) -> bool:
+        base = 0 if self._fresh.get(name) else self._base_count(name)
+        return base + self._count_delta.get(name, 0) <= 0
+
+    # -- overlay mutations -------------------------------------------------
+    def add_coll(self, name: str) -> None:
+        self._colls[name] = True
+        self._fresh[name] = True
+        self._count_delta[name] = 0
+
+    def rm_coll(self, name: str) -> None:
+        self._colls[name] = False
+
+    def create_obj(self, name: str, oid: GHObject) -> None:
+        if not self.obj_exists(name, oid):
+            self._objs[(name, oid)] = True
+            self._count_delta[name] = self._count_delta.get(name, 0) + 1
+
+    def rm_obj(self, name: str, oid: GHObject) -> None:
+        if self.obj_exists(name, oid):
+            self._objs[(name, oid)] = False
+            self._count_delta[name] = self._count_delta.get(name, 0) - 1
+
+
+def validate_op(op: Op, ov: ValidationOverlay) -> None:
     """Shared validation pass giving queue_transaction all-or-nothing
-    semantics: simulate existence effects over an overlay (colls: set of
-    names; objs: {(coll, oid): True}; counts: {coll: n_objects}) and
-    raise exactly the errors apply would, before any backend mutates."""
+    semantics: raise exactly the errors apply would, before any backend
+    mutates."""
     code = op.op
     cname = op.cid.name
 
     def need_coll():
-        if cname not in colls:
+        if not ov.coll_exists(cname):
             raise NoSuchCollection(cname)
 
     def need_obj():
         need_coll()
-        if not objs.get((cname, op.oid)):
+        if not ov.obj_exists(cname, op.oid):
             raise NoSuchObject(f"{cname}/{op.oid.name}")
-
-    def create_obj(cid_name, oid):
-        if not objs.get((cid_name, oid)):
-            objs[(cid_name, oid)] = True
-            counts[cid_name] = counts.get(cid_name, 0) + 1
 
     if code == OP_NOP:
         return
     if code == OP_MKCOLL:
-        if cname in colls:
+        if ov.coll_exists(cname):
             raise StoreError(f"collection exists: {cname}")
-        colls.add(cname)
-        counts[cname] = 0
+        ov.add_coll(cname)
         return
     if code == OP_RMCOLL:
         need_coll()
-        if counts.get(cname, 0):
+        if not ov.coll_empty(cname):
             raise StoreError(f"collection not empty: {cname}")
-        colls.discard(cname)
+        ov.rm_coll(cname)
         return
     if code in (OP_TOUCH, OP_WRITE, OP_ZERO, OP_TRUNCATE, OP_SETATTRS,
                 OP_OMAP_SETKEYS):
         need_coll()
-        create_obj(cname, op.oid)
+        ov.create_obj(cname, op.oid)
         return
     if code in (OP_REMOVE,):
         need_obj()
-        objs[(cname, op.oid)] = False  # tombstone (overlay-friendly)
-        counts[cname] = counts.get(cname, 0) - 1
+        ov.rm_obj(cname, op.oid)
+        return
+    if code == OP_TRY_REMOVE:
+        need_coll()
+        ov.rm_obj(cname, op.oid)
         return
     if code in (OP_RMATTR, OP_OMAP_RMKEYS, OP_OMAP_CLEAR):
         need_obj()
         return
     if code == OP_CLONE:
         need_obj()
-        create_obj(cname, op.dest_oid)
+        ov.create_obj(cname, op.dest_oid)
         return
     if code == OP_COLL_MOVE_RENAME:
         need_obj()
-        if op.dest_cid.name not in colls:
+        if not ov.coll_exists(op.dest_cid.name):
             raise NoSuchCollection(op.dest_cid.name)
-        objs[(cname, op.oid)] = False
-        counts[cname] = counts.get(cname, 0) - 1
-        create_obj(op.dest_cid.name, op.dest_oid)
+        ov.rm_obj(cname, op.oid)
+        ov.create_obj(op.dest_cid.name, op.dest_oid)
         return
     raise StoreError(f"unknown op {code}")
 

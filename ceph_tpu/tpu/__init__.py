@@ -1,0 +1,9 @@
+"""Host↔device runtime: the stripe-batch queue feeding EC kernels.
+
+SURVEY.md Phase 3's "hard perf part": per-op device dispatch of small
+(4 KiB) stripes would drown in launch latency, so concurrent writes are
+coalesced into one wide GF(2) matmul per codec (batch dim = stripe
+columns), the TPU analog of ISA-L processing many packets per call.
+"""
+
+from ceph_tpu.tpu.queue import StripeBatchQueue  # noqa: F401

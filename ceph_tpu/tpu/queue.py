@@ -1,0 +1,142 @@
+"""StripeBatchQueue — coalesce concurrent EC encodes into wide matmuls.
+
+The write path hands each object's [k, chunk] data planes to this
+queue and blocks on a future; a worker thread greedily drains jobs
+that share a codec, concatenates them along the column axis, runs ONE
+device matmul, and splits the coding planes back out.  Dispatch cost
+is amortized over every write in flight — the TPU equivalent of the
+reference's per-call SIMD batch (and the only way small stripes win;
+see SURVEY.md §7 hard parts #2).
+
+Double-buffering falls out of the design: while the device runs batch
+N, the worker is already collecting batch N+1.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class _Job:
+    __slots__ = ("codec", "planes", "future")
+
+    def __init__(self, codec, planes: np.ndarray) -> None:
+        self.codec = codec
+        self.planes = planes
+        self.future: Future = Future()
+
+
+class StripeBatchQueue:
+    def __init__(
+        self,
+        max_batch_cols: int = 1 << 20,
+        window_s: float = 0.0005,
+    ) -> None:
+        self.max_batch_cols = max_batch_cols
+        self.window_s = window_s
+        self._q: "queue.Queue[_Job | None]" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._worker, name="stripe-batch", daemon=True
+        )
+        self._started = False
+        self._lock = threading.Lock()
+        self.batches = 0       # perf: device dispatches
+        self.jobs = 0          # perf: logical encodes
+
+    def start(self) -> None:
+        with self._lock:
+            if not self._started:
+                self._started = True
+                self._thread.start()
+
+    def stop(self) -> None:
+        if self._started:
+            self._q.put(None)
+            self._thread.join(timeout=10)
+            self._started = False
+
+    # -- API --------------------------------------------------------------
+    def encode_async(self, codec, planes: np.ndarray) -> Future:
+        """planes: uint8 [k, n] -> Future of coding planes [m, n]."""
+        self.start()
+        job = _Job(codec, np.ascontiguousarray(planes, dtype=np.uint8))
+        self._q.put(job)
+        return job.future
+
+    def encode(self, codec, planes: np.ndarray) -> np.ndarray:
+        return self.encode_async(codec, planes).result()
+
+    # -- worker -----------------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            batch = [job]
+            cols = job.planes.shape[1]
+            # greedy same-codec coalescing: drain whatever is queued,
+            # waiting at most one window for stragglers
+            waited = False
+            while cols < self.max_batch_cols:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    if waited:
+                        break
+                    waited = True
+                    try:
+                        nxt = self._q.get(timeout=self.window_s)
+                    except queue.Empty:
+                        break
+                if nxt is None:
+                    self._run_batch(batch)
+                    return
+                if nxt.codec is not batch[0].codec or (
+                    nxt.planes.shape[0] != batch[0].planes.shape[0]
+                ):
+                    # different codec: flush current, start fresh
+                    self._run_batch(batch)
+                    batch = [nxt]
+                    cols = nxt.planes.shape[1]
+                    waited = False
+                    continue
+                batch.append(nxt)
+                cols += nxt.planes.shape[1]
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: List[_Job]) -> None:
+        try:
+            if len(batch) == 1:
+                coding = batch[0].codec.encode_array(batch[0].planes)
+                batch[0].future.set_result(np.asarray(coding))
+            else:
+                widths = [j.planes.shape[1] for j in batch]
+                stacked = np.concatenate([j.planes for j in batch], axis=1)
+                coding = np.asarray(batch[0].codec.encode_array(stacked))
+                off = 0
+                for j, w in zip(batch, widths):
+                    j.future.set_result(coding[:, off:off + w])
+                    off += w
+            self.batches += 1
+            self.jobs += len(batch)
+        except BaseException as e:  # noqa: BLE001 — propagate to callers
+            for j in batch:
+                if not j.future.done():
+                    j.future.set_exception(e)
+
+
+_default: StripeBatchQueue | None = None
+_default_lock = threading.Lock()
+
+
+def default_queue() -> StripeBatchQueue:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = StripeBatchQueue()
+        return _default

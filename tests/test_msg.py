@@ -208,6 +208,7 @@ def test_dup_suppression_across_reconnect(ctx):
         m = MEcho("only-once")
         m.seq = 1
         m.nonce = a.nonce
+        m.sid = conn.sid
         m.src = a.entity
         body = m.to_bytes()
         frame = _s.pack("<II", len(body), _crc(body)) + body

@@ -1,0 +1,292 @@
+"""Tier-2 integration: in-process mini cluster (SURVEY.md §4 tier 2).
+
+The Phase-3 "aha" path: put -> (TPU) EC encode -> k+m shards on
+distinct OSDs; kill a shard holder -> get reconstructs via decode;
+revive -> log-based recovery; scrub detects an injected shard
+corruption (reference qa analogs: test-erasure-code.sh,
+test-erasure-eio.sh, osd thrashing).
+"""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.core.context import Context
+from ceph_tpu.crush import map as cmap
+from ceph_tpu.ec import codec_from_profile
+from ceph_tpu.msg.message import EntityName
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+from ceph_tpu.osd import messages as m
+from ceph_tpu.osd import types as t_
+from ceph_tpu.osd.daemon import OSDService
+from ceph_tpu.osd.osdmap import OSDMap, PGPool, POOL_ERASURE, POOL_REPLICATED
+from ceph_tpu.store.memstore import MemStore
+
+N_OSDS = 6
+REP_POOL = 1
+EC_POOL = 2
+EC_PROFILE = "plugin=isa k=2 m=1 technique=reed_sol_van"
+
+
+def build_map() -> OSDMap:
+    cm, root = cmap.build_flat_cluster(N_OSDS, hosts=N_OSDS)
+    cm.add_simple_rule("replicated", root, 1, mode="firstn")  # host domain
+    cm.add_simple_rule("ec", root, 1, mode="indep")
+    osdmap = OSDMap(cm, max_osd=N_OSDS)
+    osdmap.add_pool(PGPool(REP_POOL, POOL_REPLICATED, size=3, min_size=2,
+                           pg_num=8, pgp_num=8, crush_rule=0))
+    osdmap.add_pool(PGPool(EC_POOL, POOL_ERASURE, size=3, min_size=2,
+                           pg_num=8, pgp_num=8, crush_rule=1,
+                           erasure_code_profile=EC_PROFILE))
+    return osdmap
+
+
+class MiniCluster:
+    """N OSDService instances over memstores + one shared map."""
+
+    def __init__(self) -> None:
+        self.ctx = Context("osd.cluster")
+        self.osdmap = build_map()
+        self.osds = {}
+        for i in range(N_OSDS):
+            svc = OSDService(self.ctx, i, MemStore(), self.osdmap,
+                             codec_from_profile)
+            svc.store.mkfs()
+            svc.init()
+            self.osds[i] = svc
+        self.refresh()
+        self.activate()
+
+    def refresh(self) -> None:
+        book = {i: o.addr for i, o in self.osds.items() if o.up}
+        for o in self.osds.values():
+            if o.up:
+                o.handle_osdmap(self.osdmap, book)
+
+    def activate(self) -> None:
+        for o in self.osds.values():
+            if o.up:
+                o.activate_pgs()
+
+    def kill(self, osd_id: int) -> None:
+        self.osds[osd_id].shutdown()
+        self.osdmap.set_osd_down(osd_id)
+        self.refresh()
+        self.activate()
+
+    def revive(self, osd_id: int) -> None:
+        old = self.osds[osd_id]
+        svc = OSDService(self.ctx, osd_id, old.store, self.osdmap,
+                         codec_from_profile)
+        svc.init()
+        self.osds[osd_id] = svc
+        self.osdmap.set_osd_up(osd_id)
+        self.refresh()
+        self.activate()
+
+    def shutdown(self) -> None:
+        for o in self.osds.values():
+            if o.up:
+                o.shutdown()
+
+    def primary_of(self, pool: int, oid: str):
+        pgid = self.osdmap.object_to_pg(pool, oid)
+        up, up_p, acting, acting_p = self.osdmap.pg_to_up_acting(pgid)
+        return pgid, acting, acting_p
+
+
+class TestClient(Dispatcher):
+    def __init__(self, cluster: MiniCluster) -> None:
+        self.cluster = cluster
+        self.msgr = Messenger(cluster.ctx, EntityName("client", 99))
+        self.msgr.add_dispatcher(self)
+        self.msgr.start()
+        self._waiters = {}
+        self._tid = 0
+        self._lock = threading.Lock()
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, m.MOSDOpReply):
+            w = self._waiters.get(msg.tid)
+            if w is not None:
+                w[1] = msg
+                w[0].set()
+            return True
+        return False
+
+    def op(self, pool: int, oid: str, ops, timeout=15.0) -> m.MOSDOpReply:
+        pgid, acting, primary = self.cluster.primary_of(pool, oid)
+        assert primary >= 0, f"no primary for {oid} (acting={acting})"
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+        msg = m.MOSDOp(pgid, self.cluster.osdmap.epoch, oid, ops)
+        msg.tid = tid
+        ev = threading.Event()
+        self._waiters[tid] = [ev, None]
+        self.msgr.send_message(msg, self.cluster.osds[primary].addr)
+        assert ev.wait(timeout), f"op on {oid} timed out"
+        rep = self._waiters.pop(tid)[1]
+        return rep
+
+    def put(self, pool: int, oid: str, data: bytes) -> m.MOSDOpReply:
+        return self.op(pool, oid,
+                       [t_.OSDOp(t_.OP_WRITEFULL, data=data)])
+
+    def get(self, pool: int, oid: str) -> bytes:
+        rep = self.op(pool, oid, [t_.OSDOp(t_.OP_READ)])
+        assert rep.result == 0, f"read failed: {rep.result}"
+        return rep.ops[0].out_data
+
+    def delete(self, pool: int, oid: str) -> m.MOSDOpReply:
+        return self.op(pool, oid, [t_.OSDOp(t_.OP_DELETE)])
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    cl = TestClient(cluster)
+    yield cl
+    cl.shutdown()
+
+
+def test_replicated_write_read(cluster, client):
+    data = b"replicated-payload" * 100
+    rep = client.put(REP_POOL, "robj1", data)
+    assert rep.result == 0
+    assert client.get(REP_POOL, "robj1") == data
+    # the object exists on every acting osd
+    pgid, acting, _ = cluster.primary_of(REP_POOL, "robj1")
+    from ceph_tpu.store.objectstore import Collection, GHObject
+
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    for osd_id in acting:
+        assert cluster.osds[osd_id].store.exists(coll, GHObject("robj1"))
+
+
+def test_replicated_xattr_omap_ops(cluster, client):
+    client.put(REP_POOL, "robj2", b"x")
+    rep = client.op(REP_POOL, "robj2", [
+        t_.OSDOp(t_.OP_SETXATTR, name="user.k", data=b"v"),
+        t_.OSDOp(t_.OP_OMAP_SET, kv={"a": b"1", "b": b"2"}),
+    ])
+    assert rep.result == 0
+    rep = client.op(REP_POOL, "robj2", [
+        t_.OSDOp(t_.OP_GETXATTR, name="user.k"),
+        t_.OSDOp(t_.OP_OMAP_GET),
+    ])
+    assert rep.result == 0
+    assert rep.ops[0].out_data == b"v"
+    assert rep.ops[1].out_kv == {"a": b"1", "b": b"2"}
+
+
+def test_ec_write_spreads_shards(cluster, client):
+    data = bytes(range(256)) * 64
+    rep = client.put(EC_POOL, "eobj1", data)
+    assert rep.result == 0
+    assert client.get(EC_POOL, "eobj1") == data
+    pgid, acting, _ = cluster.primary_of(EC_POOL, "eobj1")
+    from ceph_tpu.store.objectstore import Collection, GHObject
+
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    live = [o for o in acting if 0 <= o < N_OSDS]
+    assert len(live) == 3  # k+m
+    for shard, osd_id in enumerate(acting):
+        if not (0 <= osd_id < N_OSDS):
+            continue
+        g = GHObject("eobj1", shard=shard)
+        assert cluster.osds[osd_id].store.exists(coll, g)
+        # each shard holds a chunk, not the object
+        assert cluster.osds[osd_id].store.stat(coll, g) < len(data)
+
+
+def test_ec_degraded_read_reconstructs(cluster, client):
+    data = b"degraded-read-me" * 512
+    client.put(EC_POOL, "eobj2", data)
+    pgid, acting, primary = cluster.primary_of(EC_POOL, "eobj2")
+    victim = next(o for o in acting if o != primary and 0 <= o < N_OSDS)
+    cluster.kill(victim)
+    try:
+        # placement changed: re-resolve the primary, read degraded
+        got = client.get(EC_POOL, "eobj2")
+        assert got == data
+    finally:
+        cluster.revive(victim)
+
+
+def test_ec_recovery_after_revive(cluster, client):
+    data1 = b"before-kill" * 300
+    client.put(EC_POOL, "eobj3", data1)
+    pgid, acting, primary = cluster.primary_of(EC_POOL, "eobj3")
+    victim = next(o for o in acting if o != primary and 0 <= o < N_OSDS)
+    cluster.kill(victim)
+    data2 = b"while-down!" * 300
+    client.put(EC_POOL, "eobj3", data2)  # degraded write
+    cluster.revive(victim)
+    time.sleep(0.5)
+    assert client.get(EC_POOL, "eobj3") == data2
+
+
+def test_replicated_recovery_after_revive(cluster, client):
+    client.put(REP_POOL, "robj3", b"v1")
+    pgid, acting, primary = cluster.primary_of(REP_POOL, "robj3")
+    victim = next(o for o in acting if o != primary)
+    cluster.kill(victim)
+    client.put(REP_POOL, "robj3", b"v2-written-degraded")
+    cluster.revive(victim)
+    time.sleep(0.5)
+    # the revived replica caught up via log-based recovery
+    pgid2, acting2, _ = cluster.primary_of(REP_POOL, "robj3")
+    from ceph_tpu.store.objectstore import Collection, GHObject
+
+    coll = Collection(t_.pgid_str(pgid2) + "_head")
+    if victim in acting2:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                if (cluster.osds[victim].store.read(coll, GHObject("robj3"))
+                        == b"v2-written-degraded"):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert (cluster.osds[victim].store.read(coll, GHObject("robj3"))
+                == b"v2-written-degraded")
+    assert client.get(REP_POOL, "robj3") == b"v2-written-degraded"
+
+
+def test_scrub_clean_and_detects_corruption(cluster, client):
+    client.put(EC_POOL, "eobj4", b"scrub-me" * 1000)
+    pgid, acting, primary = cluster.primary_of(EC_POOL, "eobj4")
+    pg = cluster.osds[primary].pgs[pgid]
+    assert pg.scrub().get("eobj4") is None  # clean
+    # corrupt one shard's bytes behind the store's back
+    from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
+
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    victim_shard = next(s for s, o in enumerate(acting)
+                        if o != primary and 0 <= o < N_OSDS)
+    victim = acting[victim_shard]
+    t = Transaction()
+    t.write(coll, GHObject("eobj4", shard=victim_shard), 0, b"\xff" * 8)
+    cluster.osds[victim].store.queue_transaction(t)
+    errors = pg.scrub()
+    assert "eobj4" in errors
+    assert any("crc" in e or "parity" in e for e in errors["eobj4"])
+
+
+def test_delete_propagates(cluster, client):
+    client.put(REP_POOL, "robj4", b"bye")
+    assert client.delete(REP_POOL, "robj4").result == 0
+    rep = client.op(REP_POOL, "robj4", [t_.OSDOp(t_.OP_READ)])
+    assert rep.result == -2  # ENOENT
