@@ -1,0 +1,10 @@
+"""Cluster control plane (L7): Paxos-replicated map service.
+
+Reference: src/mon/ — Monitor + Paxos (Paxos.cc) + leader election
+(Elector.cc) + per-map services (OSDMonitor.cc) + MonClient.  The
+OSDMap is the Paxos-committed value; OSDs boot/report-failures through
+the mon and everyone subscribes to map updates.
+"""
+
+from ceph_tpu.mon.monitor import Monitor, MonMap  # noqa: F401
+from ceph_tpu.mon.client import MonClient  # noqa: F401

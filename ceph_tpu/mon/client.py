@@ -1,0 +1,119 @@
+"""MonClient — commands, subscriptions, boot/failure reporting.
+
+Reference: src/mon/MonClient.{h,cc}: daemons and clients find the
+quorum via the monmap, send commands (retrying toward the leader on
+redirect), subscribe to map updates, and (for OSDs) report boot and
+peer failures.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ceph_tpu.msg.message import EntityName, Message
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
+from ceph_tpu.mon import messages as mm
+from ceph_tpu.mon.monitor import MonMap
+from ceph_tpu.osd import map_codec
+
+Addr = Tuple[str, int]
+
+
+class MonClient(Dispatcher):
+    """Attaches to an existing Messenger (daemons share one)."""
+
+    def __init__(self, msgr: Messenger, monmap: MonMap) -> None:
+        self.msgr = msgr
+        self.monmap = monmap
+        self._tid = 0
+        self._lock = threading.Lock()
+        self._waiters: Dict[int, list] = {}
+        self.on_osdmap: Optional[Callable] = None
+        self._last_epoch = 0
+        msgr.add_dispatcher(self)
+
+    # -- dispatch ---------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, mm.MMonCommandReply):
+            with self._lock:
+                w = self._waiters.get(msg.tid)
+            if w is not None:
+                w[1] = msg
+                w[0].set()
+            return True
+        if isinstance(msg, mm.MOSDMapMsg):
+            # pushes arrive concurrently from every subscribed mon:
+            # compare-and-set under the lock so an older epoch can never
+            # be delivered after a newer one
+            deliver = False
+            with self._lock:
+                if msg.epoch > self._last_epoch and self.on_osdmap:
+                    self._last_epoch = msg.epoch
+                    deliver = True
+            if deliver:
+                self.on_osdmap(map_codec.decode_osdmap(msg.data))
+            return True
+        return False
+
+    # -- commands ---------------------------------------------------------
+    def command(self, cmd: dict, timeout: float = 10.0) -> Tuple[int, dict]:
+        """Send to rank 0; follow 'not leader' redirects."""
+        tries = 0
+        rank = 0
+        while tries < 2 * self.monmap.size:
+            rep = self._command_to(rank, cmd, timeout / 2)
+            if rep is None:
+                rank = (rank + 1) % self.monmap.size
+                tries += 1
+                continue
+            if rep.code == -11 and "leader" in rep.out:
+                leader = rep.out["leader"]
+                rank = leader if leader >= 0 else (
+                    (rank + 1) % self.monmap.size)
+                tries += 1
+                time.sleep(0.2)
+                continue
+            return rep.code, rep.out
+        return -110, {"error": "mon command timed out"}
+
+    def _command_to(self, rank: int, cmd: dict,
+                    timeout: float) -> Optional[mm.MMonCommandReply]:
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+            ev = threading.Event()
+            self._waiters[tid] = [ev, None]
+        msg = mm.MMonCommand(cmd)
+        msg.tid = tid
+        self.msgr.send_message(msg, self.monmap.addrs[rank])
+        ok = ev.wait(timeout)
+        with self._lock:
+            w = self._waiters.pop(tid, None)
+        return w[1] if ok and w else None
+
+    # -- subscriptions ----------------------------------------------------
+    def subscribe_osdmap(self, cb: Callable, since: int = 0) -> None:
+        """cb(OSDMap) fires on every newer committed map."""
+        self.on_osdmap = cb
+        ip, port = self.msgr.addr
+        for rank in range(self.monmap.size):
+            self.msgr.send_message(
+                mm.MMonSubscribe(f"osdmap:{ip}:{port}", since),
+                self.monmap.addrs[rank])
+
+    # -- osd daemon hooks -------------------------------------------------
+    def send_boot(self, osd_id: int,
+                  hb_addr: Optional[Addr] = None) -> None:
+        ip, port = self.msgr.addr
+        hb_ip, hb_port = hb_addr if hb_addr else ("", 0)
+        for rank in range(self.monmap.size):
+            self.msgr.send_message(
+                mm.MOSDBoot(osd_id, ip, port, hb_ip, hb_port),
+                self.monmap.addrs[rank])
+
+    def report_failure(self, target: int, failed_for: float = 0.0) -> None:
+        for rank in range(self.monmap.size):
+            self.msgr.send_message(mm.MOSDFailure(target, failed_for),
+                                   self.monmap.addrs[rank])

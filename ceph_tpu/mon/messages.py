@@ -1,0 +1,203 @@
+"""Monitor wire messages (election, paxos, commands, subscriptions).
+
+Reference: src/messages/MMonElection.h, MMonPaxos.h, MMonCommand.h,
+MMonSubscribe.h, MOSDMap.h, MOSDBoot.h, MOSDFailure.h.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.msg.message import Message, register
+
+
+@register
+class MMonElection(Message):
+    TYPE = 30
+    PROPOSE = 1
+    ACK = 2
+    VICTORY = 3
+
+    def __init__(self, op: int = 0, epoch: int = 0, rank: int = -1) -> None:
+        super().__init__()
+        self.op = op
+        self.epoch = epoch
+        self.rank = rank
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.u8(self.op).u32(self.epoch).s32(self.rank)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.op = d.u8()
+        self.epoch = d.u32()
+        self.rank = d.s32()
+
+
+@register
+class MMonPaxos(Message):
+    """Multi-instance Paxos (reference MMonPaxos ops: collect/last/
+    begin/accept/commit/lease)."""
+
+    TYPE = 31
+    COLLECT = 1   # phase 1a (leader -> peons)
+    LAST = 2      # phase 1b (peon -> leader, with last accepted)
+    BEGIN = 3     # phase 2a (leader proposes value for version)
+    ACCEPT = 4    # phase 2b
+    COMMIT = 5    # learn
+    LEASE = 6     # leader extends read lease
+
+    def __init__(self, op: int = 0, pn: int = 0, version: int = 0,
+                 value: bytes = b"", first_committed: int = 0,
+                 last_committed: int = 0,
+                 uncommitted_pn: int = 0,
+                 uncommitted_v: int = 0,
+                 uncommitted_value: bytes = b"") -> None:
+        super().__init__()
+        self.op = op
+        self.pn = pn
+        self.version = version
+        self.value = value
+        self.first_committed = first_committed
+        self.last_committed = last_committed
+        self.uncommitted_pn = uncommitted_pn
+        self.uncommitted_v = uncommitted_v
+        self.uncommitted_value = uncommitted_value
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.u8(self.op).u64(self.pn).u64(self.version).blob(self.value)
+        e.u64(self.first_committed).u64(self.last_committed)
+        e.u64(self.uncommitted_pn).u64(self.uncommitted_v)
+        e.blob(self.uncommitted_value)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.op = d.u8()
+        self.pn = d.u64()
+        self.version = d.u64()
+        self.value = d.blob()
+        self.first_committed = d.u64()
+        self.last_committed = d.u64()
+        self.uncommitted_pn = d.u64()
+        self.uncommitted_v = d.u64()
+        self.uncommitted_value = d.blob()
+
+
+@register
+class MMonCommand(Message):
+    """JSON command (the `ceph` CLI path, reference MMonCommand)."""
+
+    TYPE = 32
+
+    def __init__(self, cmd: Optional[dict] = None) -> None:
+        super().__init__()
+        self.cmd = cmd or {}
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(json.dumps(self.cmd))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.cmd = json.loads(d.string())
+
+
+@register
+class MMonCommandReply(Message):
+    TYPE = 33
+
+    def __init__(self, code: int = 0, out: Optional[dict] = None) -> None:
+        super().__init__()
+        self.code = code
+        self.out = out or {}
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.code).string(json.dumps(self.out))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.code = d.s32()
+        self.out = json.loads(d.string())
+
+
+@register
+class MMonSubscribe(Message):
+    """Subscribe to map updates (reference MMonSubscribe: what/since)."""
+
+    TYPE = 34
+
+    def __init__(self, what: str = "osdmap", since: int = 0) -> None:
+        super().__init__()
+        self.what = what
+        self.since = since
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.what).u32(self.since)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.what = d.string()
+        self.since = d.u32()
+
+
+@register
+class MOSDMapMsg(Message):
+    """Full osdmap push (reference MOSDMap; incrementals are a later
+    optimization — full maps keep the protocol simple)."""
+
+    TYPE = 35
+
+    def __init__(self, epoch: int = 0, data: bytes = b"") -> None:
+        super().__init__()
+        self.epoch = epoch
+        self.data = data
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.u32(self.epoch).blob(self.data)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.epoch = d.u32()
+        self.data = d.blob()
+
+
+@register
+class MOSDBoot(Message):
+    """osd -> mon: I'm up at this address (reference MOSDBoot)."""
+
+    TYPE = 36
+
+    def __init__(self, osd_id: int = -1, ip: str = "", port: int = 0,
+                 hb_ip: str = "", hb_port: int = 0) -> None:
+        super().__init__()
+        self.osd_id = osd_id
+        self.ip = ip
+        self.port = port
+        self.hb_ip = hb_ip
+        self.hb_port = hb_port
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.osd_id).string(self.ip).u32(self.port)
+        e.string(self.hb_ip).u32(self.hb_port)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.osd_id = d.s32()
+        self.ip = d.string()
+        self.port = d.u32()
+        self.hb_ip = d.string()
+        self.hb_port = d.u32()
+
+
+@register
+class MOSDFailure(Message):
+    """osd -> mon: peer missed heartbeats (reference MOSDFailure;
+    decided by OSDMonitor::prepare_failure, OSDMonitor.cc:2643)."""
+
+    TYPE = 37
+
+    def __init__(self, target: int = -1, failed_for: float = 0.0) -> None:
+        super().__init__()
+        self.target = target
+        self.failed_for = failed_for
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.target).f64(self.failed_for)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.target = d.s32()
+        self.failed_for = d.f64()

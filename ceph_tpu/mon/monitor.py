@@ -1,0 +1,765 @@
+"""Monitor: leader election + multi-instance Paxos + OSDMonitor service.
+
+Reference: src/mon/Monitor.{h,cc}, Elector.cc (rank-deference election),
+Paxos.cc (leader-driven collect/begin/accept/commit with unique proposal
+numbers), OSDMonitor.cc (osdmap mutations: boot, failure reports with
+min-reporter counting per prepare_failure :2643 / check_failure :2537,
+down→out aging, pool + EC-profile commands), MonitorDBStore.h (the
+paxos log lives in a local KV).
+
+Shape kept: the elected leader serializes all map mutations through
+Paxos; every committed version is a full encoded OSDMap (incremental
+deltas are a later optimization); all mons push committed maps to their
+subscribers, so clients may subscribe anywhere while only the leader
+accepts mutations.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.crush import map as cmap
+from ceph_tpu.msg.message import EntityName, Message
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
+from ceph_tpu.mon import messages as mm
+from ceph_tpu.osd import map_codec
+from ceph_tpu.osd.osdmap import OSDMap, PGPool, POOL_ERASURE, POOL_REPLICATED
+from ceph_tpu.store.kv import LogKV, MemDB, WriteBatch
+
+Addr = Tuple[str, int]
+
+STATE_ELECTING = "electing"
+STATE_LEADER = "leader"
+STATE_PEON = "peon"
+
+
+class MonMap:
+    """Static mon roster: rank -> address (reference MonMap)."""
+
+    def __init__(self, addrs: List[Addr]) -> None:
+        self.addrs = list(addrs)
+
+    @property
+    def size(self) -> int:
+        return len(self.addrs)
+
+    def quorum(self) -> int:
+        return self.size // 2 + 1
+
+
+class Monitor(Dispatcher):
+    def __init__(self, ctx, rank: int, monmap: MonMap,
+                 kv=None, initial_map: Optional[OSDMap] = None,
+                 bind_port: int = 0) -> None:
+        self.ctx = ctx
+        self.rank = rank
+        self.monmap = monmap
+        self.kv = kv if kv is not None else MemDB()
+        self.msgr = Messenger(ctx, EntityName("mon", rank),
+                              bind_port=bind_port)
+        self.msgr.add_dispatcher(self)
+        self._log = ctx.log.dout("mon")
+        self._plog = ctx.log.dout("paxos")
+        self.lock = threading.RLock()
+
+        # election state
+        self.state = STATE_ELECTING
+        self.election_epoch = 0
+        self.leader = -1
+        self._acks: Set[int] = set()
+        self._last_lease = time.monotonic()
+
+        # paxos state (persisted)
+        self.last_pn = 0
+        self.accepted_pn = 0
+        self.last_committed = 0
+        self.uncommitted: Optional[Tuple[int, int, bytes]] = None
+        self._accept_votes: Dict[int, Set[int]] = {}
+        self._collect_acks: List[mm.MMonPaxos] = []
+        self._proposing = False
+        self._propose_queue: List[bytes] = []
+
+        # osdmonitor state
+        self.osdmap = initial_map
+        self.failure_reports: Dict[int, Dict[int, float]] = {}
+        self.down_stamp: Dict[int, float] = {}
+        self.subscribers: Dict[Addr, int] = {}  # addr -> last epoch sent
+        self.ec_profiles: Dict[str, str] = {
+            "default": "plugin=isa k=2 m=1 technique=reed_sol_van",
+        }
+
+        # mutations accumulate into ONE pending map (the reference's
+        # pending_inc): concurrent boots/failures/commands each cloning
+        # the committed map would otherwise clobber each other
+        self._pending_map: Optional[OSDMap] = None
+        self._stop = threading.Event()
+        self._tick_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self.kv.open()
+        self._load()
+        self.msgr.start()
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, daemon=True, name=f"mon{self.rank}-tick")
+        self._tick_thread.start()
+        self.start_election()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._tick_thread:
+            self._tick_thread.join(timeout=5)
+        self.msgr.shutdown()
+        self.kv.close()
+
+    @property
+    def addr(self) -> Addr:
+        return self.msgr.addr
+
+    def _peers(self) -> List[int]:
+        return [r for r in range(self.monmap.size) if r != self.rank]
+
+    def _send_mon(self, rank: int, msg: Message) -> None:
+        self.msgr.send_message(msg, self.monmap.addrs[rank])
+
+    # -- persistence ------------------------------------------------------
+    def _load(self) -> None:
+        pn = self.kv.get("paxos", "last_pn")
+        self.last_pn = int(pn) if pn else 0
+        ap = self.kv.get("paxos", "accepted_pn")
+        self.accepted_pn = int(ap) if ap else 0
+        lc = self.kv.get("paxos", "last_committed")
+        self.last_committed = int(lc) if lc else 0
+        if self.last_committed:
+            data = self.kv.get("paxos_values", str(self.last_committed))
+            if data:
+                self.osdmap = map_codec.decode_osdmap(data)
+        prof = self.kv.get("mon", "ec_profiles")
+        if prof:
+            self.ec_profiles = json.loads(prof.decode())
+
+    def _persist(self, **kv_updates) -> None:
+        b = WriteBatch()
+        for key, val in kv_updates.items():
+            if isinstance(val, bytes):
+                b.set("paxos", key, val)
+            else:
+                b.set("paxos", key, str(val).encode())
+        self.kv.submit(b)
+
+    def _persist_value(self, version: int, value: bytes) -> None:
+        b = WriteBatch()
+        b.set("paxos_values", str(version), value)
+        b.set("paxos", "last_committed", str(version).encode())
+        self.kv.submit(b)
+
+    # -- election (Elector.cc shape) --------------------------------------
+    def start_election(self) -> None:
+        with self.lock:
+            self.state = STATE_ELECTING
+            self.election_epoch += 1
+            self.leader = -1
+            self._acks = {self.rank}
+            epoch = self.election_epoch
+        for r in self._peers():
+            self._send_mon(r, mm.MMonElection(
+                mm.MMonElection.PROPOSE, epoch, self.rank))
+        # single-mon cluster wins immediately
+        self._maybe_win()
+        threading.Timer(1.0, self._election_timeout, args=(epoch,)).start()
+
+    def _election_timeout(self, epoch: int) -> None:
+        with self.lock:
+            if self.state == STATE_ELECTING and self.election_epoch == epoch:
+                pass  # retry
+            else:
+                return
+        self._maybe_win(force_retry=True)
+
+    def _maybe_win(self, force_retry: bool = False) -> None:
+        with self.lock:
+            if self.state != STATE_ELECTING:
+                return
+            if len(self._acks) >= self.monmap.quorum():
+                self.state = STATE_LEADER
+                self.leader = self.rank
+                epoch = self.election_epoch
+            elif force_retry:
+                self.lock.release()
+                try:
+                    self.start_election()
+                finally:
+                    self.lock.acquire()
+                return
+            else:
+                return
+        self._log(1, f"mon.{self.rank} won election e{epoch}")
+        for r in self._peers():
+            self._send_mon(r, mm.MMonElection(
+                mm.MMonElection.VICTORY, epoch, self.rank))
+        self._leader_collect()
+
+    def _handle_election(self, conn: Connection, msg: mm.MMonElection) -> None:
+        restart = False
+        with self.lock:
+            if msg.op == mm.MMonElection.PROPOSE:
+                if msg.rank < self.rank:
+                    # deference: lower rank outranks us
+                    if msg.epoch > self.election_epoch:
+                        self.election_epoch = msg.epoch
+                    self.state = STATE_ELECTING
+                    ack = mm.MMonElection(mm.MMonElection.ACK,
+                                          msg.epoch, self.rank)
+                    self._send_mon(msg.rank, ack)
+                else:
+                    # we outrank the proposer: assert ourselves with a
+                    # fresher epoch (reference Elector nag)
+                    if self.state != STATE_ELECTING or (
+                        msg.epoch >= self.election_epoch
+                    ):
+                        self.election_epoch = max(self.election_epoch,
+                                                  msg.epoch)
+                        restart = True
+                if restart:
+                    pass
+            elif msg.op == mm.MMonElection.ACK:
+                win = False
+                if (self.state == STATE_ELECTING
+                        and msg.epoch == self.election_epoch):
+                    self._acks.add(msg.rank)
+                    win = len(self._acks) >= self.monmap.quorum()
+                if win:
+                    self.lock.release()
+                    try:
+                        self._maybe_win()
+                    finally:
+                        self.lock.acquire()
+                return
+            elif msg.op == mm.MMonElection.VICTORY:
+                if msg.rank > self.rank:
+                    # refuse a worse leader: crossed victories in the
+                    # first round otherwise leave the cluster split on
+                    # a higher-ranked winner — re-assert with a newer
+                    # epoch so the usurper stands down and acks us
+                    self.election_epoch = max(self.election_epoch,
+                                              msg.epoch)
+                    restart = True
+                else:
+                    self.state = STATE_PEON
+                    self.leader = msg.rank
+                    self.election_epoch = max(self.election_epoch, msg.epoch)
+                    self._last_lease = time.monotonic()
+                    self._proposing = False
+                    self._accept_votes.clear()
+                    self._propose_queue.clear()
+        if restart:
+            self.start_election()
+
+    # -- paxos ------------------------------------------------------------
+    def _new_pn(self) -> int:
+        self.last_pn = ((self.last_pn // 100) + 1) * 100 + self.rank
+        self._persist(last_pn=self.last_pn)
+        return self.last_pn
+
+    def _leader_collect(self) -> None:
+        """Phase 1 after winning: learn peons' state, recover in-flight
+        proposals (Paxos.cc collect)."""
+        with self.lock:
+            pn = self._new_pn()
+            self.accepted_pn = pn
+            self._persist(accepted_pn=pn)
+            self._collect_acks = []
+            # a proposal in flight when the election interrupted us is
+            # dead; recovery happens via the collect phase (uncommitted
+            # re-propose), so reset the pipeline or it wedges forever
+            self._proposing = False
+            self._accept_votes.clear()
+            msg = mm.MMonPaxos(mm.MMonPaxos.COLLECT, pn,
+                               last_committed=self.last_committed)
+        for r in self._peers():
+            self._send_mon(r, msg)
+        # a single-mon quorum proceeds immediately
+        threading.Timer(0.5, self._collect_done).start()
+
+    def _collect_done(self) -> None:
+        with self.lock:
+            if self.state != STATE_LEADER:
+                return
+            acks = list(self._collect_acks)
+            # NACK: a peon promised a higher pn than ours — re-collect
+            # with a fresh pn above it
+            top = max((a.pn for a in acks), default=0)
+            if top > self.accepted_pn:
+                self.last_pn = max(self.last_pn, top)
+                self._persist(last_pn=self.last_pn)
+                retry = True
+            else:
+                retry = False
+        if retry:
+            self._leader_collect()
+            return
+        with self.lock:
+            # adopt the newest uncommitted value from the quorum
+            best = None
+            for a in acks:
+                if a.uncommitted_v and a.uncommitted_v > self.last_committed:
+                    if best is None or a.uncommitted_pn > best.uncommitted_pn:
+                        best = a
+            if self.uncommitted and (
+                self.uncommitted[1] > self.last_committed
+            ) and (best is None
+                   or self.uncommitted[0] >= best.uncommitted_pn):
+                redo = self.uncommitted[2]
+            elif best is not None:
+                redo = best.uncommitted_value
+            else:
+                redo = None
+        if redo is not None:
+            self._log(1, "re-proposing uncommitted value after election")
+            self.propose(redo)
+        else:
+            self._pump_proposals()
+
+    def _handle_paxos(self, conn: Connection, msg: mm.MMonPaxos) -> None:
+        op = msg.op
+        if op == mm.MMonPaxos.COLLECT:
+            with self.lock:
+                if msg.pn > self.accepted_pn:
+                    self.accepted_pn = msg.pn
+                    self._persist(accepted_pn=msg.pn)
+                # remember the highest pn ever seen so a future election
+                # on THIS mon starts above it (else a new leader's pn can
+                # undercut the old one's and every BEGIN is ignored)
+                if msg.pn > self.last_pn:
+                    self.last_pn = msg.pn
+                    self._persist(last_pn=self.last_pn)
+                # reply carries OUR accepted_pn: if it exceeds msg.pn the
+                # collector learns its pn is stale (classic NACK)
+                rep = mm.MMonPaxos(
+                    mm.MMonPaxos.LAST, self.accepted_pn,
+                    last_committed=self.last_committed)
+                if self.uncommitted:
+                    rep.uncommitted_pn = self.uncommitted[0]
+                    rep.uncommitted_v = self.uncommitted[1]
+                    rep.uncommitted_value = self.uncommitted[2]
+                # help a behind leader catch up
+                if msg.last_committed < self.last_committed:
+                    data = self.kv.get("paxos_values",
+                                       str(self.last_committed))
+                    rep.version = self.last_committed
+                    rep.value = data or b""
+            conn.send(rep)
+            return
+        if op == mm.MMonPaxos.LAST:
+            with self.lock:
+                if msg.version > self.last_committed and msg.value:
+                    self._learn(msg.version, msg.value)
+                self._collect_acks.append(msg)
+            return
+        if op == mm.MMonPaxos.BEGIN:
+            with self.lock:
+                if msg.pn > self.last_pn:
+                    self.last_pn = msg.pn
+                    self._persist(last_pn=self.last_pn)
+                if msg.pn < self.accepted_pn:
+                    return  # stale proposer
+                self.uncommitted = (msg.pn, msg.version, msg.value)
+                self._persist(uncommitted_pn=msg.pn,
+                              uncommitted_v=msg.version)
+                b = WriteBatch()
+                b.set("paxos", "uncommitted_value", msg.value)
+                self.kv.submit(b)
+                rep = mm.MMonPaxos(mm.MMonPaxos.ACCEPT, msg.pn,
+                                   version=msg.version)
+            conn.send(rep)
+            return
+        if op == mm.MMonPaxos.ACCEPT:
+            fire = False
+            with self.lock:
+                votes = self._accept_votes.get(msg.version)
+                if votes is not None:
+                    votes.add(msg.src.num if msg.src else -1)
+                    if len(votes) >= self.monmap.quorum():
+                        del self._accept_votes[msg.version]
+                        fire = True
+            if fire:
+                self._commit(msg.version)
+            return
+        if op == mm.MMonPaxos.COMMIT:
+            with self.lock:
+                if msg.version > self.last_committed:
+                    self._learn(msg.version, msg.value)
+            self._push_maps()
+            return
+        if op == mm.MMonPaxos.LEASE:
+            with self.lock:
+                self._last_lease = time.monotonic()
+                if msg.version > self.last_committed and msg.value:
+                    self._learn(msg.version, msg.value)
+            return
+
+    def _learn(self, version: int, value: bytes) -> None:
+        self._persist_value(version, value)
+        self.last_committed = version
+        self.uncommitted = None
+        try:
+            self.osdmap = map_codec.decode_osdmap(value)
+            if (self._pending_map is not None
+                    and self.osdmap.epoch >= self._pending_map.epoch):
+                self._pending_map = None  # fully caught up
+        except Exception as e:  # pragma: no cover
+            self._plog(0, f"failed to decode committed map: {e}")
+
+    def propose(self, value: bytes) -> None:
+        """Leader-only: serialize one value through phase 2."""
+        with self.lock:
+            if self.state != STATE_LEADER:
+                return
+            if self._proposing:
+                self._propose_queue.append(value)
+                return
+            self._proposing = True
+            version = self.last_committed + 1
+            pn = self.accepted_pn
+            self.uncommitted = (pn, version, value)
+            self._accept_votes[version] = {self.rank}
+            msg = mm.MMonPaxos(mm.MMonPaxos.BEGIN, pn, version, value)
+        for r in self._peers():
+            self._send_mon(r, msg)
+        if self.monmap.size == 1:
+            self._commit(version)
+
+    def _commit(self, version: int) -> None:
+        with self.lock:
+            if not self.uncommitted or self.uncommitted[1] != version:
+                self._proposing = False
+                return
+            value = self.uncommitted[2]
+            self._learn(version, value)
+            self._proposing = False
+            msg = mm.MMonPaxos(mm.MMonPaxos.COMMIT, self.accepted_pn,
+                               version, value)
+        for r in self._peers():
+            self._send_mon(r, msg)
+        self._push_maps()
+        self._pump_proposals()
+
+    def _pump_proposals(self) -> None:
+        with self.lock:
+            if self._propose_queue and not self._proposing:
+                nxt = self._propose_queue.pop(0)
+            else:
+                return
+        self.propose(nxt)
+
+    # -- ticks: leases, failure aging -------------------------------------
+    def _tick_loop(self) -> None:
+        iv = self.ctx.conf.get("mon_tick_interval")
+        lease = self.ctx.conf.get("mon_lease")
+        while not self._stop.wait(iv):
+            with self.lock:
+                state = self.state
+            if state == STATE_LEADER:
+                msg = mm.MMonPaxos(mm.MMonPaxos.LEASE, self.accepted_pn,
+                                   version=self.last_committed)
+                with self.lock:
+                    data = self.kv.get("paxos_values",
+                                       str(self.last_committed))
+                msg.value = data or b""
+                for r in self._peers():
+                    self._send_mon(r, msg)
+                self._osd_tick()
+            elif state == STATE_PEON:
+                if time.monotonic() - self._last_lease > 2 * lease:
+                    self._log(1, f"mon.{self.rank}: leader lease expired")
+                    self.start_election()
+
+    def _osd_tick(self) -> None:
+        """down -> out aging (reference tick_osds / down_out_interval)."""
+        if self.osdmap is None:
+            return
+        interval = self.ctx.conf.get("mon_osd_down_out_interval")
+        now = time.time()
+        with self.lock:
+            stale = [osd for osd, stamp in self.down_stamp.items()
+                     if (not self.osdmap.is_up(osd)
+                         and self.osdmap.osd_weight[osd] != 0
+                         and now - stamp > interval)]
+            if stale:
+                def mut(nm: OSDMap) -> None:
+                    for osd in stale:
+                        nm.set_osd_out(osd)
+
+                self._mutate_map(mut)
+
+    # -- osdmonitor -------------------------------------------------------
+    def _clone_map(self) -> OSDMap:
+        assert self.osdmap is not None
+        return map_codec.decode_osdmap(map_codec.encode_osdmap(self.osdmap))
+
+    def _mutate_map(self, fn) -> bool:
+        """Apply `fn(pending_map)` and propose the result.  Must be
+        called with self.lock held; returns False if there is no map."""
+        if self.osdmap is None:
+            return False
+        if self._pending_map is None:
+            self._pending_map = self._clone_map()
+            self._pending_map.epoch = self.osdmap.epoch
+        fn(self._pending_map)
+        self._pending_map.epoch += 1
+        self.propose(map_codec.encode_osdmap(self._pending_map))
+        return True
+
+    def _propose_map(self, newmap: OSDMap) -> None:
+        # legacy single-shot path (commands built on _mutate_map now)
+        newmap.epoch = (self.osdmap.epoch if self.osdmap else 0) + 1
+        self.propose(map_codec.encode_osdmap(newmap))
+
+    def _handle_boot(self, msg: mm.MOSDBoot) -> None:
+        with self.lock:
+            if self.state != STATE_LEADER or self.osdmap is None:
+                return
+            if (self.osdmap.is_up(msg.osd_id)
+                    and self.osdmap.osd_addrs.get(msg.osd_id)
+                    == (msg.ip, msg.port)
+                    and self.osdmap.osd_hb_addrs.get(msg.osd_id)
+                    == (msg.hb_ip, msg.hb_port)):
+                return  # duplicate boot retry; already reflected
+            if not (0 <= msg.osd_id < self.osdmap.max_osd):
+                return
+
+            def mut(nm: OSDMap) -> None:
+                nm.set_osd_up(msg.osd_id)
+                if nm.osd_weight[msg.osd_id] == 0:
+                    nm.set_osd_in(msg.osd_id)
+                nm.osd_addrs[msg.osd_id] = (msg.ip, msg.port)
+                if msg.hb_port:
+                    nm.osd_hb_addrs[msg.osd_id] = (msg.hb_ip, msg.hb_port)
+
+            self.failure_reports.pop(msg.osd_id, None)
+            self.down_stamp.pop(msg.osd_id, None)
+            self._log(1, f"osd.{msg.osd_id} booted at {msg.ip}:{msg.port}")
+            self._mutate_map(mut)
+
+    def _handle_failure(self, msg: mm.MOSDFailure) -> None:
+        """prepare_failure: require min distinct reporters within grace
+        accounting (OSDMonitor.cc:2643/:2537)."""
+        reporter = msg.src.num if msg.src else -1
+        with self.lock:
+            if self.state != STATE_LEADER or self.osdmap is None:
+                return
+            if not self.osdmap.is_up(msg.target):
+                return  # already down
+            reports = self.failure_reports.setdefault(msg.target, {})
+            reports[reporter] = time.time()
+            need = self.ctx.conf.get("mon_osd_min_down_reporters")
+            if len(reports) < need:
+                return
+            self.down_stamp[msg.target] = time.time()
+            del self.failure_reports[msg.target]
+            self._log(1, f"marking osd.{msg.target} down "
+                      f"({len(reports)} reporters)")
+            self._mutate_map(lambda nm: nm.set_osd_down(msg.target))
+
+    # -- subscriptions ----------------------------------------------------
+    def _push_maps(self) -> None:
+        with self.lock:
+            if self.osdmap is None:
+                return
+            epoch = self.osdmap.epoch
+            data = map_codec.encode_osdmap(self.osdmap)
+            targets = [a for a, last in self.subscribers.items()
+                       if last < epoch]
+            for a in targets:
+                self.subscribers[a] = epoch
+        for a in targets:
+            self.msgr.send_message(mm.MOSDMapMsg(epoch, data), a)
+
+    # -- commands ---------------------------------------------------------
+    def _handle_command(self, conn: Connection,
+                        msg: mm.MMonCommand) -> None:
+        with self.lock:
+            if self.state != STATE_LEADER:
+                rep = mm.MMonCommandReply(-11, {"error": "not leader",
+                                                "leader": self.leader})
+                rep.tid = msg.tid
+                conn.send(rep)
+                return
+        code, out = self._do_command(msg.cmd)
+        rep = mm.MMonCommandReply(code, out)
+        rep.tid = msg.tid
+        conn.send(rep)
+
+    def _do_command(self, cmd: dict) -> Tuple[int, dict]:
+        prefix = cmd.get("prefix", "")
+        if prefix == "status":
+            with self.lock:
+                m = self.osdmap
+                n_up = int(m.osd_state_up.sum()) if m is not None else 0
+                return 0, {
+                    "quorum_leader": self.leader,
+                    "election_epoch": self.election_epoch,
+                    "osdmap_epoch": m.epoch if m else 0,
+                    "num_osds": m.max_osd if m else 0,
+                    "num_up_osds": n_up,
+                    "pools": {p.name or str(pid): pid
+                              for pid, p in (m.pools if m else {}).items()},
+                }
+        if prefix == "osd dump":
+            with self.lock:
+                m = self.osdmap
+                if m is None:
+                    return -2, {"error": "no osdmap"}
+                return 0, {
+                    "epoch": m.epoch,
+                    "max_osd": m.max_osd,
+                    "osds": [
+                        {"osd": i, "up": bool(m.osd_state_up[i]),
+                         "in": int(m.osd_weight[i]) > 0,
+                         "weight": int(m.osd_weight[i]) / 0x10000,
+                         "addr": list(m.osd_addrs.get(i, ("", 0)))}
+                        for i in range(m.max_osd)
+                    ],
+                    "pools": [
+                        {"pool": pid, "name": p.name,
+                         "type": p.pool_type, "size": p.size,
+                         "pg_num": p.pg_num,
+                         "erasure_code_profile": p.erasure_code_profile}
+                        for pid, p in m.pools.items()
+                    ],
+                }
+        if prefix == "osd erasure-code-profile set":
+            name = cmd["name"]
+            profile = cmd["profile"]
+            with self.lock:
+                self.ec_profiles[name] = profile
+                b = WriteBatch()
+                b.set("mon", "ec_profiles",
+                      json.dumps(self.ec_profiles).encode())
+                self.kv.submit(b)
+            return 0, {}
+        if prefix == "osd erasure-code-profile ls":
+            with self.lock:
+                return 0, {"profiles": dict(self.ec_profiles)}
+        if prefix == "osd pool create":
+            return self._cmd_pool_create(cmd)
+        if prefix in ("osd out", "osd in", "osd down"):
+            osd = int(cmd["id"])
+            with self.lock:
+                if self.osdmap is None:
+                    return -2, {"error": "no osdmap"}
+
+                def mut(nm: OSDMap) -> None:
+                    if prefix == "osd out":
+                        nm.set_osd_out(osd)
+                    elif prefix == "osd in":
+                        nm.set_osd_in(osd)
+                    else:
+                        nm.set_osd_down(osd)
+
+                if prefix == "osd down":
+                    self.down_stamp[osd] = time.time()
+                self._mutate_map(mut)
+            return 0, {}
+        if prefix == "osd reweight":
+            osd = int(cmd["id"])
+            weight = float(cmd["weight"])
+            with self.lock:
+                self._mutate_map(
+                    lambda nm: nm.reweight_osd(osd, int(weight * 0x10000)))
+            return 0, {}
+        return -22, {"error": f"unknown command {prefix!r}"}
+
+    def _cmd_pool_create(self, cmd: dict) -> Tuple[int, dict]:
+        name = cmd["pool"]
+        pg_num = int(cmd.get("pg_num",
+                             self.ctx.conf.get("osd_pool_default_pg_num")))
+        kind = cmd.get("pool_type", "replicated")
+        box: Dict[str, object] = {}
+        with self.lock:
+            if self.osdmap is None:
+                return -2, {"error": "no osdmap"}
+            base = self._pending_map or self.osdmap
+            for p in base.pools.values():
+                if p.name == name:
+                    return -17, {"error": f"pool {name!r} exists"}
+            if kind == "erasure":
+                profile_name = cmd.get("erasure_code_profile", "default")
+                profile = self.ec_profiles.get(profile_name)
+                if profile is None:
+                    return -2, {"error": f"no profile {profile_name!r}"}
+            else:
+                profile = ""
+
+            def mut(nm: OSDMap) -> None:
+                pool_id = max(nm.pools, default=0) + 1
+                referenced = {i for b in nm.crush.buckets.values()
+                              for i in b.items if i < 0}
+                roots = [bid for bid in nm.crush.buckets
+                         if bid not in referenced]
+                root = roots[0] if roots else max(nm.crush.buckets)
+                if kind == "erasure":
+                    kd = dict(part.split("=", 1)
+                              for part in profile.split() if "=" in part)
+                    size = int(kd.get("k", 2)) + int(kd.get("m", 1))
+                    rule = nm.crush.add_simple_rule(
+                        f"{name}_rule", root, 1, mode="indep")
+                    pool = PGPool(pool_id, POOL_ERASURE, size=size,
+                                  min_size=int(kd.get("k", 2)),
+                                  pg_num=pg_num, pgp_num=pg_num,
+                                  crush_rule=rule,
+                                  erasure_code_profile=profile)
+                else:
+                    size = int(cmd.get(
+                        "size", self.ctx.conf.get("osd_pool_default_size")))
+                    rule = nm.crush.add_simple_rule(
+                        f"{name}_rule", root, 1, mode="firstn")
+                    pool = PGPool(pool_id, POOL_REPLICATED, size=size,
+                                  min_size=max(1, size - size // 2),
+                                  pg_num=pg_num, pgp_num=pg_num,
+                                  crush_rule=rule)
+                pool.name = name
+                nm.pools[pool_id] = pool
+                box["pool_id"] = pool_id
+
+            self._mutate_map(mut)
+        return 0, {"pool_id": box.get("pool_id")}
+
+    # -- dispatch ---------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, mm.MMonElection):
+            self._handle_election(conn, msg)
+            return True
+        if isinstance(msg, mm.MMonPaxos):
+            self._handle_paxos(conn, msg)
+            return True
+        if isinstance(msg, mm.MMonCommand):
+            self._handle_command(conn, msg)
+            return True
+        if isinstance(msg, mm.MMonSubscribe):
+            return self._handle_subscribe(conn, msg)
+        if isinstance(msg, mm.MOSDBoot):
+            self._handle_boot(msg)
+            return True
+        if isinstance(msg, mm.MOSDFailure):
+            self._handle_failure(msg)
+            return True
+        return False
+
+    def _handle_subscribe(self, conn: Connection,
+                          msg: mm.MMonSubscribe) -> bool:
+        # subscribers are identified by their LISTENING address, carried
+        # in `what` as "osdmap:<ip>:<port>" (the accepted socket's
+        # ephemeral port is useless for dialing back)
+        parts = msg.what.split(":")
+        if len(parts) == 3 and parts[0] == "osdmap":
+            addr = (parts[1], int(parts[2]))
+            with self.lock:
+                self.subscribers[addr] = msg.since
+            self._push_maps()
+            return True
+        return True

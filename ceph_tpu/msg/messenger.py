@@ -339,10 +339,10 @@ class Messenger:
                         # the session; re-ack so the replayer trims
                         self._send_ack(conn, ack_writer, last)
                         continue
-                    if msg.sid not in sids and (
-                        len(sids) >= self._max_sids_per_peer
-                    ):
-                        sids.pop(next(iter(sids)))  # evict oldest session
+                    if msg.sid in sids:
+                        del sids[msg.sid]  # re-insert: LRU move-to-end
+                    elif len(sids) >= self._max_sids_per_peer:
+                        sids.pop(next(iter(sids)))  # evict least-recent
                     sids[msg.sid] = msg.seq
                     self._peer_in_seq[src] = (nonce, sids)
                 elif msg.seq <= conn.in_seq:

@@ -107,9 +107,11 @@ class PGBackend:
     # -- interface --------------------------------------------------------
     def submit(self, oid: str, state: Optional[ObjectState],
                entries: List[LogEntry], log_omap: Dict[str, bytes],
-               acting: Sequence[int], on_commit: Callable[[], None]) -> None:
-        """state=None means delete. `log_omap` are pg-log omap updates to
-        persist in the same transaction (crash = replay consistency)."""
+               acting: Sequence[int], on_commit: Callable[[], None],
+               log_rm: Optional[List[str]] = None) -> None:
+        """state=None means delete. `log_omap`/`log_rm` are pg-log omap
+        updates/trims persisted in the same transaction (crash = replay
+        consistency)."""
         raise NotImplementedError
 
     def read_object(self, oid: str, acting: Sequence[int],
@@ -141,7 +143,8 @@ def pg_meta_txn(coll: Collection, entries_omap: Dict[str, bytes],
 
 class ReplicatedBackend(PGBackend):
     def _object_txn(self, oid: str, state: Optional[ObjectState],
-                    log_omap: Dict[str, bytes]) -> Transaction:
+                    log_omap: Dict[str, bytes],
+                    log_rm: Optional[List[str]] = None) -> Transaction:
         t = Transaction()
         g = GHObject(oid)
         if state is None:
@@ -156,10 +159,13 @@ class ReplicatedBackend(PGBackend):
         if log_omap:
             t.touch(self.coll, _meta_oid())
             t.omap_setkeys(self.coll, _meta_oid(), log_omap)
+        if log_rm:
+            t.omap_rmkeys(self.coll, _meta_oid(), log_rm)
         return t
 
-    def submit(self, oid, state, entries, log_omap, acting, on_commit):
-        txn = self._object_txn(oid, state, log_omap)
+    def submit(self, oid, state, entries, log_omap, acting, on_commit,
+               log_rm=None):
+        txn = self._object_txn(oid, state, log_omap, log_rm)
         peers = [o for o in acting
                  if o != self.whoami and o != CRUSH_ITEM_NONE and o >= 0]
         tid = self._new_tid()
@@ -241,7 +247,8 @@ class ECBackend(PGBackend):
 
     def _shard_txn(self, oid: str, shard: int, chunk: Optional[bytes],
                    state: Optional[ObjectState],
-                   log_omap: Dict[str, bytes]) -> Transaction:
+                   log_omap: Dict[str, bytes],
+                   log_rm: Optional[List[str]] = None) -> Transaction:
         t = Transaction()
         g = GHObject(oid, shard=shard)
         if state is None:
@@ -258,9 +265,12 @@ class ECBackend(PGBackend):
         if log_omap:
             t.touch(self.coll, _meta_oid())
             t.omap_setkeys(self.coll, _meta_oid(), log_omap)
+        if log_rm:
+            t.omap_rmkeys(self.coll, _meta_oid(), log_rm)
         return t
 
-    def submit(self, oid, state, entries, log_omap, acting, on_commit):
+    def submit(self, oid, state, entries, log_omap, acting, on_commit,
+               log_rm=None):
         n = self.k + self.m
         chunks: List[Optional[bytes]] = [None] * n
         if state is not None:
@@ -280,7 +290,7 @@ class ECBackend(PGBackend):
             txn = self._shard_txn(
                 oid, shard,
                 chunks[shard] if state is not None else None,
-                state, log_omap)
+                state, log_omap, log_rm)
             if osd == self.whoami:
                 self.store.queue_transaction(txn)
                 op.ack((shard, osd))
@@ -315,9 +325,23 @@ class ECBackend(PGBackend):
         return [i for i, o in enumerate(acting[: self.k + self.m])
                 if o == self.whoami]
 
-    def reconstruct(self, oid: str,
-                    avail: Dict[int, bytes]) -> Optional[ObjectState]:
-        """Decode the object from >=k chunk payloads."""
+    def shard_meta(self, oid: str,
+                   shard: int) -> Tuple[Dict[str, bytes], Dict[str, bytes]]:
+        """A local shard's (attrs incl. hinfo, omap), for read replies."""
+        g = GHObject(oid, shard=shard)
+        if not self.store.exists(self.coll, g):
+            return {}, {}
+        return (dict(self.store.getattrs(self.coll, g)),
+                dict(self.store.omap_get(self.coll, g)))
+
+    def reconstruct(self, oid: str, avail: Dict[int, bytes],
+                    meta: Optional[Tuple[Dict[str, bytes],
+                                         Dict[str, bytes]]] = None,
+                    ) -> Optional[ObjectState]:
+        """Decode the object from >=k chunk payloads.  `meta` is the
+        (attrs, omap) of ANY shard — supplied by the read path from
+        whichever shard answered (possibly remote), so reconstruction
+        never depends on this OSD holding a healthy local shard."""
         if not avail:
             return None
         n = len(next(iter(avail.values())))
@@ -328,19 +352,16 @@ class ECBackend(PGBackend):
         want = list(range(self.k))
         data_chunks = self.codec.decode_array(arrs, want, n)
         buf = b"".join(data_chunks[i].tobytes() for i in range(self.k))
-        # logical size + attrs come from any shard's metadata
-        some_shard = next(iter(avail))
-        g = GHObject(oid, shard=some_shard)
-        attrs = dict(self.store.getattrs(self.coll, g)) if (
-            self.store.exists(self.coll, g)) else {}
+        if meta is None:
+            meta = self.shard_meta(oid, next(iter(avail)))
+        attrs, omap = dict(meta[0]), dict(meta[1])
         size = None
         if "hinfo" in attrs:
             size, _ = hinfo_decode(attrs["hinfo"])
         attrs.pop("hinfo", None)
-        omap = self.store.omap_get(self.coll, g) if (
-            self.store.exists(self.coll, g)) else {}
-        return ObjectState(buf[: size if size is not None else len(buf)],
-                           attrs, omap)
+        if size is None:
+            return None  # no shard metadata reached us: can't size it
+        return ObjectState(buf[:size], attrs, omap)
 
     def object_names(self) -> List[str]:
         return sorted({o.name for o in self.store.collection_list(self.coll)

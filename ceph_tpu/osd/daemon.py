@@ -58,6 +58,11 @@ class OSDService(Dispatcher):
         self.pgs: Dict[PGId, PG] = {}
         self.msgr = Messenger(ctx, EntityName("osd", whoami))
         self.msgr.add_dispatcher(self)
+        # dedicated heartbeat endpoint (reference hb_front/back
+        # messengers, OSD.cc ~7 messengers per daemon): liveness probes
+        # must never queue behind data-path dispatch
+        self.hb_msgr = Messenger(ctx, EntityName("osd", whoami))
+        self.hb_msgr.add_dispatcher(_HBDispatcher(self))
         self.addr_book: Dict[int, Addr] = {}
         self._tid = 0
         self._tid_lock = threading.Lock()
@@ -70,6 +75,7 @@ class OSDService(Dispatcher):
         self._log = ctx.log.dout("osd")
         self.on_failure_report: Optional[Callable[[int], None]] = None
         self.hb_stamps: Dict[int, float] = {}
+        self.hb_replied: set = set()  # peers that ever answered a ping
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         pc = ctx.perf.create(f"osd.{whoami}")
@@ -83,9 +89,48 @@ class OSDService(Dispatcher):
     def init(self) -> None:
         self.store.mount()
         self.msgr.start()
+        self.hb_msgr.start()
         self.wq.start()
         self.up = True
-        self._load_pgs()
+        if self.osdmap is not None:
+            self._load_pgs()
+
+    def boot(self, monmap) -> None:
+        """Join a mon-managed cluster: subscribe to maps, announce
+        ourselves, route failure reports to the mon (reference
+        OSD::start_boot -> MOSDBoot)."""
+        from ceph_tpu.mon.client import MonClient
+
+        self.monc = MonClient(self.msgr, monmap)
+        self.on_failure_report = (
+            lambda osd: self.monc.report_failure(osd))
+        self._map_lock = threading.Lock()
+        self.monc.subscribe_osdmap(
+            self._on_new_map,
+            since=self.osdmap.epoch if self.osdmap else 0)
+
+        def _boot_loop() -> None:
+            # a boot sent before the election settles is dropped by
+            # non-leaders, and a live osd spuriously marked down must
+            # re-assert itself — so keep watching the map and re-boot
+            # whenever it shows us down (reference OSD::start_boot +
+            # the "wrongly marked me down" path of handle_osd_map)
+            while self.up:
+                m_ = self.osdmap
+                if m_ is None or not m_.is_up(self.whoami):
+                    self.monc.send_boot(self.whoami,
+                                        hb_addr=self.hb_msgr.addr)
+                time.sleep(1.0)
+
+        threading.Thread(target=_boot_loop, daemon=True,
+                         name=f"osd{self.whoami}-boot").start()
+
+    def _on_new_map(self, osdmap: OSDMap) -> None:
+        with self._map_lock:
+            if self.osdmap is not None and osdmap.epoch <= self.osdmap.epoch:
+                return
+            self.handle_osdmap(osdmap, dict(osdmap.osd_addrs))
+        self.activate_pgs()
 
     def start_heartbeats(self) -> None:
         iv = self.ctx.conf.get("osd_heartbeat_interval")
@@ -101,6 +146,7 @@ class OSDService(Dispatcher):
             self._hb_thread.join(timeout=5)
         self.wq.stop()
         self.msgr.shutdown()
+        self.hb_msgr.shutdown()
         self.store.umount()
 
     @property
@@ -108,7 +154,7 @@ class OSDService(Dispatcher):
         return self.msgr.addr
 
     def epoch(self) -> int:
-        return self.osdmap.epoch
+        return self.osdmap.epoch if self.osdmap is not None else 0
 
     # -- map handling -----------------------------------------------------
     def _load_pgs(self) -> None:
@@ -139,6 +185,16 @@ class OSDService(Dispatcher):
     def handle_osdmap(self, osdmap: OSDMap,
                       addr_book: Optional[Dict[int, Addr]] = None) -> None:
         """consume_map: adopt the epoch, re-derive PG membership."""
+        old = self.osdmap
+        if old is not None:
+            # a peer that went down and came back starts a fresh
+            # liveness clock — its pre-crash stamp would otherwise
+            # trigger an instant (and unanimous) failure re-report
+            for osd in list(self.hb_stamps):
+                if (0 <= osd < osdmap.max_osd and osdmap.is_up(osd)
+                        and not old.is_up(osd)):
+                    self.hb_stamps.pop(osd, None)
+                    self.hb_replied.discard(osd)
         self.osdmap = osdmap
         if addr_book:
             self.addr_book.update(addr_book)
@@ -190,7 +246,7 @@ class OSDService(Dispatcher):
     # -- dispatch ---------------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
         if isinstance(msg, m.MOSDPing):
-            return self._handle_ping(conn, msg)
+            return self._handle_ping(conn, msg)  # legacy single-msgr path
         if isinstance(msg, (m.MOSDRepOpReply, m.MECSubWriteReply)):
             pg = self.pgs.get(msg.pgid)
             if pg is not None:
@@ -242,41 +298,50 @@ class OSDService(Dispatcher):
             self.wq.queue(msg.pgid, run,
                           priority=self.ctx.conf.get("osd_client_op_priority"))
             return True
-        # pg-targeted server-side messages run ordered on the same queue
+        # replica-side applies and reads run INLINE on the dispatch
+        # thread (ordered per session, fast local store work): if they
+        # queued behind client writes — which now block their wq shard
+        # until commit — two primaries waiting on each other's shard
+        # acks could deadlock on a shard-hash collision
         if isinstance(msg, (m.MOSDRepOp, m.MECSubWrite, m.MECSubRead,
-                            m.MPGQuery, m.MPGPush, m.MPGPull, m.MScrub)):
+                            m.MPGQuery, m.MScrub)):
+            pg = self.pgs.get(msg.pgid)
+            if pg is None:
+                return True
+            if isinstance(msg, m.MOSDRepOp):
+                pg.handle_rep_op(msg, conn)
+            elif isinstance(msg, m.MECSubWrite):
+                pg.handle_sub_write(msg, conn)
+            elif isinstance(msg, m.MECSubRead):
+                pg.handle_sub_read(msg, conn)
+            elif isinstance(msg, m.MPGQuery):
+                pg.handle_query(msg, conn)
+            elif isinstance(msg, m.MScrub):
+                rep = m.MScrubMap(msg.pgid, self.epoch(),
+                                  pg.local_scrub_map())
+                rep.tid = msg.tid
+                conn.send(rep)
+            return True
+        # recovery traffic may itself block on RPCs: keep it on the
+        # ordered queue at recovery priority
+        if isinstance(msg, (m.MPGPush, m.MPGPull)):
             pg = self.pgs.get(msg.pgid)
             if pg is None:
                 return True
 
             def run(pg=pg, msg=msg, conn=conn) -> None:
-                if isinstance(msg, m.MOSDRepOp):
-                    pg.handle_rep_op(msg, conn)
-                elif isinstance(msg, m.MECSubWrite):
-                    pg.handle_sub_write(msg, conn)
-                elif isinstance(msg, m.MECSubRead):
-                    pg.handle_sub_read(msg, conn)
-                elif isinstance(msg, m.MPGQuery):
-                    pg.handle_query(msg, conn)
-                elif isinstance(msg, m.MPGPush):
+                if isinstance(msg, m.MPGPush):
                     pg.handle_push(msg, conn)
-                elif isinstance(msg, m.MPGPull):
+                else:
                     for oid in msg.oids:
                         pg.push_object(oid, self._osd_of(msg))
                     done = m.MPGPushReply(pg.pgid, self.epoch(), "", 0)
                     done.tid = msg.tid
                     conn.send(done)  # completion marker for the puller
-                elif isinstance(msg, m.MScrub):
-                    rep = m.MScrubMap(pg.pgid, self.epoch(),
-                                      pg.local_scrub_map())
-                    rep.tid = msg.tid
-                    conn.send(rep)
 
-            prio = (self.ctx.conf.get("osd_client_op_priority")
-                    if isinstance(msg, (m.MOSDRepOp, m.MECSubWrite,
-                                        m.MECSubRead))
-                    else self.ctx.conf.get("osd_recovery_op_priority"))
-            self.wq.queue(msg.pgid, run, priority=prio)
+            self.wq.queue(msg.pgid, run,
+                          priority=self.ctx.conf.get(
+                              "osd_recovery_op_priority"))
             return True
         return False
 
@@ -288,13 +353,21 @@ class OSDService(Dispatcher):
         grace = self.ctx.conf.get("osd_heartbeat_grace")
         while not self._hb_stop.wait(interval):
             now = time.time()
-            for osd_id, addr in list(self.addr_book.items()):
-                if osd_id == self.whoami or not self.osdmap.is_up(osd_id):
+            hb_addrs = (dict(self.osdmap.osd_hb_addrs)
+                        if self.osdmap is not None else {})
+            for osd_id, addr in hb_addrs.items():
+                if osd_id == self.whoami or self.osdmap is None or (
+                        not self.osdmap.is_up(osd_id)):
                     continue
                 ping = m.MOSDPing(m.MOSDPing.PING, now, self.epoch())
-                self.msgr.send_message(ping, addr)
-                last = self.hb_stamps.get(osd_id)
-                if last is not None and now - last > grace:
+                self.hb_msgr.send_message(ping, tuple(addr))
+                # grace runs from FIRST CONTACT, not first reply, so a
+                # peer that never answers still gets reported — but with
+                # a longer fuse (3x) before the first reply so startup
+                # churn doesn't trigger spurious reports
+                last = self.hb_stamps.setdefault(osd_id, now)
+                fuse = grace if osd_id in self.hb_replied else 3 * grace
+                if now - last > fuse:
                     if self.on_failure_report:
                         self.on_failure_report(osd_id)
 
@@ -306,6 +379,7 @@ class OSDService(Dispatcher):
             osd_id = self._osd_of(msg)
             if osd_id >= 0:
                 self.hb_stamps[osd_id] = time.time()
+                self.hb_replied.add(osd_id)
         return True
 
     # -- synchronous peer RPCs (peering/recovery/scrub helpers) -----------
@@ -351,19 +425,12 @@ class OSDService(Dispatcher):
             latest[en.oid] = en
         if not info_msg.entries and info_msg.info.last_update > since:
             # fell behind the peer's log tail: backfill every object
+            # (the peer's scrub map doubles as its object listing)
             latest = {}
-            if pg.is_ec():
-                names = set()
-                reps2 = self._rpc([(best_osd, m.MScrub(pg.pgid,
-                                                       self.epoch()))])
-                if reps2 and isinstance(reps2[0], m.MScrubMap):
-                    names = set(reps2[0].digests)
-            else:
-                reps2 = self._rpc([(best_osd, m.MScrub(pg.pgid,
-                                                       self.epoch()))])
-                names = (set(reps2[0].digests)
-                         if reps2 and isinstance(reps2[0], m.MScrubMap)
-                         else set())
+            reps2 = self._rpc([(best_osd, m.MScrub(pg.pgid, self.epoch()))])
+            names = (set(reps2[0].digests)
+                     if reps2 and isinstance(reps2[0], m.MScrubMap)
+                     else set())
             for oid in names:
                 latest[oid] = t_.LogEntry(
                     t_.LOG_MODIFY, oid, info_msg.info.last_update,
@@ -459,3 +526,15 @@ class OSDService(Dispatcher):
             if isinstance(rep, m.MECSubReadReply) and rep.result == 0:
                 return rep.data
         return None
+
+
+class _HBDispatcher(Dispatcher):
+    """Heartbeat-only dispatcher for the dedicated hb messenger."""
+
+    def __init__(self, osd: OSDService) -> None:
+        self.osd = osd
+
+    def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, m.MOSDPing):
+            return self.osd._handle_ping(conn, msg)
+        return False

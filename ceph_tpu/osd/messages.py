@@ -209,19 +209,30 @@ class MECSubRead(_PGMessage):
 
 @register
 class MECSubReadReply(_PGMessage):
+    """Chunk payload + the shard's object metadata (attrs/omap ride
+    along so the primary can reconstruct without any local shard)."""
+
     TYPE = 17
 
     def __init__(self, pgid=(0, 0), epoch=0, shard: int = -1,
-                 oid: str = "", data: bytes = b"", result: int = 0) -> None:
+                 oid: str = "", data: bytes = b"", result: int = 0,
+                 attrs: Optional[Dict[str, bytes]] = None,
+                 omap: Optional[Dict[str, bytes]] = None) -> None:
         super().__init__(pgid, epoch)
         self.shard = shard
         self.oid = oid
         self.data = data
         self.result = result
+        self.attrs = attrs or {}
+        self.omap = omap or {}
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
         e.s32(self.shard).string(self.oid).blob(self.data).s32(self.result)
+        e.mapping(self.attrs, lambda enc, k: enc.string(k),
+                  lambda enc, v: enc.blob(v))
+        e.mapping(self.omap, lambda enc, k: enc.string(k),
+                  lambda enc, v: enc.blob(v))
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
@@ -229,6 +240,8 @@ class MECSubReadReply(_PGMessage):
         self.oid = d.string()
         self.data = d.blob()
         self.result = d.s32()
+        self.attrs = d.mapping(lambda dd: dd.string(), lambda dd: dd.blob())
+        self.omap = d.mapping(lambda dd: dd.string(), lambda dd: dd.blob())
 
 
 @register

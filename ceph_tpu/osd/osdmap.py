@@ -71,6 +71,7 @@ class PGPool:
     flags: int = FLAG_HASHPSPOOL
     object_hash: str = "rjenkins"
     erasure_code_profile: str = ""
+    name: str = ""
 
     @property
     def pg_num_mask_(self) -> int:
@@ -136,6 +137,12 @@ class OSDMap:
         self.pg_upmap_items: Dict[Tuple[int, int], List[Tuple[int, int]]] = {}
         self.pg_temp: Dict[Tuple[int, int], List[int]] = {}
         self.primary_temp: Dict[Tuple[int, int], int] = {}
+        # entity addresses published with the map (reference: osd_addrs
+        # + hb_front/back_addrs in OSDMap) — how daemons/clients find
+        # each other; heartbeats get their own endpoint so a busy data
+        # path can never stall liveness probes
+        self.osd_addrs: Dict[int, Tuple[str, int]] = {}
+        self.osd_hb_addrs: Dict[int, Tuple[str, int]] = {}
         self._flat = None
         self._rule_fns: Dict[Tuple[int, int], object] = {}
 

@@ -123,9 +123,13 @@ class PG:
                 reply(rep)
                 return
             writes = any(o.is_write() for o in msg.ops)
-            if writes:
-                self._do_write(msg, reply)
-            else:
+        # _do_write manages the lock itself: it must NOT be held while
+        # waiting for shard acks, or an inline replica apply (which
+        # takes it) from a peer waiting on OUR ack deadlocks both
+        if writes:
+            self._do_write(msg, reply)
+        else:
+            with self.lock:
                 self._do_read(msg, reply)
 
     def _get_state(self, oid: str,
@@ -182,34 +186,37 @@ class PG:
         return 0
 
     def _do_write(self, msg, reply):
-        def finish(state: Optional[ObjectState]) -> None:
-            # EC state fetches complete on a messenger thread: retake the
-            # pg lock so log append/version bump stay serialized
-            with self.lock:
-                exists = state is not None
-                work = state or ObjectState()
-                delete = False
-                result = 0
-                for op in msg.ops:
-                    if op.is_write():
-                        result, delete2 = self._exec_write_op(
-                            op, work, exists)
-                        delete = delete or delete2
-                        if result == 0 and op.op != t_.OP_DELETE:
-                            exists = True
-                    else:
-                        result = self._exec_read_op(
-                            op, None if not exists else work)
-                    if result < 0:
-                        break
+        # writes run START-TO-COMMIT on the pg's queue shard: the state
+        # read is synchronous and we block on the commit before the next
+        # queued op dispatches, so two writes to one object can never
+        # read the same base state (per-PG ordering, the reference's
+        # strictly-ordered RMW pipeline, ECBackend.cc:2098)
+        state = self._read_state_sync(msg.oid)
+        committed = threading.Event()
+        with self.lock:
+            exists = state is not None
+            work = state or ObjectState()
+            delete = False
+            result = 0
+            for op in msg.ops:
+                if op.is_write():
+                    result, delete2 = self._exec_write_op(op, work, exists)
+                    delete = delete or delete2
+                    if result == 0 and op.op != t_.OP_DELETE:
+                        exists = True
+                else:
+                    result = self._exec_read_op(
+                        op, None if not exists else work)
                 if result < 0:
-                    reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
-                                        msg.oid, msg.ops, result=result))
-                    return
-                self._commit_write(msg, None if delete else work, delete,
-                                   reply)
-
-        self._get_state(msg.oid, finish)
+                    break
+            if result < 0:
+                reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                    msg.oid, msg.ops, result=result))
+                return
+            self._commit_write(msg, None if delete else work, delete,
+                               reply, committed)
+        # wait OUTSIDE the lock: inline replica handlers need it
+        committed.wait(timeout=30.0)
 
     def _exec_write_op(self, op: OSDOp, st: ObjectState,
                        exists: bool) -> Tuple[int, bool]:
@@ -264,7 +271,8 @@ class PG:
         return EVersion(self.osd.epoch(), cur.version + 1)
 
     def _commit_write(self, msg, state: Optional[ObjectState],
-                      delete: bool, reply) -> None:
+                      delete: bool, reply,
+                      committed: Optional[threading.Event] = None) -> None:
         version = self._next_version()
         entry = LogEntry(
             op=t_.LOG_DELETE if delete else t_.LOG_MODIFY,
@@ -277,16 +285,18 @@ class PG:
         self.info.last_update = version
         self.info.last_complete = version
         log_omap = self.log.omap_additions([entry])
-        e = Encoder()
-        self.info.encode(e)
-        log_omap["_info"] = e.bytes()  # piggyback info in the same txn
+        # bound the log (reference osd_max_pg_log_entries trim)
+        trimmed = self.log.trim_to()
+        log_rm = self.log.omap_removals(trimmed)
 
         def on_commit() -> None:
             reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                 msg.ops, result=0, version=version))
+            if committed is not None:
+                committed.set()
 
         self.backend.submit(msg.oid, state, [entry], log_omap,
-                            self.acting, on_commit)
+                            self.acting, on_commit, log_rm=log_rm)
 
     # -- replica apply ----------------------------------------------------
     def handle_rep_op(self, msg: m.MOSDRepOp, conn) -> None:
@@ -309,6 +319,7 @@ class PG:
         for en in entries:
             if en.version > self.log.head:
                 self.log.append(en)
+        self.log.trim_to()  # replicas bound memory like the primary
         if self.log.head > self.info.last_update:
             self.info.last_update = self.log.head
             self.info.last_complete = self.log.head
@@ -316,10 +327,12 @@ class PG:
     def handle_sub_read(self, msg: m.MECSubRead, conn) -> None:
         assert isinstance(self.backend, ECBackend)
         data = self.backend.read_local_chunk(msg.oid, msg.shard)
+        attrs, omap = self.backend.shard_meta(msg.oid, msg.shard)
         rep = m.MECSubReadReply(
             self.pgid, self.osd.epoch(), msg.shard, msg.oid,
             data if data is not None else b"",
-            0 if data is not None else EIO)
+            0 if data is not None else EIO,
+            attrs, omap)
         rep.tid = msg.tid
         conn.send(rep)
 
@@ -331,15 +344,19 @@ class PG:
         acting = list(self.acting[:n]) + [CRUSH_ITEM_NONE] * (
             n - len(self.acting))
         avail: Dict[int, bytes] = {}
+        meta_box: List = [None]  # (attrs, omap) from whichever shard
         for shard in be.local_shards(acting):
             c = be.read_local_chunk(oid, shard)
             if c is not None:
                 avail[shard] = c
+                if meta_box[0] is None:
+                    meta_box[0] = be.shard_meta(oid, shard)
         remote = [(s, o) for s, o in enumerate(acting)
                   if o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
                   and o not in self.stale_peers]  # stale shards can't serve
         if not remote or len(avail) >= be.k:
-            done(be.reconstruct(oid, avail) if avail else None)
+            done(be.reconstruct(oid, avail, meta_box[0])
+                 if avail else None)
             return
         # fan out sub-reads; complete as soon as k chunks are in hand or
         # every live shard answered; a watchdog fires with whatever we
@@ -355,7 +372,8 @@ class PG:
                     return
                 fired[0] = True
             timer.cancel()
-            done(be.reconstruct(oid, avail) if avail else None)
+            done(be.reconstruct(oid, avail, meta_box[0])
+                 if avail else None)
 
         def on_reply(rep: m.MECSubReadReply) -> None:
             with lock:
@@ -364,6 +382,8 @@ class PG:
                 pending.discard(rep.shard)
                 if rep.result == 0 and rep.oid == oid:
                     avail[rep.shard] = rep.data
+                    if meta_box[0] is None and "hinfo" in rep.attrs:
+                        meta_box[0] = (dict(rep.attrs), dict(rep.omap))
                 ready = not pending or len(avail) >= be.k
             if ready:
                 finish()

@@ -52,6 +52,10 @@ class StripeBatchQueue:
         with self._lock:
             if not self._started:
                 self._started = True
+                if not self._thread.is_alive():
+                    self._thread = threading.Thread(
+                        target=self._worker, name="stripe-batch",
+                        daemon=True)
                 self._thread.start()
 
     def stop(self) -> None:
