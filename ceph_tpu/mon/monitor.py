@@ -79,7 +79,9 @@ class Monitor(Dispatcher):
         self.last_committed = 0
         self.uncommitted: Optional[Tuple[int, int, bytes]] = None
         self._accept_votes: Dict[int, Set[int]] = {}
-        self._collect_acks: List[mm.MMonPaxos] = []
+        self._collect_acks: Dict[int, mm.MMonPaxos] = {}  # peon rank -> LAST
+        self._collect_pn = 0          # pn of the in-flight collect round
+        self._collect_complete = True  # no collect in flight
         self._proposing = False
         self._propose_queue: List[bytes] = []
 
@@ -138,6 +140,15 @@ class Monitor(Dispatcher):
             data = self.kv.get("paxos_values", str(self.last_committed))
             if data:
                 self.osdmap = map_codec.decode_osdmap(data)
+        # restore an accepted-but-uncommitted proposal: our promise must
+        # survive restart or a new leader's collect can miss a value the
+        # old leader already committed elsewhere (Paxos.cc handle_collect
+        # sharing uncommitted state)
+        upn = self.kv.get("paxos", "uncommitted_pn")
+        uv = self.kv.get("paxos", "uncommitted_v")
+        uval = self.kv.get("paxos", "uncommitted_value")
+        if upn and uv and uval is not None and int(uv) > self.last_committed:
+            self.uncommitted = (int(upn), int(uv), uval)
         prof = self.kv.get("mon", "ec_profiles")
         if prof:
             self.ec_profiles = json.loads(prof.decode())
@@ -155,6 +166,10 @@ class Monitor(Dispatcher):
         b = WriteBatch()
         b.set("paxos_values", str(version), value)
         b.set("paxos", "last_committed", str(version).encode())
+        # the promise is fulfilled; drop it so a restart doesn't resurrect it
+        b.rmkey("paxos", "uncommitted_pn")
+        b.rmkey("paxos", "uncommitted_v")
+        b.rmkey("paxos", "uncommitted_value")
         self.kv.submit(b)
 
     # -- election (Elector.cc shape) --------------------------------------
@@ -170,11 +185,18 @@ class Monitor(Dispatcher):
                 mm.MMonElection.PROPOSE, epoch, self.rank))
         # single-mon cluster wins immediately
         self._maybe_win()
-        threading.Timer(1.0, self._election_timeout, args=(epoch,)).start()
+        self._timer(1.0, self._election_timeout, epoch)
+
+    def _timer(self, delay: float, fn, *args) -> None:
+        t = threading.Timer(delay, fn, args=args)
+        t.daemon = True  # never pin the process on a pending retry
+        t.start()
 
     def _election_timeout(self, epoch: int) -> None:
         with self.lock:
-            if self.state == STATE_ELECTING and self.election_epoch == epoch:
+            if (self.state == STATE_ELECTING
+                    and self.election_epoch == epoch
+                    and not self._stop.is_set()):
                 pass  # retry
             else:
                 return
@@ -267,12 +289,19 @@ class Monitor(Dispatcher):
 
     def _leader_collect(self) -> None:
         """Phase 1 after winning: learn peons' state, recover in-flight
-        proposals (Paxos.cc collect)."""
+        proposals (Paxos.cc collect).  Phase 2 is gated on LAST acks from
+        a full quorum (counting self) — proceeding with fewer can propose
+        over a value an unreached peon already accepted (Paxos.cc
+        handle_last's num_last accounting)."""
         with self.lock:
+            if self.state != STATE_LEADER:
+                return
             pn = self._new_pn()
             self.accepted_pn = pn
             self._persist(accepted_pn=pn)
-            self._collect_acks = []
+            self._collect_acks = {}
+            self._collect_pn = pn
+            self._collect_complete = False
             # a proposal in flight when the election interrupted us is
             # dead; recovery happens via the collect phase (uncommitted
             # re-propose), so reset the pipeline or it wedges forever
@@ -282,23 +311,36 @@ class Monitor(Dispatcher):
                                last_committed=self.last_committed)
         for r in self._peers():
             self._send_mon(r, msg)
-        # a single-mon quorum proceeds immediately
-        threading.Timer(0.5, self._collect_done).start()
+        # a single-mon quorum (just us) proceeds immediately
+        self._maybe_collect_done()
+        self._timer(1.0, self._collect_timeout, pn)
 
-    def _collect_done(self) -> None:
+    def _collect_timeout(self, pn: int) -> None:
         with self.lock:
-            if self.state != STATE_LEADER:
+            if (self.state != STATE_LEADER or self._collect_complete
+                    or self._collect_pn != pn or self._stop.is_set()):
                 return
-            acks = list(self._collect_acks)
+        self._plog(1, "collect quorum timeout; retrying with fresh pn")
+        self._leader_collect()
+
+    def _maybe_collect_done(self) -> None:
+        with self.lock:
+            if self.state != STATE_LEADER or self._collect_complete:
+                return
+            acks = list(self._collect_acks.values())
             # NACK: a peon promised a higher pn than ours — re-collect
             # with a fresh pn above it
             top = max((a.pn for a in acks), default=0)
             if top > self.accepted_pn:
                 self.last_pn = max(self.last_pn, top)
                 self._persist(last_pn=self.last_pn)
+                self._collect_complete = True
                 retry = True
-            else:
+            elif len(acks) + 1 >= self.monmap.quorum():
+                self._collect_complete = True
                 retry = False
+            else:
+                return  # keep waiting for more LASTs
         if retry:
             self._leader_collect()
             return
@@ -356,9 +398,16 @@ class Monitor(Dispatcher):
             return
         if op == mm.MMonPaxos.LAST:
             with self.lock:
+                if self.state != STATE_LEADER or self._collect_complete:
+                    return  # stale ack from a finished/abandoned round
                 if msg.version > self.last_committed and msg.value:
                     self._learn(msg.version, msg.value)
-                self._collect_acks.append(msg)
+                # ignore leftovers of an older collect (their pn is below
+                # the round's); key by rank so resends don't double-count
+                if msg.pn >= self._collect_pn:
+                    rank = msg.src.num if msg.src else -1
+                    self._collect_acks[rank] = msg
+            self._maybe_collect_done()
             return
         if op == mm.MMonPaxos.BEGIN:
             with self.lock:
@@ -369,10 +418,8 @@ class Monitor(Dispatcher):
                     return  # stale proposer
                 self.uncommitted = (msg.pn, msg.version, msg.value)
                 self._persist(uncommitted_pn=msg.pn,
-                              uncommitted_v=msg.version)
-                b = WriteBatch()
-                b.set("paxos", "uncommitted_value", msg.value)
-                self.kv.submit(b)
+                              uncommitted_v=msg.version,
+                              uncommitted_value=msg.value)
                 rep = mm.MMonPaxos(mm.MMonPaxos.ACCEPT, msg.pn,
                                    version=msg.version)
             conn.send(rep)
@@ -419,13 +466,20 @@ class Monitor(Dispatcher):
         with self.lock:
             if self.state != STATE_LEADER:
                 return
-            if self._proposing:
+            if self._proposing or not self._collect_complete:
+                # queue until phase 1 has heard a quorum of LASTs —
+                # proposing earlier can overwrite a value an unreached
+                # peon already accepted for this version
                 self._propose_queue.append(value)
                 return
             self._proposing = True
             version = self.last_committed + 1
             pn = self.accepted_pn
             self.uncommitted = (pn, version, value)
+            # the leader is an acceptor too: its own accept must survive
+            # restart just like a peon's (ADVICE: promise lost on restart)
+            self._persist(uncommitted_pn=pn, uncommitted_v=version,
+                          uncommitted_value=value)
             self._accept_votes[version] = {self.rank}
             msg = mm.MMonPaxos(mm.MMonPaxos.BEGIN, pn, version, value)
         for r in self._peers():

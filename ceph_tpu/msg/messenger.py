@@ -58,6 +58,7 @@ class Connection:
         self._unacked: List[Tuple[int, bytes]] = []  # (seq, frame)
         self._writer: Optional[asyncio.StreamWriter] = None
         self._send_q: asyncio.Queue = asyncio.Queue()
+        self._pump_task: Optional[asyncio.Task] = None  # accepted side
         self._closed = False
         self._lock = threading.Lock()
 
@@ -148,6 +149,13 @@ class Messenger:
         # sids within an incarnation are capped LRU-style
         self._peer_in_seq: Dict[str, Tuple[int, Dict[int, int]]] = {}
         self._max_sids_per_peer = 64
+        # accepted-side sessions keyed by the dialer's (src, nonce, sid):
+        # the lossless guarantee must hold in BOTH directions, so replies
+        # queued on an accepted Connection survive socket death and are
+        # replayed when the dialer reconnects the same logical session
+        # (the reference's lossless-peer resend discipline)
+        self._accepted_sessions: Dict[Tuple[str, int, int], Connection] = {}
+        self._max_accepted_sessions = 256
         self._log = ctx.log.dout("ms") if ctx else (lambda lvl, s: None)
 
     # -- lifecycle --------------------------------------------------------
@@ -172,6 +180,8 @@ class Messenger:
             for c in list(self._conns.values()):
                 c._close()
             for c in list(self._accepted):
+                c._close()
+            for c in list(self._accepted_sessions.values()):
                 c._close()
             if self._server is not None:
                 self._server.close()
@@ -222,6 +232,20 @@ class Messenger:
                 await asyncio.sleep(self._retry)
                 continue
             conn._writer = writer
+            # announce the session (src, nonce, sid) first so the
+            # acceptor can reattach its persistent session state even
+            # when we have nothing to send — e.g. a reconnect whose only
+            # purpose is collecting replies queued on the other side
+            announce = MAck()
+            announce.src = self.entity
+            announce.nonce = self.nonce
+            announce.sid = conn.sid
+            announce.ack_seq = conn.in_seq
+            ab = announce.to_bytes()
+            writer.write(
+                _FRAME.pack(len(ab),
+                            crc32c(ab) if self.crc_data else 0) + ab
+            )
             # lossless-peer: resend everything the peer hasn't acked
             for _, frame in conn._unacked:
                 writer.write(frame)
@@ -274,36 +298,106 @@ class Messenger:
         # sessions are bidirectional: replies from dispatchers go back
         # over this same socket (conn.send), so the accepted side pumps
         # a send queue too; if the socket drops, the dialing peer owns
-        # reconnect and we just fold
-        conn = Connection(self, peer)
-        conn._writer = writer
-        self._accepted.add(conn)
-
-        async def _pump():
-            try:
-                while True:
-                    frame = await conn._send_q.get()
-                    if frame is None:
-                        return
-                    writer.write(frame)
-                    await writer.drain()
-            except (ConnectionError, OSError, asyncio.CancelledError):
-                pass
-
-        pump_task = asyncio.create_task(_pump())
+        # reconnect and we just fold.  The session OBJECT outlives the
+        # socket: it is resolved from the first message's
+        # (src, nonce, sid) so a reconnect reattaches queued/unacked
+        # replies instead of dropping them
         try:
-            await self._read_frames(conn, reader, ack_writer=writer)
-        finally:
-            conn._closed = True
-            self._accepted.discard(conn)
-            pump_task.cancel()
+            first = await self._read_one(reader)
+            first_msg = Message.from_bytes(first)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                ValueError):
             try:
                 writer.close()
             except Exception:
                 pass
-            if conn.in_seq > 0 and not self._stopped:
-                for d in self._dispatchers:
-                    d.ms_handle_reset(conn)
+            return
+        conn = self._resolve_accepted(first_msg, peer)
+        conn._writer = writer
+        self._accepted.add(conn)
+        # ONE pump per session (not per socket): a stale socket's pump
+        # consuming frames meant for a newer socket would strand replies
+        # until the next reconnect.  The pump writes to whatever writer
+        # is current; frames that hit a dead/absent writer stay in
+        # _unacked and the next attach replays them.
+        if conn._pump_task is None or conn._pump_task.done():
+            conn._pump_task = asyncio.create_task(self._pump_session(conn))
+        try:
+            # the first frame is usually the dialer's session announce;
+            # its piggybacked ack trims _unacked before we replay
+            await self._process_frame(conn, first, first_msg,
+                                      ack_writer=writer)
+            # replies the dialer never acked are replayed on reconnect
+            # (dup-suppressed on its side if the loss was only the ack)
+            for _, frame in conn._unacked:
+                try:
+                    writer.write(frame)
+                except (ConnectionError, OSError):
+                    pass
+            await self._read_frames(conn, reader, ack_writer=writer)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            # a newer socket may already own the session: only detach
+            # and notify if we are still the current one
+            if conn._writer is writer:
+                conn._writer = None
+                self._accepted.discard(conn)
+                if conn.in_seq > 0 and not self._stopped:
+                    for d in self._dispatchers:
+                        d.ms_handle_reset(conn)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _pump_session(self, conn: Connection) -> None:
+        """Session-lifetime sender for the accepted side: drains the
+        send queue onto the CURRENT socket; frames that miss (detached
+        or dead writer) are not lost — they sit in _unacked and the
+        next reconnect replays them."""
+        while True:
+            frame = await conn._send_q.get()
+            if frame is None:
+                return
+            w = conn._writer
+            if w is None:
+                continue
+            try:
+                w.write(frame)
+                await w.drain()
+            except (ConnectionError, OSError):
+                continue
+
+    def _resolve_accepted(self, msg: Message, peer: Addr) -> Connection:
+        """Find or create the persistent accepted-side session for the
+        dialer identified by the message's (src, nonce, sid)."""
+        key = None
+        if msg.src is not None and msg.nonce and msg.sid:
+            key = (str(msg.src), msg.nonce, msg.sid)
+            conn = self._accepted_sessions.get(key)
+            if conn is not None and not conn._closed:
+                conn.peer_addr = peer  # dialer's ephemeral port moved
+                if key in self._accepted_sessions:
+                    del self._accepted_sessions[key]  # LRU move-to-end
+                self._accepted_sessions[key] = conn
+                return conn
+        conn = Connection(self, peer)
+        if key is not None:
+            while len(self._accepted_sessions) >= self._max_accepted_sessions:
+                old_key = next(iter(self._accepted_sessions))
+                self._accepted_sessions.pop(old_key)._close()
+            self._accepted_sessions[key] = conn
+        return conn
+
+    async def _read_one(self, reader: asyncio.StreamReader) -> bytes:
+        hdr = await reader.readexactly(_FRAME.size)
+        blen, want = _FRAME.unpack(hdr)
+        body = await reader.readexactly(blen)
+        if self.crc_data and want and crc32c(body) != want:
+            raise ConnectionResetError("crc mismatch")
+        return body
 
     async def _read_frames(
         self,
@@ -313,46 +407,49 @@ class Messenger:
     ) -> None:
         try:
             while True:
-                hdr = await reader.readexactly(_FRAME.size)
-                blen, want = _FRAME.unpack(hdr)
-                body = await reader.readexactly(blen)
-                if self.crc_data and want and crc32c(body) != want:
-                    self._log(0, f"crc mismatch from {conn.peer_addr}, "
-                              "dropping session")
-                    return
+                body = await self._read_one(reader)
                 msg = Message.from_bytes(body)
-                conn._handle_ack(msg.ack_seq)
-                if isinstance(msg, MAck):
-                    continue
-                # dup suppression must survive socket turnover: key the
-                # cumulative dispatched-seq by (src, nonce), one logical
-                # lossless session per peer incarnation
-                if msg.src is not None and msg.nonce:
-                    src = str(msg.src)
-                    nonce, sids = self._peer_in_seq.get(src, (0, {}))
-                    if nonce != msg.nonce:  # new incarnation supersedes
-                        nonce, sids = msg.nonce, {}
-                        self._peer_in_seq[src] = (nonce, sids)
-                    last = sids.get(msg.sid, 0)
-                    if msg.seq <= last:
-                        # already dispatched in this or a prior socket of
-                        # the session; re-ack so the replayer trims
-                        self._send_ack(conn, ack_writer, last)
-                        continue
-                    if msg.sid in sids:
-                        del sids[msg.sid]  # re-insert: LRU move-to-end
-                    elif len(sids) >= self._max_sids_per_peer:
-                        sids.pop(next(iter(sids)))  # evict least-recent
-                    sids[msg.sid] = msg.seq
-                    self._peer_in_seq[src] = (nonce, sids)
-                elif msg.seq <= conn.in_seq:
-                    continue  # duplicate within this socket
-                conn.in_seq = msg.seq
-                await self._dispatch(conn, msg, len(body))
-                self._send_ack(conn, ack_writer, conn.in_seq)
+                await self._process_frame(conn, body, msg, ack_writer)
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
                 asyncio.CancelledError):
             pass
+
+    async def _process_frame(
+        self,
+        conn: Connection,
+        body: bytes,
+        msg: Message,
+        ack_writer: Optional[asyncio.StreamWriter] = None,
+    ) -> None:
+        conn._handle_ack(msg.ack_seq)
+        if isinstance(msg, MAck):
+            return
+        # dup suppression must survive socket turnover: key the
+        # cumulative dispatched-seq by (src, nonce), one logical
+        # lossless session per peer incarnation
+        if msg.src is not None and msg.nonce:
+            src = str(msg.src)
+            nonce, sids = self._peer_in_seq.get(src, (0, {}))
+            if nonce != msg.nonce:  # new incarnation supersedes
+                nonce, sids = msg.nonce, {}
+                self._peer_in_seq[src] = (nonce, sids)
+            last = sids.get(msg.sid, 0)
+            if msg.seq <= last:
+                # already dispatched in this or a prior socket of
+                # the session; re-ack so the replayer trims
+                self._send_ack(conn, ack_writer, last)
+                return
+            if msg.sid in sids:
+                del sids[msg.sid]  # re-insert: LRU move-to-end
+            elif len(sids) >= self._max_sids_per_peer:
+                sids.pop(next(iter(sids)))  # evict least-recent
+            sids[msg.sid] = msg.seq
+            self._peer_in_seq[src] = (nonce, sids)
+        elif msg.seq <= conn.in_seq:
+            return  # duplicate within this socket
+        conn.in_seq = msg.seq
+        await self._dispatch(conn, msg, len(body))
+        self._send_ack(conn, ack_writer, conn.in_seq)
 
     def _send_ack(self, conn: Connection, ack_writer, ack_seq: int) -> None:
         if ack_writer is None or not ack_seq:

@@ -65,8 +65,24 @@ class InFlightOp:
     def ack(self, who) -> None:
         fire = False
         with self.lock:
-            self.waiting_on.discard(who)
-            fire = not self.waiting_on
+            if who in self.waiting_on:  # a late ack from a peer that
+                self.waiting_on.discard(who)  # drop_missing already
+                fire = not self.waiting_on    # removed must not re-fire
+        if fire:
+            self.on_commit()
+
+    def drop_missing(self, is_alive: Callable[[object], bool]) -> None:
+        """Stop waiting on peers the map no longer lists as alive — a
+        dead replica can never ack, and its copy is recovered by peering
+        when it returns (the reference requeues in-flight ops on
+        interval change; completing with the surviving set is the
+        all_commit outcome of that requeue)."""
+        fire = False
+        with self.lock:
+            dead = {w for w in self.waiting_on if not is_alive(w)}
+            if dead:
+                self.waiting_on -= dead
+                fire = not self.waiting_on
         if fire:
             self.on_commit()
 
@@ -100,6 +116,18 @@ class PGBackend:
         op = self.in_flight.get(tid)
         if op is not None:
             op.ack(who)
+
+    def on_peer_change(self, alive: set) -> None:
+        """Re-resolve every in-flight op against the new acting set:
+        acks expected from OSDs no longer alive are dropped (ADVICE:
+        an op stuck on a dead peer otherwise hangs forever)."""
+
+        def is_alive(who) -> bool:
+            osd = who[1] if isinstance(who, tuple) else who
+            return osd in alive
+
+        for op in list(self.in_flight.values()):
+            op.drop_missing(is_alive)
 
     def _done(self, tid: int) -> None:
         self.in_flight.pop(tid, None)

@@ -428,20 +428,31 @@ class OSDService(Dispatcher):
             # (the peer's scrub map doubles as its object listing)
             latest = {}
             reps2 = self._rpc([(best_osd, m.MScrub(pg.pgid, self.epoch()))])
-            names = (set(reps2[0].digests)
-                     if reps2 and isinstance(reps2[0], m.MScrubMap)
-                     else set())
+            if not reps2 or not isinstance(reps2[0], m.MScrubMap):
+                return  # can't list the authoritative set; retry later
+            names = set(reps2[0].digests)
             for oid in names:
                 latest[oid] = t_.LogEntry(
                     t_.LOG_MODIFY, oid, info_msg.info.last_update,
                     EVersion())
-        if not latest:
-            return
+            # backfill deletions: anything we hold that the authoritative
+            # peer does not was deleted beyond the log window — keeping
+            # it resurrects deleted data (and leaves stale EC shards that
+            # can poison reconstruction)
+            doomed = set(pg.backend.object_names()) - names
+            if doomed:
+                from ceph_tpu.store.objectstore import Transaction
+
+                t = Transaction()
+                for g in self.store.collection_list(pg.coll):
+                    if g.name in doomed:
+                        t.try_remove(pg.coll, g)
+                self.store.queue_transaction(t)
         if pg.is_ec():
             # reconstruct my shard(s) from surviving peers
             for oid, en in latest.items():
                 self._ec_self_recover(pg, oid, en)
-        else:
+        elif latest:
             pulls = [oid for oid, en in latest.items()
                      if en.op != t_.LOG_DELETE]
             dels = [oid for oid, en in latest.items()
@@ -505,6 +516,15 @@ class OSDService(Dispatcher):
                 t.omap_setkeys(pg.coll, g, state.omap)
         self.store.queue_transaction(t)
         self.perf.inc("recovery_pushes")
+
+    def list_peer_objects(self, pg: PG, osd_id: int) -> Optional[set]:
+        """A peer's object listing (its scrub map's key set); None when
+        the peer didn't answer — callers must NOT treat that as empty
+        (skipping backfill deletions on a lost reply resurrects data)."""
+        reps = self._rpc([(osd_id, m.MScrub(pg.pgid, self.epoch()))])
+        if reps and isinstance(reps[0], m.MScrubMap):
+            return set(reps[0].digests)
+        return None
 
     def collect_scrub_maps(self, pg: PG) -> Dict[int, Dict[str, int]]:
         peers = [o for o in set(pg.acting)

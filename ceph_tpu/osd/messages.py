@@ -400,6 +400,12 @@ class MScrub(_PGMessage):
 
     TYPE = 24
 
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+
 
 @register
 class MScrubMap(_PGMessage):

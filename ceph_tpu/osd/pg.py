@@ -41,7 +41,7 @@ from ceph_tpu.osd.pglog import PGLog
 from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp, PGId, PGInfo
 from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
 
-EPERM, ENOENT, EIO, EINVAL = -1, -2, -5, -22
+EPERM, ENOENT, EIO, EAGAIN, EINVAL = -1, -2, -5, -11, -22
 
 STATE_PEERING = "peering"
 STATE_ACTIVE = "active"
@@ -113,6 +113,11 @@ class PG:
         with self.lock:
             self.acting = list(acting)
             self.primary = primary
+        # in-flight writes waiting on OSDs the new interval dropped can
+        # never be acked — re-resolve them against the live set
+        alive = {o for o in acting if o >= 0 and o != CRUSH_ITEM_NONE}
+        alive.add(self.osd.whoami)
+        self.backend.on_peer_change(alive)
 
     # -- op execution (primary) -------------------------------------------
     def do_op(self, msg: m.MOSDOp, reply: Callable[[m.MOSDOpReply], None]):
@@ -193,6 +198,17 @@ class PG:
         # strictly-ordered RMW pipeline, ECBackend.cc:2098)
         state = self._read_state_sync(msg.oid)
         committed = threading.Event()
+        # exactly one reply per op, whether commit or timeout wins
+        _replied = [False]
+        _rlock = threading.Lock()
+
+        def reply_once(rep) -> None:
+            with _rlock:
+                if _replied[0]:
+                    return
+                _replied[0] = True
+            reply(rep)
+
         with self.lock:
             exists = state is not None
             work = state or ObjectState()
@@ -210,13 +226,18 @@ class PG:
                 if result < 0:
                     break
             if result < 0:
-                reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
-                                    msg.oid, msg.ops, result=result))
+                reply_once(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                         msg.oid, msg.ops, result=result))
                 return
             self._commit_write(msg, None if delete else work, delete,
-                               reply, committed)
+                               reply_once, committed)
         # wait OUTSIDE the lock: inline replica handlers need it
-        committed.wait(timeout=30.0)
+        if not committed.wait(timeout=30.0):
+            # a shard never acked and no map change resolved it: answer
+            # with a retryable error instead of silence (the reference
+            # requeues; the client's resend discipline retries EAGAIN)
+            reply_once(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                     msg.oid, msg.ops, result=EAGAIN))
 
     def _exec_write_op(self, op: OSDOp, st: ObjectState,
                        exists: bool) -> Tuple[int, bool]:
@@ -447,10 +468,28 @@ class PG:
             names = (self.backend.object_names() if changed is None
                      else list(changed))
             ok = True
+            if changed is None:
+                # the laggard fell beyond our log window: it may hold
+                # objects deleted outside the window — push explicit
+                # deletions or backfill resurrects them (the reference's
+                # backfill removes objects absent from the authoritative
+                # set)
+                peer_names = self.osd.list_peer_objects(self, osd_id)
+                if peer_names is None:
+                    ok = False  # couldn't list: keep the peer stale
+                else:
+                    for oid in sorted(peer_names - set(names)):
+                        ok = self.push_delete(oid, osd_id) and ok
             for oid in names:
                 ok = self.push_object(oid, osd_id) and ok
             if ok:
                 self.stale_peers.discard(osd_id)
+
+    def push_delete(self, oid: str, to_osd: int) -> bool:
+        msg = m.MPGPush(self.pgid, self.osd.epoch(), oid, self.log.head,
+                        deleted=True, shard=-1)
+        reps = self.osd.rpc([(to_osd, msg)], timeout=30.0)
+        return any(isinstance(r, m.MPGPushReply) for r in reps)
 
     def push_object(self, oid: str, to_osd: int) -> bool:
         """Push the authoritative copy of one object to a peer; True
@@ -511,7 +550,17 @@ class PG:
             t = Transaction()
             g = GHObject(msg.oid, shard=msg.shard)
             if msg.deleted:
-                t.try_remove(self.coll, g)
+                # remove every form this name can take locally: the
+                # replica object, the pushed shard, and (for EC) every
+                # shard id — a shard=-1 deletion push must clear EC
+                # shard objects too
+                t.try_remove(self.coll, GHObject(msg.oid))
+                if msg.shard >= 0:
+                    t.try_remove(self.coll, g)
+                if self.is_ec():
+                    n = self.backend.k + self.backend.m
+                    for s in range(n):
+                        t.try_remove(self.coll, GHObject(msg.oid, shard=s))
             else:
                 t.truncate(self.coll, g, 0)
                 t.write(self.coll, g, 0, msg.data)

@@ -1,0 +1,80 @@
+"""In-flight op re-resolution on acting-set change (ADVICE fix).
+
+A write waiting on a peer that died must not hang forever: when the map
+drops the peer, the backend re-resolves waiting_on against the live set
+and completes with the survivors (the reference requeues in-flight ops
+on interval change during peering).
+"""
+
+from ceph_tpu.osd.backend import (
+    ECBackend,
+    InFlightOp,
+    ObjectState,
+    ReplicatedBackend,
+)
+from ceph_tpu.store.memstore import MemStore
+from ceph_tpu.store.objectstore import Collection, Transaction
+
+
+def _store_with(coll: Collection) -> MemStore:
+    s = MemStore()
+    s.mkfs()
+    s.mount()
+    t = Transaction()
+    t.create_collection(coll)
+    s.queue_transaction(t)
+    return s
+
+
+def test_inflight_drop_missing_fires_once():
+    fired = []
+    op = InFlightOp({1, 2, 3}, lambda: fired.append(1))
+    op.drop_missing(lambda who: who in (1, 2))   # 3 died
+    assert not fired
+    op.ack(1)
+    assert not fired
+    op.drop_missing(lambda who: who == 1)        # 2 died too
+    assert fired == [1]
+    op.drop_missing(lambda who: False)           # idempotent when empty
+    assert fired == [1]
+
+
+def test_replicated_write_completes_when_peer_dies():
+    coll = Collection("1.0_head")
+    store = _store_with(coll)
+    sent = []
+    be = ReplicatedBackend((1, 0), coll, store, 0,
+                           lambda osd, msg: sent.append((osd, msg)),
+                           lambda: 1)
+    done = []
+    be.submit("o", ObjectState(b"x"), [], {}, [0, 1, 2],
+              lambda: done.append(1))
+    assert not done          # local ack only; peers 1,2 outstanding
+    assert len(sent) == 2
+    be.on_peer_change({0, 2})   # osd.1 marked down
+    assert not done
+    be.on_peer_change({0})      # osd.2 down too
+    assert done == [1]
+    assert not be.in_flight
+
+
+def test_ec_write_completes_when_shard_holder_dies():
+    from ceph_tpu.ec import codec_from_profile
+
+    coll = Collection("2.0_head")
+    store = _store_with(coll)
+    sent = []
+    codec = codec_from_profile("plugin=isa k=2 m=1 technique=reed_sol_van")
+    be = ECBackend((2, 0), coll, store, 0,
+                   lambda osd, msg: sent.append((osd, msg)), lambda: 1,
+                   codec)
+    done = []
+    be.submit("o", ObjectState(b"y" * 64), [], {}, [0, 1, 2],
+              lambda: done.append(1))
+    assert not done
+    be.on_peer_change({0, 1})   # shard 2's holder (osd.2) died
+    assert not done
+    # surviving remote shard acks normally
+    tid = next(iter(be.in_flight))
+    be.handle_reply(tid, (1, 1))
+    assert done == [1]

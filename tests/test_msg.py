@@ -189,6 +189,54 @@ def test_duplicate_suppression_after_replay(ctx):
         b.shutdown()
 
 
+class HoldingServer(Dispatcher):
+    """Stores the request's connection; replies only when told to."""
+
+    def __init__(self) -> None:
+        self.conns = []
+        self.event = threading.Event()
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MEcho):
+            self.conns.append(conn)
+            self.event.set()
+            return True
+        return False
+
+
+def test_reply_survives_socket_death(ctx):
+    """Lossless in BOTH directions: a reply queued after the socket died
+    must be delivered when the dialer reconnects the same session (the
+    accepted side persists per-(src,nonce,sid) state and replays)."""
+    a = _mk(ctx, "client.9")
+    b = _mk(ctx, "osd.9")
+    server = HoldingServer()
+    client = Collector()
+    b.add_dispatcher(server)
+    a.add_dispatcher(client)
+    try:
+        conn = a.connect(b.addr)
+        conn.send(MEcho("req"))
+        assert server.event.wait(10)
+        srv_conn = server.conns[0]
+
+        # sever the socket before any reply is sent
+        def kill():
+            if conn._writer:
+                conn._writer.close()
+
+        a._loop.call_soon_threadsafe(kill)
+        time.sleep(0.5)
+
+        # the reply is queued on a session with no live socket ...
+        srv_conn.send(MEchoReply("LATE"))
+        # ... and must still arrive once the dialer redials
+        assert client.wait_for_text("LATE", timeout=15)
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
 def test_dup_suppression_across_reconnect(ctx):
     """A replayed frame already dispatched before the session dropped
     must NOT dispatch twice on the new socket (state keyed by src+nonce

@@ -290,3 +290,34 @@ def test_delete_propagates(cluster, client):
     assert client.delete(REP_POOL, "robj4").result == 0
     rep = client.op(REP_POOL, "robj4", [t_.OSDOp(t_.OP_READ)])
     assert rep.result == -2  # ENOENT
+
+
+def test_backfill_removes_deleted_objects(cluster, client):
+    """An object deleted while a replica was down AND beyond the log
+    window must be removed during backfill, not resurrected (ADVICE:
+    backfill deletions)."""
+    from ceph_tpu.store.objectstore import Collection, GHObject
+
+    client.put(REP_POOL, "robj5", b"doomed" * 100)
+    pgid, acting, primary = cluster.primary_of(REP_POOL, "robj5")
+    victim = next(o for o in acting if o != primary and 0 <= o < N_OSDS)
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    assert cluster.osds[victim].store.exists(coll, GHObject("robj5"))
+
+    cluster.kill(victim)
+    assert client.delete(REP_POOL, "robj5").result == 0
+    # trim the primary's pg log so the victim falls beyond the tail
+    # (forces the backfill path instead of log-based catch-up)
+    pgid2, _, primary2 = cluster.primary_of(REP_POOL, "robj5")
+    cluster.osds[primary2].pgs[pgid2].log.trim_to(0)
+
+    cluster.revive(victim)
+    deadline = time.time() + 10
+    store = cluster.osds[victim].store
+    while time.time() < deadline:
+        if not store.exists(coll, GHObject("robj5")):
+            break
+        time.sleep(0.2)
+    assert not store.exists(coll, GHObject("robj5")), (
+        "deleted object resurrected by backfill"
+    )

@@ -1,0 +1,180 @@
+"""Paxos safety units: quorum-gated collect + durable promises.
+
+These pin the two safety properties the reference Paxos enforces
+(Paxos.cc collect/handle_last num_last accounting; begin's durable
+uncommitted triple): a new leader may not propose until it has heard
+LAST from a quorum, and an acceptor's promise survives restart.
+No sockets — _send_mon is captured, messages are injected directly.
+"""
+
+import pytest
+
+from ceph_tpu.core.context import Context
+from ceph_tpu.crush import map as cmap
+from ceph_tpu.mon import messages as mm
+from ceph_tpu.mon.monitor import (
+    MonMap,
+    Monitor,
+    STATE_LEADER,
+    STATE_PEON,
+)
+from ceph_tpu.msg.message import EntityName
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.store.kv import MemDB
+
+
+class FakeConn:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+
+_made = []
+
+
+def make_mon(rank=0, size=3, kv=None):
+    ctx = Context(f"test.mon{rank}", {})
+    monmap = MonMap([("127.0.0.1", 10000 + i) for i in range(size)])
+    cm, _root = cmap.build_flat_cluster(3, hosts=3)
+    mon = Monitor(ctx, rank, monmap, kv=kv or MemDB(),
+                  initial_map=OSDMap(cm, max_osd=3))
+    mon.kv.open()
+    mon._load()
+    sent = []
+    mon._send_mon = lambda r, msg: sent.append((r, msg))
+    _made.append(mon)
+    return mon, sent
+
+
+@pytest.fixture(autouse=True)
+def _quiesce_timers():
+    yield
+    for mon in _made:
+        mon._stop.set()  # silence pending election/collect retry timers
+    _made.clear()
+
+
+def last_msg(pn, src_rank, *, uncommitted=None, last_committed=0):
+    msg = mm.MMonPaxos(mm.MMonPaxos.LAST, pn, last_committed=last_committed)
+    msg.src = EntityName("mon", src_rank)
+    if uncommitted:
+        msg.uncommitted_pn, msg.uncommitted_v, msg.uncommitted_value = (
+            uncommitted
+        )
+    return msg
+
+
+def test_collect_waits_for_quorum_before_proposing():
+    mon, sent = make_mon(rank=0, size=3)
+    mon.state = STATE_LEADER
+    mon._leader_collect()
+    assert not mon._collect_complete
+
+    # a client proposal while phase 1 is open must queue, not BEGIN
+    mon.propose(b"new-value")
+    assert all(m.op != mm.MMonPaxos.BEGIN for _, m in sent)
+    assert mon._propose_queue == [b"new-value"]
+
+    # the late LAST carries a peon's accepted-but-uncommitted value for
+    # the very next version; once a quorum (1 ack + self = 2/3) is in,
+    # the leader must re-propose THAT value first
+    pn = mon._collect_pn
+    mon._handle_paxos(None, last_msg(
+        pn, 1, uncommitted=(pn - 100, mon.last_committed + 1, b"old-value")))
+    assert mon._collect_complete
+    begins = [m for _, m in sent if m.op == mm.MMonPaxos.BEGIN]
+    assert begins and begins[0].value == b"old-value"
+
+
+def test_collect_zero_acks_never_completes():
+    mon, sent = make_mon(rank=0, size=3)
+    mon.state = STATE_LEADER
+    mon._leader_collect()
+    # simulate the old 0.5s-timer behavior: nothing arrived
+    mon._maybe_collect_done()
+    assert not mon._collect_complete
+    mon.propose(b"v")
+    assert all(m.op != mm.MMonPaxos.BEGIN for _, m in sent)
+
+
+def test_collect_nack_retries_with_fresh_pn():
+    mon, sent = make_mon(rank=0, size=3)
+    mon.state = STATE_LEADER
+    mon._leader_collect()
+    first_pn = mon._collect_pn
+    # peon promised a higher pn: NACK -> new collect round above it
+    mon._handle_paxos(None, last_msg(first_pn + 1000, 1))
+    assert mon._collect_pn > first_pn + 1000
+    collects = [m for _, m in sent if m.op == mm.MMonPaxos.COLLECT]
+    assert len(collects) == 4  # 2 peers x 2 rounds
+
+
+def test_stale_last_from_older_round_ignored():
+    mon, sent = make_mon(rank=0, size=5)  # quorum 3
+    mon.state = STATE_LEADER
+    mon._leader_collect()
+    pn = mon._collect_pn
+    mon._handle_paxos(None, last_msg(pn - 100, 1))  # stale round
+    assert not mon._collect_complete
+    mon._handle_paxos(None, last_msg(pn, 2))
+    assert not mon._collect_complete  # 1 fresh ack + self = 2 < 3
+    # resend from the same peon must not double-count
+    mon._handle_paxos(None, last_msg(pn, 2))
+    assert not mon._collect_complete
+    mon._handle_paxos(None, last_msg(pn, 3))
+    assert mon._collect_complete
+
+
+def test_peon_promise_survives_restart():
+    kv = MemDB()
+    mon, _sent = make_mon(rank=1, kv=kv)
+    mon.state = STATE_PEON
+    mon.accepted_pn = 100
+    conn = FakeConn()
+    begin = mm.MMonPaxos(mm.MMonPaxos.BEGIN, 100, version=1, value=b"promised")
+    begin.src = EntityName("mon", 0)
+    mon._handle_paxos(conn, begin)
+    assert conn.sent and conn.sent[0].op == mm.MMonPaxos.ACCEPT
+    assert mon.uncommitted == (100, 1, b"promised")
+
+    # "restart": a fresh Monitor over the same KV must remember the promise
+    mon2, _ = make_mon(rank=1, kv=kv)
+    assert mon2.uncommitted == (100, 1, b"promised")
+
+
+def test_promise_cleared_after_commit():
+    from ceph_tpu.osd import map_codec
+
+    kv = MemDB()
+    mon, _sent = make_mon(rank=1, kv=kv)
+    val = map_codec.encode_osdmap(mon.osdmap)  # a decodable committed value
+    mon.state = STATE_PEON
+    mon.accepted_pn = 100
+    begin = mm.MMonPaxos(mm.MMonPaxos.BEGIN, 100, version=1, value=val)
+    begin.src = EntityName("mon", 0)
+    mon._handle_paxos(FakeConn(), begin)
+    commit = mm.MMonPaxos(mm.MMonPaxos.COMMIT, 100, version=1, value=val)
+    commit.src = EntityName("mon", 0)
+    mon._handle_paxos(FakeConn(), commit)
+    assert mon.uncommitted is None
+
+    mon2, _ = make_mon(rank=1, kv=kv)
+    assert mon2.uncommitted is None
+    assert mon2.last_committed == 1
+
+
+def test_leader_own_promise_survives_restart():
+    kv = MemDB()
+    mon, sent = make_mon(rank=0, size=3, kv=kv)
+    mon.state = STATE_LEADER
+    mon._leader_collect()
+    pn = mon._collect_pn
+    mon._handle_paxos(None, last_msg(pn, 1))  # quorum, no uncommitted
+    mon.propose(b"leader-value")
+    assert any(m.op == mm.MMonPaxos.BEGIN for _, m in sent)
+
+    mon2, _ = make_mon(rank=0, kv=kv)
+    assert mon2.uncommitted is not None
+    assert mon2.uncommitted[2] == b"leader-value"
