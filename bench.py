@@ -1,13 +1,21 @@
-"""Round benchmark: EC encode+decode GB/s at k=8,m=4 on the attached TPU.
+"""Round benchmark: EC encode+decode sweep + CRUSH placement sweep.
 
-Mirrors the reference's benchmark semantics
-(src/test/erasure-code/ceph_erasure_code_benchmark.cc:151-190 encode,
-:255-328 decode: GB/s = iterations x object_size / seconds, decode
-pre-encodes once then reconstructs erased chunks and verifies equality)
-for the BASELINE.md headline config: isa-equivalent RS k=8 m=4, 1 MiB
-chunks.  The baseline divisor is the native C++ GF(2^8) scalar oracle
-(csrc/gf256.cc) measured on this host's CPU, standing in for the
-reference's table-based plugins (ISA-L itself is x86-asm and absent).
+Mirrors the reference's benchmark semantics:
+- EC: GB/s = object_bytes / seconds for encode, and for decode after
+  erasing m chunks and verifying reconstructed equality
+  (src/test/erasure-code/ceph_erasure_code_benchmark.cc:151-190 encode,
+  :255-328 decode), swept over 4 KiB - 4 MiB objects like
+  qa/workunits/erasure-code/bench.sh:103-145.
+- CRUSH: placements/sec for a full-cluster sweep of object ids over a
+  1024-OSD straw2 map (BASELINE metric 6; the CrushTester/psim loop,
+  src/crush/CrushTester.cc:472, src/tools/psim.cc:64), measured against
+  the REFERENCE's own C crush_do_rule batch rate (libcrush_ref.so,
+  compiled from /root/reference/src/crush/).
+
+Engines under test: the packed SWAR GF(2^8) xor network
+(ceph_tpu/ops/gf256_swar.py) and the vmapped straw2 interpreter
+(ceph_tpu/crush/mapper.py).  CPU baseline for EC is the native scalar
+C++ oracle (csrc/gf256.cc).
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, ...}
@@ -19,8 +27,17 @@ import time
 
 import numpy as np
 
+K, M = 8, 4
+HBM_PEAK_GBPS = 819.0  # v5e
+
+
+def _block(out):
+    if hasattr(out, "block_until_ready"):
+        out.block_until_ready()
+
 
 def _bench(fn, warmup=2, iters=10):
+    out = None
     for _ in range(warmup):
         out = fn()
     _block(out)
@@ -31,82 +48,118 @@ def _bench(fn, warmup=2, iters=10):
     return (time.perf_counter() - t0) / iters
 
 
-def _block(out):
-    if hasattr(out, "block_until_ready"):
-        out.block_until_ready()
+def ec_sweep(jax, out):
+    from ceph_tpu import _native
+    from ceph_tpu.ec import matrices
+    from ceph_tpu.ec.codec import RSMatrixCodec
+    from ceph_tpu.ops import gf256_swar
+
+    coding = matrices.isa_cauchy(K, M)
+    codec = RSMatrixCodec(K, M, coding)
+    rng = np.random.default_rng(0)
+    survivors = [0, 1, 2, 3, 4, 5, 8, 9]  # lose data 6,7 + coding 2,3
+    rec, _ = codec.recovery_matrix(survivors)
+
+    sweep = {}
+    for size in (4096, 65536, 1 << 20, 4 << 20):
+        n = size // K
+        x = rng.integers(0, 256, size=(K, n), dtype=np.uint8)
+        xd = jax.device_put(x)
+
+        enc = lambda: gf256_swar.gf_matmul_bytes(coding, xd)  # noqa: E731
+        coded = np.asarray(enc())
+        # correctness pin vs the native oracle before timing anything
+        want = _native.rs_encode(coding.astype(np.uint8), x[:, :4096])
+        assert np.array_equal(coded[:, :4096], want), "encode != oracle"
+
+        surv = np.stack([x[s] if s < K else coded[s - K] for s in survivors])
+        sd = jax.device_put(surv)
+        dec = lambda: gf256_swar.gf_matmul_bytes(rec, sd)  # noqa: E731
+        assert np.array_equal(np.asarray(dec()), x), "decode != data"
+
+        enc_dt = _bench(enc)
+        dec_dt = _bench(dec)
+        sweep[str(size)] = {
+            "encode_gbps": round(size / enc_dt / 1e9, 3),
+            "decode_gbps": round(size / dec_dt / 1e9, 3),
+        }
+
+    # headline at 1 MiB
+    head = sweep[str(1 << 20)]
+    out["ec_sweep"] = sweep
+    out["encode_gbps"] = head["encode_gbps"]
+    out["decode_gbps"] = head["decode_gbps"]
+    # roofline: encode moves (k+m)/k x the object bytes over HBM
+    out["encode_hbm_frac"] = round(
+        head["encode_gbps"] * (K + M) / K / HBM_PEAK_GBPS, 3)
+
+    # CPU baseline: the same encode through the scalar native oracle
+    n = (1 << 20) // K
+    xb = rng.integers(0, 256, size=(K, n), dtype=np.uint8)
+    cm = coding.astype(np.uint8)
+    base_dt = _bench(lambda: _native.rs_encode(cm, xb), warmup=1, iters=3)
+    out["baseline_cpu_native_gbps"] = round((1 << 20) / base_dt / 1e9, 3)
+    return head, out["baseline_cpu_native_gbps"]
+
+
+def crush_sweep(jax, out):
+    from ceph_tpu import _crush_ref
+    from ceph_tpu.crush import map as cmap
+    from ceph_tpu.crush import mapper
+
+    n_osds, n_hosts, nrep = 1024, 64, 3
+    m, root = cmap.build_flat_cluster(n_osds, hosts=n_hosts)
+    steps = [(cmap.OP_TAKE, root, 0),
+             (cmap.OP_CHOOSELEAF_FIRSTN, nrep, 1),
+             (cmap.OP_EMIT, 0, 0)]
+    flat = m.flatten()
+    dev_w = np.full(n_osds, 0x10000, dtype=np.uint32)
+    fn = mapper.compile_rule(flat, steps, nrep)
+
+    # BASELINE metric 6 is 10M ids; a CPU-backend run (sanity only)
+    # scales down or the sweep itself takes minutes
+    n_x = 10_000_000 if jax.default_backend() != "cpu" else 200_000
+    xs = np.arange(n_x, dtype=np.int32)
+    xs_d = jax.device_put(xs)
+    w_d = jax.device_put(dev_w)
+    dt = _bench(lambda: fn(xs_d, w_d), warmup=1, iters=3)
+    out["crush_mplacements_per_s"] = round(n_x / dt / 1e6, 2)
+
+    # reference C rate, extrapolated from 200k ids
+    if _crush_ref.available():
+        m.add_rule(cmap.Rule("bench", steps))
+        ref = _crush_ref.RefCrushMap(m)
+        sub = xs[:200_000]
+        t0 = time.perf_counter()
+        ref_out = ref.do_rule(ref.rulenos[-1], sub, nrep, dev_w)
+        ref_dt = time.perf_counter() - t0
+        out["crush_ref_c_mplacements_per_s"] = round(
+            len(sub) / ref_dt / 1e6, 2)
+        out["crush_vs_ref_c"] = round(
+            out["crush_mplacements_per_s"]
+            / out["crush_ref_c_mplacements_per_s"], 2)
+        # spot conformance on the first ids
+        got = np.asarray(fn(xs_d[:1000], w_d))
+        assert np.array_equal(got, ref_out[:1000]), "sweep != reference C"
 
 
 def main():
     import jax
 
-    from ceph_tpu import _native
-    from ceph_tpu.ec import matrices
-    from ceph_tpu.ops import gf2_matmul
+    out = {"backend": jax.default_backend()}
+    head, base = ec_sweep(jax, out)
+    crush_sweep(jax, out)
 
-    k, m = 8, 4
-    n = 1 << 20  # 1 MiB chunks -> 8 MiB object per encode
-    rng = np.random.default_rng(0)
-    coding = matrices.isa_cauchy(k, m)
-    mbits = gf2_matmul.prepare_bitmatrix(coding)
-    x = rng.integers(0, 256, size=(k, n), dtype=np.uint8)
-
-    backend = jax.default_backend()
-    xd = jax.device_put(x)
-    md = jax.device_put(mbits)
-
-    def encode():
-        return gf2_matmul.gf2_matmul_bytes(md, xd)
-
-    # correctness pin vs the native oracle before timing anything
-    native_coding = _native.rs_encode(coding.astype(np.uint8), x[:, :4096])
-    got = np.asarray(encode())[:, :4096]
-    assert np.array_equal(got, native_coding), "TPU encode != native oracle"
-
-    enc_dt = _bench(encode)
-    enc_gbps = k * n / enc_dt / 1e9
-
-    # decode: erase m chunks (2 data + 2 coding), rebuild data rows from
-    # the k survivors via the cached recovery matrix (one bit-matmul)
-    from ceph_tpu.ec.codec import RSMatrixCodec
-
-    codec = RSMatrixCodec(k, m, coding)
-    coding_rows = np.asarray(encode())
-    survivors = [0, 1, 2, 3, 4, 5, 8, 9]  # lost data 6,7 and coding 10,11
-    _, rec_bits = codec.recovery_matrix(survivors)
-    stacked = np.concatenate([x[:6], coding_rows[:2]])
-    sd = jax.device_put(stacked)
-    rd = jax.device_put(rec_bits)
-
-    def decode():
-        return gf2_matmul.gf2_matmul_bytes(rd, sd)
-
-    dec = np.asarray(decode())
-    assert np.array_equal(dec, x), "TPU decode != original data"
-    dec_dt = _bench(decode)
-    dec_gbps = k * n / dec_dt / 1e9
-
-    # CPU baseline: the same encode through the scalar native oracle
-    base_n = 1 << 22  # 4 MiB total is plenty for a stable scalar rate
-    xb = x[:, : base_n // k]
-    cm = coding.astype(np.uint8)
-    base_dt = _bench(lambda: _native.rs_encode(cm, xb), warmup=1, iters=3)
-    base_gbps = xb.size / base_dt / 1e9
-
-    value = 2 * k * n / (enc_dt + dec_dt) / 1e9  # combined encode+decode
-    print(
-        json.dumps(
-            {
-                "metric": f"EC encode+decode GB/s (RS k={k},m={m}, 1MiB chunks, {backend})",
-                "value": round(value, 3),
-                "unit": "GB/s",
-                "vs_baseline": round(value / base_gbps, 3),
-                "encode_gbps": round(enc_gbps, 3),
-                "decode_gbps": round(dec_gbps, 3),
-                "baseline_cpu_native_gbps": round(base_gbps, 3),
-                "backend": backend,
-            }
-        )
-    )
+    value = round(
+        2 / (1 / head["encode_gbps"] + 1 / head["decode_gbps"]), 3)
+    out.update({
+        "metric": (f"EC encode+decode GB/s (RS k={K},m={M}, 1MiB object, "
+                   f"{out['backend']}) + CRUSH 10M-id sweep"),
+        "value": value,
+        "unit": "GB/s",
+        "vs_baseline": round(value / base, 2),
+    })
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,126 @@
+"""ctypes bindings to the REFERENCE CRUSH C (libcrush_ref.so).
+
+The shared library is built by csrc/Makefile from the reference's own
+kernel-frozen sources (/root/reference/src/crush/{mapper,hash,crush,
+builder}.c, compiled in place) behind csrc/crush_ref_shim.c.  It is the
+ground truth the jit mapper and the re-derived C++ oracle are pinned
+against (src/crush/mapper.c:900 crush_do_rule).
+
+Absent library (e.g. the reference tree isn't mounted) degrades to
+``available() == False`` and the conformance tests skip.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libcrush_ref.so")
+_lib: Optional[ctypes.CDLL] = None
+
+
+def available() -> bool:
+    try:
+        return lib() is not None
+    except OSError:
+        return False
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        L = ctypes.CDLL(_LIB_PATH)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        L.crushref_create.restype = ctypes.c_void_p
+        L.crushref_create.argtypes = [ctypes.c_int] * 7
+        L.crushref_add_bucket.restype = ctypes.c_int
+        L.crushref_add_bucket.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, i32p, i32p,
+        ]
+        L.crushref_add_rule.restype = ctypes.c_int
+        L.crushref_add_rule.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            i32p, i32p, i32p,
+        ]
+        L.crushref_finalize.argtypes = [ctypes.c_void_p]
+        L.crushref_destroy.argtypes = [ctypes.c_void_p]
+        L.crushref_do_rule_batch.restype = ctypes.c_int
+        L.crushref_do_rule_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, i32p, ctypes.c_int,
+            ctypes.c_int, u32p, ctypes.c_int, i32p,
+        ]
+        _lib = L
+    return _lib
+
+
+class RefCrushMap:
+    """A reference crush_map built from a ceph_tpu CrushMap."""
+
+    def __init__(self, cmap) -> None:
+        t = cmap.tunables
+        L = lib()
+        self._ptr = L.crushref_create(
+            t.choose_total_tries, t.choose_local_tries,
+            t.choose_local_fallback_tries, t.chooseleaf_descend_once,
+            t.chooseleaf_vary_r, t.chooseleaf_stable, 1)
+        if not self._ptr:
+            raise MemoryError("crushref_create failed")
+        for bid in sorted(cmap.buckets, reverse=True):  # shallowest ids last
+            b = cmap.buckets[bid]
+            items = np.asarray(b.items, dtype=np.int32)
+            weights = np.asarray(b.weights, dtype=np.int32)
+            got = L.crushref_add_bucket(
+                self._ptr, bid, b.alg, b.type, len(b.items),
+                items.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                weights.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if got != bid:
+                raise RuntimeError(f"add_bucket({bid}) -> {got}")
+        self.rulenos: List[int] = []
+        for rule in cmap.rules:
+            ops = np.asarray([s[0] for s in rule.steps], dtype=np.int32)
+            a1 = np.asarray([s[1] for s in rule.steps], dtype=np.int32)
+            a2 = np.asarray([s[2] for s in rule.steps], dtype=np.int32)
+            rn = L.crushref_add_rule(
+                self._ptr, rule.ruleset, rule.type, len(rule.steps),
+                ops.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                a1.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                a2.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if rn < 0:
+                raise RuntimeError("add_rule failed")
+            self.rulenos.append(rn)
+        L.crushref_finalize(self._ptr)
+        self.max_devices = cmap.max_devices
+
+    def do_rule(self, ruleno: int, xs: Sequence[int], result_max: int,
+                weights: Optional[np.ndarray] = None) -> np.ndarray:
+        """crush_do_rule for a batch of xs -> int32 [len(xs), result_max]
+        padded with CRUSH_ITEM_NONE (0x7fffffff)."""
+        xs = np.asarray(xs, dtype=np.int32)
+        if weights is None:
+            weights = np.full(self.max_devices, 0x10000, dtype=np.uint32)
+        weights = np.ascontiguousarray(weights, dtype=np.uint32)
+        out = np.empty((len(xs), result_max), dtype=np.int32)
+        rc = lib().crushref_do_rule_batch(
+            self._ptr, ruleno,
+            xs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(xs),
+            result_max,
+            weights.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(weights),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc < 0:
+            raise RuntimeError("crushref_do_rule_batch failed")
+        return out
+
+    def __del__(self) -> None:
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            try:
+                lib().crushref_destroy(ptr)
+            except Exception:
+                pass
+            self._ptr = None

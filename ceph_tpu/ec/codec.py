@@ -15,18 +15,19 @@ import numpy as np
 
 from ceph_tpu.ec import gf, matrices
 from ceph_tpu.ec.interface import ErasureCode, ErasureCodeError
-from ceph_tpu.ops import gf2_matmul
+from ceph_tpu.ops import gf2_matmul, gf256_swar
 
 
 class RSMatrixCodec(ErasureCode):
     """Systematic Reed-Solomon over GF(2^8) given an (m x k) coding block.
 
-    encode: one (8m x 8k) GF(2) bit-matmul over byte bit-planes (MXU).
-    decode: invert the survivors' k x k generator rows over GF(2^8) on
-    host (signature-cached), then the same bit-matmul engine applies the
-    recovery matrix; missing coding chunks are re-encoded from the
-    recovered data (matching jerasure_matrix_decode semantics,
-    reference: src/erasure-code/jerasure/ErasureCodeJerasure.cc:163).
+    encode: the packed-word SWAR xor network (ops.gf256_swar) — bytes
+    stay four-per-lane end to end.  decode: invert the survivors' k x k
+    generator rows over GF(2^8) on host (signature-cached), then the
+    same engine applies the recovery matrix; missing coding chunks are
+    re-encoded from the recovered data (matching jerasure_matrix_decode
+    semantics, reference:
+    src/erasure-code/jerasure/ErasureCodeJerasure.cc:163).
     """
 
     def __init__(self, k: int, m: int, coding: np.ndarray | None = None):
@@ -55,7 +56,7 @@ class RSMatrixCodec(ErasureCode):
     # -- device entry points ----------------------------------------------
     def encode_array(self, data: np.ndarray) -> np.ndarray:
         data = np.asarray(data, dtype=np.uint8)
-        return np.asarray(gf2_matmul.gf2_matmul_bytes(self._encode_bits, data))
+        return np.asarray(gf256_swar.gf_matmul_bytes(self.coding, data))
 
     def recovery_matrix(self, survivors: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
         """Per-signature cached (k x k GF(2^8) matrix, prepared bit-matrix)
@@ -82,13 +83,11 @@ class RSMatrixCodec(ErasureCode):
         want_coding = [i for i in want if i >= self._k]
         data = None
         if want_data or want_coding:
-            _, rec_bits = self.recovery_matrix(survivors)
+            rec, _ = self.recovery_matrix(survivors)
             stacked = np.stack(
                 [np.asarray(available[i], dtype=np.uint8) for i in survivors]
             )
-            data = np.asarray(
-                gf2_matmul.gf2_matmul_bytes(rec_bits, stacked)
-            )
+            data = np.asarray(gf256_swar.gf_matmul_bytes(rec, stacked))
         for i in want_data:
             out[i] = available[i] if i in available else data[i]
         if want_coding:

@@ -162,14 +162,17 @@ class Monitor(Dispatcher):
                 b.set("paxos", key, str(val).encode())
         self.kv.submit(b)
 
-    def _persist_value(self, version: int, value: bytes) -> None:
+    def _persist_value(self, version: int, value: bytes,
+                       clear_uncommitted: bool = True) -> None:
         b = WriteBatch()
         b.set("paxos_values", str(version), value)
         b.set("paxos", "last_committed", str(version).encode())
-        # the promise is fulfilled; drop it so a restart doesn't resurrect it
-        b.rmkey("paxos", "uncommitted_pn")
-        b.rmkey("paxos", "uncommitted_v")
-        b.rmkey("paxos", "uncommitted_value")
+        if clear_uncommitted:
+            # the promise is fulfilled; drop it so a restart doesn't
+            # resurrect it
+            b.rmkey("paxos", "uncommitted_pn")
+            b.rmkey("paxos", "uncommitted_v")
+            b.rmkey("paxos", "uncommitted_value")
         self.kv.submit(b)
 
     # -- election (Elector.cc shape) --------------------------------------
@@ -450,9 +453,16 @@ class Monitor(Dispatcher):
             return
 
     def _learn(self, version: int, value: bytes) -> None:
-        self._persist_value(version, value)
+        # a promise for a HIGHER version than what we just learned is
+        # still live (e.g. we accepted v6, then catch up on v5 during a
+        # collect): wiping it could erase the only surviving copy of a
+        # value the old leader already committed
+        keep = (self.uncommitted is not None
+                and self.uncommitted[1] > version)
+        self._persist_value(version, value, clear_uncommitted=not keep)
         self.last_committed = version
-        self.uncommitted = None
+        if not keep:
+            self.uncommitted = None
         try:
             self.osdmap = map_codec.decode_osdmap(value)
             if (self._pending_map is not None

@@ -1,0 +1,8 @@
+/* Stub config header so the reference's freestanding CRUSH C compiles
+ * outside its cmake tree (include/int_types.h includes acconfig.h for
+ * platform probes none of which the C mapper path needs on linux). */
+#ifndef CEPH_TPU_REF_ACCONFIG_STUB_H
+#define CEPH_TPU_REF_ACCONFIG_STUB_H
+#define HAVE_LINUX_TYPES_H 1
+#define HAVE_STDINT_H 1
+#endif
