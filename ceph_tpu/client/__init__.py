@@ -1,0 +1,8 @@
+"""Client library: Objecter (placement + resend engine) and the
+librados-style RadosClient/IoCtx facade (reference: src/osdc/,
+src/librados/)."""
+
+from ceph_tpu.client.objecter import Objecter, ObjecterOp
+from ceph_tpu.client.rados import IoCtx, RadosClient, RadosError
+
+__all__ = ["Objecter", "ObjecterOp", "RadosClient", "IoCtx", "RadosError"]

@@ -1,0 +1,233 @@
+"""Objecter — client-side op submission with CRUSH placement and
+map-change resend.
+
+The client library's engine (reference: src/osdc/Objecter.cc): every op
+computes its own target from the client's OSDMap (`_calc_target`,
+reference Objecter.cc:2794 — object -> PG -> up/acting primary, no
+lookup server), sends to the primary, and tracks the op until a final
+reply:
+
+- map epoch change -> every in-flight op is re-targeted; ops whose
+  acting primary moved are resent to the new one (reference
+  Objecter.cc:2264-2380 _op_submit + handle_osd_map scan).
+- retryable replies (EAGAIN from a write whose shard acks were lost to
+  an interval change, ESTALE from a non-primary target) -> backoff +
+  resend; real op errors (EPERM, ENOENT, ...) surface immediately.
+- ops with no live primary (acting set empty / pool offline) park as
+  "homeless" and resume on the next map (reference op_target_t::paused).
+- timed-out sends resend to the current target; the PG's reqid dedup
+  (client name + nonce + tid, mirroring osd_reqid_t) makes resends
+  exactly-once even across primary failover.
+
+Every op carries the submission-time epoch; replies carry the OSD's
+epoch, which (being newer) flags that the client's map is stale —
+mon-subscribed clients pick the new map up via their subscription.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ceph_tpu.core.context import Context
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+from ceph_tpu.osd import messages as m
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import OSDOp
+
+EAGAIN = -11
+ESTALE = -116  # target wasn't primary (stale client map) — retryable
+ETIMEDOUT = -110
+
+
+class ObjecterOp:
+    """One tracked client op (reference Objecter::Op)."""
+
+    __slots__ = ("tid", "pool", "oid", "ops", "reqid", "reply", "event",
+                 "attempts", "last_send", "retry_at", "target",
+                 "on_complete", "timeout_at")
+
+    def __init__(self, tid: int, pool: int, oid: str, ops: List[OSDOp],
+                 reqid: str, timeout: float,
+                 on_complete: Optional[Callable] = None) -> None:
+        self.tid = tid
+        self.pool = pool
+        self.oid = oid
+        self.ops = ops
+        self.reqid = reqid
+        self.reply: Optional[m.MOSDOpReply] = None
+        self.event = threading.Event()
+        self.attempts = 0
+        self.last_send = 0.0
+        self.retry_at = 0.0  # backoff gate; 0 = send immediately
+        self.target: Tuple[Tuple[int, int], int] = ((0, 0), -1)
+        self.on_complete = on_complete
+        self.timeout_at = time.monotonic() + timeout
+
+    # future-like surface
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> m.MOSDOpReply:
+        if not self.event.wait(timeout):
+            raise TimeoutError(f"op tid={self.tid} oid={self.oid!r}")
+        assert self.reply is not None
+        return self.reply
+
+
+class Objecter(Dispatcher):
+    MAX_ATTEMPTS = 60
+
+    def __init__(self, ctx: Context, msgr: Messenger,
+                 resend_interval: float = 1.0,
+                 backoff: float = 0.1) -> None:
+        self.ctx = ctx
+        self.msgr = msgr
+        self.resend_interval = resend_interval
+        self.backoff = backoff
+        self.osdmap: Optional[OSDMap] = None
+        self.addrbook: Dict[int, object] = {}
+        self.ops: Dict[int, ObjecterOp] = {}
+        self._tid = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        # client incarnation for exactly-once reqids (osd_reqid_t name +
+        # the messenger nonce so a restarted client never collides)
+        self._name = f"{msgr.entity}.{msgr.nonce & 0xFFFFFFFF}"
+        msgr.add_dispatcher(self)
+        self._ticker = threading.Thread(
+            target=self._tick_loop, daemon=True, name="objecter-tick")
+        self._ticker.start()
+
+    # -- map handling ------------------------------------------------------
+    def handle_osdmap(self, osdmap: OSDMap,
+                      addrbook: Optional[Dict] = None) -> None:
+        """Adopt a newer map and re-target every in-flight op
+        (reference Objecter::handle_osd_map -> _scan_requests)."""
+        with self._lock:
+            # equal epochs re-scan: single-process harnesses mutate one
+            # shared map object in place, and a re-notify must retarget
+            if self.osdmap is not None and osdmap.epoch < self.osdmap.epoch:
+                return
+            self.osdmap = osdmap
+            book = addrbook if addrbook is not None else dict(
+                getattr(osdmap, "osd_addrs", {}) or {})
+            if book:
+                self.addrbook = book
+            pending = list(self.ops.values())
+        for op in pending:
+            tgt = self._calc_target(op.pool, op.oid)
+            if tgt != op.target or op.target[1] < 0:
+                self._send_op(op)
+
+    def wait_for_map(self, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while self.osdmap is None:
+            if time.monotonic() > deadline:
+                raise TimeoutError("no osdmap received")
+            time.sleep(0.02)
+
+    # -- submission --------------------------------------------------------
+    def _calc_target(self, pool: int, oid: str):
+        """object -> pg -> acting primary (reference Objecter.cc:2794
+        _calc_target over OSDMap.cc:2149,2417)."""
+        assert self.osdmap is not None
+        pgid = self.osdmap.object_to_pg(pool, oid)
+        _up, _up_p, _acting, primary = self.osdmap.pg_to_up_acting(pgid)
+        return pgid, primary
+
+    def op_submit(self, pool: int, oid: str, ops: List[OSDOp],
+                  timeout: float = 30.0,
+                  on_complete: Optional[Callable] = None) -> ObjecterOp:
+        if self.osdmap is None:
+            raise RuntimeError("objecter has no osdmap yet")
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+            op = ObjecterOp(tid, pool, oid, ops,
+                            reqid=f"{self._name}:{tid}",
+                            timeout=timeout, on_complete=on_complete)
+            self.ops[tid] = op
+        self._send_op(op)
+        return op
+
+    def _send_op(self, op: ObjecterOp) -> None:
+        with self._lock:
+            if self.osdmap is None or op.tid not in self.ops:
+                return
+            pgid, primary = self._calc_target(op.pool, op.oid)
+            op.target = (pgid, primary)
+            addr = self.addrbook.get(primary)
+            if primary < 0 or addr is None:
+                # homeless: no live primary — parked until the next map
+                return
+            epoch = self.osdmap.epoch
+            op.attempts += 1
+            op.last_send = time.monotonic()
+        msg = m.MOSDOp(pgid, epoch, op.oid, op.ops)
+        msg.tid = op.tid
+        msg.reqid = op.reqid
+        self.msgr.send_message(msg, addr)
+
+    # -- replies -----------------------------------------------------------
+    def ms_dispatch(self, conn, msg) -> bool:
+        if not isinstance(msg, m.MOSDOpReply):
+            return False
+        with self._lock:
+            op = self.ops.get(msg.tid)
+            if op is None:
+                return True  # dup reply of a completed op
+            if msg.result in (EAGAIN, ESTALE) and (
+                op.attempts < self.MAX_ATTEMPTS
+                and time.monotonic() < op.timeout_at
+            ):
+                # retryable: EAGAIN = write interrupted by interval
+                # change; ESTALE = target wasn't primary (stale map).
+                # Backoff, then resend via the ticker.
+                op.retry_at = time.monotonic() + self.backoff * min(
+                    op.attempts, 10)
+                return True
+            del self.ops[op.tid]
+        op.reply = msg
+        op.event.set()
+        if op.on_complete is not None:
+            op.on_complete(op)
+        return True
+
+    # -- resend/timeout ticker --------------------------------------------
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(0.05):
+            now = time.monotonic()
+            with self._lock:
+                pending = list(self.ops.values())
+            for op in pending:
+                if now > op.timeout_at:
+                    with self._lock:
+                        if self.ops.pop(op.tid, None) is None:
+                            continue
+                    op.reply = m.MOSDOpReply(
+                        op.target[0], 0, op.oid, op.ops, result=ETIMEDOUT)
+                    op.event.set()
+                    if op.on_complete is not None:
+                        op.on_complete(op)
+                elif op.retry_at and now >= op.retry_at:
+                    op.retry_at = 0.0
+                    self._send_op(op)
+                elif (op.last_send
+                      and now - op.last_send > self.resend_interval):
+                    # no reply: primary may have died before the map
+                    # noticed; resend to the current target (reqid dedup
+                    # makes this safe)
+                    self._send_op(op)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._ticker.join(timeout=5)
+        with self._lock:
+            pending = list(self.ops.values())
+            self.ops.clear()
+        for op in pending:
+            op.reply = m.MOSDOpReply(op.target[0], 0, op.oid, op.ops,
+                                     result=ETIMEDOUT)
+            op.event.set()

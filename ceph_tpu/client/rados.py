@@ -1,0 +1,149 @@
+"""librados-equivalent client facade: RadosClient + IoCtx.
+
+The app-facing API (reference: src/librados/librados.cc:1517
+IoCtx::operate and friends): a RadosClient owns the messenger, the
+Objecter, and (for mon-backed clusters) a MonClient subscription that
+feeds maps to the Objecter; an IoCtx scopes ops to one pool and exposes
+sync + async object operations that all funnel through
+``Objecter.op_submit``.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from ceph_tpu.client.objecter import Objecter, ObjecterOp
+from ceph_tpu.core.context import Context
+from ceph_tpu.msg.message import EntityName
+from ceph_tpu.msg.messenger import Messenger
+from ceph_tpu.osd import types as t_
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import OSDOp
+
+
+class RadosError(OSError):
+    def __init__(self, rc: int, what: str = "") -> None:
+        super().__init__(rc, what or f"rados op failed: {rc}")
+        self.rc = rc
+
+
+class RadosClient:
+    """Connection owner (reference librados::RadosClient).
+
+    Two bootstrap modes:
+    - ``connect(monmap)``: subscribe to osdmaps through the mon cluster
+      (the production path, reference MonClient subscriptions);
+    - ``inject_osdmap(map, addrbook)``: direct map injection for
+      single-process clusters/tests (the reference's librados-with-
+      preloaded-map test harnesses).
+    """
+
+    def __init__(self, ctx: Optional[Context] = None,
+                 name: Optional[EntityName] = None) -> None:
+        self.ctx = ctx or Context("client")
+        self.name = name or EntityName("client", random.getrandbits(31))
+        self.msgr = Messenger(self.ctx, self.name)
+        self.msgr.start()
+        self.objecter = Objecter(self.ctx, self.msgr)
+        self.monc = None
+
+    # -- bootstrap ---------------------------------------------------------
+    def connect(self, monmap, timeout: float = 10.0) -> "RadosClient":
+        from ceph_tpu.mon.client import MonClient
+
+        self.monc = MonClient(self.msgr, monmap)
+        self.monc.subscribe_osdmap(
+            lambda osdmap: self.objecter.handle_osdmap(osdmap))
+        self.objecter.wait_for_map(timeout)
+        return self
+
+    def inject_osdmap(self, osdmap: OSDMap,
+                      addrbook: Optional[Dict] = None) -> "RadosClient":
+        self.objecter.handle_osdmap(osdmap, addrbook)
+        return self
+
+    def mon_command(self, cmd: dict, timeout: float = 10.0):
+        if self.monc is None:
+            raise RuntimeError("not connected to a mon cluster")
+        return self.monc.command(cmd, timeout=timeout)
+
+    def ioctx(self, pool_id: int) -> "IoCtx":
+        return IoCtx(self, pool_id)
+
+    def shutdown(self) -> None:
+        self.objecter.shutdown()
+        self.msgr.shutdown()
+
+
+class IoCtx:
+    """Pool-scoped object operations (reference librados::IoCtx)."""
+
+    def __init__(self, client: RadosClient, pool_id: int) -> None:
+        self.client = client
+        self.pool = pool_id
+
+    # -- async core --------------------------------------------------------
+    def aio_operate(self, oid: str, ops: List[OSDOp],
+                    timeout: float = 30.0) -> ObjecterOp:
+        return self.client.objecter.op_submit(
+            self.pool, oid, ops, timeout=timeout)
+
+    def operate(self, oid: str, ops: List[OSDOp],
+                timeout: float = 30.0):
+        rep = self.aio_operate(oid, ops, timeout=timeout).result(timeout)
+        return rep
+
+    def _check(self, rep) -> None:
+        if rep.result < 0:
+            raise RadosError(rep.result, f"{rep.oid}")
+
+    # -- sync convenience surface (librados.cc:1517 family) ---------------
+    def write_full(self, oid: str, data: bytes) -> None:
+        self._check(self.operate(
+            oid, [OSDOp(t_.OP_WRITEFULL, data=data)]))
+
+    def write(self, oid: str, data: bytes, off: int = 0) -> None:
+        self._check(self.operate(
+            oid, [OSDOp(t_.OP_WRITE, off=off, data=data)]))
+
+    def append(self, oid: str, data: bytes) -> None:
+        self._check(self.operate(oid, [OSDOp(t_.OP_APPEND, data=data)]))
+
+    def read(self, oid: str, length: int = 0, off: int = 0) -> bytes:
+        rep = self.operate(
+            oid, [OSDOp(t_.OP_READ, off=off, length=length)])
+        self._check(rep)
+        return rep.ops[0].out_data
+
+    def remove(self, oid: str) -> None:
+        self._check(self.operate(oid, [OSDOp(t_.OP_DELETE)]))
+
+    def stat(self, oid: str) -> int:
+        from ceph_tpu.core.encoding import Decoder
+
+        rep = self.operate(oid, [OSDOp(t_.OP_STAT)])
+        self._check(rep)
+        return Decoder(rep.ops[0].out_data).u64()
+
+    def truncate(self, oid: str, size: int) -> None:
+        self._check(self.operate(oid, [OSDOp(t_.OP_TRUNCATE, off=size)]))
+
+    def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        self._check(self.operate(
+            oid, [OSDOp(t_.OP_SETXATTR, name=name, data=value)]))
+
+    def getxattr(self, oid: str, name: str) -> bytes:
+        rep = self.operate(oid, [OSDOp(t_.OP_GETXATTR, name=name)])
+        self._check(rep)
+        return rep.ops[0].out_data
+
+    def omap_set(self, oid: str, kv: Dict[str, bytes]) -> None:
+        self._check(self.operate(oid, [OSDOp(t_.OP_OMAP_SET, kv=kv)]))
+
+    def omap_get(self, oid: str,
+                 keys: Optional[List[str]] = None) -> Dict[str, bytes]:
+        rep = self.operate(
+            oid, [OSDOp(t_.OP_OMAP_GET, keys=keys or [])])
+        self._check(rep)
+        return rep.ops[0].out_kv

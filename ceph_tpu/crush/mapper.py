@@ -5,12 +5,25 @@ The reference walks buckets scalar-style per object
 src/crush/mapper.c:900,460,655).  Here a rule is *compiled*: its steps
 are unrolled at trace time into a jit-friendly function of the hash
 input x, every straw2 choice is a vectorized draw+argmax over the padded
-bucket arrays, the retry/collision state machines become bounded
-``lax.while_loop``s, and ``jax.vmap`` maps the whole walk over millions
-of object ids at once — the north-star replacement for the thread-pooled
+bucket arrays, and ``jax.vmap`` maps the whole walk over millions of
+object ids at once — the north-star replacement for the thread-pooled
 ParallelPGMapper (reference: src/osd/OSDMapMapping.h:17).
 
-Semantics notes (kept bit-exact vs the native oracle):
+Throughput formulation (round-3 rework; the round-2 nested-while_loop
+version serialized catastrophically under vmap):
+- the bucket descent is UNROLLED to the map's actual tree depth
+  (computed host-side from the flattened hierarchy, typically 2-3
+  levels) with masked carry — there is no data-dependent while_loop
+  inside the descent, so each level is one wide [batch, bucket_width]
+  hash+draw+argmax block that XLA fuses and tiles;
+- only the retry state machine (rare collisions/rejections) remains a
+  ``lax.while_loop``, whose body is now the cheap unrolled descent; in
+  the common case it runs 1-2 rounds for the whole batch;
+- callers chunk very large id batches host-side (bench.py) so live HBM
+  temps stay bounded.
+
+Semantics notes (kept bit-exact vs the real reference C,
+tests/test_crush_vs_reference.py):
 - straw2 draw: crush_hash32_3(x, id, r) & 0xffff -> fixed-point ln table
   -> truncating s64 divide by the 16.16 weight; ties keep the first item
   (argmax == the C "strictly greater" update rule).
@@ -21,20 +34,19 @@ Semantics notes (kept bit-exact vs the native oracle):
   CRUSH_ITEM_NONE holes.
 - Supported bucket algs in the jit path: straw2 (the modern default).
   uniform/list/tree/straw maps fall back to the native oracle.
+
+64-bit note: straw2 draws are exact signed-64-bit fixed-point math
+(crush_ln values scaled 2^48 divided by 16.16 weights).  The compiled
+callable scopes ``jax.enable_x64()`` around trace and dispatch itself —
+importing this module no longer flips the global x64 flag (round-2
+advisory: the import side effect changed every consumer's dtypes).
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
-
-# straw2 draws are exact signed-64-bit fixed-point math (crush_ln values
-# scaled 2^48 divided by 16.16 weights); the interpreter is unusable
-# without x64, so require it at import rather than failing mid-trace.
-jax.config.update("jax_enable_x64", True)
-
 import jax.numpy as jnp
 import numpy as np
 
@@ -55,7 +67,7 @@ from ceph_tpu.crush.map import (
     FlatMap,
 )
 
-S64_MIN = jnp.int64(-0x8000000000000000)
+S64_MIN = -0x8000000000000000
 
 # descend status codes
 _OK = 0
@@ -64,33 +76,101 @@ _SKIP = 2  # bad item / bad type: give up on this replica slot
 
 
 class _DeviceMap:
-    """FlatMap lowered to device arrays (captured by the compiled rule)."""
+    """FlatMap lowered to device arrays (captured by the compiled rule).
+
+    Constants are materialized under a local ``enable_x64`` scope so the
+    int64 ln table survives regardless of the caller's global flag.
+    """
 
     def __init__(self, flat: FlatMap):
-        self.items = jnp.asarray(flat.items, dtype=jnp.int32)
-        self.weights = jnp.asarray(flat.weights, dtype=jnp.uint32)
-        self.sizes = jnp.asarray(flat.sizes, dtype=jnp.int32)
-        self.algs = jnp.asarray(flat.algs, dtype=jnp.int32)
-        self.types = jnp.asarray(flat.types, dtype=jnp.int32)
+        # magic reciprocals for the straw2 divide: weights are map
+        # constants, so the exact truncating s64 division ln/w becomes
+        # mulhi64(-ln, magic[w]) + one correction — TPU has no native
+        # 64-bit divide and XLA's emulation is ~100x more ops
+        w_safe = np.maximum(np.asarray(flat.weights, dtype=np.uint64), 1)
+        magic = np.uint64(0xFFFFFFFFFFFFFFFF) // w_safe
+        with jax.enable_x64():
+            self.items = jnp.asarray(flat.items, dtype=jnp.int32)
+            self.weights = jnp.asarray(flat.weights, dtype=jnp.uint32)
+            self.magic = jnp.asarray(magic, dtype=jnp.uint64)
+            self.sizes = jnp.asarray(flat.sizes, dtype=jnp.int32)
+            self.algs = jnp.asarray(flat.algs, dtype=jnp.int32)
+            self.types = jnp.asarray(flat.types, dtype=jnp.int32)
+            self.ln16 = jnp.asarray(ln.ln16_table(), dtype=jnp.int64)
         self.n_buckets = int(flat.items.shape[0])
         self.max_size = int(flat.items.shape[1])
         self.max_devices = int(flat.max_devices)
-        self.ln16 = jnp.asarray(ln.ln16_table())
+        self.depth = _tree_depth(flat)
+
+
+def _tree_depth(flat: FlatMap) -> int:
+    """Longest bucket chain (number of straw2 choices from any bucket to
+    a device) — the static unroll bound for the descent."""
+    items = np.asarray(flat.items)
+    sizes = np.asarray(flat.sizes)
+    n = items.shape[0]
+    memo = [0] * n
+
+    def depth(bno, seen):
+        if memo[bno]:
+            return memo[bno]
+        if bno in seen:  # defensive: cyclic map
+            return 1
+        d = 1
+        for j in range(int(sizes[bno])):
+            it = int(items[bno, j])
+            if it < 0:
+                sub = -1 - it
+                if 0 <= sub < n:
+                    d = max(d, 1 + depth(sub, seen | {bno}))
+        memo[bno] = d
+        return d
+
+    best = 1
+    for b in range(n):
+        if sizes[b] > 0:
+            best = max(best, depth(b, frozenset()))
+    return best
+
+
+def _umulhi64(a, b):
+    """High 64 bits of a u64*u64 product via 32-bit limbs (XLA-friendly:
+    TPU multiplies u64 pairs natively per limb, no 128-bit type)."""
+    mask = jnp.uint64(0xFFFFFFFF)
+    a0, a1 = a & mask, a >> 32
+    b0, b1 = b & mask, b >> 32
+    t = a0 * b0
+    carry = t >> 32
+    t = a1 * b0 + carry
+    w1, w2 = t & mask, t >> 32
+    t = a0 * b1 + w1
+    return a1 * b1 + w2 + (t >> 32)
 
 
 def _straw2_choose(dm: _DeviceMap, bno, x, r):
-    """Vectorized bucket_straw2_choose (reference: mapper.c:361-384)."""
+    """Vectorized bucket_straw2_choose (reference: mapper.c:361-384).
+
+    The truncating divide div64_s64(ln, w) (mapper.c:358) is computed as
+    n = -ln >= 0; q = mulhi64(n, floor((2^64-1)/w)); q += (n - q*w >= w)
+    — exact for n < 2^48 (the crush_ln range): q' in {q-1, q} before the
+    single upward correction.
+    """
     items = dm.items[bno]
-    wts = dm.weights[bno].astype(jnp.int64)
+    wts = dm.weights[bno]
     size = dm.sizes[bno]
     u = hashes.hash32_3(
         x.astype(jnp.uint32), items.astype(jnp.uint32), r.astype(jnp.uint32),
         xp=jnp,
     ) & jnp.uint32(0xFFFF)
     lnv = dm.ln16[u.astype(jnp.int64)]
-    draw = -((-lnv) // jnp.maximum(wts, 1))
+    n = (-lnv).astype(jnp.uint64)
+    q = _umulhi64(n, dm.magic[bno])
+    w64 = jnp.maximum(wts, 1).astype(jnp.uint64)
+    rdr = n - q * w64
+    q = q + (rdr >= w64).astype(jnp.uint64)
+    draw = -(q.astype(jnp.int64))
     valid = (jnp.arange(dm.max_size) < size) & (wts > 0)
-    draw = jnp.where(valid, draw, S64_MIN)
+    draw = jnp.where(valid, draw, jnp.int64(S64_MIN))
     return items[jnp.argmax(draw)]
 
 
@@ -117,13 +197,14 @@ def _descend(
     *,
     indep_numrep: Optional[object] = None,
     ftotal=None,
-    max_depth: int = 16,
 ):
     """Walk intervening buckets until an item of want_type is chosen.
 
-    For indep, r is recomputed per level from the current bucket's alg
-    (reference: mapper.c:719-728); for firstn r_base is final.
-    Returns (item, status).
+    STATICALLY UNROLLED to the map's tree depth with masked carry — no
+    while_loop, so under vmap every level is one wide batch of straw2
+    draws.  For indep, r is recomputed per level from the current
+    bucket's alg (reference: mapper.c:719-728); for firstn r_base is
+    final.  Returns (item, status).
     """
 
     def r_for(bno):
@@ -136,12 +217,12 @@ def _descend(
         mult = jnp.where(uniform, numrep + 1, numrep)
         return r_base + mult * ftotal
 
-    def cond(c):
-        _, _, done, _, depth = c
-        return (~done) & (depth < max_depth)
+    bno = jnp.asarray(start_bno, dtype=jnp.int32)
+    item = jnp.int32(0)
+    done = jnp.asarray(False)
+    status = jnp.int32(_OK)
 
-    def body(c):
-        bno, item, done, status, depth = c
+    for _ in range(dm.depth):
         empty = dm.sizes[bno] == 0
         it = _straw2_choose(dm, bno, x, r_for(bno))
         bad_item = it >= dm.max_devices
@@ -166,24 +247,28 @@ def _descend(
             ),
         )
         keep_going = (~empty) & (~bad_item) & (~is_target) & valid_sub
-        new_done = ~keep_going
-        new_bno = jnp.where(keep_going, sub_bno, bno)
         new_item = jnp.where(empty, item, it)
-        # if we fell out via keep_going exhaustion, status stays OK but
-        # done flips at depth limit -> treat as SKIP there
-        return new_bno, new_item, new_done, new_status, depth + 1
+        # masked carry: lanes already done pass through unchanged
+        status = jnp.where(done, status, new_status)
+        item = jnp.where(done, item, new_item)
+        bno = jnp.where((~done) & keep_going, sub_bno, bno)
+        done = done | ~keep_going
 
-    bno0 = jnp.asarray(start_bno, dtype=jnp.int32)
-    init = (
-        bno0,
-        jnp.int32(0),
-        jnp.asarray(False),
-        jnp.int32(_OK),
-        jnp.int32(0),
-    )
-    _, item, done, status, _ = jax.lax.while_loop(cond, body, init)
-    status = jnp.where(done, status, _SKIP)  # depth exhausted
+    status = jnp.where(done, status, jnp.int32(_SKIP))  # depth exhausted
     return item, status
+
+
+def _leaf_attempt(dm, dev_weights, bno, x, r, outpos, out2):
+    """One recursive chooseleaf descent attempt (type-0 target)."""
+    nslots = out2.shape[0]
+    item, status = _descend(dm, bno, x, r, 0)
+    collide = jnp.any((jnp.arange(nslots) < outpos) & (out2 == item))
+    reject = (status == _REJECT) | _is_out(
+        dev_weights, dm.max_devices, item, x
+    )
+    skip = status == _SKIP
+    fail = reject | collide
+    return item, (~fail) & (~skip), skip, fail
 
 
 def _leaf_firstn(
@@ -203,10 +288,18 @@ def _leaf_firstn(
     numrep = 1 (stable) / outpos+1 (legacy), collision checked against
     the leaves chosen so far (out2[:outpos]).
     Returns (leaf_item, ok).
+
+    With the modern chooseleaf_descend_once profile recurse_tries == 1,
+    so the retry loop is statically elided to a single attempt.
     """
     bno = -1 - bucket_item
     rep = jnp.where(jnp.bool_(stable), 0, outpos)
-    nslots = out2.shape[0]
+
+    if recurse_tries == 1:
+        item, placed, _, _ = _leaf_attempt(
+            dm, dev_weights, bno, x, rep + sub_r, outpos, out2
+        )
+        return item, placed
 
     def cond(c):
         ftotal, _, placed, give_up = c
@@ -214,23 +307,11 @@ def _leaf_firstn(
 
     def body(c):
         ftotal, _, placed, give_up = c
-        r = rep + sub_r + ftotal
-        item, status = _descend(dm, bno, x, r, 0)
-        collide = jnp.any(
-            (jnp.arange(nslots) < outpos) & (out2 == item)
+        item, ok, skip, fail = _leaf_attempt(
+            dm, dev_weights, bno, x, rep + sub_r + ftotal, outpos, out2
         )
-        reject = (status == _REJECT) | _is_out(
-            dev_weights, dm.max_devices, item, x
-        )
-        skip = status == _SKIP
-        fail = reject | collide
         nf = ftotal + 1
-        return (
-            nf,
-            item,
-            (~fail) & (~skip),
-            skip | (fail & (nf >= recurse_tries)),
-        )
+        return (nf, item, ok, skip | (fail & (nf >= recurse_tries)))
 
     init = (jnp.int32(0), jnp.int32(0), jnp.asarray(False), jnp.asarray(False))
     _, item, placed, _ = jax.lax.while_loop(cond, body, init)
@@ -319,19 +400,26 @@ def _leaf_indep(dm, dev_weights, bucket_item, x, numrep, parent_r,
     """Recursive indep leaf choice: one slot, r' = parent_r + n*ftotal."""
     bno = -1 - bucket_item
 
-    def body(ftotal, got):
-        def attempt(_):
-            item, status = _descend(
-                dm, bno, x, parent_r, 0,
-                indep_numrep=jnp.int32(numrep), ftotal=ftotal,
+    def attempt(ftotal):
+        item, status = _descend(
+            dm, bno, x, parent_r, 0,
+            indep_numrep=jnp.int32(numrep), ftotal=ftotal,
+        )
+        bad = status != _OK
+        outed = _is_out(dev_weights, dm.max_devices, item, x)
+        return jnp.where(bad | outed, ITEM_UNDEF, item)
+
+    if recurse_tries == 1:
+        got = attempt(jnp.int32(0))
+    else:
+        def body(ftotal, got):
+            return jnp.where(
+                got == ITEM_UNDEF, attempt(jnp.int32(ftotal)), got
             )
-            bad = status != _OK
-            outed = _is_out(dev_weights, dm.max_devices, item, x)
-            return jnp.where(bad | outed, ITEM_UNDEF, item)
 
-        return jax.lax.cond(got == ITEM_UNDEF, attempt, lambda _: got, None)
-
-    got = jax.lax.fori_loop(0, recurse_tries, body, jnp.int32(ITEM_UNDEF))
+        got = jax.lax.fori_loop(
+            0, recurse_tries, body, jnp.int32(ITEM_UNDEF)
+        )
     return jnp.where(got == ITEM_UNDEF, ITEM_NONE, got)
 
 
@@ -356,60 +444,50 @@ def _choose_indep(
     def round_body(c):
         ftotal, out, out2, left = c
         for rep in range(nslots):
-            def fill(args):
-                out, out2, left = args
-                item, status = _descend(
-                    dm, bucket_bno, x, jnp.int32(rep), want_type,
-                    indep_numrep=jnp.int32(numrep), ftotal=ftotal,
-                )
-                collide = jnp.any(out == item)
-                hard_fail = status == _SKIP
-                soft_fail = (status == _REJECT) | collide
-                leaf = item
-                if recurse_to_leaf:
-                    is_bucket = item < 0
-                    # the recursion's slot r is rep + parent_r where
-                    # parent_r is the r at which this bucket was chosen
-                    # (straw2-only => the per-level multiplier is always
-                    # numrep, so r_parent is the top-level r')
-                    r_parent = jnp.int32(rep) + jnp.int32(numrep) * ftotal
-                    leaf_val = _leaf_indep(
-                        dm, dev_weights, jnp.minimum(item, -1), x,
-                        numrep, jnp.int32(rep) + r_parent, recurse_tries,
-                    )
-                    leaf = jnp.where(is_bucket, leaf_val, item)
-                    soft_fail = soft_fail | (
-                        is_bucket & (leaf == ITEM_NONE) & (status == _OK)
-                    )
-                outed = jnp.where(
-                    want_type == 0,
-                    (status == _OK)
-                    & _is_out(dev_weights, dm.max_devices, item, x),
-                    False,
-                )
-                soft_fail = soft_fail | outed
-                ok = (status == _OK) & (~soft_fail) & (~hard_fail)
-                new_item = jnp.where(
-                    hard_fail, ITEM_NONE, jnp.where(ok, item, ITEM_UNDEF)
-                )
-                new_leaf = jnp.where(
-                    hard_fail, ITEM_NONE, jnp.where(ok, leaf, ITEM_UNDEF)
-                )
-                placed = ok | hard_fail
-                out_n = jnp.where(
-                    placed, out.at[rep].set(new_item), out
-                )
-                out2_n = jnp.where(
-                    placed, out2.at[rep].set(new_leaf), out2
-                )
-                return out_n, out2_n, left - placed.astype(jnp.int32)
-
-            out, out2, left = jax.lax.cond(
-                out[rep] == ITEM_UNDEF,
-                fill,
-                lambda args: args,
-                (out, out2, left),
+            # compute the slot unconditionally (under vmap a cond is a
+            # select anyway) and mask the update on slot-vacancy
+            vacant = out[rep] == ITEM_UNDEF
+            item, status = _descend(
+                dm, bucket_bno, x, jnp.int32(rep), want_type,
+                indep_numrep=jnp.int32(numrep), ftotal=ftotal,
             )
+            collide = jnp.any(out == item)
+            hard_fail = status == _SKIP
+            soft_fail = (status == _REJECT) | collide
+            leaf = item
+            if recurse_to_leaf:
+                is_bucket = item < 0
+                # the recursion's slot r is rep + parent_r where
+                # parent_r is the r at which this bucket was chosen
+                # (straw2-only => the per-level multiplier is always
+                # numrep, so r_parent is the top-level r')
+                r_parent = jnp.int32(rep) + jnp.int32(numrep) * ftotal
+                leaf_val = _leaf_indep(
+                    dm, dev_weights, jnp.minimum(item, -1), x,
+                    numrep, jnp.int32(rep) + r_parent, recurse_tries,
+                )
+                leaf = jnp.where(is_bucket, leaf_val, item)
+                soft_fail = soft_fail | (
+                    is_bucket & (leaf == ITEM_NONE) & (status == _OK)
+                )
+            outed = jnp.where(
+                want_type == 0,
+                (status == _OK)
+                & _is_out(dev_weights, dm.max_devices, item, x),
+                False,
+            )
+            soft_fail = soft_fail | outed
+            ok = (status == _OK) & (~soft_fail) & (~hard_fail)
+            new_item = jnp.where(
+                hard_fail, ITEM_NONE, jnp.where(ok, item, ITEM_UNDEF)
+            )
+            new_leaf = jnp.where(
+                hard_fail, ITEM_NONE, jnp.where(ok, leaf, ITEM_UNDEF)
+            )
+            placed = (ok | hard_fail) & vacant
+            out = jnp.where(placed, out.at[rep].set(new_item), out)
+            out2 = jnp.where(placed, out2.at[rep].set(new_leaf), out2)
+            left = left - placed.astype(jnp.int32)
         return ftotal + 1, out, out2, left
 
     def round_cond(c):
@@ -432,7 +510,8 @@ def compile_rule(
     """Build fn(xs[int32 N], device_weights[uint32 D]) -> int32 [N, result_max].
 
     Steps are unrolled at trace time (rules are tiny and static); holes
-    are CRUSH_ITEM_NONE.  The returned callable is jitted and vmapped.
+    are CRUSH_ITEM_NONE.  The returned callable is jitted and vmapped,
+    and scopes x64 around its own dispatch.
     """
     if not np.all(
         (np.asarray(flat.algs) == ALG_STRAW2) | (np.asarray(flat.sizes) == 0)
@@ -539,13 +618,13 @@ def compile_rule(
                 wsize = jnp.int32(0)
         return result
 
-    mapped = jax.vmap(one_x, in_axes=(0, None))
+    mapped = jax.jit(jax.vmap(one_x, in_axes=(0, None)))
 
-    @jax.jit
     def run(xs, dev_weights):
-        return mapped(
-            jnp.asarray(xs, dtype=jnp.int32),
-            jnp.asarray(dev_weights, dtype=jnp.uint32),
-        )
+        with jax.enable_x64():
+            return mapped(
+                jnp.asarray(xs, dtype=jnp.int32),
+                jnp.asarray(dev_weights, dtype=jnp.uint32),
+            )
 
     return run

@@ -53,16 +53,21 @@ class MOSDOp(_PGMessage):
         super().__init__(pgid, epoch)
         self.oid = oid
         self.ops: List[OSDOp] = ops or []
+        # client-unique request id (osd_reqid_t role): lets the PG make
+        # resends exactly-once across primary failover
+        self.reqid = ""
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
         e.string(self.oid)
         e.seq(self.ops, lambda enc, o: o.encode(enc))
+        e.string(self.reqid)
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.oid = d.string()
         self.ops = d.seq(OSDOp.decode)
+        self.reqid = d.string() if d.remaining_in_frame() else ""
 
 
 @register

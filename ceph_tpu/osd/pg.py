@@ -42,6 +42,9 @@ from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp, PGId, PGInfo
 from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
 
 EPERM, ENOENT, EIO, EAGAIN, EINVAL = -1, -2, -5, -11, -22
+# "I'm not the primary" — a *retryable* mistargeting signal, distinct
+# from EPERM op failures (e.g. exclusive create) the client must surface
+ESTALE = -116
 
 STATE_PEERING = "peering"
 STATE_ACTIVE = "active"
@@ -62,6 +65,10 @@ class PG:
         self.lock = threading.RLock()
         self.missing: Dict[str, EVersion] = {}  # objects this osd lacks
         self.peer_info: Dict[int, PGInfo] = {}
+        # reqid -> committed version: completed-op replay so client
+        # resends are exactly-once across primary failover (the
+        # reference's pg log osd_reqid_t dedup)
+        self._reqids: Dict[str, EVersion] = {}
         # peers whose log is behind ours: their shards are stale and must
         # not serve reads until recovery pushes complete (the reference's
         # peer_missing discipline)
@@ -102,6 +109,7 @@ class PG:
             if self.log.head > self.info.last_update:
                 # data+log landed but info didn't: log wins (replay)
                 self.info.last_update = self.log.head
+            self._reindex_reqids()
 
     def _persist_meta(self, extra_omap: Optional[Dict[str, bytes]] = None):
         e = Encoder()
@@ -124,7 +132,7 @@ class PG:
         with self.lock:
             if not self.is_primary():
                 rep = m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
-                                    msg.ops, result=EPERM)
+                                    msg.ops, result=ESTALE)
                 reply(rep)
                 return
             writes = any(o.is_write() for o in msg.ops)
@@ -191,6 +199,17 @@ class PG:
         return 0
 
     def _do_write(self, msg, reply):
+        # completed-op replay: a resend of an already-committed write
+        # answers from the log instead of re-executing (exactly-once
+        # even if the previous primary died after commit)
+        reqid = getattr(msg, "reqid", "")
+        if reqid:
+            with self.lock:
+                done_v = self._reqids.get(reqid)
+            if done_v is not None:
+                reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                    msg.ops, result=0, version=done_v))
+                return
         # writes run START-TO-COMMIT on the pg's queue shard: the state
         # read is synchronous and we block on the commit before the next
         # queued op dispatches, so two writes to one object can never
@@ -301,6 +320,7 @@ class PG:
             version=version,
             prior_version=self.info.last_update,
             mtime=time.time(),
+            reqid=getattr(msg, "reqid", ""),
         )
         self.log.append(entry)
         self.info.last_update = version
@@ -311,6 +331,10 @@ class PG:
         log_rm = self.log.omap_removals(trimmed)
 
         def on_commit() -> None:
+            # replay registration happens at COMMIT, not append: a write
+            # that never reached quorum (EAGAIN to client) must not be
+            # answered as done on resend
+            self._note_reqid(entry)
             reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                 msg.ops, result=0, version=version))
             if committed is not None:
@@ -340,10 +364,24 @@ class PG:
         for en in entries:
             if en.version > self.log.head:
                 self.log.append(en)
+                self._note_reqid(en)
         self.log.trim_to()  # replicas bound memory like the primary
         if self.log.head > self.info.last_update:
             self.info.last_update = self.log.head
             self.info.last_complete = self.log.head
+
+    # -- reqid replay (exactly-once resends) ------------------------------
+    def _note_reqid(self, en: LogEntry) -> None:
+        if not en.reqid:
+            return
+        self._reqids[en.reqid] = en.version
+        if len(self._reqids) > 2 * len(self.log.entries) + 512:
+            self._reindex_reqids()
+
+    def _reindex_reqids(self) -> None:
+        self._reqids = {
+            en.reqid: en.version for en in self.log.entries if en.reqid
+        }
 
     def handle_sub_read(self, msg: m.MECSubRead, conn) -> None:
         assert isinstance(self.backend, ECBackend)

@@ -54,18 +54,20 @@ class LogEntry:
     prior_version: EVersion
     mtime: float = 0.0
     payload: bytes = b""  # opaque per-backend extra (e.g. EC shard info)
+    reqid: str = ""  # client reqid for exactly-once resend replay (v2)
 
     def encode(self, e: Encoder) -> None:
-        e.start(1, 1)
+        e.start(2, 1)
         e.u8(self.op).string(self.oid)
         self.version.encode(e)
         self.prior_version.encode(e)
         e.f64(self.mtime).blob(self.payload)
+        e.string(self.reqid)
         e.finish()
 
     @classmethod
     def decode(cls, d: Decoder) -> "LogEntry":
-        d.start(1)
+        v = d.start(2)
         out = cls(
             op=d.u8(),
             oid=d.string(),
@@ -73,6 +75,7 @@ class LogEntry:
             prior_version=EVersion.decode(d),
             mtime=d.f64(),
             payload=d.blob(),
+            reqid=d.string() if v >= 2 else "",
         )
         d.end()
         return out

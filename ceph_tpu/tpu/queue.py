@@ -120,7 +120,25 @@ class StripeBatchQueue:
                 batch[0].future.set_result(np.asarray(coding))
             else:
                 widths = [j.planes.shape[1] for j in batch]
-                stacked = np.concatenate([j.planes for j in batch], axis=1)
+                total = sum(widths)
+                # pad the concatenated width up to (a power of two) x
+                # (the codec's column granularity) so the device sees a
+                # handful of distinct shapes (each distinct shape is a
+                # fresh XLA compile) while array codecs like clay keep
+                # their width-divisible-by-sub_chunk_count invariant
+                gran = 1
+                get_subs = getattr(
+                    batch[0].codec, "get_sub_chunk_count", None)
+                if get_subs is not None:
+                    gran = max(1, int(get_subs()))
+                units = -(-total // gran)  # ceil
+                padded = gran * (1 << (units - 1).bit_length())
+                k = batch[0].planes.shape[0]
+                stacked = np.zeros((k, padded), dtype=np.uint8)
+                off = 0
+                for j, w in zip(batch, widths):
+                    stacked[:, off:off + w] = j.planes
+                    off += w
                 coding = np.asarray(batch[0].codec.encode_array(stacked))
                 off = 0
                 for j, w in zip(batch, widths):
