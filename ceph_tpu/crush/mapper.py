@@ -35,11 +35,17 @@ tests/test_crush_vs_reference.py):
 - Supported bucket algs in the jit path: straw2 (the modern default).
   uniform/list/tree/straw maps fall back to the native oracle.
 
-64-bit note: straw2 draws are exact signed-64-bit fixed-point math
-(crush_ln values scaled 2^48 divided by 16.16 weights).  The compiled
-callable scopes ``jax.enable_x64()`` around trace and dispatch itself —
-importing this module no longer flips the global x64 flag (round-2
-advisory: the import side effect changed every consumer's dtypes).
+64-bit note: straw2 draws are exact signed-64-bit fixed-point math in
+the reference (crush_ln values scaled 2^48 divided by 16.16 weights,
+div64_s64 at mapper.c:358).  TPUs have no 64-bit integer datapath, so
+this interpreter computes the EXACT quotient entirely in uint32:
+n = -(ln) < 2^48 is split into 16-bit limbs, multiplied by a
+per-weight magic reciprocal floor((2^64-1)/w) (weights are map
+constants) via limb products that never overflow u32, and corrected by
+one (q+1)*w comparison; the winning item is the lexicographic argmin
+of (q_hi, q_lo) with first-index tie-break — identical to the C's
+strictly-greater draw update.  No jax_enable_x64 anywhere (the round-2
+global flip advisory), and no 64-bit ops for XLA to emulate.
 """
 
 from __future__ import annotations
@@ -67,8 +73,6 @@ from ceph_tpu.crush.map import (
     FlatMap,
 )
 
-S64_MIN = -0x8000000000000000
-
 # descend status codes
 _OK = 0
 _REJECT = 1  # empty bucket mid-descent: retry with higher ftotal
@@ -78,25 +82,36 @@ _SKIP = 2  # bad item / bad type: give up on this replica slot
 class _DeviceMap:
     """FlatMap lowered to device arrays (captured by the compiled rule).
 
-    Constants are materialized under a local ``enable_x64`` scope so the
-    int64 ln table survives regardless of the caller's global flag.
+    Everything is int32/uint32: the 2^48-scale ln magnitudes and the
+    64-bit magic reciprocals live as 16-bit limb planes (see
+    _straw2_choose).
     """
 
     def __init__(self, flat: FlatMap):
         # magic reciprocals for the straw2 divide: weights are map
         # constants, so the exact truncating s64 division ln/w becomes
-        # mulhi64(-ln, magic[w]) + one correction — TPU has no native
-        # 64-bit divide and XLA's emulation is ~100x more ops
+        # a 16-bit-limb mulhi + one correction, all in uint32 (TPU has
+        # no native 64-bit integer datapath at all)
         w_safe = np.maximum(np.asarray(flat.weights, dtype=np.uint64), 1)
-        magic = np.uint64(0xFFFFFFFFFFFFFFFF) // w_safe
-        with jax.enable_x64():
-            self.items = jnp.asarray(flat.items, dtype=jnp.int32)
-            self.weights = jnp.asarray(flat.weights, dtype=jnp.uint32)
-            self.magic = jnp.asarray(magic, dtype=jnp.uint64)
-            self.sizes = jnp.asarray(flat.sizes, dtype=jnp.int32)
-            self.algs = jnp.asarray(flat.algs, dtype=jnp.int32)
-            self.types = jnp.asarray(flat.types, dtype=jnp.int32)
-            self.ln16 = jnp.asarray(ln.ln16_table(), dtype=jnp.int64)
+        magic = (np.uint64(0xFFFFFFFFFFFFFFFF) // w_safe).astype(object)
+        # magic split into 4x16-bit limbs
+        self.magic_l = [
+            jnp.asarray(
+                ((magic >> (16 * i)) & 0xFFFF).astype(np.uint32))
+            for i in range(4)
+        ]
+        self.items = jnp.asarray(flat.items, dtype=jnp.int32)
+        self.weights = jnp.asarray(flat.weights, dtype=jnp.uint32)
+        self.sizes = jnp.asarray(flat.sizes, dtype=jnp.int32)
+        self.algs = jnp.asarray(flat.algs, dtype=jnp.int32)
+        self.types = jnp.asarray(flat.types, dtype=jnp.int32)
+        # n = -(crush_ln(u) - 2^48) in [1, 2^48] — note u=0 hits 2^48
+        # EXACTLY, so limbs must cover 49 bits: 4x16-bit tables
+        n = (-ln.ln16_table()).astype(np.uint64)
+        self.ln_l = [
+            jnp.asarray(((n >> (16 * i)) & 0xFFFF).astype(np.uint32))
+            for i in range(4)
+        ]
         self.n_buckets = int(flat.items.shape[0])
         self.max_size = int(flat.items.shape[1])
         self.max_devices = int(flat.max_devices)
@@ -133,27 +148,21 @@ def _tree_depth(flat: FlatMap) -> int:
     return best
 
 
-def _umulhi64(a, b):
-    """High 64 bits of a u64*u64 product via 32-bit limbs (XLA-friendly:
-    TPU multiplies u64 pairs natively per limb, no 128-bit type)."""
-    mask = jnp.uint64(0xFFFFFFFF)
-    a0, a1 = a & mask, a >> 32
-    b0, b1 = b & mask, b >> 32
-    t = a0 * b0
-    carry = t >> 32
-    t = a1 * b0 + carry
-    w1, w2 = t & mask, t >> 32
-    t = a0 * b1 + w1
-    return a1 * b1 + w2 + (t >> 32)
+_U16 = jnp.uint32(0xFFFF)
+_UMAX = jnp.uint32(0xFFFFFFFF)
 
 
 def _straw2_choose(dm: _DeviceMap, bno, x, r):
-    """Vectorized bucket_straw2_choose (reference: mapper.c:361-384).
+    """Vectorized bucket_straw2_choose (reference: mapper.c:361-384),
+    exact and 64-bit-free.
 
-    The truncating divide div64_s64(ln, w) (mapper.c:358) is computed as
-    n = -ln >= 0; q = mulhi64(n, floor((2^64-1)/w)); q += (n - q*w >= w)
-    — exact for n < 2^48 (the crush_ln range): q' in {q-1, q} before the
-    single upward correction.
+    The C computes draw = div64_s64(ln, w) per item and keeps the
+    strictly-greatest draw (first index on ties).  ln is negative with
+    |ln| = n < 2^48, so argmax(draw) == lexicographic argmin of the
+    positive quotient q = floor(n / w).  q is computed exactly in
+    uint32: q_est = floor(n * floor((2^64-1)/w) / 2^64) via 16-bit limb
+    products (never overflowing u32), then one upward correction
+    (q_est is provably in {q-1, q} for n < 2^48).
     """
     items = dm.items[bno]
     wts = dm.weights[bno]
@@ -161,17 +170,69 @@ def _straw2_choose(dm: _DeviceMap, bno, x, r):
     u = hashes.hash32_3(
         x.astype(jnp.uint32), items.astype(jnp.uint32), r.astype(jnp.uint32),
         xp=jnp,
-    ) & jnp.uint32(0xFFFF)
-    lnv = dm.ln16[u.astype(jnp.int64)]
-    n = (-lnv).astype(jnp.uint64)
-    q = _umulhi64(n, dm.magic[bno])
-    w64 = jnp.maximum(wts, 1).astype(jnp.uint64)
-    rdr = n - q * w64
-    q = q + (rdr >= w64).astype(jnp.uint64)
-    draw = -(q.astype(jnp.int64))
+    ) & _U16
+    ui = u.astype(jnp.int32)
+    nl = [dm.ln_l[i][ui] for i in range(4)]  # n in 4x16-bit limbs
+    ml = [mlj[bno] for mlj in dm.magic_l]  # magic in 4x16-bit limbs
+
+    # P = n * magic: 16-bit-limb column accumulation; per-column sums
+    # stay < 2^19 (<= 4 lo + 4 hi terms of < 2^16 each)
+    prods = {(i, j): nl[i] * ml[j] for i in range(4) for j in range(4)}
+    carry = jnp.zeros_like(u)
+    digits = []
+    for k in range(7):
+        s = carry
+        for (i, j), v in prods.items():
+            if i + j == k:
+                s = s + (v & _U16)
+            if i + j == k - 1:
+                s = s + (v >> 16)
+        digits.append(s & _U16)
+        carry = s >> 16
+    q_top = carry + (prods[(3, 3)] >> 16)  # digit 7 (tiny, no split)
+    q_lo = digits[4] | (digits[5] << 16)
+    q_hi = digits[6] | (q_top << 16)
+
+    # correction: rdr = n - q*w in 16-bit borrow arithmetic; q += (rdr>=w)
+    w0, w1 = wts & _U16, wts >> 16
+    ql = (digits[4], digits[5], digits[6], q_top)
+    uprods = {(i, j): ql[i] * (w0 if j == 0 else w1)
+              for i in range(4) for j in range(2)}
+    ucar = jnp.zeros_like(u)
+    udig = []
+    for k in range(4):
+        s = ucar
+        for (i, j), v in uprods.items():
+            if i + j == k:
+                s = s + (v & _U16)
+            if i + j == k - 1:
+                s = s + (v >> 16)
+        udig.append(s & _U16)
+        ucar = s >> 16
+    # rdr = n - q*w (borrow chain; q*w <= n so the final borrow is 0)
+    borrow = jnp.zeros_like(u)
+    rd = []
+    for k in range(4):
+        t = nl[k] + jnp.uint32(0x10000) - udig[k] - borrow
+        rd.append(t & _U16)
+        borrow = jnp.uint32(1) - (t >> 16)
+    # rdr >= w  (rdr < 2w < 2^33: limbs 2+3 are tiny)
+    ge = ((rd[3] > 0) | (rd[2] > 0) | (rd[1] > w1)
+          | ((rd[1] == w1) & (rd[0] >= w0)))
+    bump = ge.astype(jnp.uint32)
+    q_lo2 = q_lo + bump
+    q_hi = q_hi + (bump & (q_lo2 == 0).astype(jnp.uint32))
+    q_lo = q_lo2
+
+    # winner = first index of the minimal (q_hi, q_lo) among valid items
     valid = (jnp.arange(dm.max_size) < size) & (wts > 0)
-    draw = jnp.where(valid, draw, jnp.int64(S64_MIN))
-    return items[jnp.argmax(draw)]
+    q_hi = jnp.where(valid, q_hi, _UMAX)
+    q_lo = jnp.where(valid, q_lo, _UMAX)
+    min_hi = jnp.min(q_hi)
+    cand = q_hi == min_hi
+    min_lo = jnp.min(jnp.where(cand, q_lo, _UMAX))
+    sel = cand & (q_lo == min_lo)
+    return items[jnp.argmax(sel)]
 
 
 def _is_out(dev_weights, max_devices, item, x):
@@ -510,8 +571,9 @@ def compile_rule(
     """Build fn(xs[int32 N], device_weights[uint32 D]) -> int32 [N, result_max].
 
     Steps are unrolled at trace time (rules are tiny and static); holes
-    are CRUSH_ITEM_NONE.  The returned callable is jitted and vmapped,
-    and scopes x64 around its own dispatch.
+    are CRUSH_ITEM_NONE.  The returned callable is jitted and vmapped;
+    the whole program is uint32/int32 (see module docstring), so no x64
+    configuration is involved anywhere.
     """
     if not np.all(
         (np.asarray(flat.algs) == ALG_STRAW2) | (np.asarray(flat.sizes) == 0)
@@ -621,10 +683,9 @@ def compile_rule(
     mapped = jax.jit(jax.vmap(one_x, in_axes=(0, None)))
 
     def run(xs, dev_weights):
-        with jax.enable_x64():
-            return mapped(
-                jnp.asarray(xs, dtype=jnp.int32),
-                jnp.asarray(dev_weights, dtype=jnp.uint32),
-            )
+        return mapped(
+            jnp.asarray(xs, dtype=jnp.int32),
+            jnp.asarray(dev_weights, dtype=jnp.uint32),
+        )
 
     return run

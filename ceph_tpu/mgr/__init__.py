@@ -1,0 +1,6 @@
+"""Manager-plane services: placement balancing over the vmapped sweep
+(reference: src/mgr/ + src/pybind/mgr/balancer/)."""
+
+from ceph_tpu.mgr.balancer import BalanceReport, UpmapBalancer
+
+__all__ = ["UpmapBalancer", "BalanceReport"]

@@ -16,7 +16,7 @@ from ceph_tpu.msg.message import EntityName, Message
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
 from ceph_tpu.mon import messages as mm
 from ceph_tpu.mon.monitor import MonMap
-from ceph_tpu.osd import map_codec
+from ceph_tpu.osd import map_codec, map_inc
 
 Addr = Tuple[str, int]
 
@@ -31,6 +31,7 @@ class MonClient(Dispatcher):
         self._lock = threading.Lock()
         self._waiters: Dict[int, list] = {}
         self.on_osdmap: Optional[Callable] = None
+        self.osdmap = None  # the client's current map (inc base)
         self._last_epoch = 0
         msgr.add_dispatcher(self)
 
@@ -47,15 +48,45 @@ class MonClient(Dispatcher):
             # pushes arrive concurrently from every subscribed mon:
             # compare-and-set under the lock so an older epoch can never
             # be delivered after a newer one
-            deliver = False
+            newmap = None
+            resub = False
             with self._lock:
                 if msg.epoch > self._last_epoch and self.on_osdmap:
-                    self._last_epoch = msg.epoch
-                    deliver = True
-            if deliver:
-                self.on_osdmap(map_codec.decode_osdmap(msg.data))
+                    if msg.data:
+                        newmap = map_codec.decode_osdmap(msg.data)
+                    elif msg.incs and self.osdmap is not None:
+                        try:
+                            newmap = self.osdmap
+                            for blob in msg.incs:
+                                inc = map_inc.Incremental.decode(blob)
+                                if inc.epoch <= newmap.epoch:
+                                    continue  # another mon's push
+                                    # already covered this prefix
+                                newmap = inc.apply(newmap)
+                        except Exception:
+                            newmap = None
+                        if newmap is not None \
+                                and newmap.epoch <= self._last_epoch:
+                            return True  # chain was entirely stale
+                    if newmap is not None:
+                        self._last_epoch = newmap.epoch
+                        self.osdmap = newmap
+                    else:
+                        # inc chain didn't apply: ask for a full map
+                        resub = True
+            if newmap is not None:
+                self.on_osdmap(newmap)
+            elif resub:
+                self._resubscribe(since=0)
             return True
         return False
+
+    def _resubscribe(self, since: int) -> None:
+        ip, port = self.msgr.addr
+        for rank in range(self.monmap.size):
+            self.msgr.send_message(
+                mm.MMonSubscribe(f"osdmap:{ip}:{port}", since),
+                self.monmap.addrs[rank])
 
     # -- commands ---------------------------------------------------------
     def command(self, cmd: dict, timeout: float = 10.0) -> Tuple[int, dict]:
@@ -94,14 +125,16 @@ class MonClient(Dispatcher):
         return w[1] if ok and w else None
 
     # -- subscriptions ----------------------------------------------------
-    def subscribe_osdmap(self, cb: Callable, since: int = 0) -> None:
-        """cb(OSDMap) fires on every newer committed map."""
+    def subscribe_osdmap(self, cb: Callable, since: int = 0,
+                         base=None) -> None:
+        """cb(OSDMap) fires on every newer committed map.  `base` (the
+        caller's current map) seeds the incremental-apply chain so
+        pushes after `since` arrive as O(delta) incs."""
         self.on_osdmap = cb
-        ip, port = self.msgr.addr
-        for rank in range(self.monmap.size):
-            self.msgr.send_message(
-                mm.MMonSubscribe(f"osdmap:{ip}:{port}", since),
-                self.monmap.addrs[rank])
+        if base is not None:
+            self.osdmap = base
+            self._last_epoch = base.epoch
+        self._resubscribe(since)
 
     # -- osd daemon hooks -------------------------------------------------
     def send_boot(self, osd_id: int,

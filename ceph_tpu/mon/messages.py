@@ -47,6 +47,8 @@ class MMonPaxos(Message):
     ACCEPT = 4    # phase 2b
     COMMIT = 5    # learn
     LEASE = 6     # leader extends read lease
+    CATCHUP_REQ = 7  # peon -> leader: inc had no base, need the full map
+    CATCHUP = 8      # leader -> peon: full current map
 
     def __init__(self, op: int = 0, pn: int = 0, version: int = 0,
                  value: bytes = b"", first_committed: int = 0,
@@ -138,8 +140,9 @@ class MMonSubscribe(Message):
 
 @register
 class MOSDMapMsg(Message):
-    """Full osdmap push (reference MOSDMap; incrementals are a later
-    optimization — full maps keep the protocol simple)."""
+    """osdmap push (reference MOSDMap): either the full map (`data`,
+    first subscribe / out-of-window) or a chain of incrementals
+    (`incs`, applied in order) — O(delta) bytes per map change."""
 
     TYPE = 35
 
@@ -147,13 +150,17 @@ class MOSDMapMsg(Message):
         super().__init__()
         self.epoch = epoch
         self.data = data
+        self.incs = []  # type: list[bytes]
 
     def encode_payload(self, e: Encoder) -> None:
         e.u32(self.epoch).blob(self.data)
+        e.seq(self.incs, lambda enc, b: enc.blob(b))
 
     def decode_payload(self, d: Decoder) -> None:
         self.epoch = d.u32()
         self.data = d.blob()
+        self.incs = (d.seq(lambda dd: dd.blob())
+                     if d.remaining_in_frame() else [])
 
 
 @register

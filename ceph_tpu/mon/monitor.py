@@ -26,11 +26,15 @@ from ceph_tpu.crush import map as cmap
 from ceph_tpu.msg.message import EntityName, Message
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
 from ceph_tpu.mon import messages as mm
-from ceph_tpu.osd import map_codec
+from ceph_tpu.osd import map_codec, map_inc
 from ceph_tpu.osd.osdmap import OSDMap, PGPool, POOL_ERASURE, POOL_REPLICATED
 from ceph_tpu.store.kv import LogKV, MemDB, WriteBatch
 
 Addr = Tuple[str, int]
+
+# commit a full map (not a delta) every Nth epoch: a replay anchor that
+# bounds incremental chains (reference: OSDMonitor's periodic full_X)
+FULL_EVERY = 32
 
 STATE_ELECTING = "electing"
 STATE_LEADER = "leader"
@@ -90,6 +94,9 @@ class Monitor(Dispatcher):
         self.failure_reports: Dict[int, Dict[int, float]] = {}
         self.down_stamp: Dict[int, float] = {}
         self.subscribers: Dict[Addr, int] = {}  # addr -> last epoch sent
+        # epoch -> (prev_epoch, inc bytes): the window subscribers can be
+        # caught up from with O(delta) pushes
+        self._recent_incs: Dict[int, Tuple[int, bytes]] = {}
         self.ec_profiles: Dict[str, str] = {
             "default": "plugin=isa k=2 m=1 technique=reed_sol_van",
         }
@@ -98,6 +105,7 @@ class Monitor(Dispatcher):
         # pending_inc): concurrent boots/failures/commands each cloning
         # the committed map would otherwise clobber each other
         self._pending_map: Optional[OSDMap] = None
+        self._pending_crush: bytes = b""  # cached crush encoding
         self._stop = threading.Event()
         self._tick_thread: Optional[threading.Thread] = None
 
@@ -137,9 +145,28 @@ class Monitor(Dispatcher):
         lc = self.kv.get("paxos", "last_committed")
         self.last_committed = int(lc) if lc else 0
         if self.last_committed:
-            data = self.kv.get("paxos_values", str(self.last_committed))
-            if data:
-                self.osdmap = map_codec.decode_osdmap(data)
+            # latest_full is only written at FULL anchors (writing the
+            # O(cluster) image every commit would defeat the O(delta)
+            # commit path); boot = anchor + replay of the committed
+            # incrementals since it
+            full = self.kv.get("mon", "latest_full")
+            fv = self.kv.get("mon", "latest_full_v")
+            if full:
+                self.osdmap = map_codec.decode_osdmap(full)
+            start = int(fv) if fv else 0
+            for v in range(start + 1, self.last_committed + 1):
+                data = self.kv.get("paxos_values", str(v))
+                if not data:
+                    continue
+                try:
+                    newmap = map_inc.decode_value(data, self.osdmap)
+                    if (self.osdmap is None
+                            or newmap.epoch > self.osdmap.epoch):
+                        self.osdmap = newmap
+                except map_inc.NeedFullMap:
+                    break  # stale base: catch up from peers once live
+                except Exception:
+                    continue  # pre-framing legacy value
         # restore an accepted-but-uncommitted proposal: our promise must
         # survive restart or a new leader's collect can miss a value the
         # old leader already committed elsewhere (Paxos.cc handle_collect
@@ -451,6 +478,30 @@ class Monitor(Dispatcher):
                 if msg.version > self.last_committed and msg.value:
                     self._learn(msg.version, msg.value)
             return
+        if op == mm.MMonPaxos.CATCHUP_REQ:
+            # a peer learned an incremental it has no base for: hand it
+            # the full current map (the reference's store-sync role)
+            with self.lock:
+                if self.osdmap is None:
+                    return
+                rep = mm.MMonPaxos(
+                    mm.MMonPaxos.CATCHUP, self.accepted_pn,
+                    version=self.last_committed,
+                    value=map_inc.encode_full_value(self.osdmap))
+            conn.send(rep)
+            return
+        if op == mm.MMonPaxos.CATCHUP:
+            with self.lock:
+                if msg.value:
+                    try:
+                        newmap = map_inc.decode_value(msg.value, None)
+                    except Exception:
+                        return
+                    if (self.osdmap is None
+                            or newmap.epoch > self.osdmap.epoch):
+                        self._adopt_map(newmap, msg.value, msg.version)
+            self._push_maps()
+            return
 
     def _learn(self, version: int, value: bytes) -> None:
         # a promise for a HIGHER version than what we just learned is
@@ -464,12 +515,44 @@ class Monitor(Dispatcher):
         if not keep:
             self.uncommitted = None
         try:
-            self.osdmap = map_codec.decode_osdmap(value)
-            if (self._pending_map is not None
-                    and self.osdmap.epoch >= self._pending_map.epoch):
-                self._pending_map = None  # fully caught up
+            newmap = map_inc.decode_value(value, self.osdmap)
+        except map_inc.NeedFullMap:
+            # incremental with no matching base (we skipped commits):
+            # fetch the full map — from the leader when we're a peon,
+            # from every peer when we ARE the (freshly elected, stale)
+            # leader; any mon with a newer map answers CATCHUP
+            req = mm.MMonPaxos(mm.MMonPaxos.CATCHUP_REQ, self.accepted_pn,
+                               version=self.last_committed)
+            if self.leader >= 0 and self.leader != self.rank:
+                self._send_mon(self.leader, req)
+            else:
+                for r in self._peers():
+                    self._send_mon(r, req)
+            return
         except Exception as e:  # pragma: no cover
             self._plog(0, f"failed to decode committed map: {e}")
+            return
+        self._adopt_map(newmap, value, version)
+
+    def _adopt_map(self, newmap: OSDMap, value: bytes,
+                   version: int) -> None:
+        self.osdmap = newmap
+        if value and value[0] == map_inc.INC_TAG:
+            inc = map_inc.Incremental.decode(value[1:])
+            self._recent_incs[inc.epoch] = (inc.prev_epoch, value[1:])
+            while len(self._recent_incs) > 1024:
+                del self._recent_incs[min(self._recent_incs)]
+        else:
+            # FULL anchor: persist the boot image + the version it
+            # corresponds to (boot replays later incs on top of it)
+            b = WriteBatch()
+            b.set("mon", "latest_full", value[1:] if value
+                  else map_codec.encode_osdmap(newmap))
+            b.set("mon", "latest_full_v", str(version).encode())
+            self.kv.submit(b)
+        if (self._pending_map is not None
+                and self.osdmap.epoch >= self._pending_map.epoch):
+            self._pending_map = None  # fully caught up
 
     def propose(self, value: bytes) -> None:
         """Leader-only: serialize one value through phase 2."""
@@ -566,22 +649,35 @@ class Monitor(Dispatcher):
         return map_codec.decode_osdmap(map_codec.encode_osdmap(self.osdmap))
 
     def _mutate_map(self, fn) -> bool:
-        """Apply `fn(pending_map)` and propose the result.  Must be
-        called with self.lock held; returns False if there is no map."""
+        """Apply `fn(pending_map)` and propose the result as an
+        INCREMENTAL delta (full map every FULL_EVERY epochs as a replay
+        anchor).  Must be called with self.lock held; returns False if
+        there is no map."""
         if self.osdmap is None:
             return False
         if self._pending_map is None:
             self._pending_map = self._clone_map()
             self._pending_map.epoch = self.osdmap.epoch
+            self._pending_crush = map_inc.crush_bytes(self._pending_map)
+        prev = map_inc.clone_map(self._pending_map)
+        prev_crush = self._pending_crush
         fn(self._pending_map)
         self._pending_map.epoch += 1
-        self.propose(map_codec.encode_osdmap(self._pending_map))
+        new_crush = map_inc.crush_bytes(self._pending_map)
+        self._pending_crush = new_crush
+        if self._pending_map.epoch % FULL_EVERY == 0:
+            value = map_inc.encode_full_value(self._pending_map)
+        else:
+            value = map_inc.encode_inc_value(map_inc.diff_maps(
+                prev, self._pending_map,
+                old_crush=prev_crush, new_crush=new_crush))
+        self.propose(value)
         return True
 
     def _propose_map(self, newmap: OSDMap) -> None:
         # legacy single-shot path (commands built on _mutate_map now)
         newmap.epoch = (self.osdmap.epoch if self.osdmap else 0) + 1
-        self.propose(map_codec.encode_osdmap(newmap))
+        self.propose(map_inc.encode_full_value(newmap))
 
     def _handle_boot(self, msg: mm.MOSDBoot) -> None:
         with self.lock:
@@ -630,18 +726,47 @@ class Monitor(Dispatcher):
             self._mutate_map(lambda nm: nm.set_osd_down(msg.target))
 
     # -- subscriptions ----------------------------------------------------
+    def _inc_chain(self, last: int, epoch: int) -> Optional[List[bytes]]:
+        """Incrementals taking a subscriber from `last` to `epoch`, or
+        None if the window doesn't reach (send full instead)."""
+        if last <= 0:
+            return None
+        chain: List[bytes] = []
+        e = epoch
+        while e > last:
+            got = self._recent_incs.get(e)
+            if got is None:
+                return None
+            prev, blob = got
+            chain.append(blob)
+            e = prev
+        return list(reversed(chain)) if e == last else None
+
     def _push_maps(self) -> None:
+        """Subscribers get O(delta) incremental pushes; the full map
+        only on first subscribe or when they fell out of the window
+        (reference OSDMonitor::send_incremental)."""
+        sends: List[Tuple[Addr, mm.MOSDMapMsg]] = []
         with self.lock:
             if self.osdmap is None:
                 return
             epoch = self.osdmap.epoch
-            data = map_codec.encode_osdmap(self.osdmap)
-            targets = [a for a, last in self.subscribers.items()
-                       if last < epoch]
-            for a in targets:
+            full = None
+            for a, last in list(self.subscribers.items()):
+                if last >= epoch:
+                    continue
+                chain = self._inc_chain(last, epoch)
+                if chain is None:
+                    if full is None:
+                        full = map_codec.encode_osdmap(self.osdmap)
+                    msg = mm.MOSDMapMsg(epoch, full)
+                else:
+                    msg = mm.MOSDMapMsg(epoch, b"")
+                    msg.incs = chain
+                sends.append((a, msg))
                 self.subscribers[a] = epoch
-        for a in targets:
-            self.msgr.send_message(mm.MOSDMapMsg(epoch, data), a)
+        for a, msg in sends:
+            self.msgr.send_message(msg, a)
 
     # -- commands ---------------------------------------------------------
     def _handle_command(self, conn: Connection,

@@ -235,27 +235,96 @@ class ReplicatedBackend(PGBackend):
 # ---------------------------------------------------------------------------
 
 
-def _hinfo(chunk: bytes, total_size: int) -> bytes:
+def _hinfo(chunk: bytes, total_size: int, crc_valid: bool = True) -> bytes:
     """Per-shard HashInfo xattr: (object logical size, chunk crc32c)
-    (reference ECUtil::HashInfo, src/osd/ECUtil.h:101-122)."""
+    (reference ECUtil::HashInfo, src/osd/ECUtil.h:101-122).
+
+    Partial-stripe overwrites cannot maintain the whole-chunk crc
+    without re-reading the chunk, so they mark it invalid — scrub then
+    relies on the decode+re-encode parity check instead (the reference's
+    ec_overwrites pools likewise drop the running HashInfo crc and lean
+    on store checksums / deep scrub)."""
     e = Encoder()
-    e.u64(total_size).u32(crc32c(chunk))
+    e.u64(total_size).u32(crc32c(chunk) if crc_valid else 0)
+    e.u8(1 if crc_valid else 0)
     return e.bytes()
 
 
-def hinfo_decode(blob: bytes) -> Tuple[int, int]:
+def hinfo_decode(blob: bytes) -> Tuple[int, int, bool]:
     d = Decoder(blob)
-    return d.u64(), d.u32()
+    size, crc = d.u64(), d.u32()
+    valid = bool(d.u8()) if d.remaining_in_frame() else True
+    return size, crc, valid
+
+
+class ExtentCache:
+    """Overwrite pipeline cache (reference: ExtentCache.h role).
+
+    A bounded write-through LRU of (oid, stripe) -> merged data-plane
+    bytes for stripes this primary recently wrote.  The next RMW that
+    overlaps them skips its whole read phase (no shard reads, no
+    decode) — the way overlapping/back-to-back overwrites pipeline in
+    a strictly-ordered per-PG write path.  Invalidation: full-object
+    writes/deletes drop the object; interval changes clear everything
+    (a new primary must not trust another primary's cache)."""
+
+    def __init__(self, max_stripes: int = 1024) -> None:
+        import collections
+
+        self.max_stripes = max_stripes
+        self._lru: "collections.OrderedDict[Tuple[str, int], bytes]" = (
+            collections.OrderedDict())
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def put(self, oid: str, stripe: int, data: bytes) -> None:
+        with self._lock:
+            key = (oid, stripe)
+            self._lru[key] = bytes(data)
+            self._lru.move_to_end(key)
+            while len(self._lru) > self.max_stripes:
+                self._lru.popitem(last=False)
+
+    def get(self, oid: str, stripe: int) -> Optional[bytes]:
+        with self._lock:
+            got = self._lru.get((oid, stripe))
+            if got is None:
+                self.misses += 1
+            else:
+                self._lru.move_to_end((oid, stripe))
+                self.hits += 1
+            return got
+
+    def invalidate(self, oid: str) -> None:
+        with self._lock:
+            for key in [k for k in self._lru if k[0] == oid]:
+                del self._lru[key]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
 
 
 class ECBackend(PGBackend):
-    """EC distribution: shard i of the acting set stores chunk i."""
+    """EC distribution: shard i of the acting set stores chunk i.
+
+    Layout is STRIPED with a fixed stripe_unit (the reference's
+    stripe_info_t, ECUtil.h:27-71): logical bytes
+    [s*k*unit + i*unit, ...) live at offset s*unit of shard i's chunk
+    file.  Fixed geometry is what makes partial-stripe overwrite
+    possible: a ranged write touches only stripes
+    [off//width, ceil(end/width)) and each shard's extent
+    [s0*unit, s1*unit)."""
 
     def __init__(self, pgid, coll, store, whoami, osd_send, epoch_fn,
                  codec) -> None:
         super().__init__(pgid, coll, store, whoami, osd_send, epoch_fn)
         self.codec = codec
         self.queue = default_queue()
+        prof = getattr(codec, "profile", {}) or {}
+        self.unit = int(prof.get("stripe_unit", 4096))
+        self.cache = ExtentCache()
 
     @property
     def k(self) -> int:
@@ -265,13 +334,41 @@ class ECBackend(PGBackend):
     def m(self) -> int:
         return self.codec.m
 
+    @property
+    def stripe_width(self) -> int:
+        return self.k * self.unit
+
+    def _interleave(self, data: bytes) -> Tuple[np.ndarray, int]:
+        """Object bytes -> striped data planes [k, S*unit] (+pad)."""
+        width = self.stripe_width
+        S = max(1, -(-len(data) // width))
+        buf = np.zeros(S * width, dtype=np.uint8)
+        raw = np.frombuffer(data, dtype=np.uint8)
+        buf[: len(raw)] = raw
+        planes = buf.reshape(S, self.k, self.unit).transpose(1, 0, 2)
+        return np.ascontiguousarray(planes.reshape(self.k, S * self.unit)), S
+
+    def _deinterleave(self, planes: np.ndarray, size: int) -> bytes:
+        """Striped data planes [k, >=S*unit] -> object bytes[:size]."""
+        width = self.stripe_width
+        S = max(1, -(-size // width))
+        p = planes[:, : S * self.unit].reshape(self.k, S, self.unit)
+        return p.transpose(1, 0, 2).tobytes()[:size]
+
     def _encode_object(self, data: bytes) -> Tuple[List[bytes], int]:
         """Object buffer -> k+m chunk payloads via the batch queue."""
-        planes, chunk = self.codec.encode_prepare(data)
+        planes, S = self._interleave(data)
+        cols = S * self.unit
+        # array codecs (clay) need columns divisible by sub_chunk_count
+        D = self.codec.get_sub_chunk_count()
+        if cols % D:
+            planes = np.concatenate(
+                [planes,
+                 np.zeros((self.k, D - cols % D), dtype=np.uint8)], axis=1)
         coding = self.queue.encode(self.codec, planes)
         chunks = [planes[i].tobytes() for i in range(self.k)]
         chunks += [np.asarray(coding[j]).tobytes() for j in range(self.m)]
-        return chunks, chunk
+        return chunks, planes.shape[1]
 
     def _shard_txn(self, oid: str, shard: int, chunk: Optional[bytes],
                    state: Optional[ObjectState],
@@ -297,8 +394,16 @@ class ECBackend(PGBackend):
             t.omap_rmkeys(self.coll, _meta_oid(), log_rm)
         return t
 
+    def on_peer_change(self, alive: set) -> None:
+        # an interval change invalidates the overwrite cache: a new
+        # primary must never trust stripes another primary merged
+        self.cache.clear()
+        super().on_peer_change(alive)
+
     def submit(self, oid, state, entries, log_omap, acting, on_commit,
                log_rm=None):
+        # full-object rewrite/delete supersedes any cached stripes
+        self.cache.invalidate(oid)
         n = self.k + self.m
         chunks: List[Optional[bytes]] = [None] * n
         if state is not None:
@@ -340,14 +445,29 @@ class ECBackend(PGBackend):
             return None
         data = self.store.read(self.coll, g)
         # verify the stored crc before serving (handle_sub_read's
-        # HashInfo check, ECBackend.cc:955)
+        # HashInfo check, ECBackend.cc:955); overwritten chunks carry an
+        # invalidated crc and are vetted by scrub's parity check instead
         try:
-            _, want = hinfo_decode(self.store.getattr(self.coll, g, "hinfo"))
+            _, want, valid = hinfo_decode(
+                self.store.getattr(self.coll, g, "hinfo"))
         except Exception:
             return None
-        if crc32c(data) != want:
+        if valid and crc32c(data) != want:
             return None  # corrupt shard reads as missing -> reconstruct
         return data
+
+    def local_size(self, oid: str) -> Optional[int]:
+        """Logical object size from any local shard's HashInfo."""
+        for shard in range(self.k + self.m):
+            g = GHObject(oid, shard=shard)
+            if self.store.exists(self.coll, g):
+                try:
+                    size, _, _ = hinfo_decode(
+                        self.store.getattr(self.coll, g, "hinfo"))
+                    return size
+                except Exception:
+                    continue
+        return None
 
     def local_shards(self, acting: Sequence[int]) -> List[int]:
         return [i for i, o in enumerate(acting[: self.k + self.m])
@@ -379,18 +499,121 @@ class ECBackend(PGBackend):
             return None
         want = list(range(self.k))
         data_chunks = self.codec.decode_array(arrs, want, n)
-        buf = b"".join(data_chunks[i].tobytes() for i in range(self.k))
+        planes = np.stack([np.asarray(data_chunks[i]) for i in range(self.k)])
         if meta is None:
             meta = self.shard_meta(oid, next(iter(avail)))
         attrs, omap = dict(meta[0]), dict(meta[1])
         size = None
         if "hinfo" in attrs:
-            size, _ = hinfo_decode(attrs["hinfo"])
+            size, _, _ = hinfo_decode(attrs["hinfo"])
         attrs.pop("hinfo", None)
         if size is None:
             return None  # no shard metadata reached us: can't size it
-        return ObjectState(buf[:size], attrs, omap)
+        return ObjectState(self._deinterleave(planes, size), attrs, omap)
 
     def object_names(self) -> List[str]:
         return sorted({o.name for o in self.store.collection_list(self.coll)
                        if o.name != "_pgmeta_" and o.snap == -2})
+
+    # -- partial-stripe overwrite (RMW, reference ECBackend.cc:1791) ------
+    def assemble_range(self, extents: Dict[int, bytes], s0: int,
+                       s1: int) -> Optional[bytes]:
+        """Shard extent payloads [s0*unit, s1*unit) -> logical bytes of
+        stripes [s0, s1); decodes when data shards are missing."""
+        L = (s1 - s0) * self.unit
+        arrs = {i: np.frombuffer(c, dtype=np.uint8)
+                for i, c in extents.items() if len(c) == L}
+        data_ids = [i for i in range(self.k)]
+        if not all(i in arrs for i in data_ids):
+            if len(arrs) < self.k:
+                return None
+            decoded = self.codec.decode_array(arrs, data_ids, L)
+            arrs.update({i: np.asarray(decoded[i]) for i in data_ids})
+        planes = np.stack([arrs[i] for i in data_ids])
+        S = s1 - s0
+        return planes.reshape(self.k, S, self.unit).transpose(
+            1, 0, 2).tobytes()
+
+    def can_partial(self, oid: str, off: int, length: int) -> bool:
+        """Partial-stripe fast path precondition: flat codec (array
+        codecs couple bytes across the whole chunk), locally known
+        size, and no size change."""
+        if self.codec.get_sub_chunk_count() != 1:
+            return False
+        size = self.local_size(oid)
+        return size is not None and off + length <= size
+
+    def read_cached_stripes(self, oid: str, s0: int,
+                            s1: int) -> Tuple[Dict[int, bytearray],
+                                              List[int]]:
+        stripes: Dict[int, bytearray] = {}
+        missing: List[int] = []
+        for s in range(s0, s1):
+            c = self.cache.get(oid, s)
+            if c is not None:
+                stripes[s] = bytearray(c)
+            else:
+                missing.append(s)
+        return stripes, missing
+
+    def submit_partial(self, oid: str, s0: int,
+                       stripes: Dict[int, bytearray], size: int,
+                       entries: List[LogEntry],
+                       log_omap: Dict[str, bytes],
+                       acting: Sequence[int],
+                       on_commit: Callable[[], None],
+                       log_rm: Optional[List[str]] = None) -> None:
+        """Write merged stripes [s0, s0+len) as per-shard EXTENTS — only
+        the touched stripes move (reference three-stage RMW,
+        ECBackend.cc:1791 start_rmw / :1892 try_reads_to_commit).
+
+        The caller has merged the new bytes into `stripes`, which must
+        be contiguous from s0; the merged content feeds the extent
+        cache so the next overlapping RMW skips its read phase.
+        """
+        S = len(stripes)
+        width = self.stripe_width
+        buf = b"".join(bytes(stripes[s]) for s in range(s0, s0 + S))
+        planes = np.frombuffer(buf, dtype=np.uint8).reshape(
+            S, self.k, self.unit).transpose(1, 0, 2)
+        planes = np.ascontiguousarray(planes.reshape(self.k, S * self.unit))
+        coding = np.asarray(self.queue.encode(self.codec, planes))
+        for s in range(s0, s0 + S):
+            self.cache.put(oid, s, bytes(stripes[s]))
+
+        n = self.k + self.m
+        shard_osds = list(acting[:n]) + [CRUSH_ITEM_NONE] * (n - len(acting))
+        tid = self._new_tid()
+        waiting = {(shard, osd) for shard, osd in enumerate(shard_osds)
+                   if osd != CRUSH_ITEM_NONE and osd >= 0}
+
+        def done() -> None:
+            self._done(tid)
+            on_commit()
+
+        op = InFlightOp(waiting, done)
+        self.in_flight[tid] = op
+        ext_off = s0 * self.unit
+        for shard, osd in enumerate(shard_osds):
+            if osd == CRUSH_ITEM_NONE or osd < 0:
+                continue
+            payload = (planes[shard] if shard < self.k
+                       else coding[shard - self.k]).tobytes()
+            t = Transaction()
+            g = GHObject(oid, shard=shard)
+            t.write(self.coll, g, ext_off, payload)
+            # whole-chunk crc can't survive an extent write (see _hinfo)
+            t.setattrs(self.coll, g, {"hinfo": _hinfo(b"", size, False)})
+            if log_omap:
+                t.touch(self.coll, _meta_oid())
+                t.omap_setkeys(self.coll, _meta_oid(), log_omap)
+            if log_rm:
+                t.omap_rmkeys(self.coll, _meta_oid(), log_rm)
+            if osd == self.whoami:
+                self.store.queue_transaction(t)
+                op.ack((shard, osd))
+            else:
+                msg = m.MECSubWrite(self.pgid, self.epoch_fn(), shard,
+                                    t.to_bytes(), entries)
+                msg.tid = tid
+                self.osd_send(osd, msg)

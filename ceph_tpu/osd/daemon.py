@@ -71,6 +71,12 @@ class OSDService(Dispatcher):
         self.wq = ShardedWorkQueue(
             f"osd{whoami}-op", ctx.conf.get("osd_op_num_shards"),
             process=lambda item: item())
+        # recovery slot throttle (reference AsyncReserver.h /
+        # osd_recovery_max_active): bounds concurrent object pushes
+        from ceph_tpu.core.reserver import AsyncReserver
+
+        self.recovery_reserver = AsyncReserver(
+            ctx.conf.get("osd_recovery_max_active"))
         self.up = False
         self._log = ctx.log.dout("osd")
         self.on_failure_report: Optional[Callable[[int], None]] = None
@@ -107,7 +113,8 @@ class OSDService(Dispatcher):
         self._map_lock = threading.Lock()
         self.monc.subscribe_osdmap(
             self._on_new_map,
-            since=self.osdmap.epoch if self.osdmap else 0)
+            since=self.osdmap.epoch if self.osdmap else 0,
+            base=self.osdmap)
 
         def _boot_loop() -> None:
             # a boot sent before the election settles is dropped by
@@ -264,7 +271,8 @@ class OSDService(Dispatcher):
                 if w:
                     w.add(msg)
             return True
-        if isinstance(msg, (m.MPGInfo, m.MScrubMap, m.MPGPushReply)):
+        if isinstance(msg, (m.MPGInfo, m.MScrubMap, m.MPGPushReply,
+                            m.MPGRecoveryProbeReply)):
             w = self._waiters.get(msg.tid)
             if w:
                 w.add(msg)
@@ -304,7 +312,7 @@ class OSDService(Dispatcher):
         # until commit — two primaries waiting on each other's shard
         # acks could deadlock on a shard-hash collision
         if isinstance(msg, (m.MOSDRepOp, m.MECSubWrite, m.MECSubRead,
-                            m.MPGQuery, m.MScrub)):
+                            m.MPGQuery, m.MScrub, m.MPGRecoveryProbe)):
             pg = self.pgs.get(msg.pgid)
             if pg is None:
                 return True
@@ -314,6 +322,8 @@ class OSDService(Dispatcher):
                 pg.handle_sub_write(msg, conn)
             elif isinstance(msg, m.MECSubRead):
                 pg.handle_sub_read(msg, conn)
+            elif isinstance(msg, m.MPGRecoveryProbe):
+                pg.handle_recovery_probe(msg, conn)
             elif isinstance(msg, m.MPGQuery):
                 pg.handle_query(msg, conn)
             elif isinstance(msg, m.MScrub):

@@ -302,7 +302,9 @@ class MPGPush(_PGMessage):
                  version: EVersion = EVersion(), data: bytes = b"",
                  attrs: Optional[Dict[str, bytes]] = None,
                  omap: Optional[Dict[str, bytes]] = None,
-                 shard: int = -1, deleted: bool = False) -> None:
+                 shard: int = -1, deleted: bool = False,
+                 off: int = 0, total: int = -1,
+                 more: bool = False) -> None:
         super().__init__(pgid, epoch)
         self.oid = oid
         self.version = version
@@ -311,6 +313,12 @@ class MPGPush(_PGMessage):
         self.omap = omap or {}
         self.shard = shard
         self.deleted = deleted
+        # chunked recovery (reference ObjectRecoveryProgress,
+        # ECBackend.cc:590-620): byte offset of this chunk, total bytes
+        # of the copy, and whether more chunks follow
+        self.off = off
+        self.total = total if total >= 0 else len(data)
+        self.more = more
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
@@ -321,6 +329,7 @@ class MPGPush(_PGMessage):
                   lambda enc, v: enc.blob(v))
         e.mapping(self.omap, lambda enc, k: enc.string(k),
                   lambda enc, v: enc.blob(v))
+        e.u64(self.off).u64(self.total).boolean(self.more)
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
@@ -331,6 +340,60 @@ class MPGPush(_PGMessage):
         self.deleted = d.boolean()
         self.attrs = d.mapping(lambda dd: dd.string(), lambda dd: dd.blob())
         self.omap = d.mapping(lambda dd: dd.string(), lambda dd: dd.blob())
+        if d.remaining_in_frame():
+            self.off = d.u64()
+            self.total = d.u64()
+            self.more = d.boolean()
+        else:
+            self.off, self.total, self.more = 0, len(self.data), False
+
+
+@register
+class MPGRecoveryProbe(_PGMessage):
+    """Primary -> peer: how far did a prior (interrupted) push of this
+    object get?  Resumable recovery starts from the answer instead of
+    byte 0 (reference ObjectRecoveryProgress.data_recovered_to)."""
+
+    TYPE = 26
+
+    def __init__(self, pgid=(0, 0), epoch=0, oid: str = "",
+                 version: EVersion = EVersion(), shard: int = -1) -> None:
+        super().__init__(pgid, epoch)
+        self.oid = oid
+        self.version = version
+        self.shard = shard
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.string(self.oid)
+        self.version.encode(e)
+        e.s32(self.shard)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.oid = d.string()
+        self.version = EVersion.decode(d)
+        self.shard = d.s32()
+
+
+@register
+class MPGRecoveryProbeReply(_PGMessage):
+    TYPE = 27
+
+    def __init__(self, pgid=(0, 0), epoch=0, oid: str = "",
+                 recovered_to: int = 0) -> None:
+        super().__init__(pgid, epoch)
+        self.oid = oid
+        self.recovered_to = recovered_to
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.string(self.oid).u64(self.recovered_to)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.oid = d.string()
+        self.recovered_to = d.u64()
 
 
 @register

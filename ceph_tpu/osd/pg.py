@@ -210,6 +210,14 @@ class PG:
                 reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                     msg.ops, result=0, version=done_v))
                 return
+        # partial-stripe EC overwrite fast path: a single ranged write
+        # inside the object moves only the touched stripes (reference
+        # start_rmw, ECBackend.cc:1791) instead of re-encoding the
+        # whole object
+        if (self.is_ec() and len(msg.ops) == 1
+                and msg.ops[0].op == t_.OP_WRITE and msg.ops[0].data
+                and self._try_partial_write(msg, reply)):
+            return
         # writes run START-TO-COMMIT on the pg's queue shard: the state
         # read is synchronous and we block on the commit before the next
         # queued op dispatches, so two writes to one object can never
@@ -310,6 +318,106 @@ class PG:
         cur = self.info.last_update
         return EVersion(self.osd.epoch(), cur.version + 1)
 
+    # -- partial-stripe EC overwrite (RMW) --------------------------------
+    def _ec_read_stripes(self, oid: str, s0: int, s1: int):
+        """Old content of stripes [s0, s1): local shard extents first,
+        then ranged sub-reads; decodes when data shards are missing
+        (reference try_state_to_reads, ECBackend.cc:1817)."""
+        be: ECBackend = self.backend  # type: ignore[assignment]
+        n = be.k + be.m
+        acting = list(self.acting[:n]) + [CRUSH_ITEM_NONE] * (
+            n - len(self.acting))
+        off, length = s0 * be.unit, (s1 - s0) * be.unit
+        extents: Dict[int, bytes] = {}
+        for shard in be.local_shards(acting):
+            c = be.read_local_chunk(oid, shard)
+            if c is not None and len(c) >= off + length:
+                extents[shard] = c[off: off + length]
+        if not set(range(be.k)) <= set(extents):
+            remote = [
+                (acting[s], m.MECSubRead(self.pgid, self.osd.epoch(), s,
+                                         oid, off, length))
+                for s in range(n)
+                if s not in extents
+                and acting[s] not in (self.osd.whoami, CRUSH_ITEM_NONE)
+                and acting[s] >= 0 and acting[s] not in self.stale_peers
+            ]
+            if remote:
+                for rep in self.osd.rpc(remote, timeout=10.0):
+                    if (isinstance(rep, m.MECSubReadReply)
+                            and rep.result == 0
+                            and len(rep.data) == length):
+                        extents[rep.shard] = rep.data
+        return be.assemble_range(extents, s0, s1)
+
+    def _try_partial_write(self, msg, reply) -> bool:
+        """Returns True when the write was handled as per-shard extent
+        writes of only the touched stripes."""
+        wop = msg.ops[0]
+        be: ECBackend = self.backend  # type: ignore[assignment]
+        if not be.can_partial(msg.oid, wop.off, len(wop.data)):
+            return False
+        width = be.stripe_width
+        s0 = wop.off // width
+        s1 = -(-(wop.off + len(wop.data)) // width)
+        committed = threading.Event()
+        _replied = [False]
+        _rlock = threading.Lock()
+
+        def reply_once(rep) -> None:
+            with _rlock:
+                if _replied[0]:
+                    return
+                _replied[0] = True
+            reply(rep)
+
+        # READ: recently-written stripes come from the extent cache
+        # (no shard reads), the rest from shard extents
+        stripes, missing = be.read_cached_stripes(msg.oid, s0, s1)
+        if missing:
+            lo, hi = min(missing), max(missing) + 1
+            old = self._ec_read_stripes(msg.oid, lo, hi)
+            if old is None:
+                return False
+            for s in range(lo, hi):
+                stripes.setdefault(s, bytearray(
+                    old[(s - lo) * width: (s - lo + 1) * width]))
+        # MODIFY: splice the new bytes into the touched stripes
+        end = wop.off + len(wop.data)
+        for s in range(s0, s1):
+            base = s * width
+            d0, d1 = max(wop.off, base), min(end, base + width)
+            stripes[s][d0 - base: d1 - base] = (
+                wop.data[d0 - wop.off: d1 - wop.off])
+        size = be.local_size(msg.oid)
+        with self.lock:
+            version = self._next_version()
+            entry = LogEntry(
+                op=t_.LOG_MODIFY, oid=msg.oid, version=version,
+                prior_version=self.info.last_update,
+                mtime=time.time(), reqid=getattr(msg, "reqid", ""))
+            self.log.append(entry)
+            self.info.last_update = version
+            self.info.last_complete = version
+            log_omap = self.log.omap_additions([entry])
+            log_rm = self.log.omap_removals(self.log.trim_to())
+
+            def on_commit() -> None:
+                self._note_reqid(entry)
+                reply_once(m.MOSDOpReply(
+                    self.pgid, self.osd.epoch(), msg.oid, msg.ops,
+                    result=0, version=version))
+                committed.set()
+
+            # WRITE: per-shard extents of the touched stripes only
+            be.submit_partial(msg.oid, s0, stripes, size, [entry],
+                              log_omap, self.acting, on_commit,
+                              log_rm=log_rm)
+        if not committed.wait(timeout=30.0):
+            reply_once(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                     msg.ops, result=EAGAIN))
+        return True
+
     def _commit_write(self, msg, state: Optional[ObjectState],
                       delete: bool, reply,
                       committed: Optional[threading.Event] = None) -> None:
@@ -386,6 +494,10 @@ class PG:
     def handle_sub_read(self, msg: m.MECSubRead, conn) -> None:
         assert isinstance(self.backend, ECBackend)
         data = self.backend.read_local_chunk(msg.oid, msg.shard)
+        if data is not None and msg.length:
+            # ranged sub-read (RMW old-stripe fetch): crc was verified
+            # over the whole chunk above, then the extent is sliced
+            data = data[msg.off: msg.off + msg.length]
         attrs, omap = self.backend.shard_meta(msg.oid, msg.shard)
         rep = m.MECSubReadReply(
             self.pgid, self.osd.epoch(), msg.shard, msg.oid,
@@ -518,8 +630,24 @@ class PG:
                 else:
                     for oid in sorted(peer_names - set(names)):
                         ok = self.push_delete(oid, osd_id) and ok
+            # every object push takes a recovery slot: concurrent PG
+            # recoveries on this OSD are throttled, not unbounded
+            # (reference AsyncReserver + osd_recovery_max_active).  A
+            # reservation timeout just leaves the peer stale for this
+            # round (retried on the next map/activate) — it must never
+            # unwind activation of the remaining PGs
+            reserver = getattr(self.osd, "recovery_reserver", None)
             for oid in names:
-                ok = self.push_object(oid, osd_id) and ok
+                if reserver is not None:
+                    if not reserver.reserve(timeout=30.0):
+                        ok = False
+                        continue
+                    try:
+                        ok = self.push_object(oid, osd_id) and ok
+                    finally:
+                        reserver.release()
+                else:
+                    ok = self.push_object(oid, osd_id) and ok
             if ok:
                 self.stale_peers.discard(osd_id)
 
@@ -530,11 +658,41 @@ class PG:
         return any(isinstance(r, m.MPGPushReply) for r in reps)
 
     def push_object(self, oid: str, to_osd: int) -> bool:
-        """Push the authoritative copy of one object to a peer; True
-        once the peer acked (reads may then trust its shards again)."""
-        msgs = self._build_pushes(oid, to_osd)
-        if not msgs:
+        """Push the authoritative copy of one object to a peer in
+        resumable chunks; True once the peer acked every chunk (reads
+        may then trust its shards again).
+
+        Before sending, the peer is probed for prior progress at this
+        version (an interrupted recovery resumes mid-object instead of
+        restarting — reference ObjectRecoveryProgress.data_recovered_to,
+        ECBackend.cc:590-620)."""
+        whole = self._build_pushes(oid, to_osd)
+        if not whole:
             return False
+        chunk = int(self.osd.ctx.conf.get("osd_recovery_chunk_size"))
+        msgs: List[m.MPGPush] = []
+        for msg in whole:
+            if msg.deleted or len(msg.data) <= chunk:
+                msgs.append(msg)
+                continue
+            start = 0
+            probes = self.osd.rpc(
+                [(to_osd, m.MPGRecoveryProbe(
+                    self.pgid, self.osd.epoch(), oid, msg.version,
+                    msg.shard))], timeout=10.0)
+            for rep in probes:
+                if isinstance(rep, m.MPGRecoveryProbeReply):
+                    start = min(rep.recovered_to, len(msg.data))
+            total = len(msg.data)
+            offs = list(range(start, total, chunk)) or [start]
+            for off in offs:
+                part = msg.data[off: off + chunk]
+                msgs.append(m.MPGPush(
+                    self.pgid, self.osd.epoch(), oid, msg.version,
+                    part, dict(msg.attrs) if off == 0 else {},
+                    dict(msg.omap) if off == 0 else {},
+                    shard=msg.shard, off=off, total=total,
+                    more=off + len(part) < total))
         reps = self.osd.rpc([(to_osd, msg) for msg in msgs], timeout=30.0)
         return sum(1 for r in reps
                    if isinstance(r, m.MPGPushReply)) >= len(msgs)
@@ -600,28 +758,73 @@ class PG:
                     for s in range(n):
                         t.try_remove(self.coll, GHObject(msg.oid, shard=s))
             else:
-                t.truncate(self.coll, g, 0)
-                t.write(self.coll, g, 0, msg.data)
-                attrs = dict(msg.attrs)
-                size = attrs.pop("_size_hint", None)
-                if msg.shard >= 0 and self.is_ec():
-                    from ceph_tpu.osd.backend import _hinfo
-
-                    attrs["hinfo"] = _hinfo(
-                        msg.data,
-                        int.from_bytes(size, "little") if size else
-                        len(msg.data) * self.backend.k)
-                t.setattrs(self.coll, g, attrs)
-                t.omap_clear(self.coll, g)
-                if msg.omap:
-                    t.omap_setkeys(self.coll, g, msg.omap)
+                final = not msg.more
+                if msg.off == 0:
+                    t.truncate(self.coll, g, 0)
+                t.write(self.coll, g, msg.off, msg.data)
+                if msg.off == 0:
+                    attrs = dict(msg.attrs)
+                    size = attrs.pop("_size_hint", None)
+                    if size is not None:
+                        # kept as a real xattr until the final chunk
+                        # (the EC hinfo needs it then)
+                        attrs["_size_hint"] = size
+                    t.setattrs(self.coll, g, attrs)
+                    t.omap_clear(self.coll, g)
+                    if msg.omap:
+                        t.omap_setkeys(self.coll, g, msg.omap)
+                if not final:
+                    # persisted resumable progress (survives our restart)
+                    e = Encoder()
+                    msg.version.encode(e)
+                    e.u64(msg.off + len(msg.data))
+                    t.setattrs(self.coll, g, {"_rprogress": e.bytes()})
+                else:
+                    t.rmattr(self.coll, g, "_rprogress")
             self.osd.store.queue_transaction(t)
-            if msg.version > self.info.last_update:
-                self.info.last_update = msg.version
-                self.info.last_complete = msg.version
-            self.missing.pop(msg.oid, None)
-            self._persist_meta()
+            if not msg.deleted and not msg.more and msg.shard >= 0 \
+                    and self.is_ec():
+                # final chunk of an EC shard: hinfo crc over the WHOLE
+                # chunk now on disk
+                from ceph_tpu.osd.backend import _hinfo
+
+                full = self.osd.store.read(self.coll, g)
+                try:
+                    size_b = self.osd.store.getattr(
+                        self.coll, g, "_size_hint")
+                    obj_size = int.from_bytes(size_b, "little")
+                except Exception:
+                    obj_size = len(full) * self.backend.k
+                t2 = Transaction()
+                t2.setattrs(self.coll, g, {"hinfo": _hinfo(full, obj_size)})
+                t2.rmattr(self.coll, g, "_size_hint")
+                self.osd.store.queue_transaction(t2)
+            if msg.deleted or not msg.more:
+                # object fully recovered (partial chunks keep it missing)
+                if msg.version > self.info.last_update:
+                    self.info.last_update = msg.version
+                    self.info.last_complete = msg.version
+                self.missing.pop(msg.oid, None)
+                self._persist_meta()
         rep = m.MPGPushReply(self.pgid, self.osd.epoch(), msg.oid, 0)
+        rep.tid = msg.tid
+        conn.send(rep)
+
+    def handle_recovery_probe(self, msg: m.MPGRecoveryProbe, conn) -> None:
+        """Answer with persisted partial-push progress for (oid, version)
+        — zero when there is none or the version moved on."""
+        recovered_to = 0
+        g = GHObject(msg.oid, shard=msg.shard)
+        try:
+            blob = self.osd.store.getattr(self.coll, g, "_rprogress")
+            d = Decoder(blob)
+            ver = EVersion.decode(d)
+            if ver == msg.version:
+                recovered_to = d.u64()
+        except Exception:
+            pass
+        rep = m.MPGRecoveryProbeReply(self.pgid, self.osd.epoch(),
+                                      msg.oid, recovered_to)
         rep.tid = msg.tid
         conn.send(rep)
 

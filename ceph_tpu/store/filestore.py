@@ -48,8 +48,12 @@ def _objkey(cid: Collection, oid: GHObject) -> str:
     return f"{cid.name}/{oid.name}/{oid.snap}/{oid.shard}"
 
 
+_COMP_MAGIC = b"CPRS"  # compressed-file header magic
+
+
 class FileStore(ObjectStore):
-    def __init__(self, path: str, wal_sync: bool = False) -> None:
+    def __init__(self, path: str, wal_sync: bool = False,
+                 compression: str | None = None) -> None:
         self.path = path
         self.wal_sync = wal_sync
         self._kv = LogKV(os.path.join(path, "meta.kv"))
@@ -58,6 +62,16 @@ class FileStore(ObjectStore):
         self._seq = 0
         self._lock = threading.RLock()
         self._mounted = False
+        # inline object-data compression (the BlueStore-compression
+        # role, reference src/compressor/ + BlueStore blob compression):
+        # whole-file writes compress when they save >= 1/8 (the
+        # reference's required_ratio); extent updates decompress once
+        # and store raw until the next full rewrite
+        self._comp = None
+        if compression and compression != "none":
+            from ceph_tpu.compress import instance as _comp_registry
+
+            self._comp = _comp_registry().factory(compression)
 
     # -- layout -----------------------------------------------------------
     def _datafile(self, cid: Collection, oid: GHObject) -> str:
@@ -237,9 +251,15 @@ class FileStore(ObjectStore):
         if code == os_.OP_TRUNCATE:
             path = self._datafile(op.cid, op.oid)
             os.makedirs(os.path.dirname(path), exist_ok=True)
+            size = op.off
+            if self._file_compressed(path):
+                content = self._load_file(path)
+                content = (content[:size] if len(content) >= size
+                           else content + b"\0" * (size - len(content)))
+                self._store_file(path, content, try_compress=False)
+                return
             with open(path, "ab") as f:
                 pass
-            size = op.off
             with open(path, "r+b") as f:
                 f.truncate(size)
             return
@@ -331,10 +351,74 @@ class FileStore(ObjectStore):
             return
         raise StoreError(f"unknown op {code}")
 
+    # -- compressed-file plumbing -----------------------------------------
+    def _file_compressed(self, path: str) -> bool:
+        try:
+            with open(path, "rb") as f:
+                return f.read(4) == _COMP_MAGIC
+        except OSError:
+            return False
+
+    def _load_file(self, path: str) -> bytes:
+        """Logical file content, transparently decompressed."""
+        if not os.path.exists(path):
+            return b""
+        with open(path, "rb") as f:
+            raw = f.read()
+        if not raw.startswith(_COMP_MAGIC):
+            return raw
+        alg_len = raw[4]
+        alg = raw[5: 5 + alg_len].decode()
+        body = raw[5 + alg_len + 8:]
+        if alg == "none":
+            return body
+        from ceph_tpu.compress import instance as _reg
+
+        return _reg().factory(alg).decompress(body)
+
+    def _store_file(self, path: str, data: bytes,
+                    try_compress: bool) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        payload = data
+        if self._comp is not None and try_compress and len(data) >= 4096:
+            comp = self._comp.compress(data)
+            hdr = 4 + 1 + len(self._comp.name) + 8
+            if hdr + len(comp) <= len(data) * 7 // 8:  # required_ratio
+                payload = (_COMP_MAGIC
+                           + bytes([len(self._comp.name)])
+                           + self._comp.name.encode()
+                           + len(data).to_bytes(8, "little") + comp)
+                with open(path, "wb") as f:
+                    f.write(payload)
+                return
+        if data.startswith(_COMP_MAGIC):
+            # escape raw content that collides with the header magic
+            payload = (_COMP_MAGIC + bytes([4]) + b"none"
+                       + len(data).to_bytes(8, "little") + data)
+        with open(path, "wb") as f:
+            f.write(payload)
+
     def _data_write(self, cid: Collection, oid: GHObject, off: int,
                     data: bytes) -> None:
         path = self._datafile(cid, oid)
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        # the RMW path is taken only when it can matter: the file is
+        # already compressed, or this is an off=0 write that could
+        # become compressed / needs the magic escape.  Plain extent
+        # writes to raw files keep the O(extent) direct path (a chunked
+        # recovery of a big object must not turn O(n^2))
+        if (self._file_compressed(path)
+                or (off == 0 and (self._comp is not None
+                                  or data.startswith(_COMP_MAGIC)))):
+            old = self._load_file(path)
+            buf = bytearray(old)
+            if len(buf) < off:
+                buf.extend(b"\0" * (off - len(buf)))
+            buf[off: off + len(data)] = data
+            # compress only full rewrites; extent updates store raw
+            full = off == 0 and len(data) >= len(old)
+            self._store_file(path, bytes(buf), try_compress=full)
+            return
         with open(path, "ab"):
             pass
         with open(path, "r+b") as f:
@@ -364,6 +448,10 @@ class FileStore(ObjectStore):
             path = self._datafile(cid, oid)
             if not os.path.exists(path):
                 return b""
+            if self._file_compressed(path):
+                content = self._load_file(path)
+                end = len(content) if length == 0 else off + length
+                return content[off:end]
             with open(path, "rb") as f:
                 f.seek(off)
                 return f.read() if length == 0 else f.read(length)
@@ -372,7 +460,15 @@ class FileStore(ObjectStore):
         with self._lock:
             self._check(cid, oid)
             path = self._datafile(cid, oid)
-            return os.path.getsize(path) if os.path.exists(path) else 0
+            if not os.path.exists(path):
+                return 0
+            if self._file_compressed(path):
+                with open(path, "rb") as f:
+                    raw = f.read(4 + 1 + 255 + 8)
+                alg_len = raw[4]
+                return int.from_bytes(
+                    raw[5 + alg_len: 5 + alg_len + 8], "little")
+            return os.path.getsize(path)
 
     def getattr(self, cid: Collection, oid: GHObject, name: str) -> bytes:
         with self._lock:

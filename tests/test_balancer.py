@@ -1,0 +1,83 @@
+"""Upmap balancer: full-sweep deviation optimization with upmap
+entries riding the real OSDMap pipeline (reference:
+src/pybind/mgr/balancer/module.py:644, src/osd/OSDMap.cc:2228)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import map as cmap
+from ceph_tpu.mgr import UpmapBalancer
+from ceph_tpu.osd import map_codec
+from ceph_tpu.osd.osdmap import (
+    CRUSH_ITEM_NONE,
+    OSDMap,
+    PGPool,
+    POOL_REPLICATED,
+)
+
+
+def build_map(n_osds=64, hosts=16, pg_num=256):
+    cm, root = cmap.build_flat_cluster(n_osds, hosts=hosts)
+    cm.add_simple_rule("r", root, 1, mode="firstn")
+    m = OSDMap(cm, max_osd=n_osds)
+    m.add_pool(PGPool(1, POOL_REPLICATED, size=3, min_size=2,
+                      pg_num=pg_num, pgp_num=pg_num, crush_rule=0))
+    return m
+
+
+def test_balancer_reduces_stddev():
+    m = build_map()
+    bal = UpmapBalancer(m, max_deviation=0.5, max_moves=48)
+    (rep,) = bal.optimize([1])
+    assert rep.moves, "natural CRUSH variance should yield moves"
+    assert rep.after_stddev < rep.before_stddev, (
+        f"stddev {rep.before_stddev:.2f} -> {rep.after_stddev:.2f}"
+    )
+
+
+def test_moves_respect_failure_domain():
+    m = build_map()
+    bal = UpmapBalancer(m, max_deviation=0.5, max_moves=32)
+    (rep,) = bal.optimize([1])
+    assert rep.moves
+    for pgid, _pairs in rep.moves:
+        _, _, acting, _ = m.pg_to_up_acting(pgid)
+        osds = [o for o in acting if o >= 0 and o != CRUSH_ITEM_NONE]
+        doms = [bal.domain_of[o] for o in osds]
+        assert len(set(doms)) == len(doms), (
+            f"pg {pgid}: two replicas share a host ({osds})"
+        )
+
+
+def test_upmap_entries_roundtrip_through_pipeline():
+    m = build_map()
+    bal = UpmapBalancer(m, max_deviation=0.5, max_moves=16)
+    (rep,) = bal.optimize([1])
+    assert rep.moves
+    pgid, pairs = rep.moves[0]
+    # scalar pipeline honors the entry
+    _, _, acting, _ = m.pg_to_up_acting(pgid)
+    for frm, to in pairs:
+        assert frm not in acting and to in acting
+    # vectorized sweep agrees with the scalar path
+    sweep = m.map_pgs(1)
+    row = [o for o in sweep["up"][pgid[1]] if o != CRUSH_ITEM_NONE]
+    assert row == [o for o in acting if o != CRUSH_ITEM_NONE]
+    # survives the map codec (mon distribution)
+    m2 = map_codec.decode_osdmap(map_codec.encode_osdmap(m))
+    assert m2.pg_upmap_items[pgid] == m.pg_upmap_items[pgid]
+    assert m2.pg_to_up_acting(pgid) == m.pg_to_up_acting(pgid)
+
+
+@pytest.mark.slow
+def test_balancer_large_skewed_map():
+    """The VERDICT target shape: a skewed 1024-OSD map improves in one
+    optimizer run driven by the device sweep."""
+    m = build_map(n_osds=1024, hosts=64, pg_num=1024)
+    # skew: one host's osds carry double weight
+    for osd in range(16):
+        m.reweight_osd(osd, 0x20000)
+    bal = UpmapBalancer(m, max_deviation=1.0, max_moves=32)
+    (rep,) = bal.optimize([1])
+    assert rep.after_stddev <= rep.before_stddev
+    assert rep.moves

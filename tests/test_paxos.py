@@ -145,11 +145,12 @@ def test_peon_promise_survives_restart():
 
 
 def test_promise_cleared_after_commit():
-    from ceph_tpu.osd import map_codec
+    from ceph_tpu.osd import map_inc
 
     kv = MemDB()
     mon, _sent = make_mon(rank=1, kv=kv)
-    val = map_codec.encode_osdmap(mon.osdmap)  # a decodable committed value
+    # a decodable committed value (FULL-tagged since round 3)
+    val = map_inc.encode_full_value(mon.osdmap)
     mon.state = STATE_PEON
     mon.accepted_pn = 100
     begin = mm.MMonPaxos(mm.MMonPaxos.BEGIN, 100, version=1, value=val)
