@@ -1,0 +1,17 @@
+"""Authentication: cephx-role tickets over shared-secret keyrings
+(reference: src/auth/, src/auth/cephx/)."""
+
+from ceph_tpu.auth.cephx import (
+    AuthError,
+    CephxClient,
+    CephxServer,
+    Ticket,
+    seal,
+    unseal,
+    verify_authorizer,
+)
+from ceph_tpu.auth.keyring import Keyring, generate_secret
+
+__all__ = ["AuthError", "CephxClient", "CephxServer", "Ticket",
+           "Keyring", "generate_secret", "seal", "unseal",
+           "verify_authorizer"]

@@ -49,10 +49,36 @@ class RadosClient:
         self.monc = None
 
     # -- bootstrap ---------------------------------------------------------
-    def connect(self, monmap, timeout: float = 10.0) -> "RadosClient":
+    def connect(self, monmap, timeout: float = 10.0,
+                auth=None) -> "RadosClient":
+        """auth: optional (entity_name, secret) pair for cephx — the
+        handshake yields the ticket every OSD session presents."""
         from ceph_tpu.mon.client import MonClient
 
         self.monc = MonClient(self.msgr, monmap)
+        if auth is not None:
+            import threading
+            import time as _time
+
+            self._cephx = self.monc.authenticate(auth[0], auth[1],
+                                                 timeout=timeout)
+            self.msgr.set_auth(
+                provider=lambda: self._cephx.build_authorizer())
+
+            def _renew() -> None:
+                # refresh the ticket before expiry; sessions opened
+                # after expiry would be rejected by every daemon
+                while self.monc is not None:
+                    left = self._cephx.expires - _time.time()
+                    _time.sleep(max(30.0, left - 600))
+                    try:
+                        self._cephx = self.monc.authenticate(
+                            auth[0], auth[1], timeout=timeout)
+                    except Exception:
+                        _time.sleep(30.0)
+
+            threading.Thread(target=_renew, daemon=True,
+                             name="cephx-renew").start()
         self.monc.subscribe_osdmap(
             lambda osdmap: self.objecter.handle_osdmap(osdmap))
         self.objecter.wait_for_map(timeout)
@@ -135,6 +161,15 @@ class IoCtx:
 
     def getxattr(self, oid: str, name: str) -> bytes:
         rep = self.operate(oid, [OSDOp(t_.OP_GETXATTR, name=name)])
+        self._check(rep)
+        return rep.ops[0].out_data
+
+    def call(self, oid: str, cls: str, method: str,
+             indata: bytes = b"") -> bytes:
+        """Execute an object-class method server-side (reference
+        IoCtx::exec over OP_CALL / src/cls/)."""
+        rep = self.operate(
+            oid, [OSDOp(t_.OP_CALL, name=f"{cls}.{method}", data=indata)])
         self._check(rep)
         return rep.ops[0].out_data
 

@@ -86,6 +86,10 @@ def _opts() -> List[Option]:
         O("osd_heartbeat_interval", float, 2.0, "osd peer ping period"),
         # -- osd ------------------------------------------------------------
         O("osd_op_num_shards", int, 4, "sharded op queue shards", runtime=False),
+        O("osd_op_queue", str, "wpq",
+          "op scheduler: wpq (priority) or mclock (QoS)", runtime=False),
+        O("osd_op_complaint_time", float, 30.0,
+          "seconds after which an op counts as slow (OpTracker)"),
         O("osd_max_write_size", int, 90 << 20, "largest single write"),
         O("osd_pool_default_size", int, 3, "replica count"),
         O("osd_pool_default_min_size", int, 0, "0 = size - size/2"),

@@ -1,0 +1,102 @@
+"""OpTracker — in-flight op tracking with slow-op and historic dumps.
+
+Reference role: src/common/TrackedOp.h + src/osd/OpRequest.h (the
+`ceph daemon <osd> dump_ops_in_flight / dump_historic_ops /
+dump_historic_slow_ops` surface): every tracked op records its arrival
+and a timeline of state events; completed ops feed a bounded history,
+slow ones (>= threshold) a separate ring so stalls leave evidence.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class TrackedOp:
+    __slots__ = ("tracker", "desc", "start", "events", "done_at")
+
+    def __init__(self, tracker: "OpTracker", desc: str) -> None:
+        self.tracker = tracker
+        self.desc = desc
+        self.start = time.monotonic()
+        self.events: List = [(0.0, "initiated")]
+        self.done_at: Optional[float] = None
+
+    def mark_event(self, event: str) -> "TrackedOp":
+        self.events.append((time.monotonic() - self.start, event))
+        return self
+
+    @property
+    def age(self) -> float:
+        end = self.done_at if self.done_at is not None else time.monotonic()
+        return end - self.start
+
+    def finish(self) -> None:
+        self.tracker.unregister(self)
+
+    def dump(self) -> Dict[str, Any]:
+        return {
+            "description": self.desc,
+            "age": round(self.age, 6),
+            "events": [{"t": round(t, 6), "event": e}
+                       for t, e in self.events],
+        }
+
+    # context-manager sugar
+    def __enter__(self) -> "TrackedOp":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.mark_event(f"aborted: {exc!r}")
+        self.finish()
+
+
+class OpTracker:
+    def __init__(self, slow_op_threshold: float = 1.0,
+                 history_size: int = 20, slow_history_size: int = 20):
+        self.slow_op_threshold = slow_op_threshold
+        self._lock = threading.Lock()
+        self._in_flight: Dict[int, TrackedOp] = {}
+        self._history = collections.deque(maxlen=history_size)
+        self._slow = collections.deque(maxlen=slow_history_size)
+        self.ops_tracked = 0
+        self.slow_ops = 0
+
+    def create_op(self, desc: str) -> TrackedOp:
+        op = TrackedOp(self, desc)
+        with self._lock:
+            self._in_flight[id(op)] = op
+            self.ops_tracked += 1
+        return op
+
+    def unregister(self, op: TrackedOp) -> None:
+        op.done_at = time.monotonic()
+        op.events.append((op.done_at - op.start, "done"))
+        with self._lock:
+            self._in_flight.pop(id(op), None)
+            self._history.append(op)
+            if op.age >= self.slow_op_threshold:
+                self._slow.append(op)
+                self.slow_ops += 1
+
+    # -- dumps (admin socket payloads) --------------------------------
+    def dump_in_flight(self) -> Dict[str, Any]:
+        with self._lock:
+            ops = sorted(self._in_flight.values(), key=lambda o: o.start)
+            return {"num_ops": len(ops),
+                    "ops": [o.dump() for o in ops]}
+
+    def dump_historic(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"num_ops": len(self._history),
+                    "ops": [o.dump() for o in self._history]}
+
+    def dump_slow(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"threshold": self.slow_op_threshold,
+                    "num_ops": len(self._slow),
+                    "ops": [o.dump() for o in self._slow]}

@@ -17,6 +17,18 @@ import threading
 from typing import Any, Callable, Hashable, List, Optional, Tuple
 
 
+def _prio_to_class(priority: int) -> str:
+    """WPQ priority -> mClock op class (the mClockOpClassQueue mapping
+    role: client ops at high priority, sub-ops mid, recovery/scrub low)."""
+    if priority >= 60:
+        return "client"
+    if priority >= 10:
+        return "osd_subop"
+    if priority >= 3:
+        return "recovery"
+    return "scrub"
+
+
 class ShardedWorkQueue:
     def __init__(
         self,
@@ -24,10 +36,20 @@ class ShardedWorkQueue:
         num_shards: int,
         process: Callable[[Any], None],
         on_error: Optional[Callable[[Any, BaseException], None]] = None,
+        scheduler: str = "wpq",
     ) -> None:
         self.name = name
         self.process = process
         self.on_error = on_error
+        self.scheduler = scheduler
+        if scheduler == "mclock":
+            from ceph_tpu.osd.mclock import MClockQueue
+
+            self._mclock: Optional[List] = [
+                MClockQueue() for _ in range(num_shards)
+            ]
+        else:
+            self._mclock = None
         self._shards: List[List[Tuple[int, int, Any]]] = [
             [] for _ in range(num_shards)
         ]
@@ -47,28 +69,42 @@ class ShardedWorkQueue:
         for t in self._threads:
             t.start()
 
-    def queue(self, token: Hashable, item: Any, priority: int = 63) -> None:
-        """Higher priority dispatches first; same token stays ordered."""
+    def queue(self, token: Hashable, item: Any, priority: int = 63,
+              qos_class: Optional[str] = None) -> None:
+        """Higher priority dispatches first; same token stays ordered.
+        Under the mclock scheduler, `qos_class` (or the priority
+        mapping) selects the dmClock reservation/weight/limit class."""
         if self._stop:
             raise RuntimeError(f"work queue {self.name} is stopped")
         shard = hash(token) % len(self._shards)
         with self._drain_cond:
             self._inflight += 1
         with self._conds[shard]:
-            heapq.heappush(
-                self._shards[shard], (-priority, next(self._seq), item)
-            )
+            if self._mclock is not None:
+                self._mclock[shard].enqueue(
+                    qos_class or _prio_to_class(priority), item)
+            else:
+                heapq.heappush(
+                    self._shards[shard], (-priority, next(self._seq), item)
+                )
             self._conds[shard].notify()
 
     def _worker(self, i: int) -> None:
         cond = self._conds[i]
         q = self._shards[i]
+        mq = self._mclock[i] if self._mclock is not None else None
         while True:
             with cond:
-                cond.wait_for(lambda: q or self._stop)
-                if self._stop and not q:
-                    return
-                _, _, item = heapq.heappop(q)
+                if mq is not None:
+                    cond.wait_for(lambda: len(mq) or self._stop)
+                    if self._stop and not len(mq):
+                        return
+                    _, item = mq.dequeue()
+                else:
+                    cond.wait_for(lambda: q or self._stop)
+                    if self._stop and not q:
+                        return
+                    _, _, item = heapq.heappop(q)
             try:
                 self.process(item)
             except BaseException as e:  # noqa: BLE001 — worker must survive

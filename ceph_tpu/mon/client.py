@@ -37,7 +37,7 @@ class MonClient(Dispatcher):
 
     # -- dispatch ---------------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
-        if isinstance(msg, mm.MMonCommandReply):
+        if isinstance(msg, (mm.MMonCommandReply, mm.MAuthReply)):
             with self._lock:
                 w = self._waiters.get(msg.tid)
             if w is not None:
@@ -111,12 +111,43 @@ class MonClient(Dispatcher):
 
     def _command_to(self, rank: int, cmd: dict,
                     timeout: float) -> Optional[mm.MMonCommandReply]:
+        return self._rpc_to(rank, mm.MMonCommand(cmd), timeout)
+
+    # -- authentication ---------------------------------------------------
+    def authenticate(self, name: str, secret: bytes,
+                     timeout: float = 10.0):
+        """Cephx handshake: challenge -> proof -> ticket.  Returns a
+        CephxClient whose build_authorizer() feeds Messenger.set_auth
+        (reference MonClient's auth phase + CephxClientHandler)."""
+        import secrets as _secrets
+
+        from ceph_tpu.auth import AuthError, CephxClient
+
+        cx = CephxClient(name, secret)
+        last = "no mon answered"
+        for rank in range(self.monmap.size):
+            rep = self._rpc_to(rank, mm.MAuth(
+                mm.MAuth.GET_CHALLENGE, name), timeout / 2)
+            if rep is None or rep.result != 0:
+                last = f"mon.{rank}: challenge refused"
+                continue
+            cc = _secrets.token_bytes(16)
+            proof = cx.make_proof(rep.challenge, cc)
+            rep2 = self._rpc_to(rank, mm.MAuth(
+                mm.MAuth.REQUEST, name, cc, proof), timeout / 2)
+            if rep2 is None or rep2.result != 0:
+                last = f"mon.{rank}: proof rejected"
+                continue
+            cx.accept_reply(rep2.sealed_client, rep2.ticket_blob)
+            return cx
+        raise AuthError(f"authentication failed for {name!r}: {last}")
+
+    def _rpc_to(self, rank: int, msg: Message, timeout: float):
         with self._lock:
             self._tid += 1
             tid = self._tid
             ev = threading.Event()
             self._waiters[tid] = [ev, None]
-        msg = mm.MMonCommand(cmd)
         msg.tid = tid
         self.msgr.send_message(msg, self.monmap.addrs[rank])
         ok = ev.wait(timeout)

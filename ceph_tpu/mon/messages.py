@@ -208,3 +208,57 @@ class MOSDFailure(Message):
     def decode_payload(self, d: Decoder) -> None:
         self.target = d.s32()
         self.failed_for = d.f64()
+
+
+@register
+class MAuth(Message):
+    """client/daemon -> mon: cephx handshake (reference MAuth over
+    src/auth/cephx/CephxProtocol.h ops)."""
+
+    TYPE = 38
+    GET_CHALLENGE = 1
+    REQUEST = 2
+
+    def __init__(self, op: int = 0, name: str = "",
+                 client_challenge: bytes = b"", proof: bytes = b"") -> None:
+        super().__init__()
+        self.op = op
+        self.name = name
+        self.client_challenge = client_challenge
+        self.proof = proof
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.u8(self.op).string(self.name)
+        e.blob(self.client_challenge).blob(self.proof)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.op = d.u8()
+        self.name = d.string()
+        self.client_challenge = d.blob()
+        self.proof = d.blob()
+
+
+@register
+class MAuthReply(Message):
+    """mon -> client: challenge or (sealed session key + ticket)."""
+
+    TYPE = 39
+
+    def __init__(self, result: int = 0, challenge: bytes = b"",
+                 sealed_client: bytes = b"",
+                 ticket_blob: bytes = b"") -> None:
+        super().__init__()
+        self.result = result
+        self.challenge = challenge
+        self.sealed_client = sealed_client
+        self.ticket_blob = ticket_blob
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.result).blob(self.challenge)
+        e.blob(self.sealed_client).blob(self.ticket_blob)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.result = d.s32()
+        self.challenge = d.blob()
+        self.sealed_client = d.blob()
+        self.ticket_blob = d.blob()

@@ -58,17 +58,34 @@ class MonMap:
 class Monitor(Dispatcher):
     def __init__(self, ctx, rank: int, monmap: MonMap,
                  kv=None, initial_map: Optional[OSDMap] = None,
-                 bind_port: int = 0) -> None:
+                 bind_port: int = 0, keyring=None) -> None:
         self.ctx = ctx
         self.rank = rank
         self.monmap = monmap
+        # cephx auth service (reference AuthMonitor/CephxServiceHandler):
+        # active when a keyring is provided; the MAuth exchange itself
+        # rides unauthenticated mon connections (as in the reference's
+        # connection-negotiation phase)
+        self.auth_server = None
+        if keyring is not None:
+            from ceph_tpu.auth import CephxServer
+
+            self.auth_server = CephxServer(keyring)
         self.kv = kv if kv is not None else MemDB()
         self.msgr = Messenger(ctx, EntityName("mon", rank),
                               bind_port=bind_port)
         self.msgr.add_dispatcher(self)
+        if self.auth_server is not None:
+            # the mon's own dial-backs (map pushes to daemons/clients)
+            # carry a self-minted ticket verifiable by the service key
+            self.msgr.set_auth(
+                provider=lambda: self.auth_server.mint_authorizer(
+                    f"mon.{rank}"))
         self._log = ctx.log.dout("mon")
         self._plog = ctx.log.dout("paxos")
-        self.lock = threading.RLock()
+        from ceph_tpu.core.lockdep import make_lock
+
+        self.lock = make_lock(f"mon{rank}")
 
         # election state
         self.state = STATE_ELECTING
@@ -937,7 +954,31 @@ class Monitor(Dispatcher):
         if isinstance(msg, mm.MOSDFailure):
             self._handle_failure(msg)
             return True
+        if isinstance(msg, mm.MAuth):
+            self._handle_auth(conn, msg)
+            return True
         return False
+
+    def _handle_auth(self, conn: Connection, msg: mm.MAuth) -> None:
+        from ceph_tpu.auth import AuthError
+
+        rep = mm.MAuthReply(result=-1)
+        if self.auth_server is not None:
+            try:
+                if msg.op == mm.MAuth.GET_CHALLENGE:
+                    rep = mm.MAuthReply(
+                        result=0,
+                        challenge=self.auth_server.get_challenge(msg.name))
+                elif msg.op == mm.MAuth.REQUEST:
+                    sealed, ticket = self.auth_server.handle_request(
+                        msg.name, msg.client_challenge, msg.proof)
+                    rep = mm.MAuthReply(result=0, sealed_client=sealed,
+                                        ticket_blob=ticket)
+            except AuthError as e:
+                self._log(1, f"auth denied for {msg.name!r}: {e}")
+                rep = mm.MAuthReply(result=-13)  # EACCES
+        rep.tid = msg.tid
+        conn.send(rep)
 
     def _handle_subscribe(self, conn: Connection,
                           msg: mm.MMonSubscribe) -> bool:

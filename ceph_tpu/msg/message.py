@@ -125,6 +125,19 @@ class MPing(Message):
 @register
 class MAck(Message):
     """Explicit ack carrier when there's no reverse traffic to piggyback
-    on (reference: the ack tag in the wire protocol)."""
+    on (reference: the ack tag in the wire protocol).  Doubles as the
+    session announce, optionally carrying a cephx authorizer blob the
+    acceptor verifies before attaching the session (reference: the
+    connect message's authorizer payload)."""
 
     TYPE = 2
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.auth_blob = b""
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.blob(self.auth_blob)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.auth_blob = d.blob() if d.remaining_in_frame() else b""

@@ -156,7 +156,19 @@ class Messenger:
         # (the reference's lossless-peer resend discipline)
         self._accepted_sessions: Dict[Tuple[str, int, int], Connection] = {}
         self._max_accepted_sessions = 256
+        # cephx hooks: provider() -> authorizer bytes attached to every
+        # session announce; verifier(blob) -> bool gates every accepted
+        # socket (reference: authorizer in the connect negotiation)
+        self._auth_provider = None
+        self._auth_verifier = None
         self._log = ctx.log.dout("ms") if ctx else (lambda lvl, s: None)
+
+    def set_auth(self, provider=None, verifier=None) -> None:
+        """provider() -> bytes | None; verifier(blob) -> bool."""
+        if provider is not None:
+            self._auth_provider = provider
+        if verifier is not None:
+            self._auth_verifier = verifier
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -241,6 +253,11 @@ class Messenger:
             announce.nonce = self.nonce
             announce.sid = conn.sid
             announce.ack_seq = conn.in_seq
+            if self._auth_provider is not None:
+                try:
+                    announce.auth_blob = self._auth_provider() or b""
+                except Exception:
+                    announce.auth_blob = b""
             ab = announce.to_bytes()
             writer.write(
                 _FRAME.pack(len(ab),
@@ -312,6 +329,21 @@ class Messenger:
             except Exception:
                 pass
             return
+        if self._auth_verifier is not None:
+            blob = getattr(first_msg, "auth_blob", b"")
+            ok = False
+            try:
+                ok = bool(self._auth_verifier(blob))
+            except Exception:
+                ok = False
+            if not ok:
+                self._log(1, f"rejecting unauthenticated session from "
+                             f"{first_msg.src} at {peer}")
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                return
         conn = self._resolve_accepted(first_msg, peer)
         conn._writer = writer
         self._accepted.add(conn)

@@ -178,10 +178,11 @@ class ReplicatedBackend(PGBackend):
         if state is None:
             t.try_remove(self.coll, g)
         else:
-            t.truncate(self.coll, g, 0)
+            # full-state REPLACE: drop-and-recreate so removed xattrs
+            # stay removed (setattrs merges; cls rmxattr would resurrect)
+            t.try_remove(self.coll, g)
             t.write(self.coll, g, 0, state.data)
             t.setattrs(self.coll, g, state.xattrs)
-            t.omap_clear(self.coll, g)
             if state.omap:
                 t.omap_setkeys(self.coll, g, state.omap)
         if log_omap:
@@ -379,12 +380,12 @@ class ECBackend(PGBackend):
         if state is None:
             t.try_remove(self.coll, g)
         else:
-            t.truncate(self.coll, g, 0)
+            # full-state REPLACE (see ReplicatedBackend._object_txn)
+            t.try_remove(self.coll, g)
             t.write(self.coll, g, 0, chunk or b"")
             attrs = dict(state.xattrs)
             attrs["hinfo"] = _hinfo(chunk or b"", len(state.data))
             t.setattrs(self.coll, g, attrs)
-            t.omap_clear(self.coll, g)
             if state.omap:
                 t.omap_setkeys(self.coll, g, state.omap)
         if log_omap:

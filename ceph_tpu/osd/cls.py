@@ -1,0 +1,235 @@
+"""cls — in-OSD object classes ("stored procedures").
+
+Reference role: src/objclass/ + src/osd/ClassHandler.cc and the
+src/cls/ plugin family: clients invoke `class.method` ON an object via
+OP_CALL and the method executes atomically inside the PG write path
+with direct access to the object's data/xattrs/omap.  RBD and RGW are
+built on these in the reference; here the registry hosts the same
+extension point with python callables (third parties register at
+runtime) plus the lock / refcount / version built-ins.
+
+Method signature: fn(ctx: MethodContext, indata: bytes) -> bytes
+(raise ClsError(errno) for failures).  WR-flagged methods run in the
+PG's serialized write pipeline and their mutations replicate like any
+write; RD methods run on the read path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+CLS_RD = 1
+CLS_WR = 2
+
+EBUSY, ENOENT, EINVAL, ENOTSUP = -16, -2, -22, -95
+
+
+class ClsError(Exception):
+    def __init__(self, errno: int, what: str = "") -> None:
+        super().__init__(what or f"cls error {errno}")
+        self.errno = errno
+
+
+class MethodContext:
+    """The object view a method mutates (reference cls_method_context_t
+    over the op's ObjectState)."""
+
+    def __init__(self, state, exists: bool, writable: bool) -> None:
+        self.state = state
+        self.exists = exists
+        self.writable = writable
+        self.delete_object = False
+
+    # -- reads ------------------------------------------------------------
+    def read(self, off: int = 0, length: int = 0) -> bytes:
+        if not self.exists:
+            raise ClsError(ENOENT)
+        end = off + length if length else len(self.state.data)
+        return self.state.data[off:end]
+
+    def getxattr(self, name: str) -> bytes:
+        if not self.exists or name not in self.state.xattrs:
+            raise ClsError(ENOENT)
+        return self.state.xattrs[name]
+
+    def omap_get(self, keys=None) -> Dict[str, bytes]:
+        if not self.exists:
+            raise ClsError(ENOENT)
+        if keys:
+            return {k: self.state.omap[k] for k in keys
+                    if k in self.state.omap}
+        return dict(self.state.omap)
+
+    # -- writes -----------------------------------------------------------
+    def _need_write(self) -> None:
+        if not self.writable:
+            raise ClsError(ENOTSUP, "WR method invoked on the read path")
+
+    def write_full(self, data: bytes) -> None:
+        self._need_write()
+        self.state.data = data
+        self.exists = True
+
+    def setxattr(self, name: str, value: bytes) -> None:
+        self._need_write()
+        self.state.xattrs[name] = value
+        self.exists = True
+
+    def rmxattr(self, name: str) -> None:
+        self._need_write()
+        self.state.xattrs.pop(name, None)
+
+    def omap_set(self, kv: Dict[str, bytes]) -> None:
+        self._need_write()
+        self.state.omap.update(kv)
+        self.exists = True
+
+    def remove(self) -> None:
+        self._need_write()
+        self.delete_object = True
+
+
+class ClassHandler:
+    """name -> (flags, fn) registry (reference ClassHandler::open_class;
+    python registration replaces dlopen)."""
+
+    _instance: "ClassHandler | None" = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._methods: Dict[str, Tuple[int, Callable]] = {}
+        _register_builtins(self)
+
+    @classmethod
+    def instance(cls) -> "ClassHandler":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def register(self, cls_name: str, method: str, flags: int,
+                 fn: Callable[[MethodContext, bytes], bytes]) -> None:
+        self._methods[f"{cls_name}.{method}"] = (flags, fn)
+
+    def get(self, full_name: str) -> Optional[Tuple[int, Callable]]:
+        return self._methods.get(full_name)
+
+    def is_write(self, full_name: str) -> bool:
+        got = self._methods.get(full_name)
+        return bool(got and got[0] & CLS_WR)
+
+    def names(self):
+        return sorted(self._methods)
+
+
+# -- built-in classes (reference src/cls/{lock,refcount,version}) ----------
+
+def _register_builtins(h: ClassHandler) -> None:
+    # cls_lock: advisory object locks in an xattr
+    def lock_lock(ctx: MethodContext, indata: bytes) -> bytes:
+        req = json.loads(indata.decode() or "{}")
+        name = req.get("name", "lock")
+        owner = req.get("owner", "")
+        ltype = req.get("type", "exclusive")
+        key = f"lock.{name}"
+        cur = None
+        if ctx.exists and key in ctx.state.xattrs:
+            cur = json.loads(ctx.state.xattrs[key].decode())
+        if cur:
+            if ltype == "shared" and cur["type"] == "shared":
+                if owner not in cur["owners"]:
+                    cur["owners"].append(owner)
+                ctx.setxattr(key, json.dumps(cur).encode())
+                return b""
+            if cur["owners"] != [owner]:
+                raise ClsError(EBUSY, f"lock {name} held")
+        ctx.setxattr(key, json.dumps(
+            {"type": ltype, "owners": [owner]}).encode())
+        return b""
+
+    def lock_unlock(ctx: MethodContext, indata: bytes) -> bytes:
+        req = json.loads(indata.decode() or "{}")
+        key = f"lock.{req.get('name', 'lock')}"
+        owner = req.get("owner", "")
+        try:
+            cur = json.loads(ctx.getxattr(key).decode())
+        except ClsError:
+            raise ClsError(ENOENT, "not locked")
+        if owner not in cur["owners"]:
+            raise ClsError(EBUSY, "not the lock owner")
+        cur["owners"].remove(owner)
+        if cur["owners"]:
+            ctx.setxattr(key, json.dumps(cur).encode())
+        else:
+            ctx.rmxattr(key)
+        return b""
+
+    def lock_info(ctx: MethodContext, indata: bytes) -> bytes:
+        req = json.loads(indata.decode() or "{}")
+        key = f"lock.{req.get('name', 'lock')}"
+        return ctx.getxattr(key)
+
+    h.register("lock", "lock", CLS_RD | CLS_WR, lock_lock)
+    h.register("lock", "unlock", CLS_RD | CLS_WR, lock_unlock)
+    h.register("lock", "get_info", CLS_RD, lock_info)
+
+    # cls_refcount: reference counting with delete-on-zero
+    def refcount_get(ctx: MethodContext, indata: bytes) -> bytes:
+        tag = indata.decode() or "default"
+        refs = set()
+        if ctx.exists and "refcount" in ctx.state.xattrs:
+            refs = set(json.loads(ctx.state.xattrs["refcount"].decode()))
+        refs.add(tag)
+        ctx.setxattr("refcount", json.dumps(sorted(refs)).encode())
+        return b""
+
+    def refcount_put(ctx: MethodContext, indata: bytes) -> bytes:
+        tag = indata.decode() or "default"
+        try:
+            refs = set(json.loads(ctx.getxattr("refcount").decode()))
+        except ClsError:
+            raise ClsError(ENOENT, "no refs")
+        refs.discard(tag)
+        if refs:
+            ctx.setxattr("refcount", json.dumps(sorted(refs)).encode())
+        else:
+            ctx.remove()  # last ref dropped: the object goes away
+        return b""
+
+    def refcount_read(ctx: MethodContext, indata: bytes) -> bytes:
+        try:
+            return ctx.getxattr("refcount")
+        except ClsError:
+            return b"[]"
+
+    h.register("refcount", "get", CLS_RD | CLS_WR, refcount_get)
+    h.register("refcount", "put", CLS_RD | CLS_WR, refcount_put)
+    h.register("refcount", "read", CLS_RD, refcount_read)
+
+    # cls_version: optimistic-concurrency object versions
+    def version_set(ctx: MethodContext, indata: bytes) -> bytes:
+        ctx.setxattr("cls_version", indata)
+        return b""
+
+    def version_get(ctx: MethodContext, indata: bytes) -> bytes:
+        try:
+            return ctx.getxattr("cls_version")
+        except ClsError:
+            return b"0"
+
+    def version_check(ctx: MethodContext, indata: bytes) -> bytes:
+        want = indata
+        have = b"0"
+        try:
+            have = ctx.getxattr("cls_version")
+        except ClsError:
+            pass
+        if have != want:
+            raise ClsError(EINVAL, f"version {have!r} != {want!r}")
+        return b""
+
+    h.register("version", "set", CLS_RD | CLS_WR, version_set)
+    h.register("version", "get", CLS_RD, version_get)
+    h.register("version", "check", CLS_RD, version_check)

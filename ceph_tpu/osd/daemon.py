@@ -70,13 +70,20 @@ class OSDService(Dispatcher):
         self._read_cbs: Dict[int, Callable] = {}
         self.wq = ShardedWorkQueue(
             f"osd{whoami}-op", ctx.conf.get("osd_op_num_shards"),
-            process=lambda item: item())
+            process=lambda item: item(),
+            scheduler=ctx.conf.get("osd_op_queue"))
         # recovery slot throttle (reference AsyncReserver.h /
         # osd_recovery_max_active): bounds concurrent object pushes
         from ceph_tpu.core.reserver import AsyncReserver
 
         self.recovery_reserver = AsyncReserver(
             ctx.conf.get("osd_recovery_max_active"))
+        # in-flight op history + slow-op evidence (reference
+        # TrackedOp.h / OpRequest.h, `dump_ops_in_flight`)
+        from ceph_tpu.core.optracker import OpTracker
+
+        self.op_tracker = OpTracker(
+            slow_op_threshold=ctx.conf.get("osd_op_complaint_time"))
         self.up = False
         self._log = ctx.log.dout("osd")
         self.on_failure_report: Optional[Callable[[int], None]] = None
@@ -101,13 +108,41 @@ class OSDService(Dispatcher):
         if self.osdmap is not None:
             self._load_pgs()
 
-    def boot(self, monmap) -> None:
+    def boot(self, monmap, keyring=None) -> None:
         """Join a mon-managed cluster: subscribe to maps, announce
         ourselves, route failure reports to the mon (reference
-        OSD::start_boot -> MOSDBoot)."""
+        OSD::start_boot -> MOSDBoot).  With a keyring, the daemon
+        authenticates via cephx and requires authorizers from every
+        inbound session (reference OSD's cephx wiring)."""
         from ceph_tpu.mon.client import MonClient
 
         self.monc = MonClient(self.msgr, monmap)
+        if keyring is not None:
+            from ceph_tpu.auth import AuthError, verify_authorizer
+
+            name = f"osd.{self.whoami}"
+            secret = keyring.get(name)
+            service = keyring.get("service")
+            if secret is not None:
+                self._cephx = self.monc.authenticate(name, secret)
+                self._cephx_cred = (name, secret)
+                # indirect through self._cephx so the boot loop can
+                # renew the ticket before it expires (the messenger
+                # provider runs on the event loop and must never block
+                # on a re-auth RPC itself)
+                provider = lambda: self._cephx.build_authorizer()  # noqa: E731
+                self.msgr.set_auth(provider=provider)
+                self.hb_msgr.set_auth(provider=provider)
+            if service is not None:
+                def _verify(blob, _svc=service):
+                    try:
+                        verify_authorizer(_svc, blob)
+                        return True
+                    except (AuthError, Exception):
+                        return False
+
+                self.msgr.set_auth(verifier=_verify)
+                self.hb_msgr.set_auth(verifier=_verify)
         self.on_failure_report = (
             lambda osd: self.monc.report_failure(osd))
         self._map_lock = threading.Lock()
@@ -127,10 +162,26 @@ class OSDService(Dispatcher):
                 if m_ is None or not m_.is_up(self.whoami):
                     self.monc.send_boot(self.whoami,
                                         hb_addr=self.hb_msgr.addr)
+                self._maybe_renew_ticket()
                 time.sleep(1.0)
 
         threading.Thread(target=_boot_loop, daemon=True,
                          name=f"osd{self.whoami}-boot").start()
+
+    def _maybe_renew_ticket(self) -> None:
+        """Re-authenticate before the cephx ticket expires: sessions
+        established after expiry would otherwise be rejected forever
+        (the reference's rotating-key refresh role)."""
+        cx = getattr(self, "_cephx", None)
+        if cx is None:
+            return
+        if cx.expires - time.time() > 600:
+            return  # plenty of validity left
+        try:
+            name, secret = self._cephx_cred
+            self._cephx = self.monc.authenticate(name, secret)
+        except Exception:
+            pass  # mon unreachable: retry next tick, old ticket may live
 
     def _on_new_map(self, osdmap: OSDMap) -> None:
         with self._map_lock:
@@ -286,14 +337,21 @@ class OSDService(Dispatcher):
                 conn.send(rep)
                 return True
             tid = msg.tid
+            top = self.op_tracker.create_op(
+                f"osd_op({msg.src} tid={tid} {msg.oid} "
+                f"{'+'.join(str(o.op) for o in msg.ops)} pg={msg.pgid})")
+            top.mark_event("queued_for_pg")
 
-            def run(pg=pg, msg=msg, conn=conn, tid=tid) -> None:
+            def run(pg=pg, msg=msg, conn=conn, tid=tid, top=top) -> None:
                 t0 = time.perf_counter()
                 is_w = any(o.is_write() for o in msg.ops)
+                top.mark_event("reached_pg")
 
                 def reply(rep: m.MOSDOpReply) -> None:
                     rep.tid = tid
                     conn.send(rep)
+                    top.mark_event(f"commit_sent r={rep.result}")
+                    top.finish()
                     if is_w:
                         self.perf.inc("op_w")
                         self.perf.tinc("op_w_latency",
@@ -304,7 +362,8 @@ class OSDService(Dispatcher):
                 pg.do_op(msg, reply)
 
             self.wq.queue(msg.pgid, run,
-                          priority=self.ctx.conf.get("osd_client_op_priority"))
+                          priority=self.ctx.conf.get("osd_client_op_priority"),
+                          qos_class="client")
             return True
         # replica-side applies and reads run INLINE on the dispatch
         # thread (ordered per session, fast local store work): if they
@@ -351,7 +410,8 @@ class OSDService(Dispatcher):
 
             self.wq.queue(msg.pgid, run,
                           priority=self.ctx.conf.get(
-                              "osd_recovery_op_priority"))
+                              "osd_recovery_op_priority"),
+                          qos_class="recovery")
             return True
         return False
 

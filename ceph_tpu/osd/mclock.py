@@ -1,0 +1,146 @@
+"""dmClock op scheduling — reservation / weight / limit QoS.
+
+Reference role: src/dmclock/ (the mClock algorithm) behind the OSD's
+mClockOpClassQueue (src/osd/mClockOpClassQueue.cc): each op class
+(client, osd-subop, recovery, scrub, ...) gets a QoS triple
+
+    reservation r  — the IOPS floor the class is guaranteed,
+    weight w       — how surplus capacity is shared,
+    limit l        — the IOPS ceiling the class may not exceed
+                     (0 = unlimited),
+
+and every enqueued op receives tags R/P/L advanced by 1/r, 1/w, 1/l
+from its class's previous op.  Dequeue runs the two dmClock phases:
+first any op whose reservation tag is due (smallest R wins — floors are
+honored before anything else), otherwise the smallest proportional-
+share tag P among classes whose limit tag is not in the future.  A
+work-conserving fallback serves the smallest P when every class is
+limit-throttled (the device should never idle while ops wait).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import time
+from typing import Any, Dict, Hashable, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientInfo:
+    """QoS triple for one op class (reference dmc::ClientInfo)."""
+
+    reservation: float = 0.0  # ops/sec floor (0 = none)
+    weight: float = 1.0       # proportional share
+    limit: float = 0.0        # ops/sec ceiling (0 = unlimited)
+
+
+# the reference's default class profile (mClockOpClassQueue shape)
+DEFAULT_CLASSES: Dict[str, ClientInfo] = {
+    "client": ClientInfo(reservation=100.0, weight=100.0, limit=0.0),
+    "osd_subop": ClientInfo(reservation=100.0, weight=80.0, limit=0.0),
+    "recovery": ClientInfo(reservation=20.0, weight=10.0, limit=200.0),
+    "scrub": ClientInfo(reservation=5.0, weight=5.0, limit=100.0),
+    "best_effort": ClientInfo(reservation=0.0, weight=1.0, limit=0.0),
+}
+
+
+class _ClassState:
+    __slots__ = ("info", "r_tag", "p_tag", "l_tag", "queue")
+
+    def __init__(self, info: ClientInfo) -> None:
+        import collections
+
+        self.info = info
+        self.r_tag = 0.0
+        self.p_tag = 0.0
+        self.l_tag = 0.0
+        # strict FIFO per class: deque for O(1) popleft on the hot path
+        self.queue: "collections.deque" = collections.deque()
+
+
+class MClockQueue:
+    """Single-lock dmClock queue: enqueue(cls, item) / dequeue()."""
+
+    def __init__(self, classes: Optional[Dict[str, ClientInfo]] = None,
+                 clock=time.monotonic) -> None:
+        self.clock = clock
+        self._classes: Dict[str, _ClassState] = {}
+        for name, info in (classes or DEFAULT_CLASSES).items():
+            self._classes[name] = _ClassState(info)
+        self._seq = itertools.count()
+        self._size = 0
+
+    def add_class(self, name: str, info: ClientInfo) -> None:
+        self._classes[name] = _ClassState(info)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def enqueue(self, cls: str, item: Any) -> None:
+        st = self._classes.get(cls)
+        if st is None:
+            st = self._classes.setdefault(
+                cls, _ClassState(DEFAULT_CLASSES["best_effort"]))
+        now = self.clock()
+        info = st.info
+        if not st.queue:
+            # tags only advance from the class's live stream; an idle
+            # class restarts from now (dmclock's tag reset on idle)
+            st.r_tag = max(st.r_tag, now)
+            st.p_tag = max(st.p_tag, now)
+            st.l_tag = max(st.l_tag, now)
+        if info.reservation > 0:
+            st.r_tag = max(st.r_tag + 1.0 / info.reservation, now)
+        else:
+            st.r_tag = float("inf")
+        st.p_tag = max(st.p_tag + 1.0 / max(info.weight, 1e-9), now)
+        if info.limit > 0:
+            st.l_tag = max(st.l_tag + 1.0 / info.limit, now)
+        else:
+            st.l_tag = now
+        st.queue.append((next(self._seq), item, st.r_tag, st.p_tag,
+                         st.l_tag))
+        self._size += 1
+
+    def dequeue(self) -> Optional[Tuple[str, Any]]:
+        if self._size == 0:
+            return None
+        now = self.clock()
+        # phase 1: due reservations, smallest R first (floors always win)
+        best = None
+        for name, st in self._classes.items():
+            if not st.queue:
+                continue
+            r = st.queue[0][2]
+            if r <= now and (best is None or r < best[0]):
+                best = (r, name)
+        if best is None:
+            # phase 2: proportional share among limit-eligible classes
+            for name, st in self._classes.items():
+                if not st.queue:
+                    continue
+                if st.queue[0][4] > now:
+                    continue  # limit tag in the future: throttled
+                p = st.queue[0][3]
+                if best is None or p < best[0]:
+                    best = (p, name)
+        if best is None:
+            # all throttled: work-conserving fallback on smallest P
+            for name, st in self._classes.items():
+                if not st.queue:
+                    continue
+                p = st.queue[0][3]
+                if best is None or p < best[0]:
+                    best = (p, name)
+        assert best is not None
+        name = best[1]
+        st = self._classes[name]
+        _, item, *_ = st.queue.popleft()
+        self._size -= 1
+        return name, item
+
+    def stats(self) -> Dict[str, int]:
+        return {name: len(st.queue)
+                for name, st in self._classes.items() if st.queue}

@@ -62,7 +62,10 @@ class PG:
         self.log = PGLog()
         self.acting: List[int] = []
         self.primary: int = -1
-        self.lock = threading.RLock()
+        from ceph_tpu.core.lockdep import make_lock
+
+        self.lock = make_lock(
+            f"osd{osd.whoami}.pg{t_.pgid_str(pgid)}")
         self.missing: Dict[str, EVersion] = {}  # objects this osd lacks
         self.peer_info: Dict[int, PGInfo] = {}
         # reqid -> committed version: completed-op replay so client
@@ -135,7 +138,8 @@ class PG:
                                     msg.ops, result=ESTALE)
                 reply(rep)
                 return
-            writes = any(o.is_write() for o in msg.ops)
+            writes = any(o.is_write() or self._call_is_write(o)
+                         for o in msg.ops)
         # _do_write manages the lock itself: it must NOT be held while
         # waiting for shard acks, or an inline replica apply (which
         # takes it) from a peer waiting on OUR ack deadlocks both
@@ -166,7 +170,39 @@ class PG:
 
         self._get_state(msg.oid, finish)
 
+    # -- cls object classes (reference ClassHandler / do_osd_ops
+    # CEPH_OSD_OP_CALL, PrimaryLogPG.cc:5651) --------------------------
+    @staticmethod
+    def _call_is_write(op: OSDOp) -> bool:
+        if op.op != t_.OP_CALL:
+            return False
+        from ceph_tpu.osd.cls import ClassHandler
+
+        return ClassHandler.instance().is_write(op.name)
+
+    def _exec_call(self, op: OSDOp, state, exists: bool,
+                   writable: bool) -> Tuple[int, bool]:
+        from ceph_tpu.osd.cls import ClassHandler, ClsError, MethodContext
+
+        got = ClassHandler.instance().get(op.name)
+        if got is None:
+            op.rval = EINVAL
+            return EINVAL, False
+        flags, fn = got
+        ctx = MethodContext(state, exists, writable)
+        try:
+            op.out_data = fn(ctx, op.data) or b""
+        except ClsError as e:
+            op.rval = e.errno
+            return e.errno, False
+        return 0, ctx.delete_object
+
     def _exec_read_op(self, op: OSDOp, state: Optional[ObjectState]) -> int:
+        if op.op == t_.OP_CALL:
+            exists = state is not None
+            rc, _ = self._exec_call(op, state or ObjectState(), exists,
+                                    writable=False)
+            return rc
         if state is None:
             if op.op in (t_.OP_STAT, t_.OP_READ, t_.OP_GETXATTR,
                          t_.OP_GETXATTRS, t_.OP_OMAP_GET):
@@ -242,11 +278,19 @@ class PG:
             delete = False
             result = 0
             for op in msg.ops:
-                if op.is_write():
+                if op.is_write() or self._call_is_write(op):
                     result, delete2 = self._exec_write_op(op, work, exists)
-                    delete = delete or delete2
-                    if result == 0 and op.op != t_.OP_DELETE:
-                        exists = True
+                    if result == 0:
+                        if delete2:
+                            # deletion is CURRENT state, not sticky: a
+                            # later op in the same message may recreate
+                            # the object from scratch
+                            delete = True
+                            exists = False
+                            work = ObjectState()
+                        else:
+                            exists = True
+                            delete = False
                 else:
                     result = self._exec_read_op(
                         op, None if not exists else work)
@@ -269,6 +313,8 @@ class PG:
     def _exec_write_op(self, op: OSDOp, st: ObjectState,
                        exists: bool) -> Tuple[int, bool]:
         o = op.op
+        if o == t_.OP_CALL:
+            return self._exec_call(op, st, exists, writable=True)
         if o == t_.OP_WRITE:
             end = op.off + len(op.data)
             buf = bytearray(st.data)
@@ -760,7 +806,9 @@ class PG:
             else:
                 final = not msg.more
                 if msg.off == 0:
-                    t.truncate(self.coll, g, 0)
+                    # replace semantics: stale xattrs must not survive
+                    # the recovered copy (setattrs merges)
+                    t.try_remove(self.coll, g)
                 t.write(self.coll, g, msg.off, msg.data)
                 if msg.off == 0:
                     attrs = dict(msg.attrs)
@@ -770,7 +818,8 @@ class PG:
                         # (the EC hinfo needs it then)
                         attrs["_size_hint"] = size
                     t.setattrs(self.coll, g, attrs)
-                    t.omap_clear(self.coll, g)
+                    # no omap_clear: the try_remove above already
+                    # dropped every old key
                     if msg.omap:
                         t.omap_setkeys(self.coll, g, msg.omap)
                 if not final:

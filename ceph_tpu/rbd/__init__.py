@@ -1,0 +1,6 @@
+"""RBD-role block images over striped RADOS objects (reference:
+src/librbd/)."""
+
+from ceph_tpu.rbd.image import RBD, Image, ImageBusy, ImageNotFound
+
+__all__ = ["RBD", "Image", "ImageBusy", "ImageNotFound"]

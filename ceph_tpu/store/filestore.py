@@ -271,12 +271,10 @@ class FileStore(ObjectStore):
             elif not self._require(op.cid, op.oid, replay):
                 return
             b.rmkey(P_OBJ, key)
-            for k, _ in list(self._kv.iterate(P_XATTR)):
-                if k.startswith(key + "/"):
-                    b.rmkey(P_XATTR, k)
-            for k, _ in list(self._kv.iterate(P_OMAP)):
-                if k.startswith(key + "/"):
-                    b.rmkey(P_OMAP, k)
+            for k, _ in list(self._kv.iterate_prefix(P_XATTR, key + "/")):
+                b.rmkey(P_XATTR, k)
+            for k, _ in list(self._kv.iterate_prefix(P_OMAP, key + "/")):
+                b.rmkey(P_OMAP, k)
             try:
                 os.unlink(self._datafile(op.cid, op.oid))
             except FileNotFoundError:
@@ -305,12 +303,10 @@ class FileStore(ObjectStore):
                     data = f.read()
             with open(dst_file, "wb") as f:
                 f.write(data)
-            for k, v in list(self._kv.iterate(P_XATTR)):
-                if k.startswith(key + "/"):
-                    b.set(P_XATTR, dkey + k[len(key):], v)
-            for k, v in list(self._kv.iterate(P_OMAP)):
-                if k.startswith(key + "/"):
-                    b.set(P_OMAP, dkey + k[len(key):], v)
+            for k, v in list(self._kv.iterate_prefix(P_XATTR, key + "/")):
+                b.set(P_XATTR, dkey + k[len(key):], v)
+            for k, v in list(self._kv.iterate_prefix(P_OMAP, key + "/")):
+                b.set(P_OMAP, dkey + k[len(key):], v)
             return
         if code == os_.OP_OMAP_SETKEYS:
             for name, val in op.attrs.items():
@@ -325,9 +321,8 @@ class FileStore(ObjectStore):
         if code == os_.OP_OMAP_CLEAR:
             if not self._require(op.cid, op.oid, replay):
                 return
-            for k, _ in list(self._kv.iterate(P_OMAP)):
-                if k.startswith(key + "/"):
-                    b.rmkey(P_OMAP, k)
+            for k, _ in list(self._kv.iterate_prefix(P_OMAP, key + "/")):
+                b.rmkey(P_OMAP, k)
             return
         if code == os_.OP_COLL_MOVE_RENAME:
             if not self._require(op.cid, op.oid, replay):
@@ -340,14 +335,12 @@ class FileStore(ObjectStore):
             os.makedirs(os.path.dirname(dst_file), exist_ok=True)
             if os.path.exists(src_file):
                 os.replace(src_file, dst_file)
-            for k, v in list(self._kv.iterate(P_XATTR)):
-                if k.startswith(key + "/"):
-                    b.set(P_XATTR, dkey + k[len(key):], v)
-                    b.rmkey(P_XATTR, k)
-            for k, v in list(self._kv.iterate(P_OMAP)):
-                if k.startswith(key + "/"):
-                    b.set(P_OMAP, dkey + k[len(key):], v)
-                    b.rmkey(P_OMAP, k)
+            for k, v in list(self._kv.iterate_prefix(P_XATTR, key + "/")):
+                b.set(P_XATTR, dkey + k[len(key):], v)
+                b.rmkey(P_XATTR, k)
+            for k, v in list(self._kv.iterate_prefix(P_OMAP, key + "/")):
+                b.set(P_OMAP, dkey + k[len(key):], v)
+                b.rmkey(P_OMAP, k)
             return
         raise StoreError(f"unknown op {code}")
 

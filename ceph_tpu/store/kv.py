@@ -49,6 +49,16 @@ class KeyValueDB:
         """Sorted (key, value) pairs under prefix."""
         raise NotImplementedError
 
+    def iterate_prefix(self, space: str,
+                       key_prefix: str) -> Iterator[Tuple[str, bytes]]:
+        """Sorted (key, value) pairs in `space` whose key starts with
+        key_prefix — the ranged-iterator shape RocksDB serves with a
+        seek (reference KeyValueDB::IteratorImpl::lower_bound); scan
+        stores filter, ordered stores may seek."""
+        for k, v in self.iterate(space):
+            if k.startswith(key_prefix):
+                yield k, v
+
 
 class MemDB(KeyValueDB):
     def __init__(self) -> None:

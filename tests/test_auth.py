@@ -1,0 +1,256 @@
+"""cephx-role authentication: protocol units, messenger session gating,
+and an authenticated mon+osd+client cluster (reference:
+src/auth/cephx/CephxProtocol.h, src/auth/KeyRing.cc)."""
+
+import socket
+import time
+
+import pytest
+
+from ceph_tpu.auth import (
+    AuthError,
+    CephxClient,
+    CephxServer,
+    Keyring,
+    seal,
+    unseal,
+    verify_authorizer,
+)
+from ceph_tpu.core.context import Context
+from ceph_tpu.msg.message import EntityName, Message, register
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+
+
+# -- crypto / protocol units ------------------------------------------------
+
+def test_seal_unseal_roundtrip_and_tamper():
+    key = b"k" * 32
+    blob = seal(key, b"secret payload")
+    assert unseal(key, blob) == b"secret payload"
+    with pytest.raises(AuthError):
+        unseal(key, blob[:-1] + bytes([blob[-1] ^ 1]))
+    with pytest.raises(AuthError):
+        unseal(b"x" * 32, blob)
+
+
+def _handshake(server, name, secret):
+    import secrets
+
+    cx = CephxClient(name, secret)
+    ch = server.get_challenge(name)
+    cc = secrets.token_bytes(16)
+    sealed, ticket = server.handle_request(
+        name, cc, cx.make_proof(ch, cc))
+    cx.accept_reply(sealed, ticket)
+    return cx
+
+
+def test_handshake_and_authorizer():
+    kr = Keyring()
+    kr.add("service")
+    secret = kr.add("client.1")
+    server = CephxServer(kr)
+    cx = _handshake(server, "client.1", secret)
+    assert cx.authenticated
+    ticket = verify_authorizer(server.service_secret,
+                               cx.build_authorizer())
+    assert ticket.name == "client.1"
+    # session key is confidential: only the right entity secret unseals
+    assert cx.session_key == ticket.session_key
+
+
+def test_wrong_secret_rejected():
+    kr = Keyring()
+    kr.add("service")
+    kr.add("client.1")
+    server = CephxServer(kr)
+    with pytest.raises(AuthError):
+        _handshake(server, "client.1", b"wrong" * 8)
+    with pytest.raises(AuthError):
+        _handshake(server, "client.ghost", b"x" * 32)
+
+
+def test_expired_ticket_rejected():
+    kr = Keyring()
+    kr.add("service")
+    secret = kr.add("client.1")
+    server = CephxServer(kr)
+    cx = _handshake(server, "client.1", secret)
+    blob = cx.build_authorizer()
+    with pytest.raises(AuthError):
+        verify_authorizer(server.service_secret, blob,
+                          now=time.time() + 7200)
+
+
+def test_forged_ticket_rejected():
+    kr = Keyring()
+    kr.add("service")
+    secret = kr.add("client.1")
+    server = CephxServer(kr)
+    cx = _handshake(server, "client.1", secret)
+    # a client who knows only its OWN secret cannot mint tickets
+    from ceph_tpu.auth.cephx import Ticket
+
+    fake = Ticket("client.evil", "allow *", b"s" * 32,
+                  time.time() + 600)
+    forged = seal(secret, fake.encode())  # sealed with the WRONG key
+    import struct
+    import hmac as _hmac
+    import hashlib
+    from ceph_tpu.core.encoding import Encoder
+
+    e = Encoder()
+    e.start(1, 1)
+    stamp = time.time()
+    e.blob(forged).f64(stamp)
+    e.blob(_hmac.new(b"s" * 32, b"authorizer" + struct.pack("<d", stamp),
+                     hashlib.sha256).digest())
+    e.finish()
+    with pytest.raises(AuthError):
+        verify_authorizer(server.service_secret, e.bytes())
+
+
+def test_keyring_file_roundtrip(tmp_path):
+    kr = Keyring()
+    kr.add("mon.")
+    kr.add("osd.0")
+    kr.add("client.admin")
+    p = str(tmp_path / "keyring")
+    kr.save(p)
+    kr2 = Keyring.load(p)
+    assert kr2.names() == kr.names()
+    for n in kr.names():
+        assert kr2.get(n) == kr.get(n)
+
+
+# -- messenger session gating ------------------------------------------------
+
+@register
+class _MPing(Message):
+    TYPE = 99
+
+
+class _Sink(Dispatcher):
+    def __init__(self):
+        self.got = []
+
+    def ms_dispatch(self, conn, msg):
+        self.got.append(msg)
+        return True
+
+
+def test_messenger_rejects_unauthenticated_sessions():
+    kr = Keyring()
+    kr.add("service")
+    secret = kr.add("client.7")
+    server = CephxServer(kr)
+    cx = _handshake(server, "client.7", secret)
+
+    ctx = Context("authtest")
+    sink = _Sink()
+    acceptor = Messenger(ctx, EntityName("osd", 0))
+    acceptor.add_dispatcher(sink)
+
+    def _verify(blob):
+        try:
+            verify_authorizer(server.service_secret, blob)
+            return True
+        except Exception:
+            return False
+
+    acceptor.set_auth(verifier=_verify)
+    acceptor.start()
+
+    good = Messenger(ctx, EntityName("client", 7))
+    good.set_auth(provider=cx.build_authorizer)
+    good.start()
+    bad = Messenger(ctx, EntityName("client", 666))
+    bad.start()  # no authorizer at all
+    try:
+        good.send_message(_MPing(), acceptor.addr)
+        deadline = time.time() + 5
+        while time.time() < deadline and not sink.got:
+            time.sleep(0.05)
+        assert sink.got, "authenticated session was not delivered"
+
+        n_before = len(sink.got)
+        bad.send_message(_MPing(), acceptor.addr)
+        time.sleep(1.0)
+        assert len(sink.got) == n_before, (
+            "unauthenticated session delivered a message"
+        )
+    finally:
+        good.shutdown()
+        bad.shutdown()
+        acceptor.shutdown()
+
+
+# -- authenticated cluster ----------------------------------------------------
+
+def test_authenticated_cluster_io():
+    """mon issues tickets; OSDs require authorizers; an authenticated
+    client does IO while a wrong-key client cannot even authenticate."""
+    from ceph_tpu.client import RadosClient
+    from ceph_tpu.crush import map as cmap
+    from ceph_tpu.ec import codec_from_profile
+    from ceph_tpu.mon import MonMap, Monitor
+    from ceph_tpu.osd.daemon import OSDService
+    from ceph_tpu.osd.osdmap import OSDMap, PGPool, POOL_REPLICATED
+    from ceph_tpu.store.memstore import MemStore
+
+    kr = Keyring()
+    kr.add("service")
+    for i in range(3):
+        kr.add(f"osd.{i}")
+    admin_secret = kr.add("client.admin")
+
+    cm, root = cmap.build_flat_cluster(3, hosts=3)
+    cm.add_simple_rule("r", root, 1, mode="firstn")
+    seed = OSDMap(cm, max_osd=3)
+    seed.osd_state_up[:] = False
+    seed.add_pool(PGPool(1, POOL_REPLICATED, size=2, min_size=1,
+                         pg_num=4, pgp_num=4, crush_rule=0))
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ctx = Context("authcluster", {"mon_tick_interval": 0.3})
+    monmap = MonMap([("127.0.0.1", port)])
+    mon = Monitor(ctx, 0, monmap, initial_map=seed, bind_port=port,
+                  keyring=kr)
+    mon.start()
+    osds = []
+    cl = None
+    try:
+        for i in range(3):
+            svc = OSDService(ctx, i, MemStore(), None,
+                             codec_from_profile)
+            svc.store.mkfs()
+            svc.init()
+            svc.boot(monmap, keyring=kr)
+            osds.append(svc)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if mon.osdmap is not None and all(
+                    mon.osdmap.is_up(i) for i in range(3)):
+                break
+            time.sleep(0.2)
+        assert all(mon.osdmap.is_up(i) for i in range(3)), "osds not up"
+
+        cl = RadosClient(ctx).connect(
+            monmap, auth=("client.admin", admin_secret))
+        io = cl.ioctx(1)
+        io.write_full("authobj", b"authenticated!" * 50)
+        assert io.read("authobj") == b"authenticated!" * 50
+
+        # wrong key: the mon refuses the handshake outright
+        with pytest.raises(AuthError):
+            RadosClient(ctx).connect(
+                monmap, auth=("client.admin", b"bad" * 8))
+    finally:
+        if cl is not None:
+            cl.shutdown()
+        for o in osds:
+            o.shutdown()
+        mon.shutdown()

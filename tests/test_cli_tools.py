@@ -1,0 +1,132 @@
+"""Reference-flag-compatible CLI harnesses (reference:
+src/tools/crushtool.cc, src/tools/osdmaptool.cc,
+src/test/erasure-code/ceph_erasure_code_benchmark.cc,
+src/common/obj_bencher.h)."""
+
+import contextlib
+import io
+import json
+import os
+import sys
+
+import pytest
+
+
+def _capture(fn, argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = fn(argv)
+    return rc, buf.getvalue()
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+sys.path.insert(0, os.path.abspath(TOOLS))
+
+import crushtool  # noqa: E402
+import ec_benchmark  # noqa: E402
+import osdmaptool  # noqa: E402
+from rados_bench import ObjBencher  # noqa: E402
+
+
+def test_crushtool_build_and_test(tmp_path):
+    mapfn = str(tmp_path / "map.bin")
+    rc, _ = _capture(crushtool.main, ["--build", "--num_osds", "16",
+                                      "host", "straw2", "4",
+                                      "root", "straw2", "0",
+                                      "-o", mapfn])
+    assert rc == 0 and os.path.exists(mapfn)
+    rc, text = _capture(crushtool.main,
+                        ["-i", mapfn, "--test", "--num-rep", "3",
+                         "--min-x", "0", "--max-x", "499",
+                         "--show-statistics", "--show-utilization"])
+    assert rc == 0
+    out = json.loads(text)
+    st = out["statistics"]
+    assert st["total_mappings"] == 500 and st["bad_mappings"] == 0
+    u = st["device_utilization"]
+    assert u["min"] > 0 and abs(u["mean"] - 500 * 3 / 16) < 1
+    assert len(out["utilization"]) == 16
+
+
+def test_crushtool_weights_zero_out_device(tmp_path):
+    mapfn = str(tmp_path / "m.bin")
+    _capture(crushtool.main, ["--build", "--num_osds", "8",
+                              "root", "straw2", "0", "-o", mapfn])
+    rc, text = _capture(crushtool.main,
+                        ["-i", mapfn, "--test", "--num-rep", "2",
+                         "--max-x", "299", "--show-utilization",
+                         "--weight", "3", "0"])
+    assert rc == 0
+    out = json.loads(text)
+    assert out["utilization"]["osd.3"] == 0
+
+
+def test_osdmaptool_createsimple_and_test_map_pgs(tmp_path):
+    mapfn = str(tmp_path / "osdmap.bin")
+    rc, _ = _capture(osdmaptool.main,
+                     ["--createsimple", "16", "--pg_num", "64",
+                      "-o", mapfn])
+    assert rc == 0 and os.path.exists(mapfn)
+    rc, text = _capture(osdmaptool.main, [mapfn, "--test-map-pgs"])
+    assert rc == 0
+    out = json.loads(text)
+    assert out["pool_pgs_examined"] == 64
+    assert sum(out["osd_pg_counts"].values()) == 64 * 3
+    assert out["summary"]["max"] >= out["summary"]["min"] > 0
+
+
+def test_osdmaptool_upmap(tmp_path):
+    mapfn = str(tmp_path / "osdmap2.bin")
+    _capture(osdmaptool.main, ["--createsimple", "24", "--pg_num", "128",
+                               "-o", mapfn])
+    rc, text = _capture(osdmaptool.main,
+                        [mapfn, "--upmap", "--upmap-max", "16",
+                         "--upmap-deviation", "0.5"])
+    assert rc == 0
+    out = json.loads(text)
+    assert out["upmaps"], "no upmap entries emitted"
+    sd = out["stddev"]["pool.1"]
+    assert sd["after"] <= sd["before"]
+
+
+@pytest.mark.parametrize("workload", ["encode", "decode"])
+def test_ec_benchmark_reference_flags(workload):
+    rc, text = _capture(ec_benchmark.main, [
+        "--plugin", "jerasure", "--workload", workload,
+        "--size", "65536", "--iterations", "3",
+        "-P", "k=4", "-P", "m=2", "-P", "technique=reed_sol_van",
+        "--erasures", "2", "--verify",
+    ])
+    assert rc == 0
+    out = text.strip()
+    secs, kib = out.split("\t")  # the reference's exact output shape
+    assert float(secs) > 0
+    assert int(kib) == 65536 * 3 // 1024
+
+
+def test_ec_benchmark_pinned_erasures():
+    rc, _ = _capture(ec_benchmark.main, [
+        "--plugin", "isa", "--workload", "decode",
+        "--size", "16384", "--iterations", "2",
+        "-P", "k=4", "-P", "m=2",
+        "--erased", "0", "--erased", "5", "--verify",
+    ])
+    assert rc == 0
+
+
+def test_obj_bencher(tmp_path):
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_osd_cluster import MiniCluster, LibClient, REP_POOL
+
+    c = MiniCluster()
+    cl = LibClient(c)
+    try:
+        b = ObjBencher(cl.rc.ioctx(REP_POOL))
+        w = b.write(seconds=1.0, threads=4, size=4096)
+        assert w["total_ops"] > 0 and w["errors"] == 0
+        assert w["mb_per_sec"] > 0
+        r = b.seq(seconds=0.5, threads=4)
+        assert r["total_ops"] > 0 and r["errors"] == 0
+        b.cleanup()
+    finally:
+        cl.shutdown()
+        c.shutdown()

@@ -1,0 +1,117 @@
+"""lockdep lock-order cycle detection (reference: src/common/lockdep.cc,
+mutex_debug.h) — plus a lockdep-enabled cluster smoke run."""
+
+import threading
+
+import pytest
+
+from ceph_tpu.core import lockdep
+from ceph_tpu.core.lockdep import DMutex, LockOrderError, make_lock
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_on():
+    lockdep.reset()
+    lockdep.enable(True)
+    yield
+    lockdep.enable(False)
+    lockdep.reset()
+
+
+def test_consistent_order_is_clean():
+    a, b = DMutex("A"), DMutex("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+
+
+def test_cycle_detected():
+    a, b = DMutex("A"), DMutex("B")
+    with a:
+        with b:
+            pass
+    with pytest.raises(LockOrderError) as ei:
+        with b:
+            with a:
+                pass
+    assert "A" in str(ei.value) and "B" in str(ei.value)
+
+
+def test_transitive_cycle_detected():
+    a, b, c = DMutex("A"), DMutex("B"), DMutex("C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(LockOrderError):
+        with c:
+            with a:
+                pass
+
+
+def test_reentrant_is_not_a_cycle():
+    a = DMutex("A")
+    with a:
+        with a:  # re-entrancy must not self-edge
+            pass
+
+
+def test_per_thread_held_stacks():
+    a, b = DMutex("A"), DMutex("B")
+    errs = []
+
+    def t1():
+        try:
+            with a:
+                with b:
+                    pass
+        except LockOrderError as e:
+            errs.append(e)
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+    assert not errs
+    # the reverse order from THIS thread still trips on t1's edges
+    with pytest.raises(LockOrderError):
+        with b:
+            with a:
+                pass
+
+
+def test_make_lock_plain_when_disabled():
+    lockdep.enable(False)
+    lk = make_lock("whatever")
+    assert not isinstance(lk, DMutex)
+    lockdep.enable(True)
+    assert isinstance(make_lock("x"), DMutex)
+
+
+def test_cluster_runs_clean_under_lockdep():
+    """The tier-2 write/read/failover paths hold PG + mon locks in a
+    consistent order — lockdep active end-to-end (the reference runs
+    its qa suites with lockdep=true the same way)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_osd_cluster import MiniCluster, LibClient, REP_POOL, EC_POOL
+
+    c = MiniCluster()
+    cl = LibClient(c)
+    try:
+        cl.put(REP_POOL, "ld1", b"x" * 2000)
+        assert cl.get(REP_POOL, "ld1") == b"x" * 2000
+        cl.put(EC_POOL, "ld2", b"y" * 4096)
+        assert cl.get(EC_POOL, "ld2") == b"y" * 4096
+        _, acting, primary = c.primary_of(REP_POOL, "ld1")
+        victim = next(o for o in acting if o != primary)
+        c.kill(victim)
+        cl.put(REP_POOL, "ld1", b"z" * 100)
+        c.revive(victim)
+        assert cl.get(REP_POOL, "ld1") == b"z" * 100
+    finally:
+        cl.shutdown()
+        c.shutdown()
