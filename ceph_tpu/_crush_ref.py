@@ -54,6 +54,12 @@ def lib() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_int, i32p, ctypes.c_int,
             ctypes.c_int, u32p, ctypes.c_int, i32p,
         ]
+        L.crushref_do_rule_batch_args.restype = ctypes.c_int
+        L.crushref_do_rule_batch_args.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, i32p, ctypes.c_int,
+            ctypes.c_int, u32p, ctypes.c_int, u32p, i32p,
+            ctypes.c_int, ctypes.c_int, i32p,
+        ]
         _lib = L
     return _lib
 
@@ -67,7 +73,8 @@ class RefCrushMap:
         self._ptr = L.crushref_create(
             t.choose_total_tries, t.choose_local_tries,
             t.choose_local_fallback_tries, t.chooseleaf_descend_once,
-            t.chooseleaf_vary_r, t.chooseleaf_stable, 1)
+            t.chooseleaf_vary_r, t.chooseleaf_stable,
+            getattr(t, "straw_calc_version", 1))
         if not self._ptr:
             raise MemoryError("crushref_create failed")
         for bid in sorted(cmap.buckets, reverse=True):  # shallowest ids last
@@ -95,23 +102,43 @@ class RefCrushMap:
             self.rulenos.append(rn)
         L.crushref_finalize(self._ptr)
         self.max_devices = cmap.max_devices
+        # crush_do_rule indexes choose_args[-1-id] for EVERY bucket, so
+        # the arg array must always span the whole map
+        self.n_buckets = max((-b for b in cmap.buckets), default=0)
 
     def do_rule(self, ruleno: int, xs: Sequence[int], result_max: int,
-                weights: Optional[np.ndarray] = None) -> np.ndarray:
+                weights: Optional[np.ndarray] = None,
+                choose_args: Optional[dict] = None) -> np.ndarray:
         """crush_do_rule for a batch of xs -> int32 [len(xs), result_max]
-        padded with CRUSH_ITEM_NONE (0x7fffffff)."""
+        padded with CRUSH_ITEM_NONE (0x7fffffff).  choose_args:
+        {bucket_id: [weight,...]} straw2 weight-set overrides
+        (reference crush_choose_arg)."""
         xs = np.asarray(xs, dtype=np.int32)
         if weights is None:
             weights = np.full(self.max_devices, 0x10000, dtype=np.uint32)
         weights = np.ascontiguousarray(weights, dtype=np.uint32)
         out = np.empty((len(xs), result_max), dtype=np.int32)
-        rc = lib().crushref_do_rule_batch(
-            self._ptr, ruleno,
-            xs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(xs),
-            result_max,
-            weights.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-            len(weights),
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        if choose_args:
+            n_buckets = max(self.n_buckets, 1)
+            max_size = max(len(w) for w in choose_args.values())
+            aw = np.zeros((n_buckets, max_size), dtype=np.uint32)
+            asz = np.zeros(n_buckets, dtype=np.int32)
+            for bid, ws in choose_args.items():
+                bno = -1 - bid
+                aw[bno, : len(ws)] = ws
+                asz[bno] = len(ws)
+            rc = lib().crushref_do_rule_batch_args(
+                self._ptr, ruleno, xs.ctypes.data_as(i32p), len(xs),
+                result_max, weights.ctypes.data_as(u32p), len(weights),
+                aw.ctypes.data_as(u32p), asz.ctypes.data_as(i32p),
+                n_buckets, max_size, out.ctypes.data_as(i32p))
+        else:
+            rc = lib().crushref_do_rule_batch(
+                self._ptr, ruleno, xs.ctypes.data_as(i32p), len(xs),
+                result_max, weights.ctypes.data_as(u32p), len(weights),
+                out.ctypes.data_as(i32p))
         if rc < 0:
             raise RuntimeError("crushref_do_rule_batch failed")
         return out

@@ -140,7 +140,8 @@ class CephxServer:
         ticket_blob = seal(self.service_secret, ticket.encode())
         return sealed_client, ticket_blob
 
-    def mint_authorizer(self, name: str, caps: str = "allow *") -> bytes:
+    def mint_authorizer(self, name: str, caps: str = "allow *",
+                        target: str = "") -> bytes:
         """Self-issued authorizer for the auth service itself — the mon
         holds the service secret, so its dial-backs (map pushes) carry
         a ticket daemons can verify like any other."""
@@ -148,7 +149,7 @@ class CephxServer:
         ticket = Ticket(name, caps, session_key,
                         time.time() + TICKET_VALIDITY)
         blob = seal(self.service_secret, ticket.encode())
-        return build_authorizer_blob(blob, session_key)
+        return build_authorizer_blob(blob, session_key, target)
 
 
 class CephxClient:
@@ -178,46 +179,89 @@ class CephxClient:
         return (self.session_key is not None
                 and time.time() < self.expires)
 
-    def build_authorizer(self) -> bytes:
-        """ticket + HMAC(session_key, stamp) — presented per session."""
+    def build_authorizer(self, target: str = "") -> bytes:
+        """ticket + HMAC(session_key, stamp || target) — presented per
+        session; `target` (the dialed daemon's address) binds the blob
+        to one destination."""
         if not self.authenticated:
             raise AuthError("no live ticket")
-        return build_authorizer_blob(self.ticket_blob, self.session_key)
+        return build_authorizer_blob(self.ticket_blob, self.session_key,
+                                     target)
 
 
-def build_authorizer_blob(ticket_blob: bytes, session_key: bytes) -> bytes:
+def _authorizer_mac(session_key: bytes, stamp: float,
+                    target: str, nonce: bytes) -> bytes:
+    # every variable-length field is LENGTH-PREFIXED inside the MAC:
+    # without framing, bytes could be moved between target and nonce
+    # (e.g. re-encode with target="" and nonce=old_target+old_nonce) to
+    # strip the destination binding while keeping the MAC valid
+    t = target.encode()
+    return hmac.new(
+        session_key,
+        b"authorizer" + struct.pack("<d", stamp)
+        + struct.pack("<I", len(t)) + t
+        + struct.pack("<I", len(nonce)) + nonce,
+        hashlib.sha256).digest()
+
+
+def build_authorizer_blob(ticket_blob: bytes, session_key: bytes,
+                          target: str = "") -> bytes:
+    """The MAC covers (stamp, target, a fresh nonce): target binding
+    stops cross-daemon replay, the nonce + the verifier's seen-cache
+    stop same-daemon replay within the clock-skew window (the
+    reference's CVE-2018-1128 challenge fix, collapsed into the
+    one-shot announce shape)."""
     e = Encoder()
-    e.start(1, 1)
+    e.start(2, 1)
     stamp = time.time()
+    nonce = secrets.token_bytes(16)
     e.blob(ticket_blob).f64(stamp)
-    e.blob(hmac.new(session_key,
-                    b"authorizer" + struct.pack("<d", stamp),
-                    hashlib.sha256).digest())
+    e.blob(_authorizer_mac(session_key, stamp, target, nonce))
+    e.string(target)
+    e.blob(nonce)
     e.finish()
     return e.bytes()
 
 
 def verify_authorizer(service_secret: bytes, blob: bytes,
                       now: Optional[float] = None,
-                      max_skew: float = 300.0) -> Ticket:
+                      max_skew: float = 300.0,
+                      expect_target: str = "",
+                      seen: Optional[Dict[bytes, float]] = None) -> Ticket:
     """Daemon-side check: unseal the ticket with the service secret,
-    validate expiry and the session-key HMAC (reference
-    cephx_verify_authorizer)."""
+    validate expiry, target binding, the session-key HMAC and — when a
+    `seen` cache is provided — reject replays of a previously-used
+    authorizer (reference cephx_verify_authorizer + the CVE-2018-1128
+    challenge)."""
     now = time.time() if now is None else now
     d = Decoder(blob)
-    d.start(1)
+    v = d.start(2)
     ticket_blob = d.blob()
     stamp = d.f64()
     mac = d.blob()
+    target = d.string() if v >= 2 else ""
+    nonce = d.blob() if v >= 2 else b""
     d.end()
     ticket = Ticket.decode(unseal(service_secret, ticket_blob))
     if ticket.expires < now:
         raise AuthError(f"ticket for {ticket.name!r} expired")
     if abs(now - stamp) > max_skew:
         raise AuthError("authorizer stamp outside clock skew window")
-    want = hmac.new(ticket.session_key,
-                    b"authorizer" + struct.pack("<d", stamp),
-                    hashlib.sha256).digest()
+    if expect_target and v >= 2 and target != expect_target:
+        # an EMPTY target on a v2 blob is also a mismatch: accepting it
+        # would let a stripped binding through
+        raise AuthError(
+            f"authorizer bound to {target!r}, not {expect_target!r}")
+    want = _authorizer_mac(ticket.session_key, stamp, target, nonce)
     if not hmac.compare_digest(mac, want):
         raise AuthError(f"authorizer MAC mismatch for {ticket.name!r}")
+    if seen is not None:
+        for k in [k for k, exp in seen.items() if exp < now]:
+            del seen[k]
+        if mac in seen:
+            raise AuthError("authorizer replayed")
+        # the entry must outlive the blob's validity, which ends at
+        # stamp + max_skew (a fast client clock extends it past
+        # now + max_skew)
+        seen[mac] = stamp + max_skew
     return ticket

@@ -1,0 +1,14 @@
+"""CephFS-role POSIX-ish filesystem over RADOS (reference: src/mds/ +
+src/client/)."""
+
+from ceph_tpu.cephfs.fs import (
+    CephFS,
+    FSError,
+    IsADirectory,
+    NotADirectory,
+    NotEmpty,
+    NoSuchEntry,
+)
+
+__all__ = ["CephFS", "FSError", "NoSuchEntry", "NotADirectory",
+           "IsADirectory", "NotEmpty"]

@@ -1,0 +1,281 @@
+"""POSIX-shaped filesystem over RADOS — the CephFS role.
+
+Reference: src/mds/ + src/client/ re-derived small: directories are
+RADOS objects whose OMAP is the dentry table (the reference's CDir
+omap storage format role), file inodes carry (ino, size, mtime, mode)
+in the dentry entry (embedded inodes, as CephFS stores them), and file
+DATA rides the striping layer keyed by inode number (the reference's
+file_layout over `<ino>.<object>` data objects).  Metadata mutations
+go through the in-OSD `fsdir` object class, so each directory update
+(link/unlink/rename-step) is atomic inside the PG write pipeline —
+the single-writer discipline the MDS journal provides, collapsed onto
+the object store for this single-MDS-role implementation.
+
+Not modeled (future rounds): distributed metadata cache/capabilities,
+subtree migration, the MDS journal and multi-MDS.
+"""
+
+from __future__ import annotations
+
+import json
+import posixpath
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.client.rados import IoCtx, RadosError
+from ceph_tpu.client.striper import RadosStriper
+from ceph_tpu.osd.cls import CLS_RD, CLS_WR, ClassHandler, ClsError
+
+
+class FSError(OSError):
+    pass
+
+
+class NoSuchEntry(FSError):
+    pass
+
+
+class NotADirectory(FSError):
+    pass
+
+
+class IsADirectory(FSError):
+    pass
+
+
+class NotEmpty(FSError):
+    pass
+
+
+def _register_fs_cls() -> None:
+    """Atomic dentry-table mutations (the MDS-journal atomicity role)."""
+    h = ClassHandler.instance()
+    if h.get("fsdir.link") is not None:
+        return
+
+    def alloc_ino(ctx, indata: bytes) -> bytes:
+        """Atomic inode allocation (the MDS inotable role): the
+        read+increment runs inside the PG write pipeline, so two
+        clients can never mint the same ino."""
+        cur = int(ctx.omap_get(["next_ino"]).get("next_ino", b"1"))
+        ctx.omap_set({"next_ino": str(cur + 1).encode()})
+        return str(cur).encode()
+
+    def link(ctx, indata: bytes) -> bytes:
+        req = json.loads(indata.decode())
+        name = req["name"]
+        if not req.get("replace") and name in ctx.omap_get([name]):
+            raise ClsError(-17, "entry exists")  # EEXIST
+        ctx.omap_set({name: json.dumps(req["inode"]).encode()})
+        return b""
+
+    def unlink(ctx, indata: bytes) -> bytes:
+        req = json.loads(indata.decode())
+        name = req["name"]
+        got = ctx.omap_get([name])
+        if name not in got:
+            raise ClsError(-2, "no entry")
+        ctx.omap_rm([name])
+        return got[name]  # the unlinked inode rides back
+
+    h.register("fsdir", "link", CLS_RD | CLS_WR, link)
+    h.register("fsdir", "unlink", CLS_RD | CLS_WR, unlink)
+    h.register("fsdir", "alloc_ino", CLS_RD | CLS_WR, alloc_ino)
+
+
+_register_fs_cls()
+
+
+class CephFS:
+    def __init__(self, ioctx: IoCtx, stripe_unit: int = 65536,
+                 object_size: int = 4 << 20) -> None:
+        self.io = ioctx
+        self.striper = RadosStriper(ioctx, stripe_unit=stripe_unit,
+                                    stripe_count=4,
+                                    object_size=object_size)
+        self._mkroot()
+
+    # -- layout ------------------------------------------------------------
+    @staticmethod
+    def _norm(path: str) -> str:
+        p = posixpath.normpath("/" + path.strip("/"))
+        return p
+
+    @staticmethod
+    def _split(path: str) -> Tuple[str, str]:
+        p = CephFS._norm(path)
+        if p == "/":
+            raise FSError("root has no parent")
+        return posixpath.dirname(p), posixpath.basename(p)
+
+    @staticmethod
+    def _dir_oid(path: str) -> str:
+        return f"fs.dir.{CephFS._norm(path)}"
+
+    @staticmethod
+    def _data_oid(ino: int) -> str:
+        return f"fs.data.{ino:016x}"
+
+    def _mkroot(self) -> None:
+        try:
+            self.io.stat(self._dir_oid("/"))
+        except RadosError:
+            self.io.write_full(self._dir_oid("/"), b"")
+            self.io.omap_set("fs.meta", {"next_ino": b"1"})
+
+    def _next_ino(self) -> int:
+        # inode allocator (the MDS inotable role): read+increment runs
+        # as ONE in-OSD cls op, so concurrent clients never collide
+        return int(self.io.call("fs.meta", "fsdir", "alloc_ino"))
+
+    def _lookup(self, path: str) -> Dict:
+        p = self._norm(path)
+        if p == "/":
+            return {"type": "dir", "ino": 0}
+        parent, name = self._split(p)
+        try:
+            got = self.io.omap_get(self._dir_oid(parent), [name])
+        except RadosError:
+            raise NoSuchEntry(p)
+        if name not in got:
+            raise NoSuchEntry(p)
+        return json.loads(got[name].decode())
+
+    def _link(self, parent: str, name: str, inode: Dict,
+              replace: bool = False) -> None:
+        self.io.call(self._dir_oid(parent), "fsdir", "link",
+                     json.dumps({"name": name, "inode": inode,
+                                 "replace": replace}).encode())
+
+    def _unlink(self, parent: str, name: str) -> Dict:
+        try:
+            got = self.io.call(self._dir_oid(parent), "fsdir", "unlink",
+                               json.dumps({"name": name}).encode())
+        except RadosError as e:
+            if e.rc == -2:
+                raise NoSuchEntry(f"{parent}/{name}")
+            raise
+        return json.loads(got.decode())
+
+    # -- directories -------------------------------------------------------
+    def mkdir(self, path: str) -> None:
+        parent, name = self._split(path)
+        if self._lookup(parent)["type"] != "dir":
+            raise NotADirectory(parent)
+        self.io.write_full(self._dir_oid(path), b"")
+        self._link(parent, name, {"type": "dir", "ino": self._next_ino(),
+                                  "mtime": time.time()})
+
+    def listdir(self, path: str) -> List[str]:
+        ent = self._lookup(path)
+        if ent["type"] != "dir":
+            raise NotADirectory(path)
+        try:
+            return sorted(self.io.omap_get(self._dir_oid(path)))
+        except RadosError:
+            raise NoSuchEntry(path)
+
+    def rmdir(self, path: str) -> None:
+        if self.listdir(path):
+            raise NotEmpty(path)
+        parent, name = self._split(path)
+        self._unlink(parent, name)
+        try:
+            self.io.remove(self._dir_oid(path))
+        except RadosError:
+            pass
+
+    # -- files -------------------------------------------------------------
+    def write(self, path: str, data: bytes, off: int = 0) -> int:
+        parent, name = self._split(path)
+        try:
+            ent = self._lookup(path)
+            if ent["type"] == "dir":
+                raise IsADirectory(path)
+        except NoSuchEntry:
+            ent = {"type": "file", "ino": self._next_ino(), "size": 0}
+        self.striper.write(self._data_oid(ent["ino"]), data, off=off)
+        ent["size"] = max(ent.get("size", 0), off + len(data))
+        ent["mtime"] = time.time()
+        self._link(parent, name, ent, replace=True)
+        return len(data)
+
+    def read(self, path: str, length: int = 0, off: int = 0) -> bytes:
+        ent = self._lookup(path)
+        if ent["type"] == "dir":
+            raise IsADirectory(path)
+        size = ent.get("size", 0)
+        if off >= size:
+            return b""
+        if length == 0 or off + length > size:
+            length = size - off
+        try:
+            got = self.striper.read(self._data_oid(ent["ino"]),
+                                    length, off)
+        except RadosError:
+            got = b""
+        if len(got) < length:
+            got += b"\0" * (length - len(got))
+        return got
+
+    def stat(self, path: str) -> Dict:
+        return dict(self._lookup(path))
+
+    def unlink(self, path: str) -> None:
+        ent = self._lookup(path)
+        if ent["type"] == "dir":
+            raise IsADirectory(path)
+        parent, name = self._split(path)
+        self._unlink(parent, name)
+        try:
+            self.striper.remove(self._data_oid(ent["ino"]))
+        except RadosError:
+            pass
+
+    def truncate(self, path: str, size: int) -> None:
+        parent, name = self._split(path)
+        ent = self._lookup(path)
+        if ent["type"] == "dir":
+            raise IsADirectory(path)
+        try:
+            self.striper.truncate(self._data_oid(ent["ino"]), size)
+        except RadosError:
+            pass
+        ent["size"] = size
+        self._link(parent, name, ent, replace=True)
+
+    def rename(self, src: str, dst: str) -> None:
+        """link-then-unlink two-phase (the MDS would journal this; a
+        crash between phases leaves both names valid, never neither).
+        Directory renames move the WHOLE subtree's dentry-table
+        objects — tables are keyed by absolute path, so every
+        descendant directory relocates too."""
+        sp, sn = self._split(src)
+        dp, dn = self._split(dst)
+        ent = self._lookup(src)
+        if ent["type"] == "dir":
+            self._link(dp, dn, ent, replace=True)
+            self._move_dir_tree(self._norm(src), self._norm(dst))
+            self._unlink(sp, sn)
+        else:
+            self._link(dp, dn, ent, replace=True)
+            self._unlink(sp, sn)
+
+    def _move_dir_tree(self, src: str, dst: str) -> None:
+        """Depth-first copy of dentry tables src/* -> dst/*, then drop
+        the old tables."""
+        try:
+            kv = self.io.omap_get(self._dir_oid(src))
+        except RadosError:
+            kv = {}
+        self.io.write_full(self._dir_oid(dst), b"")
+        if kv:
+            self.io.omap_set(self._dir_oid(dst), kv)
+        for name, blob in kv.items():
+            child = json.loads(blob.decode())
+            if child.get("type") == "dir":
+                self._move_dir_tree(f"{src}/{name}", f"{dst}/{name}")
+        try:
+            self.io.remove(self._dir_oid(src))
+        except RadosError:
+            pass

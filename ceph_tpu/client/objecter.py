@@ -34,6 +34,7 @@ from ceph_tpu.core.context import Context
 from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.osd import messages as m
 from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd import types as t_
 from ceph_tpu.osd.types import OSDOp
 
 EAGAIN = -11
@@ -46,7 +47,8 @@ class ObjecterOp:
 
     __slots__ = ("tid", "pool", "oid", "ops", "reqid", "reply", "event",
                  "attempts", "last_send", "retry_at", "target",
-                 "on_complete", "timeout_at")
+                 "on_complete", "timeout_at", "snap_seq", "snaps",
+                 "snapid")
 
     def __init__(self, tid: int, pool: int, oid: str, ops: List[OSDOp],
                  reqid: str, timeout: float,
@@ -64,6 +66,9 @@ class ObjecterOp:
         self.target: Tuple[Tuple[int, int], int] = ((0, 0), -1)
         self.on_complete = on_complete
         self.timeout_at = time.monotonic() + timeout
+        self.snap_seq = 0
+        self.snaps: List[int] = []
+        self.snapid = 0
 
     # future-like surface
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -89,6 +94,10 @@ class Objecter(Dispatcher):
         self.osdmap: Optional[OSDMap] = None
         self.addrbook: Dict[int, object] = {}
         self.ops: Dict[int, ObjecterOp] = {}
+        # linger (watch) registrations: cookie -> dict(pool, oid, cb,
+        # primary) — re-sent to the new primary on failover (reference
+        # Objecter::LingerOp / _linger_submit)
+        self.lingers: Dict[int, Dict] = {}
         self._tid = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -120,6 +129,13 @@ class Objecter(Dispatcher):
             tgt = self._calc_target(op.pool, op.oid)
             if tgt != op.target or op.target[1] < 0:
                 self._send_op(op)
+        # re-register watches whose primary moved (linger resend)
+        with self._lock:
+            lingers = list(self.lingers.items())
+        for cookie, lg in lingers:
+            _, primary = self._calc_target(lg["pool"], lg["oid"])
+            if primary >= 0 and primary != lg.get("primary"):
+                self._send_watch(cookie, lg)
 
     def wait_for_map(self, timeout: float = 10.0) -> None:
         deadline = time.monotonic() + timeout
@@ -139,7 +155,9 @@ class Objecter(Dispatcher):
 
     def op_submit(self, pool: int, oid: str, ops: List[OSDOp],
                   timeout: float = 30.0,
-                  on_complete: Optional[Callable] = None) -> ObjecterOp:
+                  on_complete: Optional[Callable] = None,
+                  snapc: Optional[Tuple[int, List[int]]] = None,
+                  snapid: int = 0) -> ObjecterOp:
         if self.osdmap is None:
             raise RuntimeError("objecter has no osdmap yet")
         with self._lock:
@@ -148,6 +166,9 @@ class Objecter(Dispatcher):
             op = ObjecterOp(tid, pool, oid, ops,
                             reqid=f"{self._name}:{tid}",
                             timeout=timeout, on_complete=on_complete)
+            if snapc is not None:
+                op.snap_seq, op.snaps = snapc[0], list(snapc[1])
+            op.snapid = snapid
             self.ops[tid] = op
         self._send_op(op)
         return op
@@ -168,10 +189,69 @@ class Objecter(Dispatcher):
         msg = m.MOSDOp(pgid, epoch, op.oid, op.ops)
         msg.tid = op.tid
         msg.reqid = op.reqid
+        msg.snap_seq, msg.snaps, msg.snapid = (op.snap_seq, op.snaps,
+                                               op.snapid)
         self.msgr.send_message(msg, addr)
+
+    # -- watch/notify ------------------------------------------------------
+    def watch(self, pool: int, oid: str, callback,
+              timeout: float = 15.0) -> int:
+        """Register a watch; callback(notify_id, payload) -> ack bytes.
+        Returns the cookie (reference Objecter linger + OP_WATCH)."""
+        with self._lock:
+            self._tid += 1
+            cookie = self._tid
+            lg = {"pool": pool, "oid": oid, "cb": callback,
+                  "primary": -1}
+            self.lingers[cookie] = lg
+        rep = self._send_watch(cookie, lg, wait=timeout)
+        if rep is None or rep.result < 0:
+            with self._lock:
+                self.lingers.pop(cookie, None)
+            raise RuntimeError(
+                f"watch {oid!r} failed: "
+                f"{rep.result if rep else 'timeout'}")
+        return cookie
+
+    def unwatch(self, cookie: int, timeout: float = 15.0) -> None:
+        with self._lock:
+            lg = self.lingers.pop(cookie, None)
+        if lg is None:
+            return
+        op = self.op_submit(lg["pool"], lg["oid"],
+                            [OSDOp(t_.OP_WATCH, off=cookie, name="unwatch")],
+                            timeout=timeout)
+        op.result(timeout)
+
+    def _send_watch(self, cookie: int, lg: Dict,
+                    wait: Optional[float] = None):
+        _, primary = self._calc_target(lg["pool"], lg["oid"])
+        lg["primary"] = primary
+        op = self.op_submit(lg["pool"], lg["oid"],
+                            [OSDOp(t_.OP_WATCH, off=cookie, name="watch")],
+                            timeout=wait or 15.0)
+        if wait is not None:
+            try:
+                return op.result(wait)
+            except TimeoutError:
+                return None
+        return None
 
     # -- replies -----------------------------------------------------------
     def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, m.MWatchNotify):
+            with self._lock:
+                lg = self.lingers.get(msg.cookie)
+            blob = b""
+            if lg is not None:
+                try:
+                    blob = lg["cb"](msg.notify_id, msg.payload) or b""
+                except Exception:
+                    blob = b""
+            ack = m.MWatchNotifyAck(msg.pgid, 0, msg.oid, msg.notify_id,
+                                    msg.cookie, blob)
+            conn.send(ack)
+            return True
         if not isinstance(msg, m.MOSDOpReply):
             return False
         with self._lock:

@@ -63,7 +63,8 @@ class RadosClient:
             self._cephx = self.monc.authenticate(auth[0], auth[1],
                                                  timeout=timeout)
             self.msgr.set_auth(
-                provider=lambda: self._cephx.build_authorizer())
+                provider=lambda target="": self._cephx.build_authorizer(
+                    target))
 
             def _renew() -> None:
                 # refresh the ticket before expiry; sessions opened
@@ -108,17 +109,62 @@ class IoCtx:
     def __init__(self, client: RadosClient, pool_id: int) -> None:
         self.client = client
         self.pool = pool_id
+        # self-managed snapshot context (reference SnapContext /
+        # rados_ioctx_selfmanaged_snap_set_write_ctx): writes carry it
+        # so the PG can clone-on-write
+        self.snap_seq = 0
+        self.snaps: List[int] = []
 
     # -- async core --------------------------------------------------------
     def aio_operate(self, oid: str, ops: List[OSDOp],
-                    timeout: float = 30.0) -> ObjecterOp:
+                    timeout: float = 30.0, snapid: int = 0) -> ObjecterOp:
+        # cls calls (OP_CALL) may mutate server-side, so they carry the
+        # snap context too — the PG decides writeness there
+        snapc = ((self.snap_seq, self.snaps)
+                 if self.snap_seq and any(
+                     o.is_write() or o.op == t_.OP_CALL for o in ops)
+                 else None)
         return self.client.objecter.op_submit(
-            self.pool, oid, ops, timeout=timeout)
+            self.pool, oid, ops, timeout=timeout, snapc=snapc,
+            snapid=snapid)
 
     def operate(self, oid: str, ops: List[OSDOp],
-                timeout: float = 30.0):
-        rep = self.aio_operate(oid, ops, timeout=timeout).result(timeout)
+                timeout: float = 30.0, snapid: int = 0):
+        rep = self.aio_operate(oid, ops, timeout=timeout,
+                               snapid=snapid).result(timeout)
         return rep
+
+    # -- self-managed snapshots -------------------------------------------
+    def selfmanaged_snap_create(self) -> int:
+        """Allocate a snap id (atomic cls counter — the mon snap-seq
+        allocator role) and fold it into this ioctx's write context."""
+        snapid = int(self.call("rados.snapmeta", "counter", "alloc",
+                               b"snapseq"))
+        self.set_snap_context(snapid, [snapid] + self.snaps)
+        return snapid
+
+    def set_snap_context(self, seq: int, snaps: List[int]) -> None:
+        self.snap_seq = seq
+        self.snaps = list(snaps)
+
+    def snap_read(self, oid: str, snapid: int, length: int = 0,
+                  off: int = 0) -> bytes:
+        rep = self.operate(
+            oid, [OSDOp(t_.OP_READ, off=off, length=length)],
+            snapid=snapid)
+        self._check(rep)
+        return rep.ops[0].out_data
+
+    def snap_trim(self, oid: str, snapid: int) -> None:
+        """Drop one object's clone for `snapid` (per-object trimmer;
+        a background pool-wide trimmer is future work)."""
+        self._check(self.operate(
+            oid, [OSDOp(t_.OP_SNAPTRIM, off=snapid)]))
+
+    def selfmanaged_snap_remove(self, snapid: int) -> None:
+        self.snaps = [s for s in self.snaps if s != snapid]
+        if self.snap_seq == snapid:
+            self.snap_seq = max(self.snaps, default=0)
 
     def _check(self, rep) -> None:
         if rep.result < 0:
@@ -172,6 +218,26 @@ class IoCtx:
             oid, [OSDOp(t_.OP_CALL, name=f"{cls}.{method}", data=indata)])
         self._check(rep)
         return rep.ops[0].out_data
+
+    # -- watch/notify (reference rados_watch/rados_notify) ----------------
+    def watch(self, oid: str, callback) -> int:
+        """callback(notify_id, payload) -> ack bytes; returns cookie."""
+        return self.client.objecter.watch(self.pool, oid, callback)
+
+    def unwatch(self, cookie: int) -> None:
+        self.client.objecter.unwatch(cookie)
+
+    def notify(self, oid: str, payload: bytes = b"",
+               timeout_ms: int = 5000):
+        """Returns ({watcher key: ack bytes}, [watcher keys that never
+        acked]).  Watcher keys are "<entity>.<nonce>:<cookie>" strings
+        (two clients may legally share a cookie); match your own watch
+        with key.endswith(f":{cookie}")."""
+        rep = self.operate(
+            oid, [OSDOp(t_.OP_NOTIFY, data=payload, length=timeout_ms)])
+        self._check(rep)
+        missed = [c for c in rep.ops[0].out_data.decode().split(",") if c]
+        return rep.ops[0].out_kv, missed
 
     def omap_set(self, oid: str, kv: Dict[str, bytes]) -> None:
         self._check(self.operate(oid, [OSDOp(t_.OP_OMAP_SET, kv=kv)]))

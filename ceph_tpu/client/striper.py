@@ -146,7 +146,13 @@ class RadosStriper:
             got = rep.ops[0].out_data
             at = 0
             for _, lpos, n in units:  # scatter units back to logical
-                buf[lpos - off: lpos - off + n] = got[at: at + n]
+                chunk = got[at: at + n]
+                if len(chunk) < n:
+                    # short object (sparse tail): zero-fill — a
+                    # mismatched slice assignment would RESIZE the
+                    # buffer and shift every later byte
+                    chunk = chunk + b"\0" * (n - len(chunk))
+                buf[lpos - off: lpos - off + n] = chunk
                 at += n
         return bytes(buf)
 

@@ -55,6 +55,7 @@ class Tunables:
     chooseleaf_descend_once: int = 1
     chooseleaf_vary_r: int = 1
     chooseleaf_stable: int = 1
+    straw_calc_version: int = 1  # original-straw scaling formula rev
 
 
 @dataclasses.dataclass
@@ -82,7 +83,12 @@ class Rule:
 
 @dataclasses.dataclass
 class FlatMap:
-    """Dense padded arrays; the device/oracle-facing map image."""
+    """Dense padded arrays; the device/oracle-facing map image.
+
+    Legacy bucket algorithms carry their builder-derived aux planes
+    (reference src/crush/builder.c): straw scaling factors
+    (crush_calc_straw), list cumulative sums, and tree node weights —
+    so the jit interpreter needs no per-walk recomputation."""
 
     items: np.ndarray  # int32 [B, S]
     weights: np.ndarray  # uint32 [B, S]
@@ -91,6 +97,95 @@ class FlatMap:
     types: np.ndarray  # int32 [B]
     max_devices: int
     tunables: Tunables
+    straws: Optional[np.ndarray] = None        # uint32 [B, S] (straw)
+    sum_weights: Optional[np.ndarray] = None   # uint32 [B, S] (list)
+    tree_weights: Optional[np.ndarray] = None  # uint32 [B, NN] (tree)
+    tree_nodes: Optional[np.ndarray] = None    # int32 [B] num_nodes
+
+
+def calc_straws(weights: Sequence[int], version: int = 0) -> List[int]:
+    """Original-straw scaling factors (reference: builder.c:427
+    crush_calc_straw; version 0 is crush_create's default, with its
+    zero-weight numleft quirk)."""
+    import math
+
+    size = len(weights)
+    order = sorted(range(size), key=lambda i: (weights[i], i))
+    straws = [0] * size
+    numleft = size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        if weights[order[i]] == 0:
+            straws[order[i]] = 0
+            i += 1
+            if version >= 1:
+                numleft -= 1
+            continue
+        straws[order[i]] = int(straw * 0x10000)
+        i += 1
+        if i == size:
+            break
+        if version == 0 and weights[order[i]] == weights[order[i - 1]]:
+            continue
+        wbelow += (float(weights[order[i - 1]]) - lastw) * numleft
+        if version == 0:
+            j = i
+            while j < size and weights[order[j]] == weights[order[i]]:
+                numleft -= 1
+                j += 1
+        else:
+            numleft -= 1
+        wnext = numleft * (weights[order[i]] - weights[order[i - 1]])
+        pbelow = wbelow / (wbelow + wnext)
+        straw *= math.pow(1.0 / pbelow, 1.0 / numleft)
+        lastw = float(weights[order[i - 1]])
+    return straws
+
+
+def calc_tree_depth(size: int) -> int:
+    """builder.c:307 calc_depth."""
+    if size == 0:
+        return 0
+    depth = 1
+    t = size - 1
+    while t:
+        t >>= 1
+        depth += 1
+    return depth
+
+
+def calc_tree_weights(weights: Sequence[int]) -> List[int]:
+    """Tree bucket node weights: leaf i at node 2i+1, every ancestor
+    accumulates (reference: builder.c crush_make_tree_bucket:354-385,
+    crush.h:504 crush_calc_tree_node)."""
+    size = len(weights)
+    depth = calc_tree_depth(size)
+    num_nodes = 1 << depth
+    nw = [0] * num_nodes
+
+    def height(n: int) -> int:
+        h = 0
+        while (n & 1) == 0:
+            h += 1
+            n >>= 1
+        return h
+
+    def parent(n: int) -> int:
+        h = height(n)
+        if n & (1 << (h + 1)):
+            return n - (1 << h)
+        return n + (1 << h)
+
+    for i, w in enumerate(weights):
+        node = ((i + 1) << 1) - 1
+        nw[node] = w
+        for _ in range(1, depth):
+            node = parent(node)
+            nw[node] += w
+    return nw
 
 
 class CrushMap:
@@ -182,6 +277,19 @@ class CrushMap:
         sizes = np.zeros(n_buckets, dtype=np.int32)
         algs = np.zeros(n_buckets, dtype=np.int32)
         types = np.zeros(n_buckets, dtype=np.int32)
+        legacy_algs = {b.alg for b in self.buckets.values()} - {ALG_STRAW2}
+        straws = sum_w = tree_w = tree_n = None
+        if ALG_STRAW in legacy_algs:
+            straws = np.zeros((n_buckets, max_size), dtype=np.uint32)
+        if ALG_LIST in legacy_algs:
+            sum_w = np.zeros((n_buckets, max_size), dtype=np.uint32)
+        if ALG_TREE in legacy_algs:
+            max_nodes = max(
+                (1 << calc_tree_depth(len(b.items))
+                 for b in self.buckets.values() if b.alg == ALG_TREE),
+                default=1)
+            tree_w = np.zeros((n_buckets, max_nodes), dtype=np.uint32)
+            tree_n = np.zeros(n_buckets, dtype=np.int32)
         for bid, b in self.buckets.items():
             bno = -1 - bid
             n = len(b.items)
@@ -190,6 +298,17 @@ class CrushMap:
             sizes[bno] = n
             algs[bno] = b.alg
             types[bno] = b.type
+            if b.alg == ALG_STRAW and straws is not None and n:
+                straws[bno, :n] = calc_straws(
+                    b.weights, version=self.tunables.straw_calc_version)
+            if b.alg == ALG_LIST and sum_w is not None and n:
+                sum_w[bno, :n] = np.cumsum(
+                    np.asarray(b.weights, dtype=np.uint64)
+                ).astype(np.uint32)
+            if b.alg == ALG_TREE and tree_w is not None and n:
+                nw = calc_tree_weights(b.weights)
+                tree_w[bno, : len(nw)] = nw
+                tree_n[bno] = len(nw)
         return FlatMap(
             items=items,
             weights=weights,
@@ -198,6 +317,10 @@ class CrushMap:
             types=types,
             max_devices=self.max_devices,
             tunables=self.tunables,
+            straws=straws,
+            sum_weights=sum_w,
+            tree_weights=tree_w,
+            tree_nodes=tree_n,
         )
 
 

@@ -58,7 +58,10 @@ import numpy as np
 
 from ceph_tpu.crush import hashes, ln
 from ceph_tpu.crush.map import (
+    ALG_LIST,
+    ALG_STRAW,
     ALG_STRAW2,
+    ALG_TREE,
     ALG_UNIFORM,
     ITEM_NONE,
     ITEM_UNDEF,
@@ -72,6 +75,12 @@ from ceph_tpu.crush.map import (
     OP_TAKE,
     FlatMap,
 )
+
+def dataclasses_replace_weights(flat: FlatMap, weights: np.ndarray):
+    import dataclasses
+
+    return dataclasses.replace(flat, weights=weights)
+
 
 # descend status codes
 _OK = 0
@@ -87,7 +96,21 @@ class _DeviceMap:
     _straw2_choose).
     """
 
-    def __init__(self, flat: FlatMap):
+    def __init__(self, flat: FlatMap, choose_args=None):
+        # choose_args ({bucket_id: [weights]}, reference
+        # CrushWrapper.h:72 / crush_choose_arg) substitute the straw2
+        # draw weights — balancer weight-set overrides
+        base_w = np.asarray(flat.weights).copy()
+        if choose_args:
+            algs_np = np.asarray(flat.algs)
+            for bid, ws in choose_args.items():
+                bno = -1 - bid
+                # the reference consults the weight set in straw2
+                # buckets only (bucket_straw2_choose's arg)
+                if (0 <= bno < base_w.shape[0]
+                        and algs_np[bno] == ALG_STRAW2):
+                    base_w[bno, : len(ws)] = ws
+        flat = dataclasses_replace_weights(flat, base_w)
         # magic reciprocals for the straw2 divide: weights are map
         # constants, so the exact truncating s64 division ln/w becomes
         # a 16-bit-limb mulhi + one correction, all in uint32 (TPU has
@@ -116,6 +139,27 @@ class _DeviceMap:
         self.max_size = int(flat.items.shape[1])
         self.max_devices = int(flat.max_devices)
         self.depth = _tree_depth(flat)
+        # legacy bucket algorithm support: aux planes are materialized
+        # only for algs the map actually uses (straw2-only maps — the
+        # modern default — pay nothing)
+        present = set(int(a) for a, s in
+                      zip(np.asarray(flat.algs), np.asarray(flat.sizes))
+                      if s > 0)
+        self.algs_present = present
+        self.only_straw2 = present <= {ALG_STRAW2}
+        if flat.straws is not None:
+            self.straws = jnp.asarray(flat.straws, dtype=jnp.uint32)
+        if flat.sum_weights is not None:
+            self.sum_weights = jnp.asarray(flat.sum_weights,
+                                           dtype=jnp.uint32)
+        if flat.tree_weights is not None:
+            self.tree_weights = jnp.asarray(flat.tree_weights,
+                                            dtype=jnp.uint32)
+            self.tree_nodes = jnp.asarray(flat.tree_nodes,
+                                          dtype=jnp.int32)
+            self.tree_depth_max = max(
+                1, int(np.asarray(flat.tree_weights).shape[1]
+                       ).bit_length() - 1)
 
 
 def _tree_depth(flat: FlatMap) -> int:
@@ -235,6 +279,133 @@ def _straw2_choose(dm: _DeviceMap, bno, x, r):
     return items[jnp.argmax(sel)]
 
 
+def _umulhi32(a, b):
+    """(u32 * u32) >> 32 exactly, via 16-bit limbs (no 64-bit ops)."""
+    mask = _U16
+    a0, a1 = a & mask, a >> 16
+    b0, b1 = b & mask, b >> 16
+    mid = a1 * b0 + ((a0 * b0) >> 16)
+    mid2 = a0 * b1 + (mid & mask)
+    return a1 * b1 + (mid >> 16) + (mid2 >> 16)
+
+
+def _bucket_id_u32(bno):
+    """The bucket's signed id (-1-bno) as the u32 the C hashes use."""
+    return (jnp.int32(-1) - bno).astype(jnp.uint32)
+
+
+def _straw_choose(dm: _DeviceMap, bno, x, r):
+    """Original straw (reference mapper.c:227 bucket_straw_choose):
+    draw = (hash16) * precomputed straw scale; strictly-greater keeps
+    the first maximum.  Draws are 48-bit: compared as (hi, lo16)."""
+    items = dm.items[bno]
+    strw = dm.straws[bno]
+    size = dm.sizes[bno]
+    h = hashes.hash32_3(
+        x.astype(jnp.uint32), items.astype(jnp.uint32),
+        r.astype(jnp.uint32), xp=jnp) & _U16
+    hi = h * (strw >> 16)
+    lo = h * (strw & _U16)
+    c_hi = hi + (lo >> 16)
+    c_lo = lo & _U16
+    valid = jnp.arange(dm.max_size) < size
+    c_hi = jnp.where(valid, c_hi, 0)
+    c_lo = jnp.where(valid, c_lo, 0)
+    max_hi = jnp.max(c_hi)
+    cand = c_hi == max_hi
+    max_lo = jnp.max(jnp.where(cand, c_lo, 0))
+    sel = cand & (c_lo == max_lo)
+    return items[jnp.argmax(sel)]
+
+
+def _list_choose(dm: _DeviceMap, bno, x, r):
+    """List bucket (reference mapper.c:141 bucket_list_choose): walk
+    from the tail; item i wins when hash16 * sum_weights[i] >> 16 <
+    item_weights[i]; fall back to items[0]."""
+    items = dm.items[bno]
+    sumw = dm.sum_weights[bno]
+    iw = dm.weights[bno]
+    size = dm.sizes[bno]
+    h = hashes.hash32_4(
+        x.astype(jnp.uint32), items.astype(jnp.uint32),
+        r.astype(jnp.uint32), _bucket_id_u32(bno), xp=jnp) & _U16
+    scaled = h * (sumw >> 16) + ((h * (sumw & _U16)) >> 16)
+    cond = (jnp.arange(dm.max_size) < size) & (scaled < iw)
+    # the C loop runs size-1 down to 0 and returns the first hit =
+    # the LARGEST satisfying index
+    rev_first = jnp.argmax(cond[::-1])
+    idx = jnp.where(jnp.any(cond),
+                    jnp.int32(dm.max_size - 1) - rev_first.astype(jnp.int32),
+                    jnp.int32(0))
+    return items[idx]
+
+
+def _tree_choose(dm: _DeviceMap, bno, x, r):
+    """Tree bucket (reference mapper.c:195 bucket_tree_choose): descend
+    the weight tree from the root, hashing (x, node, r, id) at each
+    level; leaves live at odd nodes, item = node >> 1."""
+    nw = dm.tree_weights[bno]
+    n = (dm.tree_nodes[bno] >> 1).astype(jnp.int32)
+    bid = _bucket_id_u32(bno)
+    for _ in range(dm.tree_depth_max):
+        term = (n & 1) == 1
+        w = nw[n]
+        t = _umulhi32(
+            hashes.hash32_4(x.astype(jnp.uint32), n.astype(jnp.uint32),
+                            r.astype(jnp.uint32), bid, xp=jnp), w)
+        lowbit = (n & (-n)).astype(jnp.int32)
+        half = lowbit >> 1
+        left = n - half
+        nxt = jnp.where(t < nw[jnp.clip(left, 0, nw.shape[0] - 1)],
+                        left, n + half)
+        n = jnp.where(term, n, nxt)
+    return dm.items[bno][jnp.clip(n >> 1, 0, dm.max_size - 1)]
+
+
+def _uniform_choose(dm: _DeviceMap, bno, x, r):
+    """Uniform bucket (reference mapper.c:73 bucket_perm_choose): the
+    lazily-built pseudo-random permutation, computed functionally —
+    the C's incremental workspace state is path-independent (each step
+    p's swap depends only on (x, id, p)), so running the swaps
+    0..pr reproduces perm[pr] exactly."""
+    size = dm.sizes[bno]
+    bid = _bucket_id_u32(bno)
+    pr = (r % jnp.maximum(size, 1)).astype(jnp.int32)
+    perm = jnp.arange(dm.max_size, dtype=jnp.int32)
+    for p in range(dm.max_size - 1):
+        active = (jnp.int32(p) <= pr) & (jnp.int32(p) < size - 1)
+        i = (hashes.hash32_3(
+            x.astype(jnp.uint32), bid, jnp.uint32(p), xp=jnp)
+            % jnp.maximum(size - p, 1).astype(jnp.uint32)).astype(jnp.int32)
+        pi = jnp.clip(p + i, 0, dm.max_size - 1)
+        vp, vpi = perm[p], perm[pi]
+        swapped = perm.at[p].set(vpi).at[pi].set(vp)
+        perm = jnp.where(active, swapped, perm)
+    return dm.items[bno][perm[pr]]
+
+
+def _bucket_choose(dm: _DeviceMap, bno, x, r):
+    """Per-alg dispatch; straw2-only maps trace straight through the
+    straw2 path with zero overhead."""
+    if dm.only_straw2:
+        return _straw2_choose(dm, bno, x, r)
+    out = _straw2_choose(dm, bno, x, r)
+    alg = dm.algs[bno]
+    if ALG_STRAW in dm.algs_present:
+        out = jnp.where(alg == ALG_STRAW, _straw_choose(dm, bno, x, r),
+                        out)
+    if ALG_LIST in dm.algs_present:
+        out = jnp.where(alg == ALG_LIST, _list_choose(dm, bno, x, r),
+                        out)
+    if ALG_TREE in dm.algs_present:
+        out = jnp.where(alg == ALG_TREE, _tree_choose(dm, bno, x, r),
+                        out)
+    if ALG_UNIFORM in dm.algs_present:
+        out = jnp.where(alg == ALG_UNIFORM,
+                        _uniform_choose(dm, bno, x, r), out)
+    return out
+
+
 def _is_out(dev_weights, max_devices, item, x):
     """Reweight rejection (reference: mapper.c:424-438)."""
     wmax = dev_weights.shape[0]
@@ -285,7 +456,7 @@ def _descend(
 
     for _ in range(dm.depth):
         empty = dm.sizes[bno] == 0
-        it = _straw2_choose(dm, bno, x, r_for(bno))
+        it = _bucket_choose(dm, bno, x, r_for(bno))
         bad_item = it >= dm.max_devices
         sub_bno = -1 - it
         valid_sub = (it < 0) & (sub_bno < dm.n_buckets)
@@ -567,22 +738,18 @@ def compile_rule(
     flat: FlatMap,
     steps: Sequence[Tuple[int, int, int]],
     result_max: int,
+    choose_args=None,
 ):
     """Build fn(xs[int32 N], device_weights[uint32 D]) -> int32 [N, result_max].
 
     Steps are unrolled at trace time (rules are tiny and static); holes
     are CRUSH_ITEM_NONE.  The returned callable is jitted and vmapped;
     the whole program is uint32/int32 (see module docstring), so no x64
-    configuration is involved anywhere.
+    configuration is involved anywhere.  `choose_args`
+    ({bucket_id: [weights]}) bakes straw2 weight-set overrides into the
+    compiled rule (reference crush_do_rule's choose_args parameter).
     """
-    if not np.all(
-        (np.asarray(flat.algs) == ALG_STRAW2) | (np.asarray(flat.sizes) == 0)
-    ):
-        raise NotImplementedError(
-            "jit mapper supports straw2 buckets; use the native oracle for "
-            "legacy uniform/list/tree/straw maps"
-        )
-    dm = _DeviceMap(flat)
+    dm = _DeviceMap(flat, choose_args)
     tun = flat.tunables
     steps = [tuple(int(v) for v in s) for s in steps]
 

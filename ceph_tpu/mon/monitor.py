@@ -79,8 +79,8 @@ class Monitor(Dispatcher):
             # the mon's own dial-backs (map pushes to daemons/clients)
             # carry a self-minted ticket verifiable by the service key
             self.msgr.set_auth(
-                provider=lambda: self.auth_server.mint_authorizer(
-                    f"mon.{rank}"))
+                provider=lambda target="": self.auth_server.mint_authorizer(
+                    f"mon.{rank}", target=target))
         self._log = ctx.log.dout("mon")
         self._plog = ctx.log.dout("paxos")
         from ceph_tpu.core.lockdep import make_lock

@@ -254,8 +254,13 @@ class Messenger:
             announce.sid = conn.sid
             announce.ack_seq = conn.in_seq
             if self._auth_provider is not None:
+                # the authorizer is bound to the dialed address;
+                # providers take the target (a failure yields an empty
+                # blob, which a verifying acceptor rejects)
+                target = f"{conn.peer_addr[0]}:{conn.peer_addr[1]}"
                 try:
-                    announce.auth_blob = self._auth_provider() or b""
+                    announce.auth_blob = (
+                        self._auth_provider(target) or b"")
                 except Exception:
                     announce.auth_blob = b""
             ab = announce.to_bytes()

@@ -193,8 +193,14 @@ class ReplicatedBackend(PGBackend):
         return t
 
     def submit(self, oid, state, entries, log_omap, acting, on_commit,
-               log_rm=None):
+               log_rm=None, pre_txn=None):
         txn = self._object_txn(oid, state, log_omap, log_rm)
+        if pre_txn is not None:
+            # snapshot clone-on-write rides the SAME transaction: the
+            # clone of the pre-write head and the new head land
+            # atomically, on the primary and every replica
+            pre_txn.append(txn)
+            txn = pre_txn
         peers = [o for o in acting
                  if o != self.whoami and o != CRUSH_ITEM_NONE and o >= 0]
         tid = self._new_tid()

@@ -86,6 +86,11 @@ class MethodContext:
         self.state.omap.update(kv)
         self.exists = True
 
+    def omap_rm(self, keys) -> None:
+        self._need_write()
+        for k in keys:
+            self.state.omap.pop(k, None)
+
     def remove(self) -> None:
         self._need_write()
         self.delete_object = True
@@ -233,3 +238,13 @@ def _register_builtins(h: ClassHandler) -> None:
     h.register("version", "set", CLS_RD | CLS_WR, version_set)
     h.register("version", "get", CLS_RD, version_get)
     h.register("version", "check", CLS_RD, version_check)
+
+    # cls_counter: atomic monotonic allocators (snap ids, inode
+    # numbers, ... — the mon-allocator role for pool-local sequences)
+    def counter_alloc(ctx: MethodContext, indata: bytes) -> bytes:
+        key = (indata.decode() or "seq")
+        cur = int(ctx.omap_get([key]).get(key, b"0")) if ctx.exists else 0
+        ctx.omap_set({key: str(cur + 1).encode()})
+        return str(cur + 1).encode()
+
+    h.register("counter", "alloc", CLS_RD | CLS_WR, counter_alloc)

@@ -68,6 +68,7 @@ class OSDService(Dispatcher):
         self._tid_lock = threading.Lock()
         self._waiters: Dict[int, _Waiter] = {}
         self._read_cbs: Dict[int, Callable] = {}
+        self._notify_cbs: Dict[int, Callable] = {}
         self.wq = ShardedWorkQueue(
             f"osd{whoami}-op", ctx.conf.get("osd_op_num_shards"),
             process=lambda item: item(),
@@ -130,19 +131,30 @@ class OSDService(Dispatcher):
                 # renew the ticket before it expires (the messenger
                 # provider runs on the event loop and must never block
                 # on a re-auth RPC itself)
-                provider = lambda: self._cephx.build_authorizer()  # noqa: E731
+                provider = (  # noqa: E731
+                    lambda target="": self._cephx.build_authorizer(target))
                 self.msgr.set_auth(provider=provider)
                 self.hb_msgr.set_auth(provider=provider)
             if service is not None:
-                def _verify(blob, _svc=service):
-                    try:
-                        verify_authorizer(_svc, blob)
-                        return True
-                    except (AuthError, Exception):
-                        return False
+                def _mk_verify(msgr, _svc=service):
+                    seen = {}
 
-                self.msgr.set_auth(verifier=_verify)
-                self.hb_msgr.set_auth(verifier=_verify)
+                    def _verify(blob):
+                        try:
+                            verify_authorizer(
+                                _svc, blob,
+                                expect_target=(
+                                    f"{msgr.addr[0]}:{msgr.addr[1]}"
+                                    if msgr.addr else ""),
+                                seen=seen)
+                            return True
+                        except (AuthError, Exception):
+                            return False
+
+                    return _verify
+
+                self.msgr.set_auth(verifier=_mk_verify(self.msgr))
+                self.hb_msgr.set_auth(verifier=_mk_verify(self.hb_msgr))
         self.on_failure_report = (
             lambda osd: self.monc.report_failure(osd))
         self._map_lock = threading.Lock()
@@ -283,6 +295,18 @@ class OSDService(Dispatcher):
             return
         self.msgr.send_message(msg, addr)
 
+    # -- watch/notify plumbing --------------------------------------------
+    def register_notify(self, notify_id: int, cb) -> None:
+        self._notify_cbs[notify_id] = cb
+
+    def unregister_notify(self, notify_id: int) -> None:
+        self._notify_cbs.pop(notify_id, None)
+
+    def ms_handle_reset(self, conn) -> None:
+        # a watcher's session died: its watches die with it
+        for pg in list(self.pgs.values()):
+            pg.prune_watchers(conn)
+
     def new_tid(self) -> int:
         with self._tid_lock:
             self._tid += 1
@@ -359,11 +383,16 @@ class OSDService(Dispatcher):
                     else:
                         self.perf.inc("op_r")
 
-                pg.do_op(msg, reply)
+                pg.do_op(msg, reply, conn=conn)
 
             self.wq.queue(msg.pgid, run,
                           priority=self.ctx.conf.get("osd_client_op_priority"),
                           qos_class="client")
+            return True
+        if isinstance(msg, m.MWatchNotifyAck):
+            cb = self._notify_cbs.get(msg.notify_id)
+            if cb is not None:
+                cb(msg.src, msg.nonce, msg.cookie, msg.reply)
             return True
         # replica-side applies and reads run INLINE on the dispatch
         # thread (ordered per session, fast local store work): if they

@@ -56,18 +56,32 @@ class MOSDOp(_PGMessage):
         # client-unique request id (osd_reqid_t role): lets the PG make
         # resends exactly-once across primary failover
         self.reqid = ""
+        # snapshot context (reference SnapContext): writes carry the
+        # latest snap seq + existing snap ids so the PG can
+        # clone-on-write; reads may target a snap id (0 = head)
+        self.snap_seq = 0
+        self.snaps: List[int] = []
+        self.snapid = 0
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
         e.string(self.oid)
         e.seq(self.ops, lambda enc, o: o.encode(enc))
         e.string(self.reqid)
+        e.u64(self.snap_seq).u64(self.snapid)
+        e.seq(self.snaps, lambda enc, s: enc.u64(s))
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.oid = d.string()
         self.ops = d.seq(OSDOp.decode)
         self.reqid = d.string() if d.remaining_in_frame() else ""
+        if d.remaining_in_frame():
+            self.snap_seq = d.u64()
+            self.snapid = d.u64()
+            self.snaps = d.seq(lambda dd: dd.u64())
+        else:
+            self.snap_seq, self.snapid, self.snaps = 0, 0, []
 
 
 @register
@@ -492,3 +506,61 @@ class MScrubMap(_PGMessage):
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.digests = d.mapping(lambda dd: dd.string(), lambda dd: dd.u32())
+
+
+@register
+class MWatchNotify(_PGMessage):
+    """primary -> watcher client: a notify fired on a watched object
+    (reference MWatchNotify over the Watch/Notify machinery,
+    src/osd/Watch.cc)."""
+
+    TYPE = 28
+
+    def __init__(self, pgid=(0, 0), epoch=0, oid: str = "",
+                 notify_id: int = 0, cookie: int = 0,
+                 payload: bytes = b"") -> None:
+        super().__init__(pgid, epoch)
+        self.oid = oid
+        self.notify_id = notify_id
+        self.cookie = cookie
+        self.payload = payload
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.string(self.oid).u64(self.notify_id).u64(self.cookie)
+        e.blob(self.payload)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.oid = d.string()
+        self.notify_id = d.u64()
+        self.cookie = d.u64()
+        self.payload = d.blob()
+
+
+@register
+class MWatchNotifyAck(_PGMessage):
+    """watcher client -> primary: notify delivered (with reply blob)."""
+
+    TYPE = 29
+
+    def __init__(self, pgid=(0, 0), epoch=0, oid: str = "",
+                 notify_id: int = 0, cookie: int = 0,
+                 reply: bytes = b"") -> None:
+        super().__init__(pgid, epoch)
+        self.oid = oid
+        self.notify_id = notify_id
+        self.cookie = cookie
+        self.reply = reply
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.string(self.oid).u64(self.notify_id).u64(self.cookie)
+        e.blob(self.reply)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.oid = d.string()
+        self.notify_id = d.u64()
+        self.cookie = d.u64()
+        self.reply = d.blob()

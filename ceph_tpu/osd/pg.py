@@ -72,6 +72,10 @@ class PG:
         # resends are exactly-once across primary failover (the
         # reference's pg log osd_reqid_t dedup)
         self._reqids: Dict[str, EVersion] = {}
+        # watch/notify (reference src/osd/Watch.cc): oid -> cookie ->
+        # the watcher's connection; notifies fan out over these and the
+        # client's linger re-registers across failover
+        self.watchers: Dict[str, Dict[int, object]] = {}
         # peers whose log is behind ours: their shards are stale and must
         # not serve reads until recovery pushes complete (the reference's
         # peer_missing discipline)
@@ -131,13 +135,24 @@ class PG:
         self.backend.on_peer_change(alive)
 
     # -- op execution (primary) -------------------------------------------
-    def do_op(self, msg: m.MOSDOp, reply: Callable[[m.MOSDOpReply], None]):
+    def do_op(self, msg: m.MOSDOp, reply: Callable[[m.MOSDOpReply], None],
+              conn=None):
         with self.lock:
             if not self.is_primary():
                 rep = m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                     msg.ops, result=ESTALE)
                 reply(rep)
                 return
+            if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_WATCH:
+                self._do_watch(msg, reply, conn)
+                return
+        if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_NOTIFY:
+            self._do_notify(msg, reply)
+            return
+        if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_SNAPTRIM:
+            self._do_snaptrim(msg, reply)
+            return
+        with self.lock:
             writes = any(o.is_write() or self._call_is_write(o)
                          for o in msg.ops)
         # _do_write manages the lock itself: it must NOT be held while
@@ -149,6 +164,89 @@ class PG:
             with self.lock:
                 self._do_read(msg, reply)
 
+    # -- watch/notify (reference src/osd/Watch.cc + the do_osd_ops
+    # CEPH_OSD_OP_WATCH / NOTIFY handling) --------------------------------
+    @staticmethod
+    def _watcher_key(src, nonce, cookie: int) -> str:
+        # watchers are identified by (entity incarnation, cookie) like
+        # the reference's (entity_name, cookie) — client-chosen cookies
+        # alone collide across clients
+        return f"{src}.{nonce & 0xFFFFFFFF}:{cookie}"
+
+    def _do_watch(self, msg, reply, conn) -> None:
+        """Register/unregister a watcher (op.name: watch|unwatch,
+        op.off: the client's cookie).  Called with self.lock held."""
+        op = msg.ops[0]
+        key = self._watcher_key(msg.src, msg.nonce, int(op.off))
+        if op.name == "unwatch":
+            self.watchers.get(msg.oid, {}).pop(key, None)
+        else:
+            if conn is None:
+                reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                    msg.ops, result=EINVAL))
+                return
+            self.watchers.setdefault(msg.oid, {})[key] = (
+                int(op.off), conn)
+        reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                            msg.ops, result=0))
+
+    def _do_notify(self, msg, reply) -> None:
+        """Fan the payload out to every watcher, gather acks until all
+        answered or the timeout (op.length ms, default 5000) passes,
+        reply with {watcher key: ack blob} (reference Notify/
+        complete_watcher discipline).  The wait runs on its OWN thread:
+        an unresponsive watcher must never pin a shard worker for the
+        whole timeout (the reference's notifies are likewise async to
+        the op pipeline)."""
+        op = msg.ops[0]
+        with self.lock:
+            targets = list(self.watchers.get(msg.oid, {}).items())
+        timeout = (op.length / 1000.0) if op.length else 5.0
+        notify_id = self.osd.new_tid()
+        ev = threading.Event()
+        acks: Dict[str, bytes] = {}
+
+        def on_ack(src, nonce, cookie: int, blob: bytes) -> None:
+            acks[self._watcher_key(src, nonce, cookie)] = blob
+            if len(acks) >= len(targets):
+                ev.set()
+
+        self.osd.register_notify(notify_id, on_ack)
+        for key, (cookie, wconn) in targets:
+            note = m.MWatchNotify(self.pgid, self.osd.epoch(),
+                                  msg.oid, notify_id, cookie, op.data)
+            try:
+                wconn.send(note)
+            except Exception:
+                pass  # dead watcher: the timeout covers it
+
+        def finish() -> None:
+            try:
+                if targets:
+                    ev.wait(timeout)
+            finally:
+                self.osd.unregister_notify(notify_id)
+            op.out_kv = dict(acks)
+            # watchers that never acked (reference timed-out watchers)
+            missed = [key for key, _ in targets if key not in acks]
+            op.out_data = (",".join(missed)).encode()
+            reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                msg.ops, result=0))
+
+        threading.Thread(target=finish, daemon=True,
+                         name="notify-wait").start()
+
+    def prune_watchers(self, conn) -> None:
+        """Drop watchers whose session died (daemon ms_handle_reset)."""
+        with self.lock:
+            for oid in list(self.watchers):
+                self.watchers[oid] = {
+                    k: (c, w) for k, (c, w) in self.watchers[oid].items()
+                    if w is not conn
+                }
+                if not self.watchers[oid]:
+                    del self.watchers[oid]
+
     def _get_state(self, oid: str,
                    done: Callable[[Optional[ObjectState]], None]) -> None:
         """Fetch current full object state (degraded-aware for EC)."""
@@ -159,9 +257,16 @@ class PG:
 
     def _do_read(self, msg, reply):
         def finish(state: Optional[ObjectState]) -> None:
+            st = state
+            if getattr(msg, "snapid", 0) and not self.is_ec():
+                st = self._resolve_snap(msg.oid, msg.snapid, state)
+            if st is not None and st.xattrs.get("whiteout") == b"1":
+                # whiteouts (deleted head / deleted-as-of-snap clone)
+                # read as nonexistent
+                st = None
             result = 0
             for op in msg.ops:
-                result = self._exec_read_op(op, state)
+                result = self._exec_read_op(op, st)
                 if result < 0:
                     break
             reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
@@ -169,6 +274,98 @@ class PG:
                                 version=self.info.last_update))
 
         self._get_state(msg.oid, finish)
+
+    # -- snapshots (reference SnapSet/SnapMapper, src/osd/SnapMapper.h,
+    # osd_types.h SnapSet; clone-on-write in make_writeable) -------------
+    def _snapset_of(self, state: Optional[ObjectState]) -> Dict:
+        import json
+
+        if state is not None and "snapset" in state.xattrs:
+            try:
+                return json.loads(state.xattrs["snapset"].decode())
+            except Exception:
+                pass
+        return {"seq": 0, "clones": []}
+
+    def _resolve_snap(self, oid: str, snapid: int,
+                      head: Optional[ObjectState]) -> Optional[ObjectState]:
+        """Snap read resolution: the OLDEST clone with snap >= snapid
+        holds the state as of `snapid`; no such clone means the object
+        hasn't changed since — serve head (reference SnapSet clone
+        lookup in PrimaryLogPG::find_object_context)."""
+        ss = self._snapset_of(head)
+        cands = sorted(c for c in ss.get("clones", []) if c >= snapid)
+        if not cands:
+            return head
+        g = GHObject(oid, snap=cands[0])
+        if not self.osd.store.exists(self.coll, g):
+            return head
+        return ObjectState(
+            self.osd.store.read(self.coll, g),
+            self.osd.store.getattrs(self.coll, g),
+            self.osd.store.omap_get(self.coll, g),
+        )
+
+    def _do_snaptrim(self, msg, reply) -> None:
+        """Drop one clone (op.off = snap id) and prune it from the
+        head's SnapSet — the snap-trimmer role (reference
+        PrimaryLogPG::trim_object), as an explicit per-object op."""
+        import json
+
+        snapid = int(msg.ops[0].off)
+        state = self._read_state_sync(msg.oid)
+        if state is None or self.is_ec():
+            reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                msg.ops, result=ENOENT))
+            return
+        ss = self._snapset_of(state)
+        if snapid not in ss.get("clones", []):
+            reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                msg.ops, result=ENOENT))
+            return
+        ss["clones"] = [c for c in ss["clones"] if c != snapid]
+        state.xattrs["snapset"] = json.dumps(ss).encode()
+        pre = Transaction()
+        pre.try_remove(self.coll, GHObject(msg.oid, snap=snapid))
+        committed = threading.Event()
+        _replied = [False]
+        _rlock = threading.Lock()
+
+        def reply_once(rep) -> None:
+            with _rlock:
+                if _replied[0]:
+                    return
+                _replied[0] = True
+            reply(rep)
+
+        with self.lock:
+            self._commit_write(msg, state, False, reply_once, committed,
+                               pre_txn=pre)
+        if not committed.wait(timeout=30.0):
+            # same retryable discipline as stalled writes
+            reply_once(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                     msg.oid, msg.ops, result=EAGAIN))
+
+    def _snap_pre_txn(self, msg, state: Optional[ObjectState],
+                      work: ObjectState):
+        """Clone-on-write: first write after a new snap clones the head
+        BEFORE mutating it, in the same transaction (the reference's
+        make_writeable clone step)."""
+        snap_seq = getattr(msg, "snap_seq", 0)
+        if not snap_seq or state is None or self.is_ec():
+            return None
+        ss = self._snapset_of(state)
+        if ss["seq"] >= snap_seq:
+            return None
+        pre = Transaction()
+        pre.clone(self.coll, GHObject(msg.oid),
+                  GHObject(msg.oid, snap=snap_seq))
+        ss["clones"] = sorted(set(ss["clones"]) | {snap_seq})
+        ss["seq"] = snap_seq
+        import json
+
+        work.xattrs["snapset"] = json.dumps(ss).encode()
+        return pre
 
     # -- cls object classes (reference ClassHandler / do_osd_ops
     # CEPH_OSD_OP_CALL, PrimaryLogPG.cc:5651) --------------------------
@@ -272,9 +469,17 @@ class PG:
                 _replied[0] = True
             reply(rep)
 
+        whiteout = (state is not None
+                    and state.xattrs.get("whiteout") == b"1")
         with self.lock:
-            exists = state is not None
-            work = state or ObjectState()
+            # a whiteout head is logically ABSENT for client ops but its
+            # SnapSet must flow into any recreated head (clone-seq
+            # protection: a stale snap_seq must never re-clone over a
+            # preserved snapshot)
+            exists = state is not None and not whiteout
+            work = state if exists else ObjectState()
+            if whiteout and "snapset" in state.xattrs:
+                work.xattrs["snapset"] = state.xattrs["snapset"]
             delete = False
             result = 0
             for op in msg.ops:
@@ -300,8 +505,25 @@ class PG:
                 reply_once(m.MOSDOpReply(self.pgid, self.osd.epoch(),
                                          msg.oid, msg.ops, result=result))
                 return
-            self._commit_write(msg, None if delete else work, delete,
-                               reply_once, committed)
+            pre = self._snap_pre_txn(msg, state, work)
+            commit_state = None if delete else work
+            if delete:
+                # deleting a head that has snapshot clones keeps a
+                # WHITEOUT carrying the SnapSet (the reference's
+                # snapdir object): without it the clones become
+                # unreachable and a recreate could re-clone over them
+                ss = self._snapset_of(work)
+                if not ss.get("clones"):
+                    ss = self._snapset_of(state)
+                if ss.get("clones"):
+                    import json
+
+                    commit_state = ObjectState(
+                        b"", {"snapset": json.dumps(ss).encode(),
+                              "whiteout": b"1"}, {})
+                    delete = False
+            self._commit_write(msg, commit_state, delete,
+                               reply_once, committed, pre_txn=pre)
         # wait OUTSIDE the lock: inline replica handlers need it
         if not committed.wait(timeout=30.0):
             # a shard never acked and no map change resolved it: answer
@@ -466,7 +688,8 @@ class PG:
 
     def _commit_write(self, msg, state: Optional[ObjectState],
                       delete: bool, reply,
-                      committed: Optional[threading.Event] = None) -> None:
+                      committed: Optional[threading.Event] = None,
+                      pre_txn=None) -> None:
         version = self._next_version()
         entry = LogEntry(
             op=t_.LOG_DELETE if delete else t_.LOG_MODIFY,
@@ -494,8 +717,11 @@ class PG:
             if committed is not None:
                 committed.set()
 
+        kw = {"log_rm": log_rm}
+        if pre_txn is not None:
+            kw["pre_txn"] = pre_txn
         self.backend.submit(msg.oid, state, [entry], log_omap,
-                            self.acting, on_commit, log_rm=log_rm)
+                            self.acting, on_commit, **kw)
 
     # -- replica apply ----------------------------------------------------
     def handle_rep_op(self, msg: m.MOSDRepOp, conn) -> None:

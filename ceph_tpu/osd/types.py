@@ -135,6 +135,7 @@ OP_CREATE = 16
 OP_CALL = 17          # object class method (cls plugins)
 OP_NOTIFY = 18
 OP_WATCH = 19
+OP_SNAPTRIM = 20      # drop one clone of one object (snap trimmer role)
 
 WRITE_OPS = {OP_WRITE, OP_WRITEFULL, OP_APPEND, OP_DELETE, OP_TRUNCATE,
              OP_ZERO, OP_SETXATTR, OP_RMXATTR, OP_OMAP_SET, OP_OMAP_RM,

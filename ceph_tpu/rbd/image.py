@@ -172,4 +172,23 @@ class Image:
         return got
 
     def discard(self, off: int, length: int) -> None:
-        self.write(off, b"\0" * length)
+        """Zero a range without materializing it in one buffer: chunked
+        zero writes, and a tail discard truncates the striped data
+        (the reference deallocates extents; truncate is our extent
+        drop)."""
+        length = min(length, self.size - off)
+        if length <= 0:
+            return
+        if off + length >= self.size:
+            try:
+                self.striper.truncate(self.meta["data_prefix"], off)
+            except RadosError:
+                pass
+            return
+        step = 1 << 20
+        zeros = b"\0" * step
+        pos = off
+        while pos < off + length:
+            n = min(step, off + length - pos)
+            self.write(pos, zeros[:n])
+            pos += n

@@ -1,0 +1,13 @@
+"""RGW-role object gateway: S3-shaped buckets/objects over RADOS
+(reference: src/rgw/)."""
+
+from ceph_tpu.rgw.gateway import (
+    BucketExists,
+    BucketNotEmpty,
+    NoSuchBucket,
+    NoSuchKey,
+    RGW,
+)
+
+__all__ = ["RGW", "NoSuchBucket", "NoSuchKey", "BucketExists",
+           "BucketNotEmpty"]

@@ -1,0 +1,204 @@
+"""S3-shaped object gateway over the client library.
+
+Reference role: src/rgw/ re-derived on this framework's primitives:
+bucket metadata lives in a root registry object (the rgw_directory /
+zone bucket-index root role), each bucket's KEY INDEX is an omap on a
+bucket-index object maintained ATOMICALLY by an in-OSD `rgw` object
+class (the cls_rgw role — index updates execute inside the PG write
+pipeline, so a crashed gateway can never leave index/data torn on the
+index side), and object payloads ride the striping layer so big
+uploads fan out across PGs.
+
+Surface: create/list/delete buckets, put/get/head/delete objects with
+ETags + user metadata, prefix/marker/max-keys listing (the S3
+ListObjects pagination contract).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.client.rados import IoCtx, RadosError
+from ceph_tpu.client.striper import RadosStriper
+from ceph_tpu.osd.cls import CLS_RD, CLS_WR, ClassHandler, ClsError
+
+ROOT_OID = "rgw.root"
+
+
+class NoSuchBucket(KeyError):
+    pass
+
+
+class NoSuchKey(KeyError):
+    pass
+
+
+class BucketExists(ValueError):
+    pass
+
+
+class BucketNotEmpty(ValueError):
+    pass
+
+
+def _register_rgw_cls() -> None:
+    """cls_rgw role: atomic bucket-index mutations server-side."""
+    h = ClassHandler.instance()
+    if h.get("rgw.index_put") is not None:
+        return
+
+    def index_put(ctx, indata: bytes) -> bytes:
+        req = json.loads(indata.decode())
+        ctx.omap_set({req["key"]: json.dumps(req["entry"]).encode()})
+        return b""
+
+    def index_rm(ctx, indata: bytes) -> bytes:
+        key = indata.decode()
+        if key not in ctx.omap_get([key]):
+            raise ClsError(-2, "no such key")
+        ctx.omap_rm([key])
+        return b""
+
+    def index_list(ctx, indata: bytes) -> bytes:
+        req = json.loads(indata.decode() or "{}")
+        prefix = req.get("prefix", "")
+        marker = req.get("marker", "")
+        maxk = int(req.get("max_keys", 1000))
+        out = []
+        for k in sorted(ctx.omap_get()):
+            if k <= marker or not k.startswith(prefix):
+                continue
+            out.append((k, ctx.omap_get([k])[k].decode()))
+            if len(out) >= maxk + 1:
+                break
+        truncated = len(out) > maxk
+        return json.dumps({"entries": out[:maxk],
+                           "truncated": truncated}).encode()
+
+    h.register("rgw", "index_put", CLS_RD | CLS_WR, index_put)
+    h.register("rgw", "index_rm", CLS_RD | CLS_WR, index_rm)
+    h.register("rgw", "index_list", CLS_RD, index_list)
+
+
+_register_rgw_cls()
+
+
+class RGW:
+    def __init__(self, ioctx: IoCtx, stripe_unit: int = 65536,
+                 object_size: int = 4 << 20) -> None:
+        self.io = ioctx
+        self.striper = RadosStriper(ioctx, stripe_unit=stripe_unit,
+                                    stripe_count=4,
+                                    object_size=object_size)
+
+    # -- buckets -----------------------------------------------------------
+    def _index_oid(self, bucket: str) -> str:
+        return f"rgw.bucket.{bucket}"
+
+    def create_bucket(self, name: str) -> None:
+        try:
+            known = self.io.omap_get(ROOT_OID, [name])
+        except RadosError:
+            known = {}
+        if name in known:
+            raise BucketExists(name)
+        self.io.write_full(self._index_oid(name), b"")
+        meta = {"created": time.time()}
+        self.io.omap_set(ROOT_OID, {name: json.dumps(meta).encode()})
+
+    def list_buckets(self) -> List[str]:
+        try:
+            return sorted(self.io.omap_get(ROOT_OID))
+        except RadosError:
+            return []
+
+    def _require_bucket(self, name: str) -> None:
+        try:
+            known = self.io.omap_get(ROOT_OID, [name])
+        except RadosError:
+            raise NoSuchBucket(name)
+        if name not in known:
+            raise NoSuchBucket(name)
+
+    def delete_bucket(self, name: str) -> None:
+        self._require_bucket(name)
+        if self.list_objects(name, max_keys=1)[0]:
+            raise BucketNotEmpty(name)
+        try:
+            self.io.remove(self._index_oid(name))
+        except RadosError:
+            pass
+        self.io.operate(ROOT_OID, [_omap_rm(name)])
+
+    # -- objects -----------------------------------------------------------
+    def _data_oid(self, bucket: str, key: str) -> str:
+        return f"rgw.obj.{bucket}/{key}"
+
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        self._require_bucket(bucket)
+        etag = hashlib.md5(data).hexdigest()
+        self.striper.write(self._data_oid(bucket, key), data)
+        entry = {"size": len(data), "etag": etag,
+                 "mtime": time.time(), "meta": metadata or {}}
+        # ATOMIC index update inside the PG (cls_rgw role)
+        self.io.call(self._index_oid(bucket), "rgw", "index_put",
+                     json.dumps({"key": key, "entry": entry}).encode())
+        return etag
+
+    def head_object(self, bucket: str, key: str) -> Dict:
+        self._require_bucket(bucket)
+        got = self.io.call(self._index_oid(bucket), "rgw", "index_list",
+                           json.dumps({"prefix": key,
+                                       "max_keys": 1}).encode())
+        entries = json.loads(got.decode())["entries"]
+        if not entries or entries[0][0] != key:
+            raise NoSuchKey(f"{bucket}/{key}")
+        return json.loads(entries[0][1])
+
+    def get_object(self, bucket: str, key: str) -> Tuple[bytes, Dict]:
+        head = self.head_object(bucket, key)
+        data = self.striper.read(self._data_oid(bucket, key),
+                                 head["size"])
+        return data, head
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._require_bucket(bucket)
+        try:
+            self.io.call(self._index_oid(bucket), "rgw", "index_rm",
+                         key.encode())
+        except RadosError as e:
+            if e.rc == -2:
+                raise NoSuchKey(f"{bucket}/{key}")
+            raise
+        try:
+            self.striper.remove(self._data_oid(bucket, key))
+        except RadosError:
+            pass
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     marker: str = "", max_keys: int = 1000
+                     ) -> Tuple[List[Dict], bool]:
+        """S3 ListObjects: ([{Key, Size, ETag}...], is_truncated)."""
+        self._require_bucket(bucket)
+        got = self.io.call(self._index_oid(bucket), "rgw", "index_list",
+                           json.dumps({"prefix": prefix,
+                                       "marker": marker,
+                                       "max_keys": max_keys}).encode())
+        out = json.loads(got.decode())
+        entries = []
+        for k, blob in out["entries"]:
+            e = json.loads(blob)
+            entries.append({"Key": k, "Size": e["size"],
+                            "ETag": e["etag"], "Meta": e.get("meta", {})})
+        return entries, out["truncated"]
+
+
+def _omap_rm(key: str):
+    from ceph_tpu.osd import types as t_
+    from ceph_tpu.osd.types import OSDOp
+
+    return OSDOp(t_.OP_OMAP_RM, keys=[key])

@@ -92,3 +92,52 @@ int crushref_do_rule_batch(void *vmap, int ruleno, const int *xs, int n_x,
   free(cwin);
   return result_max;
 }
+
+/* Like crushref_do_rule_batch but with per-bucket weight-set overrides
+ * (choose_args): arg_weights is [n_buckets * max_size] flattened in
+ * flat-bucket order (index -1-id), arg_sizes[n_buckets] gives each
+ * bucket's item count (0 = no override for that bucket). */
+int crushref_do_rule_batch_args(void *vmap, int ruleno, const int *xs,
+                                int n_x, int result_max,
+                                const unsigned *weights, int weight_max,
+                                const unsigned *arg_weights,
+                                const int *arg_sizes, int n_buckets,
+                                int max_size, int *out) {
+  struct crush_map *map = (struct crush_map *)vmap;
+  struct crush_choose_arg *args =
+      (struct crush_choose_arg *)calloc((size_t)n_buckets, sizeof(*args));
+  struct crush_weight_set *sets =
+      (struct crush_weight_set *)calloc((size_t)n_buckets, sizeof(*sets));
+  if (!args || !sets) {
+    free(args);
+    free(sets);
+    return -1;
+  }
+  for (int b = 0; b < n_buckets; b++) {
+    if (arg_sizes[b] > 0) {
+      sets[b].weights = (unsigned *)(arg_weights + (size_t)b * max_size);
+      sets[b].size = (unsigned)arg_sizes[b];
+      args[b].weight_set = &sets[b];
+      args[b].weight_set_positions = 1;
+    }
+  }
+  char *cwin = (char *)malloc(crush_work_size(map, result_max));
+  int *result = (int *)malloc(sizeof(int) * (size_t)result_max);
+  int rc = result_max;
+  if (!cwin || !result) {
+    rc = -1;
+  } else {
+    for (int i = 0; i < n_x; i++) {
+      crush_init_workspace(map, cwin);
+      int n = crush_do_rule(map, ruleno, xs[i], result, result_max,
+                            weights, weight_max, cwin, args);
+      for (int r = 0; r < result_max; r++)
+        out[i * result_max + r] = (r < n) ? result[r] : CRUSH_ITEM_NONE;
+    }
+  }
+  free(result);
+  free(cwin);
+  free(sets);
+  free(args);
+  return rc;
+}

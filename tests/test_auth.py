@@ -254,3 +254,33 @@ def test_authenticated_cluster_io():
         for o in osds:
             o.shutdown()
         mon.shutdown()
+
+
+def test_authorizer_replay_and_target_binding():
+    """A captured authorizer cannot be replayed (seen-cache) or pointed
+    at a different daemon (target binding) — the CVE-2018-1128 class of
+    attack in the reference."""
+    kr = Keyring()
+    kr.add("service")
+    secret = kr.add("client.9")
+    server = CephxServer(kr)
+    cx = _handshake(server, "client.9", secret)
+
+    blob = cx.build_authorizer(target="127.0.0.1:6800")
+    seen = {}
+    t = verify_authorizer(server.service_secret, blob,
+                          expect_target="127.0.0.1:6800", seen=seen)
+    assert t.name == "client.9"
+    # same blob again: replay rejected
+    with pytest.raises(AuthError):
+        verify_authorizer(server.service_secret, blob,
+                          expect_target="127.0.0.1:6800", seen=seen)
+    # bound to another daemon: rejected there
+    blob2 = cx.build_authorizer(target="127.0.0.1:6800")
+    with pytest.raises(AuthError):
+        verify_authorizer(server.service_secret, blob2,
+                          expect_target="127.0.0.1:6801", seen={})
+    # a fresh blob for the right target still works
+    blob3 = cx.build_authorizer(target="127.0.0.1:6800")
+    verify_authorizer(server.service_secret, blob3,
+                      expect_target="127.0.0.1:6800", seen=seen)

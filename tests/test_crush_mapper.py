@@ -154,13 +154,17 @@ def test_distribution_tracks_weights():
     np.testing.assert_allclose(frac, expect, atol=0.02)
 
 
-def test_non_straw2_falls_back():
+def test_uniform_bucket_compiles():
+    """Round 3: every legacy bucket alg compiles in the jit path (the
+    round-2 fallback-to-oracle gap is closed)."""
     m = cmap.CrushMap()
     root = m.add_bucket(cmap.ALG_UNIFORM, 10, [0, 1, 2], [0x10000] * 3)
-    with pytest.raises(NotImplementedError):
-        mapper.compile_rule(
-            m.flatten(),
-            [(cmap.OP_TAKE, root, 0), (cmap.OP_CHOOSE_FIRSTN, 1, 0),
-             (cmap.OP_EMIT, 0, 0)],
-            1,
-        )
+    fn = mapper.compile_rule(
+        m.flatten(),
+        [(cmap.OP_TAKE, root, 0), (cmap.OP_CHOOSE_FIRSTN, 1, 0),
+         (cmap.OP_EMIT, 0, 0)],
+        1,
+    )
+    out = np.asarray(fn(np.arange(64, dtype=np.int32),
+                        np.full(3, 0x10000, dtype=np.uint32)))
+    assert set(np.unique(out)) <= {0, 1, 2}

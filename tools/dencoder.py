@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""ceph-dencoder — encoding inspection + cross-version corpus checks.
+
+Reference role: src/tools/ceph-dencoder/ with the ceph-object-corpus
+discipline (SURVEY §4 tier 5): every registered wire type can be
+listed, encoded from a representative example, decoded and round-trip
+checked; `corpus generate` archives today's encodings and
+`corpus verify` proves a NEWER build still decodes them — the guard
+that encodings only evolve forward-compatibly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import binascii
+import os
+import sys
+
+from ceph_tpu.core.encoding import Encoder
+from ceph_tpu.msg.message import MSG_REGISTRY, EntityName, Message
+from ceph_tpu.osd import map_codec, map_inc, messages as om  # noqa: F401
+from ceph_tpu.mon import messages as mm  # noqa: F401 (registers types)
+from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp
+
+
+def _example(cls: type) -> Message:
+    """A representative instance: defaults + generically populated
+    common fields so encodings exercise real content."""
+    msg = cls()
+    msg.tid = 42
+    msg.seq = 7
+    msg.src = EntityName("client", 4242)
+    for name, val in (
+        ("oid", "corpus-object"), ("epoch", 33), ("pgid", (2, 5)),
+        ("data", b"corpus-payload"), ("txn", b"\x01\x02\x03"),
+        ("shard", 1), ("result", 0), ("version", EVersion(3, 9)),
+        ("ops", [OSDOp(3, off=8, data=b"x")]),
+        ("entries", [LogEntry(op=1, oid="e", version=EVersion(3, 9),
+                              prior_version=EVersion(3, 8),
+                              reqid="client.1:5")]),
+        ("reqid", "client.1:5"), ("name", "osd.0"),
+        ("value", b"paxos-value"), ("cmd", {"prefix": "status"}),
+        ("what", "osdmap:127.0.0.1:1234"),
+    ):
+        if hasattr(msg, name):
+            cur = getattr(msg, name)
+            # only when the example value matches the field's actual
+            # type (e.g. MMonPaxos.version is an int, not EVersion)
+            if cur is None or isinstance(val, type(cur)):
+                try:
+                    setattr(msg, name, val)
+                except Exception:
+                    pass
+    return msg
+
+
+def type_names():
+    return sorted(c.__name__ for c in MSG_REGISTRY.values())
+
+
+def _cls(name: str) -> type:
+    for c in MSG_REGISTRY.values():
+        if c.__name__ == name:
+            return c
+    raise SystemExit(f"unknown type {name!r}; see `list`")
+
+
+def roundtrip(cls: type) -> bytes:
+    blob = _example(cls).to_bytes()
+    back = Message.from_bytes(blob)
+    blob2 = back.to_bytes()
+    if blob != blob2:
+        raise SystemExit(
+            f"{cls.__name__}: re-encode differs after decode "
+            f"({len(blob)}B vs {len(blob2)}B)")
+    return blob
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ceph-dencoder")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list")
+    e = sub.add_parser("encode")
+    e.add_argument("type")
+    d = sub.add_parser("decode")
+    d.add_argument("hexfile")
+    sub.add_parser("roundtrip-all")
+    c = sub.add_parser("corpus")
+    c.add_argument("action", choices=["generate", "verify"])
+    c.add_argument("dir")
+    args = p.parse_args(argv)
+
+    if args.cmd == "list":
+        for n in type_names():
+            print(n)
+        return 0
+    if args.cmd == "encode":
+        print(binascii.hexlify(_example(_cls(args.type))).decode())
+        return 0
+    if args.cmd == "decode":
+        with open(args.hexfile) as f:
+            blob = binascii.unhexlify(f.read().strip())
+        msg = Message.from_bytes(blob)
+        print(type(msg).__name__, vars(msg))
+        return 0
+    if args.cmd == "roundtrip-all":
+        for cls in sorted(MSG_REGISTRY.values(),
+                          key=lambda c: c.__name__):
+            blob = roundtrip(cls)
+            print(f"{cls.__name__}: ok ({len(blob)}B)")
+        return 0
+    if args.cmd == "corpus":
+        os.makedirs(args.dir, exist_ok=True)
+        bad = 0
+        for cls in sorted(MSG_REGISTRY.values(),
+                          key=lambda c: c.__name__):
+            path = os.path.join(args.dir, cls.__name__ + ".bin")
+            if args.action == "generate":
+                with open(path, "wb") as f:
+                    f.write(_example(cls).to_bytes())
+                print(f"wrote {path}")
+            else:
+                if not os.path.exists(path):
+                    # a type with no archived blob (new this build, or
+                    # a test-registered type): nothing old to break
+                    print(f"skip {cls.__name__}: no archived encoding")
+                    continue
+                with open(path, "rb") as f:
+                    blob = f.read()
+                try:
+                    msg = Message.from_bytes(blob)
+                    assert type(msg).__name__ == cls.__name__
+                    print(f"{cls.__name__}: decodes ok")
+                except Exception as ex:
+                    print(f"FAIL {cls.__name__}: {ex!r}")
+                    bad += 1
+        return 1 if bad else 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
