@@ -1,0 +1,29 @@
+"""Reference-code quarantine guard.
+
+The ONLY permitted use of the reference's compiled CRUSH C
+(ceph_tpu/libcrush_ref.so, built in place from /root/reference/src/crush
+by csrc/Makefile) is differential testing: conformance tests and the
+bench baseline.  Product code must never call it — the jit mapper and
+the re-derived C++ oracle are the product.  This test fails the build if
+any ceph_tpu module (other than the binding itself) imports it.
+"""
+
+import os
+import re
+
+PKG = os.path.join(os.path.dirname(__file__), os.pardir, "ceph_tpu")
+
+
+def test_product_code_never_imports_reference_oracle():
+    offenders = []
+    for dirpath, _dirnames, filenames in os.walk(PKG):
+        for fn in filenames:
+            if not fn.endswith(".py") or fn == "_crush_ref.py":
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            if re.search(r"\b_crush_ref\b", src):
+                offenders.append(os.path.relpath(path, PKG))
+    assert not offenders, (
+        f"product modules import the reference oracle: {offenders}")
