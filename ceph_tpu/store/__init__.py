@@ -26,4 +26,8 @@ def create(kind: str, path: str = "", **kw):
         from ceph_tpu.store.filestore import FileStore
 
         return FileStore(path, **kw)
+    if kind == "blockstore":
+        from ceph_tpu.store.blockstore import BlockStore
+
+        return BlockStore(path, **kw)
     raise ValueError(f"unknown objectstore {kind!r}")

@@ -32,6 +32,72 @@ class WriteBatch:
         self.ops.append((False, prefix + _SEP + key, b""))
 
 
+class KVIterator:
+    """Seekable ordered iterator over one prefix space — the reference
+    KeyValueDB::IteratorImpl surface (src/kv/KeyValueDB.h: seek_to_first,
+    lower_bound, upper_bound, valid, next, prev, key, value).  Operates
+    on a stable point-in-time view, like a RocksDB iterator."""
+
+    def __init__(self, items: List[Tuple[str, bytes]]) -> None:
+        self._items = items  # sorted
+        self._keys = [k for k, _ in items]
+        self._pos = 0
+
+    def seek_to_first(self) -> "KVIterator":
+        self._pos = 0
+        return self
+
+    def seek_to_last(self) -> "KVIterator":
+        self._pos = len(self._items) - 1
+        return self
+
+    def lower_bound(self, key: str) -> "KVIterator":
+        import bisect
+
+        self._pos = bisect.bisect_left(self._keys, key)
+        return self
+
+    def upper_bound(self, key: str) -> "KVIterator":
+        import bisect
+
+        self._pos = bisect.bisect_right(self._keys, key)
+        return self
+
+    def valid(self) -> bool:
+        return 0 <= self._pos < len(self._items)
+
+    def next(self) -> None:
+        self._pos += 1
+
+    def prev(self) -> None:
+        self._pos -= 1
+
+    def key(self) -> str:
+        return self._items[self._pos][0]
+
+    def value(self) -> bytes:
+        return self._items[self._pos][1]
+
+
+class KVSnapshot:
+    """Read-only point-in-time view (the RocksDB GetSnapshot role):
+    reads are stable against later submits."""
+
+    def __init__(self, data: Dict[str, bytes]) -> None:
+        self._data = data
+
+    def get(self, prefix: str, key: str) -> Optional[bytes]:
+        return self._data.get(prefix + _SEP + key)
+
+    def iterate(self, prefix: str) -> Iterator[Tuple[str, bytes]]:
+        pat = prefix + _SEP
+        return iter(sorted((k[len(pat):], v) for k, v in self._data.items()
+                           if k.startswith(pat)))
+
+    def get_iterator(self, prefix: str) -> KVIterator:
+        return KVIterator(list(self.iterate(prefix)))
+
+
 class KeyValueDB:
     def open(self) -> None:
         raise NotImplementedError
@@ -58,6 +124,14 @@ class KeyValueDB:
         for k, v in self.iterate(space):
             if k.startswith(key_prefix):
                 yield k, v
+
+    def get_iterator(self, prefix: str) -> KVIterator:
+        """Seekable iterator over `prefix` (KeyValueDB::get_iterator)."""
+        return KVIterator(list(self.iterate(prefix)))
+
+    def snapshot(self) -> KVSnapshot:
+        """Stable read view (RocksDB GetSnapshot role)."""
+        raise NotImplementedError
 
 
 class MemDB(KeyValueDB):
@@ -89,6 +163,10 @@ class MemDB(KeyValueDB):
                 if k.startswith(pat)
             )
         return iter(items)
+
+    def snapshot(self) -> KVSnapshot:
+        with self._lock:
+            return KVSnapshot(dict(self._data))
 
 
 class LogKV(KeyValueDB):
@@ -213,3 +291,7 @@ class LogKV(KeyValueDB):
                 if k.startswith(pat)
             )
         return iter(items)
+
+    def snapshot(self) -> KVSnapshot:
+        with self._lock:
+            return KVSnapshot(dict(self._data))

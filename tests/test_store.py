@@ -22,7 +22,7 @@ CID = Collection("1.0_head")
 OID = GHObject("obj1")
 
 
-@pytest.fixture(params=["memstore", "filestore"])
+@pytest.fixture(params=["memstore", "filestore", "blockstore"])
 def store(request, tmp_path):
     s = create(request.param, path=str(tmp_path / "store"))
     s.mkfs()
@@ -306,3 +306,59 @@ def test_same_txn_setattr_then_remove_no_resurrect(store):
     t.touch(CID, OID)  # re-create same name
     store.queue_transaction(t)
     assert store.getattrs(CID, OID) == {}  # no stale attr resurrects
+
+
+def test_kv_iterator_seek_surface():
+    db = MemDB()
+    db.open()
+    b = WriteBatch()
+    for k in ("a", "b", "d", "e"):
+        b.set("P", k, k.encode())
+    db.submit(b)
+    it = db.get_iterator("P")
+    it.seek_to_first()
+    assert it.valid() and it.key() == "a"
+    it.lower_bound("c")
+    assert it.key() == "d"
+    it.upper_bound("d")
+    assert it.key() == "e"
+    it.next()
+    assert not it.valid()
+    it.seek_to_last()
+    assert it.key() == "e"
+    it.prev()
+    assert it.key() == "d"
+    # iterators are stable views: later writes don't appear
+    b2 = WriteBatch()
+    b2.set("P", "c", b"c")
+    db.submit(b2)
+    it.seek_to_first()
+    keys = []
+    while it.valid():
+        keys.append(it.key())
+        it.next()
+    assert keys == ["a", "b", "d", "e"]  # no "c" in the old view
+    it2 = db.get_iterator("P")
+    it2.lower_bound("c")
+    assert it2.key() == "c"
+
+
+def test_kv_snapshot_isolated_from_writes(tmp_path):
+    db = LogKV(str(tmp_path / "kv.log"))
+    db.open()
+    b = WriteBatch()
+    b.set("P", "x", b"1")
+    db.submit(b)
+    snap = db.snapshot()
+    b2 = WriteBatch()
+    b2.set("P", "x", b"2")
+    b2.set("P", "y", b"3")
+    db.submit(b2)
+    assert snap.get("P", "x") == b"1"
+    assert snap.get("P", "y") is None
+    assert dict(snap.iterate("P")) == {"x": b"1"}
+    assert db.get("P", "x") == b"2"
+    it = snap.get_iterator("P")
+    it.seek_to_first()
+    assert it.key() == "x" and it.value() == b"1"
+    db.close()
