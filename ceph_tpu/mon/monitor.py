@@ -118,6 +118,12 @@ class Monitor(Dispatcher):
             "default": "plugin=isa k=2 m=1 technique=reed_sol_van",
         }
 
+        # PaxosService family (reference src/mon/PaxosService.h):
+        # Config/Log/Health/Auth monitors multiplexed onto this paxos
+        from ceph_tpu.mon import services as mon_services
+
+        self.services = mon_services.build_services(self)
+
         # mutations accumulate into ONE pending map (the reference's
         # pending_inc): concurrent boots/failures/commands each cloning
         # the committed map would otherwise clobber each other
@@ -171,10 +177,14 @@ class Monitor(Dispatcher):
             if full:
                 self.osdmap = map_codec.decode_osdmap(full)
             start = int(fv) if fv else 0
+            from ceph_tpu.mon.services import SVC_TAG
+
             for v in range(start + 1, self.last_committed + 1):
                 data = self.kv.get("paxos_values", str(v))
                 if not data:
                     continue
+                if data[0] == SVC_TAG:
+                    continue  # service state reloads from its own kv rows
                 try:
                     newmap = map_inc.decode_value(data, self.osdmap)
                     if (self.osdmap is None
@@ -196,6 +206,8 @@ class Monitor(Dispatcher):
         prof = self.kv.get("mon", "ec_profiles")
         if prof:
             self.ec_profiles = json.loads(prof.decode())
+        for svc in self.services.values():
+            svc.load()
 
     def _persist(self, **kv_updates) -> None:
         b = WriteBatch()
@@ -207,8 +219,11 @@ class Monitor(Dispatcher):
         self.kv.submit(b)
 
     def _persist_value(self, version: int, value: bytes,
-                       clear_uncommitted: bool = True) -> None:
+                       clear_uncommitted: bool = True,
+                       extra: Optional[WriteBatch] = None) -> None:
         b = WriteBatch()
+        if extra is not None:
+            b.ops.extend(extra.ops)
         b.set("paxos_values", str(version), value)
         b.set("paxos", "last_committed", str(version).encode())
         if clear_uncommitted:
@@ -527,6 +542,28 @@ class Monitor(Dispatcher):
         # value the old leader already committed
         keep = (self.uncommitted is not None
                 and self.uncommitted[1] > version)
+        from ceph_tpu.mon import services as mon_services
+
+        if value and value[0] == mon_services.SVC_TAG:
+            # PaxosService payload: the service's state rows land in the
+            # SAME KV batch as the paxos value, so a crash can never
+            # leave a committed value unapplied (the reference applies
+            # service state in the paxos transaction,
+            # PaxosService::propose_pending)
+            batch = WriteBatch()
+            try:
+                payload = mon_services.decode_payload(value)
+                svc = self.services.get(payload.get("svc", ""))
+                if svc is not None:
+                    svc.apply(payload, batch)
+            except Exception as e:  # pragma: no cover
+                self._plog(0, f"failed to apply service value: {e}")
+            self._persist_value(version, value, clear_uncommitted=not keep,
+                                extra=batch)
+            self.last_committed = version
+            if not keep:
+                self.uncommitted = None
+            return
         self._persist_value(version, value, clear_uncommitted=not keep)
         self.last_committed = version
         if not keep:
@@ -878,6 +915,10 @@ class Monitor(Dispatcher):
                 self._mutate_map(
                     lambda nm: nm.reweight_osd(osd, int(weight * 0x10000)))
             return 0, {}
+        for svc in self.services.values():
+            got = svc.command(cmd)
+            if got is not None:
+                return got
         return -22, {"error": f"unknown command {prefix!r}"}
 
     def _cmd_pool_create(self, cmd: dict) -> Tuple[int, dict]:

@@ -1,0 +1,301 @@
+"""PaxosService family: Config/Log/Health/Auth monitors.
+
+Reference: src/mon/PaxosService.{h,cc} — each cluster service keeps its
+own versioned state machine, but ALL of them serialize their commits
+through the monitor's single Paxos instance.  Same inversion here: a
+service mutation is proposed as a tagged value (SVC_TAG + JSON payload)
+on the same paxos stream that carries OSDMap commits; every mon —
+leader and peons alike — applies it in `_learn`, so service state is
+exactly as replicated and exactly as durable as the map itself.
+
+Services (each cites its reference counterpart):
+- ConfigMonitor  (src/mon/ConfigMonitor.cc): centralized config db,
+  `config set/rm/get/dump`, applied to the local daemon config when the
+  section matches (the reference pushes config to subscribed daemons;
+  here daemons read it via `config get` / the mon applies it locally).
+- LogMonitor    (src/mon/LogMonitor.cc): the cluster log — `log` adds
+  an entry through paxos, `log last` reads the tail; bounded retention.
+- HealthMonitor (src/mon/HealthMonitor.cc): health checks derived from
+  the osdmap (down/out OSDs) plus persisted mutes; `health` returns
+  HEALTH_OK/WARN + the check list.
+- AuthMonitor   (src/mon/AuthMonitor.cc): entity key db on top of the
+  cephx keyring — `auth get-or-create/get/ls/rm`; new keys replicate
+  through paxos so every mon's CephxServer can validate them.
+
+Commit semantics: mutating commands return after the value is QUEUED on
+the leader's paxos (on a single-mon cluster that is synchronous commit,
+matching the tests; on multi-mon the commit lands one accept round
+later) — the same asynchrony the map-mutation path already has.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.store.kv import WriteBatch
+
+# paxos-value tag for service payloads; map values use 0/1
+# (ceph_tpu/osd/map_inc.py FULL_TAG/INC_TAG)
+SVC_TAG = 0xD5
+
+
+def encode_payload(svc: str, payload: dict) -> bytes:
+    return bytes([SVC_TAG]) + json.dumps(
+        {"svc": svc, **payload}, sort_keys=True).encode()
+
+
+def decode_payload(value: bytes) -> dict:
+    return json.loads(value[1:].decode())
+
+
+class PaxosService:
+    """One service state machine multiplexed onto the mon's Paxos."""
+
+    name = ""
+
+    def __init__(self, mon) -> None:
+        self.mon = mon
+        self.kv = mon.kv
+
+    def load(self) -> None:
+        """Restore committed state from the mon's KV."""
+
+    def apply(self, payload: dict, batch: WriteBatch) -> None:
+        """Apply one committed payload — runs on EVERY mon.  All KV
+        persistence goes into `batch`, which the monitor submits
+        atomically WITH the paxos value (a crash can never separate a
+        committed value from its effect)."""
+
+    def command(self, cmd: dict) -> Optional[Tuple[int, dict]]:
+        """Handle a mon command; None = not mine."""
+        return None
+
+    def health_checks(self) -> Dict[str, dict]:
+        """Contribution to `health` output."""
+        return {}
+
+    def propose(self, payload: dict) -> None:
+        self.mon.propose(encode_payload(self.name, payload))
+
+
+class ConfigMonitor(PaxosService):
+    name = "config"
+
+    def __init__(self, mon) -> None:
+        super().__init__(mon)
+        self.db: Dict[str, Dict[str, str]] = {}  # section -> key -> value
+
+    def load(self) -> None:
+        raw = self.kv.get("svc_config", "db")
+        self.db = json.loads(raw.decode()) if raw else {}
+
+    def apply(self, payload: dict, batch: WriteBatch) -> None:
+        op = payload["op"]
+        who, key = payload["who"], payload.get("key", "")
+        if op == "set":
+            self.db.setdefault(who, {})[key] = payload["value"]
+        elif op == "rm":
+            self.db.get(who, {}).pop(key, None)
+        batch.set("svc_config", "db", json.dumps(self.db).encode())
+        # hot-apply to this mon's own runtime config when addressed
+        # (reference: daemons apply pushed config via md_config_t)
+        if who in ("global", "mon", f"mon.{self.mon.rank}"):
+            try:
+                if op == "set":
+                    self.mon.ctx.conf.set_val(key, payload["value"])
+            except Exception:
+                pass  # unknown/invalid key stays db-only
+
+    def get_effective(self, who: str) -> Dict[str, str]:
+        """global < type < type.id precedence (ConfigMonitor.cc
+        get_config shape)."""
+        out: Dict[str, str] = dict(self.db.get("global", {}))
+        if "." in who:
+            kind = who.split(".", 1)[0]
+            out.update(self.db.get(kind, {}))
+        out.update(self.db.get(who, {}))
+        return out
+
+    def command(self, cmd: dict) -> Optional[Tuple[int, dict]]:
+        prefix = cmd.get("prefix", "")
+        if prefix == "config set":
+            self.propose({"op": "set", "who": cmd["who"],
+                          "key": cmd["name"], "value": str(cmd["value"])})
+            return 0, {}
+        if prefix == "config rm":
+            self.propose({"op": "rm", "who": cmd["who"], "key": cmd["name"]})
+            return 0, {}
+        if prefix == "config get":
+            return 0, {"config": self.get_effective(cmd["who"])}
+        if prefix == "config dump":
+            return 0, {"config": {k: dict(v) for k, v in self.db.items()}}
+        return None
+
+
+class LogMonitor(PaxosService):
+    name = "logm"
+    KEEP = 500
+
+    def __init__(self, mon) -> None:
+        super().__init__(mon)
+        self.entries: List[dict] = []  # {stamp, who, level, msg}
+
+    def load(self) -> None:
+        raw = self.kv.get("svc_log", "entries")
+        self.entries = json.loads(raw.decode()) if raw else []
+
+    def apply(self, payload: dict, batch: WriteBatch) -> None:
+        self.entries.append({
+            "stamp": payload.get("stamp", 0.0),
+            "who": payload.get("who", "?"),
+            "level": payload.get("level", "info"),
+            "msg": payload.get("msg", ""),
+        })
+        del self.entries[:-self.KEEP]
+        batch.set("svc_log", "entries", json.dumps(self.entries).encode())
+
+    def log(self, who: str, msg: str, level: str = "info") -> None:
+        """Daemon-facing API (the reference's LogClient -> MLog path)."""
+        self.propose({"who": who, "msg": msg, "level": level,
+                      "stamp": time.time()})
+
+    def command(self, cmd: dict) -> Optional[Tuple[int, dict]]:
+        prefix = cmd.get("prefix", "")
+        if prefix == "log":
+            self.propose({"who": cmd.get("who", "client"),
+                          "msg": str(cmd.get("logtext", "")),
+                          "level": cmd.get("level", "info"),
+                          "stamp": time.time()})
+            return 0, {}
+        if prefix == "log last":
+            n = int(cmd.get("num", 20))
+            return 0, {"lines": self.entries[-n:]}
+        return None
+
+
+class HealthMonitor(PaxosService):
+    name = "health"
+
+    def __init__(self, mon) -> None:
+        super().__init__(mon)
+        self.muted: Dict[str, bool] = {}
+
+    def load(self) -> None:
+        raw = self.kv.get("svc_health", "muted")
+        self.muted = json.loads(raw.decode()) if raw else {}
+
+    def apply(self, payload: dict, batch: WriteBatch) -> None:
+        if payload["op"] == "mute":
+            self.muted[payload["check"]] = True
+        elif payload["op"] == "unmute":
+            self.muted.pop(payload["check"], None)
+        batch.set("svc_health", "muted", json.dumps(self.muted).encode())
+
+    def gather(self) -> Tuple[str, Dict[str, dict]]:
+        """HEALTH_OK/HEALTH_WARN + checks, derived live from the map +
+        every service's contributions (HealthMonitor.cc check shape)."""
+        checks: Dict[str, dict] = {}
+        m = self.mon.osdmap
+        if m is not None:
+            down = [i for i in range(m.max_osd)
+                    if not bool(m.osd_state_up[i])]
+            if down:
+                checks["OSD_DOWN"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": f"{len(down)} osds down",
+                    "detail": [f"osd.{i} is down" for i in down],
+                }
+            out = [i for i in range(m.max_osd)
+                   if int(m.osd_weight[i]) == 0]
+            if out:
+                checks["OSD_OUT"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": f"{len(out)} osds out",
+                    "detail": [f"osd.{i} is out" for i in out],
+                }
+        for svc in self.mon.services.values():
+            if svc is not self:
+                checks.update(svc.health_checks())
+        live = {k: v for k, v in checks.items() if k not in self.muted}
+        rank = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
+        status = "HEALTH_OK"
+        for c in live.values():
+            if rank.get(c["severity"], 0) > rank[status]:
+                status = c["severity"]
+        return status, checks
+
+    def command(self, cmd: dict) -> Optional[Tuple[int, dict]]:
+        prefix = cmd.get("prefix", "")
+        if prefix == "health":
+            status, checks = self.gather()
+            return 0, {"status": status, "checks": checks,
+                       "muted": sorted(self.muted)}
+        if prefix == "health mute":
+            self.propose({"op": "mute", "check": cmd["check"]})
+            return 0, {}
+        if prefix == "health unmute":
+            self.propose({"op": "unmute", "check": cmd["check"]})
+            return 0, {}
+        return None
+
+
+class AuthMonitor(PaxosService):
+    name = "auth"
+
+    def load(self) -> None:
+        raw = self.kv.get("svc_auth", "keyring")
+        if raw and self.mon.auth_server is not None:
+            from ceph_tpu.auth.keyring import Keyring
+
+            stored = Keyring.loads(raw.decode())
+            kr = self.mon.auth_server.keyring
+            for name in stored.names():
+                kr.add(name, stored.get(name))
+
+    def apply(self, payload: dict, batch: WriteBatch) -> None:
+        if self.mon.auth_server is None:
+            return
+        kr = self.mon.auth_server.keyring
+        if payload["op"] == "add":
+            kr.add(payload["entity"], bytes.fromhex(payload["secret"]))
+        elif payload["op"] == "rm" and payload["entity"] in list(kr.names()):
+            kr._keys.pop(payload["entity"], None)
+        batch.set("svc_auth", "keyring", kr.dump().encode())
+
+    def command(self, cmd: dict) -> Optional[Tuple[int, dict]]:
+        prefix = cmd.get("prefix", "")
+        if prefix not in ("auth get-or-create", "auth get", "auth ls",
+                          "auth rm"):
+            return None
+        if self.mon.auth_server is None:
+            return -95, {"error": "auth disabled (no keyring)"}
+        kr = self.mon.auth_server.keyring
+        if prefix == "auth get-or-create":
+            entity = cmd["entity"]
+            secret = kr.get(entity)
+            if secret is None:
+                from ceph_tpu.auth.keyring import generate_secret
+
+                secret = generate_secret()
+                self.propose({"op": "add", "entity": entity,
+                              "secret": secret.hex()})
+            return 0, {"entity": entity, "key": secret.hex()}
+        if prefix == "auth get":
+            secret = kr.get(cmd["entity"])
+            if secret is None:
+                return -2, {"error": f"no key for {cmd['entity']}"}
+            return 0, {"entity": cmd["entity"], "key": secret.hex()}
+        if prefix == "auth ls":
+            return 0, {"entities": sorted(kr.names())}
+        if prefix == "auth rm":
+            self.propose({"op": "rm", "entity": cmd["entity"]})
+            return 0, {}
+        return None
+
+
+def build_services(mon) -> Dict[str, PaxosService]:
+    svcs = [ConfigMonitor(mon), LogMonitor(mon), HealthMonitor(mon),
+            AuthMonitor(mon)]
+    return {s.name: s for s in svcs}
