@@ -43,14 +43,50 @@ class Dispatcher:
         """Session dropped and could not be restored."""
 
 
+class Policy:
+    """Session policy (reference src/msg/Policy.h).
+
+    - lossless_peer: never give up — unacked messages replay across
+      reconnects in both directions (osd<->osd, mon<->mon).  This is
+      the messenger's default and the behavior every daemon relies on.
+    - lossy client/server: the session dies with the socket.  No
+      reconnect, no replay; the higher layer owns retries (the
+      reference's client->osd sessions, where the Objecter resends by
+      epoch).  On the server, a lossy peer's session state is dropped
+      the moment its socket dies.
+    """
+
+    def __init__(self, lossy: bool = False, server: bool = False) -> None:
+        self.lossy = lossy
+        self.server = server
+
+    @classmethod
+    def lossless_peer(cls) -> "Policy":
+        return cls(lossy=False, server=False)
+
+    @classmethod
+    def lossy_client(cls) -> "Policy":
+        return cls(lossy=True, server=False)
+
+    @classmethod
+    def stateless_server(cls) -> "Policy":
+        """Serving lossy clients: forget their sessions on disconnect."""
+        return cls(lossy=True, server=True)
+
+    def __repr__(self) -> str:
+        return f"Policy(lossy={self.lossy}, server={self.server})"
+
+
 class Connection:
     """One ordered session to a peer address."""
 
-    def __init__(self, msgr: "Messenger", addr: Addr) -> None:
+    def __init__(self, msgr: "Messenger", addr: Addr,
+                 policy: Optional["Policy"] = None) -> None:
         import random
 
         self.msgr = msgr
         self.peer_addr = addr
+        self.policy = policy or Policy.lossless_peer()
         self.sid = random.getrandbits(63) | 1  # this session's seq space
         self.out_seq = 0
         self.in_seq = 0
@@ -80,7 +116,9 @@ class Connection:
         body = msg.to_bytes()
         frame = _FRAME.pack(len(body),
                             crc32c(body) if self.msgr.crc_data else 0) + body
-        self._unacked.append((msg.seq, frame))
+        if not self.policy.lossy:
+            # lossy sessions never replay, so nothing to retain
+            self._unacked.append((msg.seq, frame))
         self._send_q.put_nowait(frame)
 
     def _handle_ack(self, ack_seq: int) -> None:
@@ -161,7 +199,23 @@ class Messenger:
         # socket (reference: authorizer in the connect negotiation)
         self._auth_provider = None
         self._auth_verifier = None
+        # session policies keyed by peer entity type ("mon"/"osd"/
+        # "client"/...); unset types use the default (reference:
+        # Messenger::set_policy / set_default_policy, src/msg/Policy.h)
+        self._policies: Dict[str, Policy] = {}
+        self._default_policy = Policy.lossless_peer()
         self._log = ctx.log.dout("ms") if ctx else (lambda lvl, s: None)
+
+    def set_policy(self, peer_type: str, policy: Policy) -> None:
+        self._policies[peer_type] = policy
+
+    def set_default_policy(self, policy: Policy) -> None:
+        self._default_policy = policy
+
+    def get_policy(self, peer_type: Optional[str]) -> Policy:
+        if peer_type is None:
+            return self._default_policy
+        return self._policies.get(peer_type, self._default_policy)
 
     def set_auth(self, provider=None, verifier=None) -> None:
         """provider() -> bytes | None; verifier(blob) -> bool."""
@@ -206,14 +260,23 @@ class Messenger:
         self._dispatchers.append(d)
 
     # -- connection management -------------------------------------------
-    def connect(self, addr: Addr) -> Connection:
+    def connect(self, addr: Addr,
+                peer_type: Optional[str] = None) -> Connection:
         addr = (addr[0], addr[1])
         with self._conn_lock:
             conn = self._conns.get(addr)
             if conn is None or conn._closed:
-                conn = Connection(self, addr)
+                conn = Connection(self, addr,
+                                  policy=self.get_policy(peer_type))
                 self._conns[addr] = conn
                 self._loop_call(self._spawn_outgoing, conn)
+            elif (peer_type is not None
+                  and conn.policy.lossy != self.get_policy(peer_type).lossy):
+                # an existing live session keeps its policy; surface the
+                # mismatch rather than silently handing back the other
+                # caller's semantics
+                self._log(1, f"connect({addr}, {peer_type}): reusing live "
+                             f"session with {conn.policy!r}")
             return conn
 
     def send_message(self, msg: Message, addr: Addr) -> None:
@@ -233,6 +296,8 @@ class Messenger:
                     asyncio.open_connection(*conn.peer_addr), timeout=10
                 )
             except (OSError, asyncio.TimeoutError):
+                if conn.policy.lossy:
+                    break  # lossy teardown below: no dial retries either
                 await asyncio.sleep(self._retry)
                 continue
             # guard against TCP self-connect: dialing a dead localhost
@@ -309,7 +374,17 @@ class Messenger:
                     pass
             if conn._closed or self._stopped:
                 break
+            if conn.policy.lossy:
+                break  # lossy teardown below
             await asyncio.sleep(self._retry)
+        if conn.policy.lossy and not conn._closed and not self._stopped:
+            # lossy client: the session dies with the socket (or the
+            # failed dial) — no reconnect, no replay; tell the upper
+            # layer to retry at its own protocol level (Objecter role)
+            conn._closed = True
+            conn._unacked.clear()
+            for d in self._dispatchers:
+                d.ms_handle_reset(conn)
         conn._closed = True
 
     # -- incoming ---------------------------------------------------------
@@ -410,6 +485,12 @@ class Messenger:
     def _resolve_accepted(self, msg: Message, peer: Addr) -> Connection:
         """Find or create the persistent accepted-side session for the
         dialer identified by the message's (src, nonce, sid)."""
+        policy = self.get_policy(
+            getattr(msg.src, "kind", None) if msg.src is not None else None)
+        if policy.lossy and policy.server:
+            # stateless server for lossy clients: the session lives and
+            # dies with this socket — never retained, never replayed
+            return Connection(self, peer, policy=policy)
         key = None
         if msg.src is not None and msg.nonce and msg.sid:
             key = (str(msg.src), msg.nonce, msg.sid)

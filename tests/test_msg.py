@@ -58,6 +58,11 @@ class Collector(Dispatcher):
             conn.send(MEchoReply(msg.text.upper()))
         return True
 
+    def ms_handle_reset(self, conn) -> None:
+        with self.cond:
+            self.resets.append(conn)
+            self.cond.notify_all()
+
     def wait_for(self, n: int, timeout: float = 10.0) -> bool:
         with self.cond:
             return self.cond.wait_for(lambda: len(self.got) >= n, timeout)
@@ -271,3 +276,83 @@ def test_dup_suppression_across_reconnect(ctx):
     finally:
         a.shutdown()
         b.shutdown()
+
+
+def test_lossy_client_policy_drops_on_reset(ctx):
+    """Policy.lossy_client (src/msg/Policy.h): the session dies with the
+    socket — no reconnect, no replay; the dispatcher sees a reset and
+    the higher layer owns retries."""
+    from ceph_tpu.msg.messenger import Policy
+
+    a = _mk(ctx, "client.7")
+    a.set_policy("osd", Policy.lossy_client())
+    b = _mk(ctx, "osd.0")
+    server = Collector()
+    client = Collector()
+    b.add_dispatcher(server)
+    a.add_dispatcher(client)
+    try:
+        conn = a.connect(b.addr, peer_type="osd")
+        assert conn.policy.lossy
+        conn.send(MEcho("before"))
+        assert server.wait_for(1)
+        port = b.addr[1]
+        b.shutdown()
+        # sends into the dead session are dropped, not queued for replay
+        conn.send(MEcho("lost"))
+        deadline = time.monotonic() + 10
+        while not conn._closed and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert conn._closed, "lossy session must die with the socket"
+        assert conn._unacked == []
+        assert client.resets, "dispatcher must hear ms_handle_reset"
+        # restart the peer on the same port: nothing is replayed
+        b2 = Messenger(ctx, EntityName.parse("osd.0"), bind_port=port)
+        b2.start()
+        server2 = Collector()
+        b2.add_dispatcher(server2)
+        # a NEW connect works (fresh session through the same API)
+        conn2 = a.connect(b.addr, peer_type="osd")
+        assert conn2 is not conn
+        conn2.send(MEcho("fresh"))
+        assert server2.wait_for_text("fresh")
+        assert not any(m.text == "lost" for m in server2.got)
+        b2.shutdown()
+    finally:
+        a.shutdown()
+
+
+def test_stateless_server_policy_forgets_sessions(ctx):
+    """Policy.stateless_server: an accepted lossy session is never
+    retained for replay across sockets."""
+    from ceph_tpu.msg.messenger import Policy
+
+    a = _mk(ctx, "client.9")
+    b = _mk(ctx, "osd.3")
+    b.set_policy("client", Policy.stateless_server())
+    server = Collector(reply=True)
+    b.add_dispatcher(server)
+    client = Collector()
+    a.add_dispatcher(client)
+    try:
+        conn = a.connect(b.addr)
+        conn.send(MEcho("hi"))
+        assert client.wait_for(1)  # reply arrived over the same socket
+        assert b._accepted_sessions == {}  # nothing retained
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_default_policy_unchanged_lossless(ctx):
+    from ceph_tpu.msg.messenger import Policy
+
+    m = _mk(ctx, "osd.5")
+    try:
+        assert not m.get_policy("anything").lossy
+        m.set_default_policy(Policy.lossy_client())
+        assert m.get_policy("osd").lossy
+        m.set_policy("mon", Policy.lossless_peer())
+        assert not m.get_policy("mon").lossy
+    finally:
+        m.shutdown()
