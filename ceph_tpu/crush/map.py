@@ -194,6 +194,12 @@ class CrushMap:
         self.rules: List[Rule] = []
         self.tunables = tunables or Tunables()
         self.type_names: Dict[int, str] = {0: "osd"}
+        # bucket id -> name (reference CrushWrapper name_map); filled by
+        # the text compiler, optional everywhere else
+        self.bucket_names: Dict[int, str] = {}
+        # named weight-set overrides (reference CrushWrapper choose_args):
+        # name -> {bucket_id: [16.16 weights]}
+        self.choose_args: Dict[str, Dict[int, List[int]]] = {}
         self._next_id = -1
 
     # -- construction -----------------------------------------------------

@@ -18,7 +18,9 @@ from ceph_tpu.osd.osdmap import OSDMap, PGPool
 
 
 def encode_crush(e: Encoder, cm: cmap.CrushMap) -> None:
-    e.start(1, 1)
+    # v2 adds bucket_names + choose_args (compat 1: old decoders skip
+    # the trailing fields via the frame length)
+    e.start(2, 1)
     t = cm.tunables
     e.u32(t.choose_total_tries).u32(t.choose_local_tries)
     e.u32(t.choose_local_fallback_tries)
@@ -38,15 +40,26 @@ def encode_crush(e: Encoder, cm: cmap.CrushMap) -> None:
         enc.u8(r.type)
         enc.seq(r.steps, lambda en2, s: (
             en2.s32(s[0]), en2.s32(s[1]), en2.s32(s[2])))
+        # v2: rule size bounds + ruleset id (previously lost on decode)
+        enc.s32(r.ruleset).s32(r.min_size).s32(r.max_size)
 
     e.seq(cm.rules, enc_rule)
     e.mapping(cm.type_names, lambda enc, k: enc.s32(k),
               lambda enc, v: enc.string(v))
+    e.mapping(cm.bucket_names, lambda enc, k: enc.s32(k),
+              lambda enc, v: enc.string(v))
+    e.mapping(
+        cm.choose_args,
+        lambda enc, k: enc.string(k),
+        lambda enc, v: enc.mapping(
+            v, lambda e2, bid: e2.s32(bid),
+            lambda e2, ws: e2.seq(ws, lambda e3, w: e3.u32(w))),
+    )
     e.finish()
 
 
 def decode_crush(d: Decoder) -> cmap.CrushMap:
-    d.start(1)
+    v = d.start(1)
     t = cmap.Tunables(
         choose_total_tries=d.u32(),
         choose_local_tries=d.u32(),
@@ -71,10 +84,23 @@ def decode_crush(d: Decoder) -> cmap.CrushMap:
         name = dd.string()
         rtype = dd.u8()
         steps = dd.seq(lambda x: (x.s32(), x.s32(), x.s32()))
-        return cmap.Rule(name=name, steps=steps, type=rtype)
+        r = cmap.Rule(name=name, steps=steps, type=rtype)
+        if v >= 2:
+            r.ruleset = dd.s32()
+            r.min_size = dd.s32()
+            r.max_size = dd.s32()
+        return r
 
     cm.rules = d.seq(dec_rule)
     cm.type_names = d.mapping(lambda dd: dd.s32(), lambda dd: dd.string())
+    if v >= 2:
+        cm.bucket_names = d.mapping(lambda dd: dd.s32(),
+                                    lambda dd: dd.string())
+        cm.choose_args = d.mapping(
+            lambda dd: dd.string(),
+            lambda dd: dd.mapping(lambda d2: d2.s32(),
+                                  lambda d2: d2.seq(lambda d3: d3.u32())),
+        )
     d.end()
     return cm
 

@@ -130,3 +130,23 @@ def test_obj_bencher(tmp_path):
     finally:
         cl.shutdown()
         c.shutdown()
+
+
+def test_crushtool_compile_decompile_roundtrip(tmp_path):
+    """crushtool -d / -c (reference CrushCompiler, crushtool.cc)."""
+    binfn = str(tmp_path / "m.bin")
+    textfn = str(tmp_path / "m.txt")
+    bin2fn = str(tmp_path / "m2.bin")
+    rc, _ = _capture(crushtool.main, ["--build", "--num_osds", "8",
+                                      "host", "straw2", "4",
+                                      "root", "straw2", "0", "-o", binfn])
+    assert rc == 0
+    rc, _ = _capture(crushtool.main, ["-d", "-i", binfn, "-o", textfn])
+    assert rc == 0
+    text = open(textfn).read()
+    assert "alg straw2" in text and "item osd.0 weight" in text
+    rc, _ = _capture(crushtool.main, ["-c", textfn, "-o", bin2fn])
+    assert rc == 0
+    rc, out2 = _capture(crushtool.main, ["-d", "-i", bin2fn])
+    assert rc == 0
+    assert out2 == text

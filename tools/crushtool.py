@@ -111,6 +111,10 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="crushtool")
     p.add_argument("-i", "--infn", help="input map file")
     p.add_argument("-o", "--outfn", help="output map file")
+    p.add_argument("-d", "--decompile", action="store_true",
+                   help="decompile -i map to text (CrushCompiler role)")
+    p.add_argument("-c", "--compile", dest="compilefn", metavar="TEXTFN",
+                   help="compile a text map (write binary with -o)")
     p.add_argument("--build", action="store_true")
     p.add_argument("--num_osds", type=int, default=0)
     p.add_argument("layers", nargs="*",
@@ -138,12 +142,28 @@ def main(argv=None) -> int:
                    int(args.layers[i + 2]))
                   for i in range(0, len(args.layers), 3)]
         m = build_map(args.num_osds, layers)
+    elif args.compilefn:
+        from ceph_tpu.crush.compiler import compile_text
+
+        with open(args.compilefn) as f:
+            m = compile_text(f.read())
     elif args.infn:
         with open(args.infn, "rb") as f:
             m = decode_crush(Decoder(f.read()))
     else:
-        print("need --build or -i", file=sys.stderr)
+        print("need --build, -c or -i", file=sys.stderr)
         return 1
+
+    if args.decompile:
+        from ceph_tpu.crush.compiler import decompile
+
+        text = decompile(m)
+        if args.outfn:
+            with open(args.outfn, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
 
     if args.outfn:
         e = Encoder()
