@@ -1,0 +1,110 @@
+"""Crash-dump capture + archive.
+
+Reference roles: the crash metadata writer (src/global/signal_handler.cc
+writes a backtrace + recent log ring on fatal signals; the ceph-crash
+agent and the mgr crash module, src/pybind/mgr/crash/module.py, archive
+and list them).  Here `CrashArchive.record()` captures a Python
+exception — backtrace, entity, version, the log ring tail — as a JSON
+crash report in a spool directory; `install()` hooks
+`threading.excepthook` so an unhandled daemon-thread death is archived
+automatically; `ls`/`info` serve the mgr `crash ls` commands.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+
+class CrashArchive:
+    def __init__(self, path: str, entity: str = "",
+                 log=None) -> None:
+        self.path = path
+        self.entity = entity
+        self.log = log
+        self._lock = threading.Lock()
+        self._installed_hook = None
+        os.makedirs(path, exist_ok=True)
+
+    # -- capture ----------------------------------------------------------
+    def record(self, exc: BaseException,
+               entity: Optional[str] = None) -> str:
+        """Archive one crash; returns the crash id."""
+        stamp = time.time()
+        with self._lock:
+            crash_id = (time.strftime("%Y-%m-%dT%H:%M:%S",
+                                      time.gmtime(stamp))
+                        + f".{int(stamp * 1e6) % 1_000_000:06d}")
+            report = {
+                "crash_id": crash_id,
+                "timestamp": stamp,
+                "entity_name": entity or self.entity,
+                "exception": repr(exc),
+                "backtrace": traceback.format_exception(
+                    type(exc), exc, exc.__traceback__),
+                "recent_events": (self.log.dump_recent(200)
+                                  if self.log is not None else []),
+            }
+            with open(os.path.join(self.path, crash_id + ".json"),
+                      "w") as f:
+                json.dump(report, f, indent=1)
+        return crash_id
+
+    def install(self) -> None:
+        """Hook threading.excepthook: a daemon thread dying on an
+        unhandled exception leaves a crash report behind (the fatal
+        signal-handler role)."""
+        prev = threading.excepthook
+
+        def hook(args):
+            if args.exc_value is not None:
+                try:
+                    self.record(args.exc_value)
+                except Exception:
+                    pass
+            prev(args)
+
+        self._installed_hook = hook
+        threading.excepthook = hook
+
+    def uninstall(self) -> None:
+        if (self._installed_hook is not None
+                and threading.excepthook is self._installed_hook):
+            threading.excepthook = threading.__excepthook__
+        self._installed_hook = None
+
+    # -- query (mgr crash module commands) --------------------------------
+    def ls(self) -> List[Dict[str, object]]:
+        out = []
+        for fn in sorted(os.listdir(self.path)):
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.path, fn)) as f:
+                    r = json.load(f)
+                out.append({"crash_id": r["crash_id"],
+                            "entity_name": r.get("entity_name", ""),
+                            "timestamp": r.get("timestamp", 0)})
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def info(self, crash_id: str) -> Optional[Dict[str, object]]:
+        p = os.path.join(self.path, crash_id + ".json")
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return json.load(f)
+
+    def prune(self, keep: int = 100) -> None:
+        files = sorted(fn for fn in os.listdir(self.path)
+                       if fn.endswith(".json"))
+        for fn in files[:-keep] if keep else files:
+            try:
+                os.unlink(os.path.join(self.path, fn))
+            except OSError:
+                pass

@@ -1,0 +1,190 @@
+"""Manager daemon: perf aggregation, module registry, metrics export.
+
+Reference: ceph-mgr (src/mgr/) — daemons report their PerfCounters to
+the mgr (MMgrReport via DaemonServer.cc), python modules consume the
+aggregated state (src/pybind/mgr/mgr_module.py), and the prometheus
+module exports it in text exposition format
+(src/pybind/mgr/prometheus/module.py).
+
+In-process inversion: instead of MMgrReport messages, registered
+daemons hand the mgr their Context (whose PerfCountersCollection is
+already thread-safe), and `collect()` polls them — the same data the
+reference ships over the wire, without re-encoding it.  Modules follow
+the MgrModule shape: `serve()`-less objects with `handle_command`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class MgrModule:
+    """mgr_module.MgrModule shape: named, command-handling plugin."""
+
+    name = ""
+
+    def __init__(self, mgr: "MgrDaemon") -> None:
+        self.mgr = mgr
+
+    def handle_command(self, cmd: dict) -> Optional[Tuple[int, dict]]:
+        return None
+
+
+class StatusModule(MgrModule):
+    name = "status"
+
+    def handle_command(self, cmd):
+        if cmd.get("prefix") != "mgr status":
+            return None
+        return 0, {
+            "daemons": sorted(self.mgr.daemons),
+            "modules": sorted(self.mgr.modules),
+            "last_collect": self.mgr.last_collect,
+        }
+
+
+class PrometheusModule(MgrModule):
+    """Text exposition format over the aggregated counters
+    (src/pybind/mgr/prometheus/module.py role)."""
+
+    name = "prometheus"
+
+    def export(self) -> str:
+        metrics = self.mgr.collect()
+        lines: List[str] = []
+        seen_help = set()
+        for daemon, subsystems in sorted(metrics.items()):
+            for subsys, counters in sorted(subsystems.items()):
+                for cname, val in sorted(counters.items()):
+                    metric = f"ceph_{subsys}_{cname}".replace("-", "_")
+                    label = f'{{daemon="{daemon}"}}'
+                    if isinstance(val, dict):
+                        if "avgcount" in val:
+                            if metric not in seen_help:
+                                lines.append(f"# TYPE {metric} summary")
+                                seen_help.add(metric)
+                            lines.append(
+                                f"{metric}_count{label} {val['avgcount']}")
+                            lines.append(f"{metric}_sum{label} {val['sum']}")
+                        elif "buckets" in val:
+                            if metric not in seen_help:
+                                lines.append(f"# TYPE {metric} histogram")
+                                seen_help.add(metric)
+                            acc = 0
+                            for i, b in enumerate(val["buckets"]):
+                                acc += b
+                                lines.append(
+                                    f'{metric}_bucket{{daemon="{daemon}",'
+                                    f'le="{1 << i}"}} {acc}')
+                            lines.append(
+                                f"{metric}_count{label} {val['count']}")
+                            lines.append(f"{metric}_sum{label} {val['sum']}")
+                    else:
+                        if metric not in seen_help:
+                            lines.append(f"# TYPE {metric} counter")
+                            seen_help.add(metric)
+                        lines.append(f"{metric}{label} {val}")
+        return "\n".join(lines) + "\n"
+
+    def handle_command(self, cmd):
+        if cmd.get("prefix") != "prometheus export":
+            return None
+        return 0, {"body": self.export()}
+
+
+class CrashModule(MgrModule):
+    """crash ls / crash info over a CrashArchive
+    (src/pybind/mgr/crash/module.py role)."""
+
+    name = "crash"
+
+    def __init__(self, mgr: "MgrDaemon") -> None:
+        super().__init__(mgr)
+        self.archives: List[object] = []
+
+    def add_archive(self, archive) -> None:
+        self.archives.append(archive)
+
+    def handle_command(self, cmd):
+        prefix = cmd.get("prefix", "")
+        if prefix == "crash ls":
+            out: List[dict] = []
+            for a in self.archives:
+                out.extend(a.ls())
+            return 0, {"crashes": sorted(out,
+                                         key=lambda c: c["crash_id"])}
+        if prefix == "crash info":
+            for a in self.archives:
+                r = a.info(cmd["id"])
+                if r is not None:
+                    return 0, r
+            return -2, {"error": f"no crash {cmd['id']!r}"}
+        return None
+
+
+class BalancerModule(MgrModule):
+    """Command surface over the upmap optimizer (the balancer module
+    role, src/pybind/mgr/balancer/module.py:644)."""
+
+    name = "balancer"
+
+    def handle_command(self, cmd):
+        if cmd.get("prefix") != "balancer optimize":
+            return None
+        if self.mgr.osdmap is None:
+            return -2, {"error": "mgr has no osdmap"}
+        from ceph_tpu.mgr.balancer import UpmapBalancer
+
+        b = UpmapBalancer(self.mgr.osdmap,
+                          max_moves=int(cmd.get("max_moves", 16)))
+        report = b.optimize_pool(int(cmd["pool"]))
+        return 0, {
+            "pool": report.pool_id,
+            "before_stddev": report.before_stddev,
+            "after_stddev": report.after_stddev,
+            "moves": [
+                [list(pg), [list(m) for m in moves]]
+                for pg, moves in report.moves
+            ],
+        }
+
+
+class MgrDaemon:
+    """The aggregation point: daemons register, modules serve."""
+
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        self.daemons: Dict[str, object] = {}  # name -> Context
+        self.modules: Dict[str, MgrModule] = {}
+        self.osdmap = None  # fed by whoever owns the map (mon/tests)
+        self.last_collect = 0.0
+        self._lock = threading.Lock()
+        for m in (StatusModule(self), PrometheusModule(self),
+                  CrashModule(self), BalancerModule(self)):
+            self.modules[m.name] = m
+
+    def register_daemon(self, name: str, ctx) -> None:
+        """The MMgrReport-session role: this daemon's counters become
+        visible to every module."""
+        with self._lock:
+            self.daemons[name] = ctx
+
+    def unregister_daemon(self, name: str) -> None:
+        with self._lock:
+            self.daemons.pop(name, None)
+
+    def collect(self) -> Dict[str, Dict[str, Dict[str, object]]]:
+        """daemon -> subsystem -> counter -> value."""
+        with self._lock:
+            daemons = list(self.daemons.items())
+        self.last_collect = time.time()
+        return {name: ctx.perf.dump() for name, ctx in daemons}
+
+    def handle_command(self, cmd: dict) -> Tuple[int, dict]:
+        for m in self.modules.values():
+            got = m.handle_command(cmd)
+            if got is not None:
+                return got
+        return -22, {"error": f"unknown mgr command {cmd.get('prefix')!r}"}

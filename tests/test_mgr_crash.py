@@ -1,0 +1,113 @@
+"""Mgr daemon + crash archive tests (reference tier: src/mgr/ +
+src/pybind/mgr/{prometheus,crash,balancer}).
+"""
+
+import threading
+
+import pytest
+
+from ceph_tpu.core.context import Context
+from ceph_tpu.core.crash import CrashArchive
+from ceph_tpu.mgr.manager import MgrDaemon
+
+
+@pytest.fixture
+def mgr():
+    return MgrDaemon(Context("mgr.x", {}))
+
+
+def _ctx_with_counters(name):
+    ctx = Context(name, {})
+    pc = ctx.perf.create("osd")
+    pc.add_u64_counter("op_w")
+    pc.add_time_avg("op_w_latency")
+    pc.add_histogram("op_size")
+    pc.inc("op_w", 5)
+    pc.tinc("op_w_latency", 0.25)
+    pc.tinc("op_w_latency", 0.75)
+    pc.hinc("op_size", 4096)
+    return ctx
+
+
+def test_collect_aggregates_registered_daemons(mgr):
+    mgr.register_daemon("osd.0", _ctx_with_counters("osd.0"))
+    mgr.register_daemon("osd.1", _ctx_with_counters("osd.1"))
+    got = mgr.collect()
+    assert set(got) == {"osd.0", "osd.1"}
+    assert got["osd.0"]["osd"]["op_w"] == 5
+    assert got["osd.1"]["osd"]["op_w_latency"]["avgcount"] == 2
+    mgr.unregister_daemon("osd.1")
+    assert set(mgr.collect()) == {"osd.0"}
+
+
+def test_prometheus_export_format(mgr):
+    mgr.register_daemon("osd.0", _ctx_with_counters("osd.0"))
+    code, out = mgr.handle_command({"prefix": "prometheus export"})
+    assert code == 0
+    body = out["body"]
+    assert '# TYPE ceph_osd_op_w counter' in body
+    assert 'ceph_osd_op_w{daemon="osd.0"} 5' in body
+    assert 'ceph_osd_op_w_latency_count{daemon="osd.0"} 2' in body
+    assert 'ceph_osd_op_w_latency_sum{daemon="osd.0"} 1.0' in body
+    # histogram buckets are cumulative
+    assert 'ceph_osd_op_size_bucket{daemon="osd.0",le=' in body
+
+
+def test_mgr_status_and_unknown_command(mgr):
+    mgr.register_daemon("osd.0", Context("osd.0", {}))
+    code, out = mgr.handle_command({"prefix": "mgr status"})
+    assert code == 0
+    assert out["daemons"] == ["osd.0"]
+    assert "prometheus" in out["modules"]
+    code, _ = mgr.handle_command({"prefix": "nope"})
+    assert code == -22
+
+
+def test_crash_archive_record_ls_info(tmp_path, mgr):
+    ctx = Context("osd.2", {})
+    ctx.log.log("osd", 1, "about to die")
+    arch = CrashArchive(str(tmp_path / "crash"), entity="osd.2",
+                        log=ctx.log)
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as e:
+        cid = arch.record(e)
+    mgr.modules["crash"].add_archive(arch)
+    code, out = mgr.handle_command({"prefix": "crash ls"})
+    assert code == 0
+    assert [c["crash_id"] for c in out["crashes"]] == [cid]
+    code, out = mgr.handle_command({"prefix": "crash info", "id": cid})
+    assert code == 0
+    assert out["entity_name"] == "osd.2"
+    assert any("boom" in line for line in out["backtrace"])
+    assert any("about to die" in line for line in out["recent_events"])
+    code, _ = mgr.handle_command({"prefix": "crash info", "id": "nope"})
+    assert code == -2
+
+
+def test_crash_hook_captures_thread_death(tmp_path):
+    arch = CrashArchive(str(tmp_path / "crash"), entity="osd.3")
+    arch.install()
+    try:
+        t = threading.Thread(
+            target=lambda: (_ for _ in ()).throw(ValueError("thread-die")))
+        t.start()
+        t.join()
+    finally:
+        arch.uninstall()
+    crashes = arch.ls()
+    assert len(crashes) == 1
+    info = arch.info(crashes[0]["crash_id"])
+    assert "thread-die" in info["exception"]
+
+
+def test_crash_prune(tmp_path):
+    arch = CrashArchive(str(tmp_path / "crash"))
+    for i in range(5):
+        try:
+            raise KeyError(i)
+        except KeyError as e:
+            arch.record(e)
+    assert len(arch.ls()) == 5
+    arch.prune(keep=2)
+    assert len(arch.ls()) == 2
