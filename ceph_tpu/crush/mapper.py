@@ -734,6 +734,33 @@ def _choose_indep(
     return (out2 if recurse_to_leaf else out), jnp.int32(nslots)
 
 
+def _rule_digest(flat: FlatMap, steps, result_max: int,
+                 choose_args) -> str:
+    """Content key for the global compile cache: two maps with identical
+    structure share one compiled program (the map arrays are baked into
+    the trace as constants, so identical content => identical program)."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for arr in (flat.items, flat.weights, flat.sizes, flat.algs,
+                flat.types, flat.straws, flat.sum_weights,
+                flat.tree_weights, flat.tree_nodes):
+        if arr is not None:
+            a = np.ascontiguousarray(arr)
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+    h.update(repr(flat.tunables).encode())
+    h.update(repr((flat.max_devices, result_max, list(steps))).encode())
+    if choose_args:
+        for bid in sorted(choose_args):
+            h.update(repr((bid, list(choose_args[bid]))).encode())
+    return h.hexdigest()
+
+
+_compiled_rules: dict = {}  # digest -> compiled fn (process lifetime)
+
+
 def compile_rule(
     flat: FlatMap,
     steps: Sequence[Tuple[int, int, int]],
@@ -748,7 +775,15 @@ def compile_rule(
     configuration is involved anywhere.  `choose_args`
     ({bucket_id: [weights]}) bakes straw2 weight-set overrides into the
     compiled rule (reference crush_do_rule's choose_args parameter).
+
+    Compiled programs are cached process-wide by map content: rebuilding
+    an identical map (common in tests and in OSDMap churn that leaves
+    the crush tree untouched) costs a digest, not a ~10s XLA compile.
     """
+    digest = _rule_digest(flat, steps, result_max, choose_args)
+    cached = _compiled_rules.get(digest)
+    if cached is not None:
+        return cached
     dm = _DeviceMap(flat, choose_args)
     tun = flat.tunables
     steps = [tuple(int(v) for v in s) for s in steps]
@@ -855,4 +890,7 @@ def compile_rule(
             jnp.asarray(dev_weights, dtype=jnp.uint32),
         )
 
+    _compiled_rules[digest] = run
+    if len(_compiled_rules) > 256:  # bound trace/executable retention
+        _compiled_rules.pop(next(iter(_compiled_rules)))
     return run

@@ -205,14 +205,24 @@ def const_to_bitmatrix(c: int, w: int = 8) -> np.ndarray:
     """w x w GF(2) matrix B with B[l, x] = bit l of (c * 2^x).
 
     For x viewed as a bit-column vector, (B @ bits(x)) mod 2 == bits(c*x).
+    Memoized: only 2^w constants exist, and recovery-matrix expansion
+    calls this per matrix cell (hot in the all-survivor-subsets sweeps).
     """
+    got = _const_bitmatrix_cache.get((c, w))
+    if got is not None:
+        return got
     B = np.zeros((w, w), dtype=np.uint8)
     elt = c
     for x in range(w):
         for l in range(w):
             B[l, x] = (elt >> l) & 1
         elt = int(mul(elt, 2, w))
+    B.setflags(write=False)  # shared across callers
+    _const_bitmatrix_cache[(c, w)] = B
     return B
+
+
+_const_bitmatrix_cache: dict = {}
 
 
 def matrix_to_bitmatrix(M: np.ndarray, w: int = 8) -> np.ndarray:
