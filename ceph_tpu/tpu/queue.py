@@ -36,9 +36,15 @@ class StripeBatchQueue:
         self,
         max_batch_cols: int = 1 << 20,
         window_s: float = 0.0005,
+        mesh=None,
     ) -> None:
         self.max_batch_cols = max_batch_cols
         self.window_s = window_s
+        # optional MeshCompute (ceph_tpu.tpu.meshio): coalesced batches
+        # with a plain coding matrix run data-parallel over the mesh's
+        # stripe axis instead of on one device
+        self.mesh = mesh
+        self.mesh_batches = 0
         self._q: "queue.Queue[_Job | None]" = queue.Queue()
         self._thread = threading.Thread(
             target=self._worker, name="stripe-batch", daemon=True
@@ -139,7 +145,17 @@ class StripeBatchQueue:
                 for j, w in zip(batch, widths):
                     stacked[:, off:off + w] = j.planes
                     off += w
-                coding = np.asarray(batch[0].codec.encode_array(stacked))
+                codec = batch[0].codec
+                coding_mat = getattr(codec, "coding", None)
+                if (self.mesh is not None and coding_mat is not None
+                        and gran == 1):
+                    # the mesh path shards the coalesced columns over
+                    # the stripe axis (meshio.encode_scatter)
+                    coding = self.mesh.encode_scatter(
+                        np.asarray(coding_mat, dtype=np.uint8), stacked)
+                    self.mesh_batches += 1
+                else:
+                    coding = np.asarray(codec.encode_array(stacked))
                 off = 0
                 for j, w in zip(batch, widths):
                     j.future.set_result(coding[:, off:off + w])
