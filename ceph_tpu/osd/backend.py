@@ -332,6 +332,7 @@ class ECBackend(PGBackend):
         prof = getattr(codec, "profile", {}) or {}
         self.unit = int(prof.get("stripe_unit", 4096))
         self.cache = ExtentCache()
+        self._sinfo = None  # lazy StripeInfo (ecutil.py)
 
     @property
     def k(self) -> int:
@@ -342,25 +343,24 @@ class ECBackend(PGBackend):
         return self.codec.m
 
     @property
+    def sinfo(self):
+        """The shared offset algebra (ECUtil stripe_info_t role)."""
+        from ceph_tpu.osd.ecutil import StripeInfo
+
+        si = self._sinfo
+        if si is None or si.k != self.k or si.chunk_size != self.unit:
+            si = self._sinfo = StripeInfo(self.k, self.unit)
+        return si
+
+    @property
     def stripe_width(self) -> int:
-        return self.k * self.unit
+        return self.sinfo.stripe_width
 
     def _interleave(self, data: bytes) -> Tuple[np.ndarray, int]:
-        """Object bytes -> striped data planes [k, S*unit] (+pad)."""
-        width = self.stripe_width
-        S = max(1, -(-len(data) // width))
-        buf = np.zeros(S * width, dtype=np.uint8)
-        raw = np.frombuffer(data, dtype=np.uint8)
-        buf[: len(raw)] = raw
-        planes = buf.reshape(S, self.k, self.unit).transpose(1, 0, 2)
-        return np.ascontiguousarray(planes.reshape(self.k, S * self.unit)), S
+        return self.sinfo.interleave(data)
 
     def _deinterleave(self, planes: np.ndarray, size: int) -> bytes:
-        """Striped data planes [k, >=S*unit] -> object bytes[:size]."""
-        width = self.stripe_width
-        S = max(1, -(-size // width))
-        p = planes[:, : S * self.unit].reshape(self.k, S, self.unit)
-        return p.transpose(1, 0, 2).tobytes()[:size]
+        return self.sinfo.deinterleave(planes, size)
 
     def _encode_object(self, data: bytes) -> Tuple[List[bytes], int]:
         """Object buffer -> k+m chunk payloads via the batch queue."""
@@ -600,7 +600,7 @@ class ECBackend(PGBackend):
 
         op = InFlightOp(waiting, done)
         self.in_flight[tid] = op
-        ext_off = s0 * self.unit
+        ext_off, _ = self.sinfo.chunk_extent(s0, s0 + S)
         for shard, osd in enumerate(shard_osds):
             if osd == CRUSH_ITEM_NONE or osd < 0:
                 continue

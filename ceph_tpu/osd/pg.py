@@ -595,7 +595,7 @@ class PG:
         n = be.k + be.m
         acting = list(self.acting[:n]) + [CRUSH_ITEM_NONE] * (
             n - len(self.acting))
-        off, length = s0 * be.unit, (s1 - s0) * be.unit
+        off, length = be.sinfo.chunk_extent(s0, s1)
         extents: Dict[int, bytes] = {}
         for shard in be.local_shards(acting):
             c = be.read_local_chunk(oid, shard)
@@ -626,8 +626,7 @@ class PG:
         if not be.can_partial(msg.oid, wop.off, len(wop.data)):
             return False
         width = be.stripe_width
-        s0 = wop.off // width
-        s1 = -(-(wop.off + len(wop.data)) // width)
+        s0, s1 = be.sinfo.stripe_range(wop.off, len(wop.data))
         committed = threading.Event()
         _replied = [False]
         _rlock = threading.Lock()
