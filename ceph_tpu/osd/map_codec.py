@@ -106,22 +106,30 @@ def decode_crush(d: Decoder) -> cmap.CrushMap:
 
 
 def _enc_pool(e: Encoder, p: PGPool) -> None:
-    e.start(1, 1)
+    e.start(2, 1)  # v2 adds hit-set params; v1 blobs still decode
     e.s64(p.pool_id).u8(p.pool_type).u32(p.size).u32(p.min_size)
     e.u32(p.pg_num).u32(p.pgp_num).u32(p.crush_rule).u32(p.flags)
     e.string(p.object_hash).string(p.erasure_code_profile)
     e.string(p.name)
+    # v2: hit-set tracking params
+    e.u32(p.hit_set_count).u64(int(p.hit_set_period * 1000))
+    e.u32(p.hit_set_target_size).u64(int(p.hit_set_fpp * 1e9))
     e.finish()
 
 
 def _dec_pool(d: Decoder) -> PGPool:
-    d.start(1)
+    v = d.start(1)
     p = PGPool(
         pool_id=d.s64(), pool_type=d.u8(), size=d.u32(), min_size=d.u32(),
         pg_num=d.u32(), pgp_num=d.u32(), crush_rule=d.u32(), flags=d.u32(),
         object_hash=d.string(), erasure_code_profile=d.string(),
         name=d.string(),
     )
+    if v >= 2:
+        p.hit_set_count = d.u32()
+        p.hit_set_period = d.u64() / 1000.0
+        p.hit_set_target_size = d.u32()
+        p.hit_set_fpp = d.u64() / 1e9
     d.end()
     return p
 

@@ -72,6 +72,13 @@ class PGPool:
     object_hash: str = "rjenkins"
     erasure_code_profile: str = ""
     name: str = ""
+    # hit-set tracking (cache-tier statistics; reference pg_pool_t
+    # hit_set_params/period/count, src/osd/osd_types.h): count == 0
+    # disables tracking
+    hit_set_count: int = 0
+    hit_set_period: float = 0.0
+    hit_set_target_size: int = 1000
+    hit_set_fpp: float = 0.01
 
     @property
     def pg_num_mask_(self) -> int:

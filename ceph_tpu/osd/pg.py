@@ -80,6 +80,14 @@ class PG:
         # not serve reads until recovery pushes complete (the reference's
         # peer_missing discipline)
         self.stale_peers: set = set()
+        # hit-set tracking (reference PrimaryLogPG hit_set_* over
+        # src/osd/HitSet.h): enabled when the pool sets hit_set_count
+        self.hit_set = None
+        self.hit_set_start = 0.0
+        from ceph_tpu.osd.hitset import HitSetHistory
+
+        self.hit_set_history = HitSetHistory(
+            count=getattr(pool, "hit_set_count", 0) or 4)
         if codec is not None:
             self.backend: PGBackend = ECBackend(
                 pgid, self.coll, osd.store, osd.whoami, osd.send_to_osd,
@@ -255,7 +263,57 @@ class PG:
         else:
             self.backend.read_object(oid, self.acting, done)
 
+    # -- hit-set tracking --------------------------------------------------
+    def record_hit(self, oid: str) -> None:
+        """Track one access in the current hit set; rotate on period or
+        fullness (PrimaryLogPG::hit_set_create/persist roles).  Archived
+        sets persist in the PG meta omap so the history survives
+        restart."""
+        count = getattr(self.pool, "hit_set_count", 0)
+        if not count:
+            return
+        from ceph_tpu.osd.hitset import BloomHitSet
+
+        now = time.time()
+        if self.hit_set is None:
+            self.hit_set = BloomHitSet(
+                target_size=getattr(self.pool, "hit_set_target_size", 1000),
+                fpp=getattr(self.pool, "hit_set_fpp", 0.01))
+            self.hit_set_start = now
+        self.hit_set.insert(oid)
+        period = getattr(self.pool, "hit_set_period", 0.0)
+        if self.hit_set.is_full() or (period and
+                                      now - self.hit_set_start >= period):
+            self._rotate_hit_set(now)
+
+    def _rotate_hit_set(self, now: float) -> None:
+        self.hit_set_history.count = self.pool.hit_set_count
+        self.hit_set_history.add(self.hit_set_start, now, self.hit_set)
+        e = Encoder()
+        self.hit_set.encode(e)
+        key = f"hitset_{now:.6f}"
+        self._persist_meta(extra_omap={key: e.bytes()})
+        self.hit_set = None
+
+    def load_hit_set_history(self) -> None:
+        """Rebuild the archive ring from PG meta omap (newest last)."""
+        from ceph_tpu.osd.hitset import decode_hitset
+
+        g = GHObject("_pgmeta_")
+        if not self.osd.store.exists(self.coll, g):
+            return
+        omap = self.osd.store.omap_get(self.coll, g)
+        for k in sorted(k for k in omap if k.startswith("hitset_")):
+            try:
+                hs = decode_hitset(Decoder(omap[k]))
+                stamp = float(k[len("hitset_"):])
+                self.hit_set_history.add(stamp, stamp, hs)
+            except Exception:
+                continue
+
     def _do_read(self, msg, reply):
+        self.record_hit(msg.oid)
+
         def finish(state: Optional[ObjectState]) -> None:
             st = state
             if getattr(msg, "snapid", 0) and not self.is_ec():
@@ -432,6 +490,7 @@ class PG:
         return 0
 
     def _do_write(self, msg, reply):
+        self.record_hit(msg.oid)
         # completed-op replay: a resend of an already-committed write
         # answers from the log instead of re-executing (exactly-once
         # even if the previous primary died after commit)

@@ -1,0 +1,146 @@
+"""HitSet + tier-agent tests (reference src/osd/HitSet.h,
+src/osd/TierAgentState.h, PrimaryLogPG hit_set_* / agent_work roles).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.osd.hitset import (
+    BloomHitSet,
+    ExplicitHitSet,
+    HitSetHistory,
+    TierAgent,
+    decode_hitset,
+)
+
+
+def test_bloom_membership_and_fpp():
+    hs = BloomHitSet(target_size=2000, fpp=0.01)
+    members = [f"obj{i}" for i in range(2000)]
+    for n in members:
+        hs.insert(n)
+    assert all(hs.contains(n) for n in members)
+    # false positives on non-members stay near the target fpp
+    probes = [f"other{i}" for i in range(4000)]
+    fp = int(hs.contains_batch(probes).sum())
+    assert fp / len(probes) < 0.05
+    assert hs.is_full()
+
+
+def test_bloom_batch_matches_scalar():
+    hs = BloomHitSet(target_size=100)
+    for i in range(0, 100, 2):
+        hs.insert(f"o{i}")
+    names = [f"o{i}" for i in range(100)]
+    batch = hs.contains_batch(names)
+    scalar = np.array([hs.contains(n) for n in names])
+    assert np.array_equal(batch, scalar)
+
+
+@pytest.mark.parametrize("cls", [BloomHitSet, ExplicitHitSet])
+def test_hitset_encode_roundtrip(cls):
+    hs = cls(target_size=50)
+    for i in range(30):
+        hs.insert(f"x{i}")
+    e = Encoder()
+    hs.encode(e)
+    hs2 = decode_hitset(Decoder(e.bytes()))
+    assert type(hs2) is cls
+    assert all(hs2.contains(f"x{i}") for i in range(30))
+    assert hs2.inserts == hs.inserts
+
+
+def test_history_temperature_and_promote():
+    hist = HitSetHistory(count=3)
+    for epoch in range(4):  # 4 periods; ring keeps last 3
+        hs = ExplicitHitSet()
+        for i in range(10):
+            if i % (epoch + 1) == 0:
+                hs.insert(f"o{i}")
+        hist.add(epoch, epoch + 1, hs)
+    assert len(hist.archive) == 3
+    assert hist.hit_count("o0") == 3  # hot in every kept set
+    temps = hist.temperature_batch([f"o{i}" for i in range(10)])
+    assert temps[0] == 3
+    agent = TierAgent(hist, min_recency_for_promote=2)
+    assert agent.should_promote("o0")
+    assert not agent.should_promote("o7")
+
+
+def test_agent_plan_flush_evict_coldest_first():
+    hist = HitSetHistory(count=2)
+    hot = ExplicitHitSet()
+    hot.insert("hot-dirty")
+    hot.insert("hot-clean")
+    hist.add(0, 1, hot)
+    hist.add(1, 2, hot)
+    objects = {  # name -> dirty?
+        "hot-dirty": True, "cold-dirty": True,
+        "hot-clean": False, "cold-clean": False,
+    }
+    agent = TierAgent(hist, target_dirty_ratio=0.25,
+                      target_full_ratio=0.5)
+    flush, evict = agent.plan(objects, used_ratio=0.9, dirty_ratio=0.5,
+                              max_ops=1)
+    assert flush == ["cold-dirty"]   # coldest dirty flushes first
+    assert evict == ["cold-clean"]   # coldest clean evicts first
+    # below thresholds: agent idles
+    flush, evict = agent.plan(objects, used_ratio=0.1, dirty_ratio=0.1)
+    assert flush == [] and evict == []
+
+
+def test_pg_records_and_persists_hitsets(tmp_path):
+    """PG-level wiring: hits land in the current set, rotation archives
+    into the meta omap, a fresh PG reloads the history."""
+    from ceph_tpu.core.context import Context
+    from ceph_tpu.osd.osdmap import PGPool
+    from ceph_tpu.osd.pg import PG
+    from ceph_tpu.store.memstore import MemStore
+
+    class StubOSD:
+        whoami = 0
+
+        def __init__(self):
+            self.store = MemStore()
+            self.store.mount()
+            self.ctx = Context("osd.0", {})
+            self.log = self.ctx.log
+
+        def epoch(self):
+            return 1
+
+        def send_to_osd(self, osd, msg):
+            pass
+
+    osd = StubOSD()
+    pool = PGPool(pool_id=1, hit_set_count=2, hit_set_target_size=5,
+                  hit_set_fpp=0.05)
+    pg = PG((1, 0), pool, osd)
+    pg.create_onstore()
+    pg.acting = [0]
+    pg.primary = 0
+    for i in range(12):  # 12 hits, target 5 -> >=2 rotations
+        pg.record_hit(f"obj{i % 6}")
+    assert len(pg.hit_set_history.archive) >= 2
+    assert pg.hit_set_history.hit_count("obj0") >= 1
+
+    pg2 = PG((1, 0), pool, osd)
+    pg2.load_hit_set_history()
+    assert len(pg2.hit_set_history.archive) >= 2
+    assert pg2.hit_set_history.hit_count("obj0") >= 1
+
+
+def test_pool_codec_carries_hit_set_params():
+    from ceph_tpu.osd.map_codec import _dec_pool, _enc_pool
+    from ceph_tpu.osd.osdmap import PGPool
+
+    p = PGPool(pool_id=7, hit_set_count=4, hit_set_period=1.5,
+               hit_set_target_size=777, hit_set_fpp=0.02)
+    e = Encoder()
+    _enc_pool(e, p)
+    p2 = _dec_pool(Decoder(e.bytes()))
+    assert p2.hit_set_count == 4
+    assert abs(p2.hit_set_period - 1.5) < 1e-3
+    assert p2.hit_set_target_size == 777
+    assert abs(p2.hit_set_fpp - 0.02) < 1e-6
