@@ -48,7 +48,7 @@ class ObjecterOp:
     __slots__ = ("tid", "pool", "oid", "ops", "reqid", "reply", "event",
                  "attempts", "last_send", "retry_at", "target",
                  "on_complete", "timeout_at", "snap_seq", "snaps",
-                 "snapid")
+                 "snapid", "pgid_override")
 
     def __init__(self, tid: int, pool: int, oid: str, ops: List[OSDOp],
                  reqid: str, timeout: float,
@@ -69,6 +69,7 @@ class ObjecterOp:
         self.snap_seq = 0
         self.snaps: List[int] = []
         self.snapid = 0
+        self.pgid_override = None
 
     # future-like surface
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -157,7 +158,7 @@ class Objecter(Dispatcher):
                   timeout: float = 30.0,
                   on_complete: Optional[Callable] = None,
                   snapc: Optional[Tuple[int, List[int]]] = None,
-                  snapid: int = 0) -> ObjecterOp:
+                  snapid: int = 0, pgid=None) -> ObjecterOp:
         if self.osdmap is None:
             raise RuntimeError("objecter has no osdmap yet")
         with self._lock:
@@ -169,6 +170,9 @@ class Objecter(Dispatcher):
             if snapc is not None:
                 op.snap_seq, op.snaps = snapc[0], list(snapc[1])
             op.snapid = snapid
+            # explicit PG targeting (pgls and other per-PG ops; the
+            # reference's base_pgid path in Objecter::_calc_target)
+            op.pgid_override = pgid
             self.ops[tid] = op
         self._send_op(op)
         return op
@@ -177,7 +181,13 @@ class Objecter(Dispatcher):
         with self._lock:
             if self.osdmap is None or op.tid not in self.ops:
                 return
-            pgid, primary = self._calc_target(op.pool, op.oid)
+            override = getattr(op, "pgid_override", None)
+            if override is not None:
+                pgid = override
+                _up, _up_p, _acting, primary = \
+                    self.osdmap.pg_to_up_acting(pgid)
+            else:
+                pgid, primary = self._calc_target(op.pool, op.oid)
             op.target = (pgid, primary)
             addr = self.addrbook.get(primary)
             if primary < 0 or addr is None:

@@ -210,6 +210,22 @@ class IoCtx:
         self._check(rep)
         return rep.ops[0].out_data
 
+    def list_objects(self, timeout: float = 30.0) -> List[str]:
+        """Pool-wide object listing: one PGLS per PG, merged (reference
+        librados nobjects_begin over CEPH_OSD_OP_PGLS)."""
+        import json
+
+        osdmap = self.client.objecter.osdmap
+        pool = osdmap.pools[self.pool]
+        names: set = set()
+        for ps in range(pool.pg_num):
+            rep = self.client.objecter.op_submit(
+                self.pool, "", [OSDOp(t_.OP_PGLS)], timeout=timeout,
+                pgid=(self.pool, ps)).result(timeout)
+            if rep.result == 0 and rep.ops[0].out_data:
+                names.update(json.loads(rep.ops[0].out_data.decode()))
+        return sorted(names)
+
     def call(self, oid: str, cls: str, method: str,
              indata: bytes = b"") -> bytes:
         """Execute an object-class method server-side (reference

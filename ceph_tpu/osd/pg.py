@@ -312,6 +312,17 @@ class PG:
                 continue
 
     def _do_read(self, msg, reply):
+        if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_PGLS:
+            # PG-scoped listing (reference do_pg_op / CEPH_OSD_OP_PGLS):
+            # head objects only, meta excluded
+            import json
+
+            names = sorted(self.backend.object_names())
+            msg.ops[0].out_data = json.dumps(names).encode()
+            reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                msg.ops, result=0,
+                                version=self.info.last_update))
+            return
         self.record_hit(msg.oid)
 
         def finish(state: Optional[ObjectState]) -> None:

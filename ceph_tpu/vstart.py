@@ -1,0 +1,210 @@
+"""VStartCluster — the dev/test cluster launcher (vstart.sh role).
+
+Reference: src/vstart.sh + src/mstart.sh — bring up N mons + M osds on
+localhost with real sockets, wait for quorum and OSD boot, create
+pools, hand out connected clients.  Here the daemons are in-process
+objects over real TCP messengers (the same daemons the tier-3 tests
+exercise), so one Python process IS a whole cluster:
+
+    from ceph_tpu.vstart import VStartCluster
+    with VStartCluster(n_mons=3, n_osds=4) as c:
+        pool = c.create_pool("data", size=3)
+        io = c.client().ioctx(pool)
+        io.write_full("obj", b"hello")
+        assert io.read("obj") == b"hello"
+
+Stores default to MemStore; pass data_dir= for durable per-OSD
+filestores (survives shutdown; a new VStartCluster over the same dir
+remounts them).  keyring=True enables cephx end to end (mon mints, every
+daemon and client authenticates).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Dict, List, Optional
+
+from ceph_tpu.client import RadosClient
+from ceph_tpu.core.context import Context
+from ceph_tpu.crush import map as cmap
+from ceph_tpu.ec import codec_from_profile
+from ceph_tpu.mon.monitor import MonMap, Monitor
+from ceph_tpu.osd.daemon import OSDService
+from ceph_tpu.osd.osdmap import OSDMap
+
+
+def _free_ports(n: int) -> List[int]:
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class VStartCluster:
+    def __init__(self, n_mons: int = 1, n_osds: int = 3,
+                 data_dir: Optional[str] = None,
+                 keyring: bool = False,
+                 conf: Optional[dict] = None,
+                 wait: bool = True) -> None:
+        self.n_mons = n_mons
+        self.n_osds = n_osds
+        self.data_dir = data_dir
+        self.ctx = Context("vstart", {
+            "osd_heartbeat_interval": 0.5,
+            "osd_heartbeat_grace": 3.0,
+            "mon_tick_interval": 0.5,
+            **(conf or {}),
+        })
+        self.keyring = None
+        if keyring:
+            from ceph_tpu.auth.keyring import Keyring
+
+            self.keyring = Keyring()
+            self.keyring.add("service")  # rotating service key
+            for i in range(n_osds):
+                self.keyring.add(f"osd.{i}")
+            self.keyring.add("client.admin")
+
+        cm_, root = cmap.build_flat_cluster(n_osds, hosts=n_osds)
+        seed = OSDMap(cm_, max_osd=n_osds)
+        seed.osd_state_up[:] = False  # everyone boots through the mon
+
+        ports = _free_ports(n_mons)
+        self.monmap = MonMap([("127.0.0.1", p) for p in ports])
+        self.mons: List[Monitor] = []
+        for rank in range(n_mons):
+            mon = Monitor(self.ctx, rank, self.monmap, initial_map=seed,
+                          bind_port=ports[rank], keyring=self.keyring)
+            mon.start()
+            self.mons.append(mon)
+
+        self.osds: Dict[int, OSDService] = {}
+        self._clients: List[RadosClient] = []
+        for i in range(n_osds):
+            self.osds[i] = self._spawn_osd(i)
+        if wait:
+            self.wait_for_up()
+
+    # -- daemons -----------------------------------------------------------
+    def _make_store(self, i: int):
+        if self.data_dir is None:
+            from ceph_tpu.store.memstore import MemStore
+
+            return MemStore(), True
+        from ceph_tpu.store.filestore import FileStore
+
+        path = os.path.join(self.data_dir, f"osd{i}")
+        fresh = not os.path.exists(os.path.join(path, "wal.log"))
+        os.makedirs(path, exist_ok=True)
+        return FileStore(path), fresh
+
+    def _spawn_osd(self, i: int) -> OSDService:
+        store, fresh = self._make_store(i)
+        svc = OSDService(self.ctx, i, store, None, codec_from_profile)
+        if fresh:
+            svc.store.mkfs()
+        svc.init()
+        svc.boot(self.monmap, keyring=self.keyring)
+        svc.start_heartbeats()
+        return svc
+
+    # -- orchestration -----------------------------------------------------
+    def leader(self) -> Monitor:
+        for mon in self.mons:
+            if mon.state == "leader":
+                return mon
+        raise RuntimeError("no mon leader")
+
+    def wait_for(self, pred, timeout: float = 30.0,
+                 what: str = "condition") -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                if pred():
+                    return
+            except Exception:
+                pass
+            time.sleep(0.2)
+        raise TimeoutError(f"vstart: timeout waiting for {what}")
+
+    def wait_for_up(self, timeout: float = 30.0) -> None:
+        self.wait_for(lambda: any(m.state == "leader" for m in self.mons),
+                      timeout, "mon quorum")
+
+        def all_up() -> bool:
+            m = self.leader().osdmap
+            return m is not None and int(m.osd_state_up.sum()) == len(
+                [o for o in self.osds.values() if o.up])
+
+        self.wait_for(all_up, timeout, "osd boot")
+
+    def command(self, cmd: dict) -> tuple:
+        """Admin command against the current leader (ceph CLI role)."""
+        client = self.client()
+        return client.mon_command(cmd)
+
+    def create_pool(self, name: str, size: int = 3,
+                    pool_type: str = "replicated",
+                    ec_profile: str = "", pg_num: int = 8) -> int:
+        cmd = {"prefix": "osd pool create", "pool": name,
+               "pg_num": pg_num, "pool_type": pool_type, "size": size}
+        if ec_profile:
+            self.command({"prefix": "osd erasure-code-profile set",
+                          "name": name + "_profile",
+                          "profile": ec_profile})
+            cmd["erasure_code_profile"] = name + "_profile"
+        code, out = self.command(cmd)
+        if code != 0:
+            raise RuntimeError(f"pool create failed: {out}")
+        pool_id = out.get("pool_id")
+
+        def visible() -> bool:
+            m = self.leader().osdmap
+            return m is not None and pool_id in m.pools
+
+        self.wait_for(visible, what=f"pool {name}")
+        return pool_id
+
+    def client(self) -> RadosClient:
+        auth = None
+        if self.keyring is not None:
+            auth = ("client.admin", self.keyring.get("client.admin"))
+        rc = RadosClient(Context("client.vstart", {}))
+        rc.connect(self.monmap, auth=auth)
+        self._clients.append(rc)
+        return rc
+
+    def kill_osd(self, i: int) -> None:
+        self.osds[i].shutdown()
+
+    def revive_osd(self, i: int) -> None:
+        old = self.osds[i]
+        svc = OSDService(self.ctx, i, old.store, None, codec_from_profile)
+        svc.init()
+        svc.boot(self.monmap, keyring=self.keyring)
+        svc.start_heartbeats()
+        self.osds[i] = svc
+
+    def shutdown(self) -> None:
+        for rc in self._clients:
+            try:
+                rc.shutdown()
+            except Exception:
+                pass
+        self._clients.clear()
+        for o in self.osds.values():
+            if o.up:
+                o.shutdown()
+        for mon in self.mons:
+            mon.shutdown()
+
+    def __enter__(self) -> "VStartCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

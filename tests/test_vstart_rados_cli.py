@@ -1,0 +1,94 @@
+"""VStartCluster + rados CLI tests (reference src/vstart.sh +
+src/tools/rados; the "a user can drive the whole thing" surface).
+"""
+
+import contextlib
+import io
+import os
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+sys.path.insert(0, os.path.abspath(TOOLS))
+
+
+def _capture(fn, argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = fn(argv)
+    return rc, buf.getvalue()
+
+
+def test_vstart_pool_io_and_listing():
+    from ceph_tpu.vstart import VStartCluster
+
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        pool = c.create_pool("data", size=2)
+        io_ = c.client().ioctx(pool)
+        io_.write_full("alpha", b"A" * 1000)
+        io_.write_full("beta", b"B" * 10)
+        assert io_.read("alpha") == b"A" * 1000
+        assert io_.list_objects() == ["alpha", "beta"]
+        io_.remove("beta")
+        assert io_.list_objects() == ["alpha"]
+        code, out = c.command({"prefix": "health"})
+        assert code == 0 and out["status"] == "HEALTH_OK"
+
+
+def test_vstart_survives_osd_kill():
+    from ceph_tpu.vstart import VStartCluster
+
+    with VStartCluster(n_mons=1, n_osds=4) as c:
+        pool = c.create_pool("r3", size=3)
+        io_ = c.client().ioctx(pool)
+        io_.write_full("obj", b"payload" * 100)
+        victim = None
+        m = c.leader().osdmap
+        pgid = m.object_to_pg(pool, "obj")
+        _up, _upp, acting, _ap = m.pg_to_up_acting(pgid)
+        victim = acting[0]
+        c.kill_osd(victim)
+
+        def remapped():
+            mm = c.leader().osdmap
+            _u, _up2, act, _a = mm.pg_to_up_acting(pgid)
+            return victim not in act and all(a >= 0 for a in act[:2])
+
+        c.wait_for(remapped, what="remap after kill")
+        assert io_.read("obj") == b"payload" * 100
+
+
+def test_vstart_durable_dir_remount(tmp_path):
+    from ceph_tpu.vstart import VStartCluster
+
+    d = str(tmp_path / "cluster")
+    with VStartCluster(n_mons=1, n_osds=2, data_dir=d) as c:
+        pool = c.create_pool("keep", size=2)
+        c.client().ioctx(pool).write_full("persist", b"still here")
+    # fresh cluster over the same stores: object data survives (mon
+    # state is fresh, so recreate the pool with the same id ordering)
+    with VStartCluster(n_mons=1, n_osds=2, data_dir=d) as c2:
+        pool2 = c2.create_pool("keep", size=2)
+        io2 = c2.client().ioctx(pool2)
+        assert io2.read("persist") == b"still here"
+
+
+def test_rados_cli_script():
+    import rados as rados_cli
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(delete=False) as f:
+        f.write(b"cli-payload")
+        path = f.name
+    rc, out = _capture(rados_cli.main, [
+        "--vstart", "1x3", "--pool", "cli", "--pool-size", "2",
+        "--script",
+        f"mkpool cli; put a {path}; stat a; ls; df",
+    ])
+    assert rc == 0
+    lines = out.strip().splitlines()
+    assert lines[0].startswith("pool cli id ")
+    assert "a size 11" in out
+    assert "osds: 3/3 up" in out
+    os.unlink(path)
