@@ -243,14 +243,27 @@ SECTIONS = [
 
 
 def main():
+    print("bench: importing jax...", file=sys.stderr, flush=True)
     import jax
 
+    print(f"bench: backend={jax.default_backend()} "
+          f"devices={jax.devices()}", file=sys.stderr, flush=True)
     out = {"backend": jax.default_backend(), "errors": {}}
     for name, fn in SECTIONS:
+        # progress to stderr: if the tunnel wedges mid-run, the log
+        # shows WHICH section hung (round-3 outage forensics)
+        t0 = time.perf_counter()
+        print(f"bench: section {name} start", file=sys.stderr, flush=True)
         try:
             fn(jax, out)
+            print(f"bench: section {name} done "
+                  f"({time.perf_counter() - t0:.1f}s)",
+                  file=sys.stderr, flush=True)
         except Exception:
             out["errors"][name] = traceback.format_exc(limit=4)
+            print(f"bench: section {name} FAILED "
+                  f"({time.perf_counter() - t0:.1f}s)",
+                  file=sys.stderr, flush=True)
 
     enc = out.get("encode_gbps")
     dec = out.get("decode_gbps")
