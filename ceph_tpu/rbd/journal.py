@@ -1,0 +1,218 @@
+"""RBD image journal — crash-consistent write journaling + mirror replay.
+
+Reference: src/journal/ (Journaler over "journal data" RADOS objects
+with a commit position in journal metadata) and librbd's journaling
+feature (librbd/journal/: every image mutation is appended as an event
+BEFORE it is applied to the data objects; on open, events past the
+commit position replay; rbd-mirror tails the same journal and applies
+the events to a remote image).
+
+Layout (all in the image's pool):
+- `journal.<image>` : metadata object — omap {"commit": seq,
+  "head": seq} (the commit-position object)
+- `journal_data.<image>.<n>` : entry ring objects, appended frames
+  [u64 seq][u32 len][u32 crc32c(payload)][payload], splayed by
+  seq % splay (the reference's splay_width)
+
+Events are JSON {"t": "write"|"discard"|"resize", ...} — applying an
+event is idempotent, so replay after a crash (or replaying a prefix
+twice on a mirror) converges.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ceph_tpu.client.rados import IoCtx, RadosError
+from ceph_tpu.core.crc import crc32c
+
+_FRAME = struct.Struct("<QII")  # seq, payload_len, crc
+
+
+class Journaler:
+    """Append/replay/commit over the journal objects (src/journal/
+    Journaler role)."""
+
+    def __init__(self, io: IoCtx, name: str, splay: int = 4) -> None:
+        self.io = io
+        self.name = name
+        self.splay = splay
+        self.meta_oid = f"journal.{name}"
+
+    # -- metadata ----------------------------------------------------------
+    def _meta(self) -> Dict[str, int]:
+        try:
+            raw = self.io.read(self.meta_oid)
+            return json.loads(raw.decode()) if raw else {}
+        except RadosError:
+            return {}
+
+    def _set_meta(self, meta: Dict[str, int]) -> None:
+        self.io.write_full(self.meta_oid, json.dumps(meta).encode())
+
+    def create(self) -> None:
+        if not self._meta():
+            self._set_meta({"commit": 0, "head": 0})
+
+    def head(self) -> int:
+        return self._meta().get("head", 0)
+
+    def committed(self) -> int:
+        return self._meta().get("commit", 0)
+
+    def _data_oid(self, seq: int) -> str:
+        return f"journal_data.{self.name}.{seq % self.splay}"
+
+    # -- write side --------------------------------------------------------
+    def append(self, payload: bytes) -> int:
+        """Durably append one entry; returns its seq.  The entry frame
+        lands in the data object BEFORE head advances, so a torn append
+        is invisible (head never points past a full frame)."""
+        meta = self._meta()
+        seq = meta.get("head", 0) + 1
+        frame = _FRAME.pack(seq, len(payload), crc32c(payload)) + payload
+        self.io.append(self._data_oid(seq), frame)
+        meta["head"] = seq
+        meta.setdefault("commit", 0)
+        self._set_meta(meta)
+        return seq
+
+    def commit(self, seq: int) -> None:
+        """Advance the commit position (events <= seq are applied)."""
+        meta = self._meta()
+        if seq > meta.get("commit", 0):
+            meta["commit"] = seq
+            self._set_meta(meta)
+
+    # -- read side ---------------------------------------------------------
+    def _entries_of(self, oid: str) -> List[Tuple[int, bytes]]:
+        try:
+            raw = self.io.read(oid)
+        except RadosError:
+            return []
+        out = []
+        off = 0
+        while off + _FRAME.size <= len(raw):
+            seq, ln, want = _FRAME.unpack_from(raw, off)
+            payload = raw[off + _FRAME.size: off + _FRAME.size + ln]
+            if len(payload) < ln or crc32c(payload) != want:
+                break  # torn tail of this ring object
+            out.append((seq, payload))
+            off += _FRAME.size + ln
+        return out
+
+    def entries(self, after: int = 0,
+                upto: Optional[int] = None) -> List[Tuple[int, bytes]]:
+        """All entries with after < seq <= upto, seq-ordered across the
+        splayed objects."""
+        upto = self.head() if upto is None else upto
+        got: List[Tuple[int, bytes]] = []
+        for n in range(self.splay):
+            got.extend(e for e in self._entries_of(
+                f"journal_data.{self.name}.{n}")
+                if after < e[0] <= upto)
+        got.sort()
+        return got
+
+    def replay(self, handler: Callable[[int, bytes], None],
+               from_committed: bool = True) -> int:
+        """Feed uncommitted (or all) entries to `handler`; returns the
+        last seq seen (caller commits it when applied)."""
+        after = self.committed() if from_committed else 0
+        last = after
+        for seq, payload in self.entries(after=after):
+            handler(seq, payload)
+            last = seq
+        return last
+
+    def trim(self) -> None:
+        """Drop ring objects wholly below the commit position
+        (the reference's object-set trimming; ring objects are only
+        removed when every entry in them is committed)."""
+        commit = self.committed()
+        for n in range(self.splay):
+            oid = f"journal_data.{self.name}.{n}"
+            entries = self._entries_of(oid)
+            if entries and all(seq <= commit for seq, _ in entries):
+                try:
+                    self.io.remove(oid)
+                except RadosError:
+                    pass
+
+    def remove(self) -> None:
+        for n in range(self.splay):
+            try:
+                self.io.remove(f"journal_data.{self.name}.{n}")
+            except RadosError:
+                pass
+        try:
+            self.io.remove(self.meta_oid)
+        except RadosError:
+            pass
+
+
+class ImageJournal:
+    """librbd journaling feature: append-before-apply + crash replay +
+    mirror replay (librbd/journal/ + rbd-mirror roles)."""
+
+    def __init__(self, image) -> None:
+        self.image = image
+        self.journaler = Journaler(image.io, image.name)
+        self.journaler.create()
+
+    # -- event plumbing ----------------------------------------------------
+    @staticmethod
+    def _apply_event(image, ev: dict) -> None:
+        t = ev["t"]
+        if t == "write":
+            image.write(ev["off"], bytes.fromhex(ev["data"]))
+        elif t == "discard":
+            image.discard(ev["off"], ev["len"])
+        elif t == "resize":
+            image.resize(ev["size"])
+
+    def log_and_apply(self, ev: dict) -> None:
+        """The journaled write path: the event is durable in the journal
+        BEFORE the data objects change; commit advances after apply."""
+        seq = self.journaler.append(json.dumps(ev).encode())
+        self._apply_event(self.image, ev)
+        self.journaler.commit(seq)
+
+    # -- image ops ---------------------------------------------------------
+    def write(self, off: int, data: bytes) -> int:
+        self.log_and_apply({"t": "write", "off": off,
+                            "data": data.hex()})
+        return len(data)
+
+    def discard(self, off: int, length: int) -> None:
+        self.log_and_apply({"t": "discard", "off": off, "len": length})
+
+    def resize(self, size: int) -> None:
+        self.log_and_apply({"t": "resize", "size": size})
+
+    # -- recovery + mirroring ---------------------------------------------
+    def replay_pending(self) -> int:
+        """Crash recovery at open: re-apply events past the commit
+        position (idempotent), then commit.  Returns replayed count."""
+        n = 0
+
+        def h(seq: int, payload: bytes) -> None:
+            nonlocal n
+            self._apply_event(self.image, json.loads(payload.decode()))
+            n += 1
+
+        last = self.journaler.replay(h)
+        self.journaler.commit(last)
+        return n
+
+    def mirror_to(self, other_image, after: int = 0) -> int:
+        """rbd-mirror role (one-shot): apply this journal's events
+        (seq > after) to another image; returns the last seq applied —
+        feed it back as `after` to tail incrementally."""
+        last = after
+        for seq, payload in self.journaler.entries(after=after):
+            self._apply_event(other_image, json.loads(payload.decode()))
+            last = seq
+        return last
