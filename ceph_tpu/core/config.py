@@ -63,6 +63,7 @@ def _opts() -> List[Option]:
         O("log_level", int, 1, "default log verbosity", LEVEL_BASIC),
         O("log_file", str, "", "log output path ('' = stderr)"),
         O("log_ring_size", int, 10000, "crash-dump ring entries"),
+        O("tracing", bool, False, "record blkin-style trace spans"),
         O("admin_socket", str, "", "admin socket path ('' = disabled)"),
         O("heartbeat_interval", float, 5.0, "internal liveness check period"),
         # -- messenger ------------------------------------------------------

@@ -34,6 +34,10 @@ class Context:
         )
         self.perf = PerfCountersCollection()
         self.heartbeat = HeartbeatMap()
+        from ceph_tpu.core.tracing import Tracer
+
+        self.trace = Tracer(self.name,
+                            enabled=bool(self.conf.get("tracing")))
         self.admin: Optional[AdminSocket] = None
         path = self.conf.get("admin_socket")
         if path:
@@ -62,6 +66,10 @@ class Context:
             "healthy": self.heartbeat.is_healthy(),
             "unhealthy_workers": self.heartbeat.unhealthy_workers(),
         }, "thread liveness")
+        a.register("dump_tracing", lambda c: (
+            self.trace.dump(int(c["trace_id"], 16)) if "trace_id" in c
+            else self.trace.recent(int(c.get("count", 100)))),
+            "archived trace spans (blkin role)")
         a.start()
         self.admin = a
 

@@ -1,0 +1,147 @@
+"""Distributed trace spans (the blkin/Zipkin + LTTng tracepoint role).
+
+Reference: src/blkin/ (Zipkin-style trace/span/parent ids propagated
+with requests, annotations at interesting points) and the LTTng-UST
+tracepoints compiled into the daemons (src/tracing/*.tp).  Here:
+
+- `Tracer.start_span(name, parent=...)` opens a span; `span.annotate()`
+  adds timestamped events; `span.finish()` archives it in a bounded
+  ring.
+- Wire propagation is by VALUE, not by magic: `span.context()` returns
+  (trace_id, span_id) to embed in a message (the client library puts it
+  in the op reqid; any carrier works), and the receiving daemon opens
+  its span with `parent=that_context` — the cross-daemon parent/child
+  chain of blkin.
+- `Tracer.dump(trace_id)` returns the archived spans of one trace,
+  `Tracer.recent()` the ring tail — the admin-socket surface.
+
+Tracepoint analog: `Tracer.event(subsys, name, **kw)` records a flat
+timestamped event in the same ring when tracing is enabled — the
+compiled-in, off-by-default tracepoint shape.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+TraceContext = Tuple[int, int]  # (trace_id, span_id)
+
+
+class Span:
+    __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
+                 "start", "end", "annotations")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: int,
+                 span_id: int, parent_id: int) -> None:
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end = 0.0
+        self.annotations: List[Tuple[float, str]] = []
+
+    def annotate(self, what: str) -> None:
+        self.annotations.append((time.time(), what))
+
+    def context(self) -> TraceContext:
+        """The wire-propagatable identity of this span."""
+        return (self.trace_id, self.span_id)
+
+    def finish(self) -> None:
+        if not self.end:
+            self.end = time.time()
+            self.tracer._archive(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+    def to_dict(self) -> Dict:
+        return {
+            "name": self.name,
+            "trace_id": f"{self.trace_id:016x}",
+            "span_id": f"{self.span_id:016x}",
+            "parent_id": (f"{self.parent_id:016x}"
+                          if self.parent_id else None),
+            "start": self.start,
+            "duration_s": round((self.end or time.time()) - self.start, 6),
+            "annotations": [
+                {"at": at, "what": w} for at, w in self.annotations],
+        }
+
+
+class Tracer:
+    """Per-daemon span recorder; disabled tracers are near-free."""
+
+    def __init__(self, name: str = "", enabled: bool = True,
+                 ring_size: int = 2048) -> None:
+        self.name = name
+        self.enabled = enabled
+        self._ring: Deque[Span] = collections.deque(maxlen=ring_size)
+        self._lock = threading.Lock()
+
+    # -- spans -------------------------------------------------------------
+    def start_span(self, name: str,
+                   parent: Optional[TraceContext] = None) -> Span:
+        if parent is not None:
+            trace_id, parent_id = parent
+        else:
+            trace_id, parent_id = random.getrandbits(63) | 1, 0
+        return Span(self, name, trace_id, random.getrandbits(63) | 1,
+                    parent_id)
+
+    def _archive(self, span: Span) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring.append(span)
+
+    # -- tracepoints -------------------------------------------------------
+    def event(self, subsys: str, name: str, **kw) -> None:
+        """Flat tracepoint (the LTTng .tp role): recorded only when
+        enabled, compiled in always."""
+        if not self.enabled:
+            return
+        s = Span(self, f"{subsys}:{name}", 0, 0, 0)
+        s.end = s.start
+        if kw:
+            s.annotations.append((s.start, repr(kw)))
+        with self._lock:
+            self._ring.append(s)
+
+    # -- query (admin-socket surface) --------------------------------------
+    def dump(self, trace_id: int) -> List[Dict]:
+        with self._lock:
+            spans = [s for s in self._ring if s.trace_id == trace_id]
+        return [s.to_dict() for s in sorted(spans, key=lambda s: s.start)]
+
+    def recent(self, n: int = 100) -> List[Dict]:
+        with self._lock:
+            tail = list(self._ring)[-n:]
+        return [s.to_dict() for s in tail]
+
+
+def trace_id_of(reqid: str) -> int:
+    """Deterministic trace id from a request id: every daemon touching
+    one client op derives the SAME trace id without any wire change —
+    the reqid IS the correlator (the reference's osd_reqid_t threading
+    through op tracking)."""
+    from ceph_tpu.core.crc import crc32c
+
+    b = reqid.encode()
+    return ((crc32c(b) << 32) | crc32c(b, 0xA5A5A5A5)) | 1
+
+
+_global = Tracer("global")
+
+
+def tracer() -> Tracer:
+    return _global

@@ -145,6 +145,24 @@ class PG:
     # -- op execution (primary) -------------------------------------------
     def do_op(self, msg: m.MOSDOp, reply: Callable[[m.MOSDOpReply], None],
               conn=None):
+        tr = getattr(self.osd.ctx, "trace", None)
+        if tr is not None and tr.enabled:
+            # cross-daemon correlation by reqid (blkin role: every
+            # daemon touching this op derives the same trace id)
+            from ceph_tpu.core.tracing import trace_id_of
+
+            reqid = getattr(msg, "reqid", "") or f"anon:{msg.tid}"
+            span = tr.start_span(
+                f"pg{t_.pgid_str(self.pgid)}.do_op",
+                parent=(trace_id_of(reqid), 0))
+            span.annotate(f"oid={msg.oid} ops={[o.op for o in msg.ops]}")
+            inner_reply = reply
+
+            def reply(rep, _span=span, _inner=inner_reply):  # noqa: F811
+                _span.annotate(f"reply result={rep.result}")
+                _span.finish()
+                _inner(rep)
+
         with self.lock:
             if not self.is_primary():
                 rep = m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,

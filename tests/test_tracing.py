@@ -1,0 +1,70 @@
+"""Trace-span tests (reference src/blkin/ + src/tracing/ tracepoints)."""
+
+import pytest
+
+from ceph_tpu.core.tracing import Tracer, trace_id_of
+
+
+def test_span_parentage_and_dump():
+    tr = Tracer("t")
+    root = tr.start_span("client.op")
+    root.annotate("sent")
+    child = tr.start_span("osd.op", parent=root.context())
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    child.finish()
+    root.finish()
+    spans = tr.dump(root.trace_id)
+    assert [s["name"] for s in spans] == ["client.op", "osd.op"]
+    assert spans[0]["parent_id"] is None
+    assert spans[1]["parent_id"] == spans[0]["span_id"]
+    assert spans[0]["annotations"][0]["what"] == "sent"
+
+
+def test_disabled_tracer_archives_nothing():
+    tr = Tracer("t", enabled=False)
+    with tr.start_span("x") as s:
+        s.annotate("y")
+    tr.event("osd", "enqueue")
+    assert tr.recent() == []
+
+
+def test_trace_id_of_is_deterministic_correlator():
+    assert trace_id_of("client.1:42") == trace_id_of("client.1:42")
+    assert trace_id_of("client.1:42") != trace_id_of("client.1:43")
+    assert trace_id_of("x") & 1  # never zero
+
+
+def test_tracepoint_events_and_ring_bound():
+    tr = Tracer("t", ring_size=16)
+    for i in range(40):
+        tr.event("osd", "tick", i=i)
+    got = tr.recent(100)
+    assert len(got) == 16  # bounded ring
+    assert got[-1]["name"] == "osd:tick"
+
+
+def test_pg_op_spans_cross_daemon_correlation():
+    """The PG op path emits spans correlated by reqid when tracing is
+    on (covers the do_op wiring + admin dump shape)."""
+    import sys, os
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_osd_cluster import MiniCluster, LibClient, REP_POOL
+
+    c = MiniCluster()
+    c.ctx.trace.enabled = True
+    cl = LibClient(c)
+    try:
+        io = cl.rc.ioctx(REP_POOL)
+        io.write_full("traced", b"x")
+        io.read("traced")
+        spans = c.ctx.trace.recent(50)
+        names = [s["name"] for s in spans]
+        assert any(".do_op" in n for n in names)
+        # the write and its read correlate to DIFFERENT traces
+        tids = {s["trace_id"] for s in spans if ".do_op" in s["name"]}
+        assert len(tids) >= 2
+    finally:
+        cl.shutdown()
+        c.shutdown()
