@@ -202,18 +202,33 @@ def crush_sweep(jax, out):
     chunk = min(CRUSH_CHUNK, n_x)
     xs0 = jax.device_put(np.arange(chunk, dtype=np.int32))
 
-    def sweep_once():
-        res = None
-        for start in range(0, n_x, chunk):
-            # id chunks are iota offsets: reuse one device buffer
-            res = fn(xs0 + np.int32(start), w_d)
-        return res
-
     # warmup compiles the single chunk shape
     _block(fn(xs0, w_d))
-    dt = _bench(sweep_once, warmup=0, iters=2)
-    out["crush_mplacements_per_s"] = round(n_x / dt / 1e6, 2)
+    # time-budgeted sweep: measure one chunk, then run only as many
+    # chunks as fit the budget and extrapolate — a slow mapper degrades
+    # to a smaller measured sweep instead of eating the round's bench
+    t0 = time.perf_counter()
+    _block(fn(xs0 + np.int32(1), w_d))
+    per_chunk = time.perf_counter() - t0
+    budget_s = 120.0
+    total_chunks = -(-n_x // chunk)
+    run_chunks = max(1, min(total_chunks,
+                            int(budget_s / max(per_chunk, 1e-9))))
+
+    def sweep_once():
+        res = None
+        for ci in range(run_chunks):
+            # id chunks are iota offsets: reuse one device buffer
+            res = fn(xs0 + np.int32(ci * chunk), w_d)
+        return res
+
+    iters = 2 if run_chunks * per_chunk * 2 <= budget_s else 1
+    dt = _bench(sweep_once, warmup=0, iters=iters)
+    measured = min(n_x, run_chunks * chunk)
+    out["crush_mplacements_per_s"] = round(measured / dt / 1e6, 2)
     out["crush_ids"] = n_x
+    out["crush_ids_measured"] = measured
+    out["crush_extrapolated"] = measured < n_x
     out["crush_chunk"] = chunk
 
     # reference C rate, extrapolated from 200k ids
