@@ -83,7 +83,7 @@ class MonClient(Dispatcher):
 
     def _resubscribe(self, since: int) -> None:
         ip, port = self.msgr.addr
-        for rank in range(self.monmap.size):
+        for rank in self.monmap.live_ranks():
             self.msgr.send_message(
                 mm.MMonSubscribe(f"osdmap:{ip}:{port}", since),
                 self.monmap.addrs[rank])
@@ -125,7 +125,7 @@ class MonClient(Dispatcher):
 
         cx = CephxClient(name, secret)
         last = "no mon answered"
-        for rank in range(self.monmap.size):
+        for rank in self.monmap.live_ranks():
             rep = self._rpc_to(rank, mm.MAuth(
                 mm.MAuth.GET_CHALLENGE, name), timeout / 2)
             if rep is None or rep.result != 0:
@@ -172,12 +172,12 @@ class MonClient(Dispatcher):
                   hb_addr: Optional[Addr] = None) -> None:
         ip, port = self.msgr.addr
         hb_ip, hb_port = hb_addr if hb_addr else ("", 0)
-        for rank in range(self.monmap.size):
+        for rank in self.monmap.live_ranks():
             self.msgr.send_message(
                 mm.MOSDBoot(osd_id, ip, port, hb_ip, hb_port),
                 self.monmap.addrs[rank])
 
     def report_failure(self, target: int, failed_for: float = 0.0) -> None:
-        for rank in range(self.monmap.size):
+        for rank in self.monmap.live_ranks():
             self.msgr.send_message(mm.MOSDFailure(target, failed_for),
                                    self.monmap.addrs[rank])

@@ -49,6 +49,8 @@ class MMonPaxos(Message):
     LEASE = 6     # leader extends read lease
     CATCHUP_REQ = 7  # peon -> leader: inc had no base, need the full map
     CATCHUP = 8      # leader -> peon: full current map
+    SYNC_REQ = 9     # lagging mon: send me your service-state snapshot
+    SYNC = 10        # reply: JSON snapshot of every PaxosService state
 
     def __init__(self, op: int = 0, pn: int = 0, version: int = 0,
                  value: bytes = b"", first_committed: int = 0,

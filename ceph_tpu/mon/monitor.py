@@ -42,17 +42,44 @@ STATE_PEON = "peon"
 
 
 class MonMap:
-    """Static mon roster: rank -> address (reference MonMap)."""
+    """Versioned mon roster: rank -> address (reference MonMap).
+    Mutations go through the MonmapMonitor paxos service, which
+    REPLACES a monitor's monmap rather than mutating a (possibly
+    shared) instance."""
 
-    def __init__(self, addrs: List[Addr]) -> None:
-        self.addrs = list(addrs)
+    def __init__(self, addrs: List[Optional[Addr]], epoch: int = 1) -> None:
+        # a removed rank leaves a None HOLE: ranks are identity (baked
+        # into entity names and running sessions), so they never shift
+        self.addrs = [tuple(a) if a is not None else None for a in addrs]
+        self.epoch = epoch
 
     @property
     def size(self) -> int:
-        return len(self.addrs)
+        return len(self.addrs)  # rank slots, incl. holes
+
+    def live_ranks(self) -> List[int]:
+        return [r for r, a in enumerate(self.addrs) if a is not None]
 
     def quorum(self) -> int:
-        return self.size // 2 + 1
+        return len(self.live_ranks()) // 2 + 1
+
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch,
+                "addrs": [list(a) if a is not None else None
+                          for a in self.addrs]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MonMap":
+        return cls([tuple(a) if a is not None else None
+                    for a in d["addrs"]], epoch=d["epoch"])
+
+    def with_added(self, addr: Addr) -> "MonMap":
+        return MonMap(self.addrs + [tuple(addr)], epoch=self.epoch + 1)
+
+    def with_removed(self, rank: int) -> "MonMap":
+        addrs = list(self.addrs)
+        addrs[rank] = None
+        return MonMap(addrs, epoch=self.epoch + 1)
 
 
 class Monitor(Dispatcher):
@@ -154,10 +181,14 @@ class Monitor(Dispatcher):
         return self.msgr.addr
 
     def _peers(self) -> List[int]:
-        return [r for r in range(self.monmap.size) if r != self.rank]
+        return [r for r in self.monmap.live_ranks() if r != self.rank]
 
     def _send_mon(self, rank: int, msg: Message) -> None:
-        self.msgr.send_message(msg, self.monmap.addrs[rank])
+        addr = (self.monmap.addrs[rank]
+                if rank < self.monmap.size else None)
+        if addr is None:
+            return  # removed rank (monmap hole)
+        self.msgr.send_message(msg, addr)
 
     # -- persistence ------------------------------------------------------
     def _load(self) -> None:
@@ -534,6 +565,38 @@ class Monitor(Dispatcher):
                         self._adopt_map(newmap, msg.value, msg.version)
             self._push_maps()
             return
+        if op == mm.MMonPaxos.SYNC_REQ:
+            # full-store-sync role (reference Monitor::sync_*): a mon
+            # that jumped a paxos gap pulls every service's state
+            with self.lock:
+                snap = {name: s for name, s in (
+                    (n, svc.snapshot())
+                    for n, svc in self.services.items()) if s is not None}
+                rep = mm.MMonPaxos(mm.MMonPaxos.SYNC, self.accepted_pn,
+                                   version=self.last_committed,
+                                   value=json.dumps(snap).encode())
+            conn.send(rep)
+            return
+        if op == mm.MMonPaxos.SYNC:
+            with self.lock:
+                # only adopt a snapshot at least as new as our paxos head
+                if msg.version < self.last_committed or not msg.value:
+                    return
+                try:
+                    snap = json.loads(msg.value.decode())
+                except ValueError:
+                    return
+                batch = WriteBatch()
+                for name, s in snap.items():
+                    svc = self.services.get(name)
+                    if svc is not None:
+                        try:
+                            svc.restore(s, batch)
+                        except Exception as e:  # pragma: no cover
+                            self._plog(0, f"sync restore {name}: {e}")
+                if batch.ops:
+                    self.kv.submit(batch)
+            return
 
     def _learn(self, version: int, value: bytes) -> None:
         # a promise for a HIGHER version than what we just learned is
@@ -542,6 +605,17 @@ class Monitor(Dispatcher):
         # value the old leader already committed
         keep = (self.uncommitted is not None
                 and self.uncommitted[1] > version)
+        if version > self.last_committed + 1:
+            # we are JUMPING a gap: the skipped versions may carry
+            # PaxosService values we'll never see — pull a full service
+            # snapshot from whoever is ahead (reference store sync)
+            req = mm.MMonPaxos(mm.MMonPaxos.SYNC_REQ, self.accepted_pn,
+                               version=self.last_committed)
+            targets = ([self.leader]
+                       if self.leader >= 0 and self.leader != self.rank
+                       else self._peers())
+            for r in targets:
+                self._send_mon(r, req)
         from ceph_tpu.mon import services as mon_services
 
         if value and value[0] == mon_services.SVC_TAG:
@@ -631,7 +705,7 @@ class Monitor(Dispatcher):
             msg = mm.MMonPaxos(mm.MMonPaxos.BEGIN, pn, version, value)
         for r in self._peers():
             self._send_mon(r, msg)
-        if self.monmap.size == 1:
+        if len(self.monmap.live_ranks()) == 1:
             self._commit(version)
 
     def _commit(self, version: int) -> None:

@@ -76,6 +76,15 @@ class PaxosService:
         """Contribution to `health` output."""
         return {}
 
+    def snapshot(self) -> Optional[dict]:
+        """JSON-serializable committed state for mon store sync (the
+        reference's full-store-sync role: a mon that jumped a paxos
+        version gap pulls every service's state wholesale)."""
+        return None
+
+    def restore(self, snap: dict, batch: WriteBatch) -> None:
+        """Adopt a snapshot (persistence into `batch`)."""
+
     def propose(self, payload: dict) -> None:
         self.mon.propose(encode_payload(self.name, payload))
 
@@ -107,6 +116,13 @@ class ConfigMonitor(PaxosService):
                     self.mon.ctx.conf.set_val(key, payload["value"])
             except Exception:
                 pass  # unknown/invalid key stays db-only
+
+    def snapshot(self) -> Optional[dict]:
+        return {"db": self.db}
+
+    def restore(self, snap: dict, batch: WriteBatch) -> None:
+        self.db = {k: dict(v) for k, v in snap["db"].items()}
+        batch.set("svc_config", "db", json.dumps(self.db).encode())
 
     def get_effective(self, who: str) -> Dict[str, str]:
         """global < type < type.id precedence (ConfigMonitor.cc
@@ -156,6 +172,13 @@ class LogMonitor(PaxosService):
         del self.entries[:-self.KEEP]
         batch.set("svc_log", "entries", json.dumps(self.entries).encode())
 
+    def snapshot(self) -> Optional[dict]:
+        return {"entries": self.entries}
+
+    def restore(self, snap: dict, batch: WriteBatch) -> None:
+        self.entries = list(snap["entries"])[-self.KEEP:]
+        batch.set("svc_log", "entries", json.dumps(self.entries).encode())
+
     def log(self, who: str, msg: str, level: str = "info") -> None:
         """Daemon-facing API (the reference's LogClient -> MLog path)."""
         self.propose({"who": who, "msg": msg, "level": level,
@@ -191,6 +214,13 @@ class HealthMonitor(PaxosService):
             self.muted[payload["check"]] = True
         elif payload["op"] == "unmute":
             self.muted.pop(payload["check"], None)
+        batch.set("svc_health", "muted", json.dumps(self.muted).encode())
+
+    def snapshot(self) -> Optional[dict]:
+        return {"muted": self.muted}
+
+    def restore(self, snap: dict, batch: WriteBatch) -> None:
+        self.muted = dict(snap["muted"])
         batch.set("svc_health", "muted", json.dumps(self.muted).encode())
 
     def gather(self) -> Tuple[str, Dict[str, dict]]:
@@ -244,6 +274,22 @@ class HealthMonitor(PaxosService):
 class AuthMonitor(PaxosService):
     name = "auth"
 
+    def snapshot(self) -> Optional[dict]:
+        if self.mon.auth_server is None:
+            return None
+        return {"keyring": self.mon.auth_server.keyring.dump()}
+
+    def restore(self, snap: dict, batch: WriteBatch) -> None:
+        if self.mon.auth_server is None:
+            return
+        from ceph_tpu.auth.keyring import Keyring
+
+        stored = Keyring.loads(snap["keyring"])
+        kr = self.mon.auth_server.keyring
+        for name in stored.names():
+            kr.add(name, stored.get(name))
+        batch.set("svc_auth", "keyring", kr.dump().encode())
+
     def load(self) -> None:
         raw = self.kv.get("svc_auth", "keyring")
         if raw and self.mon.auth_server is not None:
@@ -295,7 +341,76 @@ class AuthMonitor(PaxosService):
         return None
 
 
+
+
+
+class MonmapMonitor(PaxosService):
+    """Mon-roster changes through paxos (src/mon/MonmapMonitor.cc).
+
+    `mon add` appends a rank; `mon rm` leaves a None hole (ranks are
+    identity — see MonMap).  Every mon applies the new roster on
+    commit, so quorum math changes cluster-wide in one paxos round; a
+    NEWLY added mon is then started by the operator with the new map
+    and catches up through the ordinary collect/CATCHUP path.
+    """
+
+    name = "monmap"
+
+    def load(self) -> None:
+        raw = self.kv.get("svc_monmap", "map")
+        if raw:
+            from ceph_tpu.mon.monitor import MonMap
+
+            stored = MonMap.from_dict(json.loads(raw.decode()))
+            if stored.epoch > self.mon.monmap.epoch:
+                self.mon.monmap = stored
+
+    def apply(self, payload: dict, batch: WriteBatch) -> None:
+        from ceph_tpu.mon.monitor import MonMap
+
+        new = MonMap.from_dict(payload["monmap"])
+        if new.epoch > self.mon.monmap.epoch:
+            self.mon.monmap = new
+        batch.set("svc_monmap", "map",
+                  json.dumps(payload["monmap"]).encode())
+
+    def snapshot(self) -> Optional[dict]:
+        return {"monmap": self.mon.monmap.to_dict()}
+
+    def restore(self, snap: dict, batch: WriteBatch) -> None:
+        from ceph_tpu.mon.monitor import MonMap
+
+        new = MonMap.from_dict(snap["monmap"])
+        if new.epoch > self.mon.monmap.epoch:
+            self.mon.monmap = new
+        batch.set("svc_monmap", "map",
+                  json.dumps(snap["monmap"]).encode())
+
+    def command(self, cmd: dict) -> Optional[Tuple[int, dict]]:
+        prefix = cmd.get("prefix", "")
+        if prefix == "mon dump":
+            return 0, {"monmap": self.mon.monmap.to_dict(),
+                       "leader": self.mon.leader}
+        if prefix == "mon add":
+            addr = (cmd["addr"][0], int(cmd["addr"][1]))
+            new = self.mon.monmap.with_added(addr)
+            self.propose({"monmap": new.to_dict()})
+            return 0, {"rank": new.size - 1, "epoch": new.epoch}
+        if prefix == "mon rm":
+            rank = int(cmd["rank"])
+            if rank >= self.mon.monmap.size or \
+                    self.mon.monmap.addrs[rank] is None:
+                return -2, {"error": f"no mon rank {rank}"}
+            live = len(self.mon.monmap.live_ranks())
+            if live <= 1:
+                return -22, {"error": "refusing to remove the last mon"}
+            new = self.mon.monmap.with_removed(rank)
+            self.propose({"monmap": new.to_dict()})
+            return 0, {"epoch": new.epoch}
+        return None
+
+
 def build_services(mon) -> Dict[str, PaxosService]:
     svcs = [ConfigMonitor(mon), LogMonitor(mon), HealthMonitor(mon),
-            AuthMonitor(mon)]
+            AuthMonitor(mon), MonmapMonitor(mon)]
     return {s.name: s for s in svcs}

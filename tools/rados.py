@@ -127,6 +127,15 @@ def main(argv=None) -> int:
                        data_dir=args.data_dir,
                        keyring=args.cephx) as cluster:
         client = cluster.client()
+
+        def _wait_pool(pid):
+            # the CLIENT's own subscribed map must carry the pool
+            # before ops can target it
+            cluster.wait_for(
+                lambda: client.objecter.osdmap is not None
+                and pid in client.objecter.osdmap.pools,
+                what=f"pool {pid} on client")
+
         pool_id = None
         io = None
         rc = 0
@@ -141,6 +150,7 @@ def main(argv=None) -> int:
                     ec_profile=args.ec_profile)
                 print(f"pool {rest[0] if rest else args.pool} "
                       f"id {pool_id}")
+                _wait_pool(pool_id)
                 io = client.ioctx(pool_id)
                 continue
             if name not in COMMANDS:
@@ -158,6 +168,7 @@ def main(argv=None) -> int:
                         pool_type=("erasure" if args.ec_profile
                                    else "replicated"),
                         ec_profile=args.ec_profile)
+                _wait_pool(pool_id)
                 io = client.ioctx(pool_id)
             t0 = time.time()
             rc = COMMANDS[name](io, rest, cluster)
