@@ -92,3 +92,50 @@ def test_rados_cli_script():
     assert "a size 11" in out
     assert "osds: 3/3 up" in out
     os.unlink(path)
+
+
+def test_ceph_admin_cli_script():
+    import ceph as ceph_cli
+    import json
+
+    rc, out = _capture(ceph_cli.main, [
+        "--vstart", "1x3", "--script",
+        "status; health; osd tree; config set global debug 5; "
+        "config get osd.1; log cli smoke; log last 5; mon dump",
+    ])
+    assert rc == 0
+    docs = []
+    depth = 0
+    buf = ""
+    for line in out.splitlines():  # split the concatenated json docs
+        buf += line + "\n"
+        depth += line.count("{") - line.count("}")
+        if depth == 0 and buf.strip():
+            docs.append(json.loads(buf))
+            buf = ""
+    status, health, tree, cset, cget, logw, loglast, mondump = docs
+    assert status["rc"] == 0 and status["num_up_osds"] == 3
+    assert health["status"] == "HEALTH_OK"
+    assert any(n["name"] == "osd.2" for n in tree["nodes"])
+    assert any(n.get("type") for n in tree["nodes"])
+    assert cget["config"]["debug"] == "5"  # global applies to osd.1
+    assert loglast["lines"][-1]["msg"] == "cli smoke"
+    assert mondump["monmap"]["epoch"] >= 1
+
+
+def test_ceph_cli_osd_down_and_cephx():
+    import ceph as ceph_cli
+    import json
+
+    rc, out = _capture(ceph_cli.main, [
+        "--vstart", "1x3", "--cephx", "--script",
+        "auth get-or-create client.app; auth ls; osd out 1; health",
+    ])
+    assert rc == 0
+    docs = [json.loads(d) for d in
+            out.replace("}\n{", "}\x00{").split("\x00")]
+    create, ls, _out_cmd, health = docs
+    assert len(bytes.fromhex(create["key"])) == 32
+    assert "client.app" in ls["entities"]
+    assert health["status"] == "HEALTH_WARN"  # osd.1 out
+    assert "OSD_OUT" in health["checks"]

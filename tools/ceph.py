@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""ceph — cluster admin CLI (reference src/ceph.in + mon command table).
+
+Covers the admin surface the mon + services expose: status, health
+(+mute/unmute), osd dump/tree/out/in/down/reweight, osd pool create,
+osd erasure-code-profile set/ls, config set/get/rm/dump, auth
+get-or-create/get/ls/rm, log/log last, mon dump/add/rm.
+
+Like tools/rados.py, `--vstart MxN` runs the command sequence against
+an ephemeral in-process cluster (`--script "a; b; c"`), or over a
+durable --data-dir.  Commands are the same JSON-prefix commands the
+mon's _do_command consumes — this CLI is the human front end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import sys
+
+
+def _parse(tokens):
+    """CLI tokens -> mon command dict (the ceph.in argparse role)."""
+    t = tokens
+    joined = " ".join(t)
+    if joined.startswith("osd pool create"):
+        cmd = {"prefix": "osd pool create", "pool": t[3]}
+        if len(t) > 4:
+            cmd["pg_num"] = int(t[4])
+        for extra in t[5:]:
+            if extra == "erasure":
+                cmd["pool_type"] = "erasure"
+            elif "=" in extra:
+                k, v = extra.split("=", 1)
+                cmd[k] = v
+        return cmd
+    if joined.startswith("osd erasure-code-profile set"):
+        return {"prefix": "osd erasure-code-profile set", "name": t[3],
+                "profile": " ".join(t[4:])}
+    if joined.startswith("osd erasure-code-profile ls"):
+        return {"prefix": "osd erasure-code-profile ls"}
+    if t[0] == "osd" and t[1] in ("out", "in", "down"):
+        return {"prefix": f"osd {t[1]}", "id": int(t[2])}
+    if t[0] == "osd" and t[1] == "reweight":
+        return {"prefix": "osd reweight", "id": int(t[2]),
+                "weight": float(t[3])}
+    if t[0] == "osd" and t[1] == "dump":
+        return {"prefix": "osd dump"}
+    if t[0] == "osd" and t[1] == "tree":
+        return {"prefix": "osd tree"}
+    if t[0] == "status":
+        return {"prefix": "status"}
+    if t[0] == "health":
+        if len(t) > 1 and t[1] in ("mute", "unmute"):
+            return {"prefix": f"health {t[1]}", "check": t[2]}
+        return {"prefix": "health"}
+    if t[0] == "config":
+        if t[1] == "set":
+            return {"prefix": "config set", "who": t[2], "name": t[3],
+                    "value": " ".join(t[4:])}
+        if t[1] == "rm":
+            return {"prefix": "config rm", "who": t[2], "name": t[3]}
+        if t[1] == "get":
+            return {"prefix": "config get", "who": t[2]}
+        if t[1] == "dump":
+            return {"prefix": "config dump"}
+    if t[0] == "auth":
+        if t[1] == "get-or-create":
+            return {"prefix": "auth get-or-create", "entity": t[2]}
+        if t[1] == "get":
+            return {"prefix": "auth get", "entity": t[2]}
+        if t[1] == "ls":
+            return {"prefix": "auth ls"}
+        if t[1] == "rm":
+            return {"prefix": "auth rm", "entity": t[2]}
+    if t[0] == "log":
+        if len(t) > 1 and t[1] == "last":
+            return {"prefix": "log last",
+                    "num": int(t[2]) if len(t) > 2 else 20}
+        return {"prefix": "log", "logtext": " ".join(t[1:])}
+    if t[0] == "mon":
+        if t[1] == "dump":
+            return {"prefix": "mon dump"}
+        if t[1] == "add":
+            ip, port = t[2].rsplit(":", 1)
+            return {"prefix": "mon add", "addr": [ip, int(port)]}
+        if t[1] == "rm":
+            return {"prefix": "mon rm", "rank": int(t[2])}
+    raise ValueError(f"unknown command: {joined!r}")
+
+
+def _osd_tree(cluster) -> dict:
+    """Rendered CRUSH hierarchy (crushtool/osd tree role) straight off
+    the leader's map."""
+    m = cluster.leader().osdmap
+    cm = m.crush
+    names = dict(cm.bucket_names)
+    out = []
+
+    def walk(item, depth):
+        if item >= 0:
+            up = bool(m.osd_state_up[item])
+            w = int(m.osd_weight[item]) / 0x10000
+            out.append({"indent": depth, "name": f"osd.{item}",
+                        "up": up, "reweight": w})
+            return
+        b = cm.buckets[item]
+        out.append({"indent": depth,
+                    "name": names.get(item, f"bucket{-item}"),
+                    "type": cm.type_names.get(b.type, str(b.type)),
+                    "weight": b.weight / 0x10000})
+        for it in b.items:
+            walk(it, depth + 1)
+
+    roots = set(cm.buckets) - {
+        it for b in cm.buckets.values() for it in b.items if it < 0}
+    for r in sorted(roots, reverse=True):
+        walk(r, 0)
+    return {"nodes": out}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ceph")
+    p.add_argument("--vstart", default="1x3")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--cephx", action="store_true")
+    p.add_argument("--script", default="")
+    p.add_argument("command", nargs="*")
+    args = p.parse_args(argv)
+
+    from ceph_tpu.vstart import VStartCluster
+
+    n_mons, n_osds = (int(v) for v in args.vstart.split("x"))
+    scripts = ([s.strip() for s in args.script.split(";") if s.strip()]
+               if args.script else [" ".join(args.command)])
+    if not scripts or not scripts[0]:
+        p.error("no command given")
+
+    rc = 0
+    with VStartCluster(n_mons=n_mons, n_osds=n_osds,
+                       data_dir=args.data_dir,
+                       keyring=args.cephx) as cluster:
+        for line in scripts:
+            tokens = shlex.split(line)
+            if tokens[:2] == ["osd", "tree"]:
+                print(json.dumps(_osd_tree(cluster), indent=1))
+                continue
+            try:
+                cmd = _parse(tokens)
+            except (ValueError, IndexError) as e:
+                print(str(e), file=sys.stderr)
+                return 22
+            code, out = cluster.command(cmd)
+            print(json.dumps({"rc": code, **out}, indent=1, default=str))
+            if code != 0:
+                rc = abs(code)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
