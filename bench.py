@@ -197,11 +197,12 @@ def baseline_configs(jax, out):
     dt = _bench(lambda: jer.encode(range(6), payload), warmup=2, iters=20)
     out["jerasure_k4m2_4k_encode_gbps"] = round(4096 / dt / 1e9, 3)
 
-    # BASELINE row 4 asks k=8,m=4,l=4; this lrc's kml grouping needs
-    # (k+m)/l to divide both k and m, so l=6 is the closest valid
-    # profile (2 local groups, one local parity each)
+    # BASELINE row 4 asks k=8,m=4,l=4 — which the REFERENCE's own
+    # parse_kml rejects (ErasureCodeLrc.cc parse_kml: k and m must be
+    # multiples of (k+m)/l; 8 % 3 != 0).  l=6 is the closest profile
+    # both implementations accept (2 local groups, one parity each).
     lrc = instance().factory("lrc", {"k": "8", "m": "4", "l": "6"})
-    out["lrc_profile"] = "k=8 m=4 l=6"
+    out["lrc_profile"] = "k=8 m=4 l=6 (l=4 invalid per reference parse_kml)"
     n = lrc.get_chunk_count()
     obj = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
     lchunks = lrc.encode(range(n), obj)
@@ -218,8 +219,10 @@ def baseline_configs(jax, out):
                           np.asarray(lchunks[lost])), "lrc repair mismatch"
     dt = _bench(rep, warmup=1, iters=5)
     chunk_bytes = np.asarray(lchunks[lost]).size
+    # object-equivalent GB/s (same convention as clay_repair_gbps and
+    # BASELINE.md: bytes = chunk * k), so rows compare 1:1
     out["lrc_local_repair_gbps"] = round(
-        chunk_bytes * len(need) / dt / 1e9, 3)
+        chunk_bytes * 8 / dt / 1e9, 3)
 
 
 def crush_sweep(jax, out):
@@ -311,8 +314,7 @@ def _probe_accelerator(timeout_s: float = 240.0) -> bool:
     import os
     import subprocess
 
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return False
+    timeout_s = float(os.environ.get("CEPH_TPU_PROBE_TIMEOUT", timeout_s))
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
@@ -328,6 +330,11 @@ def main():
     import os
 
     if (os.environ.get("CEPH_TPU_BENCH_FALLBACK") != "1"
+            and os.environ.get("JAX_PLATFORMS", "") != "cpu"
+            # an explicit CPU run is honored as-is (no probe, no
+            # re-exec, user env untouched); only accelerator-targeted
+            # runs pay the probe (one extra backend bring-up) because a
+            # wedged tunnel would otherwise hang the round's artifact
             and not _probe_accelerator()):
         # the axon sitecustomize imports jax at interpreter START, so
         # env mutation in-process is too late — re-exec scrubbed (the
