@@ -534,8 +534,15 @@ class ECBackend(PGBackend):
         if not all(i in arrs for i in data_ids):
             if len(arrs) < self.k:
                 return None
-            decoded = self.codec.decode_array(arrs, data_ids, L)
-            arrs.update({i: np.asarray(decoded[i]) for i in data_ids})
+            if hasattr(self.codec, "recovery_matrix"):
+                # batched recovery matmul: concurrent degraded reads
+                # sharing a survivor signature coalesce into one device
+                # dispatch (decode twin of the write-path batching)
+                data = self.queue.decode_data(self.codec, arrs)
+                arrs.update({i: data[i] for i in data_ids})
+            else:
+                decoded = self.codec.decode_array(arrs, data_ids, L)
+                arrs.update({i: np.asarray(decoded[i]) for i in data_ids})
         planes = np.stack([arrs[i] for i in data_ids])
         S = s1 - s0
         return planes.reshape(self.k, S, self.unit).transpose(

@@ -23,11 +23,14 @@ import numpy as np
 
 
 class _Job:
-    __slots__ = ("codec", "planes", "future")
+    __slots__ = ("codec", "planes", "future", "kind", "sig")
 
-    def __init__(self, codec, planes: np.ndarray) -> None:
+    def __init__(self, codec, planes: np.ndarray, kind: str = "enc",
+                 sig: Tuple[int, ...] = ()) -> None:
         self.codec = codec
         self.planes = planes
+        self.kind = kind      # "enc" | "dec"
+        self.sig = sig        # decode: sorted survivor ids
         self.future: Future = Future()
 
 
@@ -81,6 +84,27 @@ class StripeBatchQueue:
     def encode(self, codec, planes: np.ndarray) -> np.ndarray:
         return self.encode_async(codec, planes).result()
 
+    def decode_data_async(self, codec,
+                          available: "Dict[int, np.ndarray]") -> Future:
+        """Survivor planes {shard: [n]} -> Future of data planes [k, n].
+
+        The decode twin of encode_async: jobs sharing a survivor
+        SIGNATURE coalesce into one wide recovery matmul (the
+        reference's per-signature cached decode matrix, ECBackend
+        minimum_to_decode -> decode_chunks, batched the TPU way).
+        Requires a flat matrix codec (recovery_matrix)."""
+        self.start()
+        sig = tuple(sorted(available))[: codec.k]
+        stacked = np.ascontiguousarray(
+            np.stack([np.asarray(available[i], dtype=np.uint8)
+                      for i in sig]))
+        job = _Job(codec, stacked, kind="dec", sig=sig)
+        self._q.put(job)
+        return job.future
+
+    def decode_data(self, codec, available) -> np.ndarray:
+        return self.decode_data_async(codec, available).result()
+
     # -- worker -----------------------------------------------------------
     def _worker(self) -> None:
         while True:
@@ -106,9 +130,10 @@ class StripeBatchQueue:
                 if nxt is None:
                     self._run_batch(batch)
                     return
-                if nxt.codec is not batch[0].codec or (
-                    nxt.planes.shape[0] != batch[0].planes.shape[0]
-                ):
+                if (nxt.codec is not batch[0].codec
+                        or nxt.kind != batch[0].kind
+                        or nxt.sig != batch[0].sig
+                        or nxt.planes.shape[0] != batch[0].planes.shape[0]):
                     # different codec: flush current, start fresh
                     self._run_batch(batch)
                     batch = [nxt]
@@ -119,9 +144,28 @@ class StripeBatchQueue:
                 cols += nxt.planes.shape[1]
             self._run_batch(batch)
 
+    def _apply_matrix(self, codec, batch: List[_Job],
+                      stacked: np.ndarray) -> np.ndarray:
+        """One device matmul for the whole batch (encode or decode)."""
+        if batch[0].kind == "dec":
+            rec, _bits = codec.recovery_matrix(list(batch[0].sig))
+            if self.mesh is not None:
+                self.mesh_batches += 1
+                return self.mesh.recovery_gather(
+                    np.asarray(rec, dtype=np.uint8), stacked)
+            from ceph_tpu.ops import gf256_swar
+
+            return np.asarray(gf256_swar.gf_matmul_bytes(rec, stacked))
+        coding_mat = getattr(codec, "coding", None)
+        if self.mesh is not None and coding_mat is not None:
+            self.mesh_batches += 1
+            return self.mesh.encode_scatter(
+                np.asarray(coding_mat, dtype=np.uint8), stacked)
+        return np.asarray(codec.encode_array(stacked))
+
     def _run_batch(self, batch: List[_Job]) -> None:
         try:
-            if len(batch) == 1:
+            if len(batch) == 1 and batch[0].kind == "enc":
                 coding = batch[0].codec.encode_array(batch[0].planes)
                 batch[0].future.set_result(np.asarray(coding))
             else:
@@ -146,14 +190,8 @@ class StripeBatchQueue:
                     stacked[:, off:off + w] = j.planes
                     off += w
                 codec = batch[0].codec
-                coding_mat = getattr(codec, "coding", None)
-                if (self.mesh is not None and coding_mat is not None
-                        and gran == 1):
-                    # the mesh path shards the coalesced columns over
-                    # the stripe axis (meshio.encode_scatter)
-                    coding = self.mesh.encode_scatter(
-                        np.asarray(coding_mat, dtype=np.uint8), stacked)
-                    self.mesh_batches += 1
+                if gran == 1:
+                    coding = self._apply_matrix(codec, batch, stacked)
                 else:
                     coding = np.asarray(codec.encode_array(stacked))
                 off = 0

@@ -93,3 +93,37 @@ def test_single_device_mesh_degenerates(codec):
     got = solo.encode_scatter(np.asarray(codec.coding, np.uint8), x)
     want = np.asarray(gf256_swar.gf_matmul_bytes(codec.coding, x))
     assert np.array_equal(got, want)
+
+
+def test_decode_batching_matches_and_coalesces(codec):
+    """decode_data_async: same-signature degraded reads coalesce into
+    one recovery matmul and return exact data planes."""
+    q = StripeBatchQueue(window_s=0.005)
+    rng = np.random.default_rng(6)
+    objs = [rng.integers(0, 256, size=(K, 256), dtype=np.uint8)
+            for _ in range(32)]
+    survivors = [0, 1, 2, 3, 4, 5, 8, 9]
+    futs = []
+    for x in objs:
+        coding = np.asarray(gf256_swar.gf_matmul_bytes(codec.coding, x))
+        avail = {s: (x[s] if s < K else coding[s - K]) for s in survivors}
+        futs.append((x, q.decode_data_async(codec, avail)))
+    for x, f in futs:
+        assert np.array_equal(np.asarray(f.result()), x)
+    q.stop()
+    assert q.jobs == 32
+    assert q.batches < 32, "same-signature decodes must coalesce"
+
+
+def test_decode_batching_rides_mesh(mesh, codec):
+    q = StripeBatchQueue(mesh=mesh, window_s=0.005)
+    rng = np.random.default_rng(7)
+    x = rng.integers(0, 256, size=(K, 512), dtype=np.uint8)
+    coding = np.asarray(gf256_swar.gf_matmul_bytes(codec.coding, x))
+    survivors = [0, 1, 2, 3, 4, 5, 8, 9]
+    avail = {s: (x[s] if s < K else coding[s - K]) for s in survivors}
+    futs = [q.decode_data_async(codec, dict(avail)) for _ in range(8)]
+    for f in futs:
+        assert np.array_equal(np.asarray(f.result()), x)
+    q.stop()
+    assert q.mesh_batches >= 1
