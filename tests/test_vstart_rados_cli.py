@@ -139,3 +139,24 @@ def test_ceph_cli_osd_down_and_cephx():
     assert "client.app" in ls["entities"]
     assert health["status"] == "HEALTH_WARN"  # osd.1 out
     assert "OSD_OUT" in health["checks"]
+
+
+def test_rbd_cli_lifecycle(tmp_path):
+    import rbd as rbd_cli
+
+    payload = os.urandom(300_000)
+    src = tmp_path / "disk.img"
+    src.write_bytes(payload)
+    out_path = tmp_path / "out.img"
+    rc, out = _capture(rbd_cli.main, [
+        "--vstart", "1x3", "--script",
+        f"import {src} vol1; ls; info vol1; "
+        f"create vol2 1m; journal-replay vol1 vol2; "
+        f"export vol1 {out_path}; resize vol1 64k; info vol1; rm vol2; ls",
+    ])
+    assert rc == 0
+    assert out_path.read_bytes() == payload
+    assert "vol1" in out and "vol2" in out
+    assert "size 65536 bytes" in out  # post-resize info
+    # final ls shows only vol1
+    assert out.strip().splitlines()[-1] == "vol1"

@@ -1,0 +1,170 @@
+#!/usr/bin/env python3
+"""rbd — block-image CLI (reference src/tools/rbd).
+
+Subcommands: create, ls, info, rm, resize, import, export, bench,
+journal-replay (the rbd-mirror one-shot).  Same session model as
+tools/rados.py: `--vstart MxN --script "a; b; c"` drives an ephemeral
+in-process cluster; --data-dir makes it durable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+import time
+
+
+def _size(s: str) -> int:
+    mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
+    s = s.lower()
+    if s and s[-1] in mult:
+        return int(float(s[:-1]) * mult[s[-1]])
+    return int(s)
+
+
+def cmd_create(rbd, io, args) -> int:
+    name, size = args[0], _size(args[1])
+    order = int(args[2]) if len(args) > 2 else 22
+    rbd.create(io, name, size, order=order)
+    return 0
+
+
+def cmd_ls(rbd, io, args) -> int:
+    for name in rbd.list(io):
+        print(name)
+    return 0
+
+
+def cmd_info(rbd, io, args) -> int:
+    with rbd.open(io, args[0]) as img:
+        print(f"rbd image '{args[0]}':")
+        print(f"\tsize {img.size} bytes")
+        print(f"\torder {img.meta['order']} "
+              f"({1 << img.meta['order']} byte objects)")
+        print(f"\tstripe unit {img.meta['stripe_unit']}, "
+              f"count {img.meta['stripe_count']}")
+    return 0
+
+
+def cmd_rm(rbd, io, args) -> int:
+    rbd.remove(io, args[0])
+    return 0
+
+
+def cmd_resize(rbd, io, args) -> int:
+    with rbd.open(io, args[0]) as img:
+        img.resize(_size(args[1]))
+    return 0
+
+
+def cmd_import(rbd, io, args) -> int:
+    path, name = args[0], args[1]
+    data = (sys.stdin.buffer.read() if path == "-"
+            else open(path, "rb").read())
+    rbd.create(io, name, len(data))
+    with rbd.open(io, name) as img:
+        step = 4 << 20
+        for off in range(0, len(data), step):
+            img.write(off, data[off: off + step])
+    print(f"imported {len(data)} bytes into {name}")
+    return 0
+
+
+def cmd_export(rbd, io, args) -> int:
+    name, path = args[0], args[1]
+    with rbd.open(io, name) as img:
+        data = b"".join(
+            img.read(off, min(4 << 20, img.size - off))
+            for off in range(0, img.size, 4 << 20))
+    if path == "-":
+        sys.stdout.buffer.write(data)
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+        print(f"exported {len(data)} bytes from {name}")
+    return 0
+
+
+def cmd_bench(rbd, io, args) -> int:
+    name = args[0]
+    seconds = float(args[1]) if len(args) > 1 else 2.0
+    bs = _size(args[2]) if len(args) > 2 else 65536
+    with rbd.open(io, name) as img:
+        buf = b"b" * bs
+        end = time.time() + seconds
+        ops = 0
+        off = 0
+        while time.time() < end:
+            img.write(off % max(bs, img.size - bs), buf)
+            off += bs
+            ops += 1
+        mb = ops * bs / (1 << 20) / seconds
+        print(f"bench write {ops} ops, {mb:.2f} MB/s")
+    return 0
+
+
+def cmd_journal_replay(rbd, io, args) -> int:
+    """Mirror src image's journal onto dst (rbd-mirror one-shot)."""
+    from ceph_tpu.rbd.journal import ImageJournal
+
+    src_name, dst_name = args[0], args[1]
+    with rbd.open(io, src_name) as src, rbd.open(io, dst_name) as dst:
+        j = ImageJournal(src)
+        last = j.mirror_to(dst)
+        print(f"replayed journal of {src_name} -> {dst_name} "
+              f"(through seq {last})")
+    return 0
+
+
+COMMANDS = {
+    "create": cmd_create, "ls": cmd_ls, "info": cmd_info, "rm": cmd_rm,
+    "resize": cmd_resize, "import": cmd_import, "export": cmd_export,
+    "bench": cmd_bench, "journal-replay": cmd_journal_replay,
+}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="rbd")
+    p.add_argument("--vstart", default="1x3")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--pool", "-p", default="rbd")
+    p.add_argument("--pool-size", type=int, default=2)
+    p.add_argument("--script", default="")
+    p.add_argument("command", nargs="*")
+    args = p.parse_args(argv)
+
+    from ceph_tpu.rbd import RBD
+    from ceph_tpu.vstart import VStartCluster
+
+    n_mons, n_osds = (int(v) for v in args.vstart.split("x"))
+    scripts = ([s.strip() for s in args.script.split(";") if s.strip()]
+               if args.script else [" ".join(args.command)])
+    if not scripts or not scripts[0]:
+        p.error("no command given")
+
+    with VStartCluster(n_mons=n_mons, n_osds=n_osds,
+                       data_dir=args.data_dir) as cluster:
+        client = cluster.client()
+        pool_id = cluster.create_pool(args.pool, size=args.pool_size)
+        cluster.wait_for(
+            lambda: client.objecter.osdmap is not None
+            and pool_id in client.objecter.osdmap.pools,
+            what="pool on client")
+        io = client.ioctx(pool_id)
+        rbd = RBD()
+        for line in scripts:
+            parts = shlex.split(line)
+            name, rest = parts[0], parts[1:]
+            fn = COMMANDS.get(name)
+            if fn is None:
+                print(f"unknown command {name!r}", file=sys.stderr)
+                return 22
+            rc = fn(rbd, io, rest)
+            if rc != 0:
+                return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
