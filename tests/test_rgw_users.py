@@ -1,0 +1,106 @@
+"""RGW user + SigV4 auth tests (reference src/rgw/rgw_user.* +
+rgw_auth_s3.cc).
+"""
+
+import sys, os
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_osd_cluster import MiniCluster, LibClient, REP_POOL
+
+from ceph_tpu.rgw.users import (
+    AuthFailure,
+    NoSuchUser,
+    RGWUserAdmin,
+    _sign_v4,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def admin(cluster):
+    cl = LibClient(cluster)
+    yield RGWUserAdmin(cl.rc.ioctx(REP_POOL))
+    cl.shutdown()
+
+
+def test_sigv4_known_answer_vector():
+    """AWS's published SigV4 example (docs 'Signature Version 4
+    signing process', IAM GET example) — pins the key-derivation chain
+    against an external authority, not our own code."""
+    secret = "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+    sts = ("AWS4-HMAC-SHA256\n"
+           "20150830T123600Z\n"
+           "20150830/us-east-1/iam/aws4_request\n"
+           "f536975d06c0309214f805bb90ccff089219ecd68b2577efef23edd43b7e1a59")
+    got = _sign_v4(secret, "20150830", "us-east-1", "iam", sts)
+    assert got == ("5d672d79c15b13162d9279b0855cfba6"
+                   "789a8edb4c82c400e06b5924a6f2b5d7")
+
+
+def test_user_crud_and_key_index(admin):
+    u = admin.user_create("alice", "Alice A")
+    assert u["access_key"].startswith("AK")
+    assert admin.user_info("alice")["display_name"] == "Alice A"
+    assert "alice" in admin.user_ls()
+    assert admin.resolve_key(u["access_key"])["uid"] == "alice"
+    with pytest.raises(ValueError):
+        admin.user_create("alice")
+    admin.user_rm("alice")
+    with pytest.raises(NoSuchUser):
+        admin.user_info("alice")
+    with pytest.raises(AuthFailure):
+        admin.resolve_key(u["access_key"])
+
+
+def test_authenticate_roundtrip_and_failures(admin):
+    admin.user_create("bob")
+    sts = "AWS4-HMAC-SHA256\n20260730T000000Z\n..."
+    sig = admin.sign("bob", "20260730", "tpu-east", sts)
+    user = admin.authenticate(admin.user_info("bob")["access_key"],
+                              "20260730", "tpu-east", sts, sig)
+    assert user["uid"] == "bob"
+    # wrong signature / wrong scope / suspended user all refuse
+    with pytest.raises(AuthFailure):
+        admin.authenticate(user["access_key"], "20260730", "tpu-east",
+                           sts, "0" * 64)
+    with pytest.raises(AuthFailure):
+        admin.authenticate(user["access_key"], "20260731", "tpu-east",
+                           sts, sig)  # different date scope
+    admin.user_suspend("bob")
+    with pytest.raises(AuthFailure):
+        admin.authenticate(user["access_key"], "20260730", "tpu-east",
+                           sts, sig)
+    admin.user_suspend("bob", suspended=False)
+    assert admin.authenticate(user["access_key"], "20260730",
+                              "tpu-east", sts, sig)["uid"] == "bob"
+
+
+def test_radosgw_admin_cli():
+    import contextlib
+    import io as _io
+    import json as _json
+
+    sys.path.insert(0, os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "tools")))
+    import radosgw_admin
+
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = radosgw_admin.main([
+            "--vstart", "1x3", "--script",
+            "user create carol Carol C; user ls; user info carol; "
+            "bucket list; user rm carol; user ls",
+        ])
+    assert rc == 0
+    out = buf.getvalue()
+    assert '"uid": "carol"' in out
+    assert '["carol"]' in out
+    assert out.strip().splitlines()[-1] == "[]"

@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""radosgw-admin — RGW administration CLI (reference src/rgw/
+radosgw-admin): user create/info/ls/rm/suspend/enable, bucket
+list/stats.  Same --vstart/--script session model as the other CLIs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="radosgw-admin")
+    p.add_argument("--vstart", default="1x3")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--pool", default="rgw")
+    p.add_argument("--script", default="")
+    p.add_argument("command", nargs="*")
+    args = p.parse_args(argv)
+
+    from ceph_tpu.rgw import RGW
+    from ceph_tpu.rgw.users import NoSuchUser, RGWUserAdmin
+    from ceph_tpu.vstart import VStartCluster
+
+    n_mons, n_osds = (int(v) for v in args.vstart.split("x"))
+    scripts = ([s.strip() for s in args.script.split(";") if s.strip()]
+               if args.script else [" ".join(args.command)])
+    if not scripts or not scripts[0]:
+        p.error("no command given")
+
+    with VStartCluster(n_mons=n_mons, n_osds=n_osds,
+                       data_dir=args.data_dir) as cluster:
+        client = cluster.client()
+        pool_id = cluster.create_pool(args.pool, size=2)
+        cluster.wait_for(
+            lambda: client.objecter.osdmap is not None
+            and pool_id in client.objecter.osdmap.pools,
+            what="pool on client")
+        io = client.ioctx(pool_id)
+        admin = RGWUserAdmin(io)
+        rgw = RGW(io)
+        for line in scripts:
+            t = shlex.split(line)
+            try:
+                if t[:2] == ["user", "create"]:
+                    name = t[2]
+                    dn = " ".join(t[3:]) if len(t) > 3 else ""
+                    print(json.dumps(admin.user_create(name, dn),
+                                     indent=1))
+                elif t[:2] == ["user", "info"]:
+                    print(json.dumps(admin.user_info(t[2]), indent=1))
+                elif t[:2] == ["user", "ls"]:
+                    print(json.dumps(admin.user_ls()))
+                elif t[:2] == ["user", "rm"]:
+                    admin.user_rm(t[2])
+                elif t[:2] == ["user", "suspend"]:
+                    admin.user_suspend(t[2], True)
+                elif t[:2] == ["user", "enable"]:
+                    admin.user_suspend(t[2], False)
+                elif t[:2] == ["bucket", "list"]:
+                    print(json.dumps(rgw.list_buckets()))
+                elif t[:2] == ["bucket", "stats"]:
+                    bucket = t[2]
+                    objs = rgw.list_objects(bucket)["contents"]
+                    print(json.dumps({
+                        "bucket": bucket,
+                        "num_objects": len(objs),
+                        "size": sum(o["size"] for o in objs),
+                    }, indent=1))
+                else:
+                    print(f"unknown command: {line!r}", file=sys.stderr)
+                    return 22
+            except (NoSuchUser, KeyError, ValueError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
