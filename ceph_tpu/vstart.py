@@ -48,12 +48,14 @@ def _free_ports(n: int) -> List[int]:
 class VStartCluster:
     def __init__(self, n_mons: int = 1, n_osds: int = 3,
                  data_dir: Optional[str] = None,
+                 store_kind: str = "filestore",
                  keyring: bool = False,
                  conf: Optional[dict] = None,
                  wait: bool = True) -> None:
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.data_dir = data_dir
+        self.store_kind = store_kind  # for data_dir: filestore|blockstore
         self.ctx = Context("vstart", {
             "osd_heartbeat_interval": 0.5,
             "osd_heartbeat_grace": 3.0,
@@ -96,12 +98,13 @@ class VStartCluster:
             from ceph_tpu.store.memstore import MemStore
 
             return MemStore(), True
-        from ceph_tpu.store.filestore import FileStore
+        from ceph_tpu.store import create
 
         path = os.path.join(self.data_dir, f"osd{i}")
-        fresh = not os.path.exists(os.path.join(path, "wal.log"))
+        marker = "wal.log" if self.store_kind == "filestore" else "block"
+        fresh = not os.path.exists(os.path.join(path, marker))
         os.makedirs(path, exist_ok=True)
-        return FileStore(path), fresh
+        return create(self.store_kind, path=path), fresh
 
     def _spawn_osd(self, i: int) -> OSDService:
         store, fresh = self._make_store(i)

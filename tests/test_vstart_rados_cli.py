@@ -160,3 +160,24 @@ def test_rbd_cli_lifecycle(tmp_path):
     assert "size 65536 bytes" in out  # post-resize info
     # final ls shows only vol1
     assert out.strip().splitlines()[-1] == "vol1"
+
+
+def test_vstart_blockstore_backed_cluster(tmp_path):
+    """The BlueStore-role BlockStore under the FULL daemon stack:
+    writes through mons+osds, durable across cluster restart, fsck
+    clean."""
+    from ceph_tpu.vstart import VStartCluster
+
+    d = str(tmp_path / "bs-cluster")
+    with VStartCluster(n_mons=1, n_osds=2, data_dir=d,
+                       store_kind="blockstore") as c:
+        pool = c.create_pool("bs", size=2)
+        io_ = c.client().ioctx(pool)
+        io_.write_full("obj", b"block-backed" * 500)
+    with VStartCluster(n_mons=1, n_osds=2, data_dir=d,
+                       store_kind="blockstore") as c2:
+        pool2 = c2.create_pool("bs", size=2)
+        io2 = c2.client().ioctx(pool2)
+        assert io2.read("obj") == b"block-backed" * 500
+        for o in c2.osds.values():
+            assert o.store.fsck() == []
