@@ -181,3 +181,22 @@ def test_vstart_blockstore_backed_cluster(tmp_path):
         assert io2.read("obj") == b"block-backed" * 500
         for o in c2.osds.values():
             assert o.store.fsck() == []
+
+
+def test_cephfs_shell_cli(tmp_path):
+    import cephfs_shell
+
+    src = tmp_path / "hello.txt"
+    src.write_bytes(b"fs payload")
+    rc, out = _capture(cephfs_shell.main, [
+        "--vstart", "1x3", "--script",
+        f"mkdir /docs; put {src} /docs/hello.txt; stat /docs/hello.txt; "
+        "mv /docs/hello.txt /docs/renamed.txt; ls /docs; tree /; "
+        "cat /docs/renamed.txt; rm /docs/renamed.txt; rmdir /docs; ls /",
+    ])
+    assert rc == 0
+    assert "size 10" in out
+    assert "renamed.txt" in out
+    assert "d docs" in out
+    assert "fs payload" in out
+    assert out.strip().splitlines()[-1] != "docs"  # rmdir removed it

@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""cephfs-shell — file operations on a CephFS pool (reference
+src/tools/cephfs/cephfs-shell): mkdir, ls, put, get, cat, stat, mv,
+rm, rmdir, tree.  Same --vstart/--script session model as the other
+CLIs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+
+
+def _out_bytes(data: bytes) -> None:
+    buf = getattr(sys.stdout, "buffer", None)
+    if buf is not None:
+        buf.write(data)
+    else:  # captured stdout (tests): decode best-effort
+        sys.stdout.write(data.decode(errors="replace"))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cephfs-shell")
+    p.add_argument("--vstart", default="1x3")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--pool", default="cephfs_data")
+    p.add_argument("--script", default="")
+    p.add_argument("command", nargs="*")
+    args = p.parse_args(argv)
+
+    from ceph_tpu.cephfs import CephFS
+    from ceph_tpu.cephfs.fs import FSError
+    from ceph_tpu.vstart import VStartCluster
+
+    n_mons, n_osds = (int(v) for v in args.vstart.split("x"))
+    scripts = ([s.strip() for s in args.script.split(";") if s.strip()]
+               if args.script else [" ".join(args.command)])
+    if not scripts or not scripts[0]:
+        p.error("no command given")
+
+    def tree(fs, path, depth, out):
+        for name in sorted(fs.listdir(path)):
+            full = (path.rstrip("/") + "/" + name)
+            st = fs.stat(full)
+            kind = "d" if st["type"] == "dir" else "-"
+            out.append("  " * depth + f"{kind} {name}")
+            if st["type"] == "dir":
+                tree(fs, full, depth + 1, out)
+
+    with VStartCluster(n_mons=n_mons, n_osds=n_osds,
+                       data_dir=args.data_dir) as cluster:
+        client = cluster.client()
+        pool_id = cluster.create_pool(args.pool, size=2)
+        cluster.wait_for(
+            lambda: client.objecter.osdmap is not None
+            and pool_id in client.objecter.osdmap.pools,
+            what="pool on client")
+        fs = CephFS(client.ioctx(pool_id))
+        for line in scripts:
+            t = shlex.split(line)
+            cmd, rest = t[0], t[1:]
+            try:
+                if cmd == "mkdir":
+                    fs.mkdir(rest[0])
+                elif cmd == "ls":
+                    for n in sorted(fs.listdir(rest[0] if rest else "/")):
+                        print(n)
+                elif cmd == "put":
+                    data = (sys.stdin.buffer.read() if rest[0] == "-"
+                            else open(rest[0], "rb").read())
+                    fs.write(rest[1], data)
+                elif cmd == "get":
+                    data = fs.read(rest[0])
+                    if len(rest) > 1 and rest[1] != "-":
+                        open(rest[1], "wb").write(data)
+                    else:
+                        _out_bytes(data)
+                elif cmd == "cat":
+                    _out_bytes(fs.read(rest[0]))
+                    print()
+                elif cmd == "stat":
+                    st = fs.stat(rest[0])
+                    print(f"{rest[0]}: {st['type']} size {st.get('size', 0)}"
+                          f" ino {st['ino']}")
+                elif cmd == "mv":
+                    fs.rename(rest[0], rest[1])
+                elif cmd == "rm":
+                    fs.unlink(rest[0])
+                elif cmd == "rmdir":
+                    fs.rmdir(rest[0])
+                elif cmd == "tree":
+                    out = []
+                    tree(fs, rest[0] if rest else "/", 0, out)
+                    print("\n".join(out))
+                else:
+                    print(f"unknown command {cmd!r}", file=sys.stderr)
+                    return 22
+            except FSError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
