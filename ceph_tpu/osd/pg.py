@@ -88,6 +88,12 @@ class PG:
 
         self.hit_set_history = HitSetHistory(
             count=getattr(pool, "hit_set_count", 0) or 4)
+        # object-context cache (reference object_contexts SharedLRU)
+        import collections as _collections
+
+        self._obc: "_collections.OrderedDict[str, ObjectState]" = (
+            _collections.OrderedDict())
+        self._obc_lock = threading.Lock()
         if codec is not None:
             self.backend: PGBackend = ECBackend(
                 pgid, self.coll, osd.store, osd.whoami, osd.send_to_osd,
@@ -136,6 +142,9 @@ class PG:
         with self.lock:
             self.acting = list(acting)
             self.primary = primary
+        # recovery/peering may rewrite local objects outside the op
+        # path: contexts cached in the old interval are suspect
+        self._obc_invalidate()
         # in-flight writes waiting on OSDs the new interval dropped can
         # never be acked — re-resolve them against the live set
         alive = {o for o in acting if o >= 0 and o != CRUSH_ITEM_NONE}
@@ -275,11 +284,48 @@ class PG:
 
     def _get_state(self, oid: str,
                    done: Callable[[Optional[ObjectState]], None]) -> None:
-        """Fetch current full object state (degraded-aware for EC)."""
+        """Fetch current full object state (degraded-aware for EC),
+        served from the object-context cache when warm (the reference's
+        object_contexts LRU, PrimaryLogPG::get_object_context): per-PG
+        write ordering makes the cached copy read-your-writes."""
+        with self._obc_lock:
+            cached = self._obc.get(oid)
+            if cached is not None:
+                self._obc.move_to_end(oid)
+                done(ObjectState(cached.data, dict(cached.xattrs),
+                                 dict(cached.omap)))
+                return
+
+        def fill(state: Optional[ObjectState]) -> None:
+            if state is not None:
+                self._obc_put(oid, state)
+            done(state)
+
         if self.is_ec():
-            self._ec_read_object(oid, done)
+            self._ec_read_object(oid, fill)
         else:
-            self.backend.read_object(oid, self.acting, done)
+            self.backend.read_object(oid, self.acting, fill)
+
+    # -- object-context cache ---------------------------------------------
+    OBC_CAPACITY = 128
+
+    def _obc_put(self, oid: str, state: Optional[ObjectState]) -> None:
+        with self._obc_lock:
+            if state is None:
+                self._obc.pop(oid, None)
+                return
+            self._obc[oid] = ObjectState(state.data, dict(state.xattrs),
+                                         dict(state.omap))
+            self._obc.move_to_end(oid)
+            while len(self._obc) > self.OBC_CAPACITY:
+                self._obc.popitem(last=False)
+
+    def _obc_invalidate(self, oid: Optional[str] = None) -> None:
+        with self._obc_lock:
+            if oid is None:
+                self._obc.clear()
+            else:
+                self._obc.pop(oid, None)
 
     # -- hit-set tracking --------------------------------------------------
     def record_hit(self, oid: str) -> None:
@@ -765,6 +811,7 @@ class PG:
                 committed.set()
 
             # WRITE: per-shard extents of the touched stripes only
+            self._obc_invalidate(msg.oid)  # extents bypass full state
             be.submit_partial(msg.oid, s0, stripes, size, [entry],
                               log_omap, self.acting, on_commit,
                               log_rm=log_rm)
@@ -807,6 +854,9 @@ class PG:
         kw = {"log_rm": log_rm}
         if pre_txn is not None:
             kw["pre_txn"] = pre_txn
+        # the queued write IS the newest state (per-PG ordering):
+        # read-your-writes from the context cache
+        self._obc_put(msg.oid, None if delete else state)
         self.backend.submit(msg.oid, state, [entry], log_omap,
                             self.acting, on_commit, **kw)
 

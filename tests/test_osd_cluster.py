@@ -354,3 +354,28 @@ def test_resend_is_exactly_once(cluster, client):
     assert client.get(REP_POOL, "dedup1") == b"base-tail", (
         "resend re-executed a committed op"
     )
+
+
+def test_object_context_cache_serves_and_invalidates(cluster, client):
+    """obc cache (reference object_contexts LRU): repeated reads hit
+    the cache, writes update it read-your-writes, and the served copy
+    is a COPY (mutating a reply must not poison the cache)."""
+    io = client.rc.ioctx(REP_POOL)
+    io.write_full("obc1", b"v1")
+    pgid = cluster.osdmap.object_to_pg(REP_POOL, "obc1")
+    _up, _upp, acting, primary = cluster.osdmap.pg_to_up_acting(pgid)
+    pg = cluster.osds[primary].pgs[pgid]
+    assert io.read("obc1") == b"v1"
+    with pg._obc_lock:
+        assert "obc1" in pg._obc  # cached after the write/read
+    io.write_full("obc1", b"v2-longer")
+    assert io.read("obc1") == b"v2-longer"  # read-your-writes
+    io.remove("obc1")
+    with pg._obc_lock:
+        assert "obc1" not in pg._obc  # delete drops the context
+    # interval change clears the cache wholesale
+    io.write_full("obc2", b"x")
+    io.read("obc2")
+    pg.update_acting(pg.acting, pg.primary)
+    with pg._obc_lock:
+        assert pg._obc == {}
