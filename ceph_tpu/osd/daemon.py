@@ -547,6 +547,8 @@ class OSDService(Dispatcher):
                     if g.name in doomed:
                         t.try_remove(pg.coll, g)
                 self.store.queue_transaction(t)
+                # deleted objects must not survive in the context cache
+                pg._obc_invalidate()
         if pg.is_ec():
             # reconstruct my shard(s) from surviving peers
             for oid, en in latest.items():
@@ -559,6 +561,7 @@ class OSDService(Dispatcher):
             from ceph_tpu.store.objectstore import GHObject, Transaction
 
             for oid in dels:
+                pg._obc_invalidate(oid)
                 t = Transaction()
                 t.try_remove(pg.coll, GHObject(oid))
                 self.store.queue_transaction(t)
@@ -579,6 +582,7 @@ class OSDService(Dispatcher):
         from ceph_tpu.osd.backend import ECBackend
         from ceph_tpu.store.objectstore import GHObject, Transaction
 
+        pg._obc_invalidate(oid)  # local shards rewritten below
         be: ECBackend = pg.backend  # type: ignore[assignment]
         my_shards = be.local_shards(pg.acting)
         if en.op == t_.LOG_DELETE:

@@ -89,11 +89,9 @@ class PG:
         self.hit_set_history = HitSetHistory(
             count=getattr(pool, "hit_set_count", 0) or 4)
         # object-context cache (reference object_contexts SharedLRU)
-        import collections as _collections
+        from ceph_tpu.core.lru import LRUCache
 
-        self._obc: "_collections.OrderedDict[str, ObjectState]" = (
-            _collections.OrderedDict())
-        self._obc_lock = threading.Lock()
+        self._obc = LRUCache(capacity=128)
         if codec is not None:
             self.backend: PGBackend = ECBackend(
                 pgid, self.coll, osd.store, osd.whoami, osd.send_to_osd,
@@ -288,17 +286,20 @@ class PG:
         served from the object-context cache when warm (the reference's
         object_contexts LRU, PrimaryLogPG::get_object_context): per-PG
         write ordering makes the cached copy read-your-writes."""
-        with self._obc_lock:
-            cached = self._obc.get(oid)
-            if cached is not None:
-                self._obc.move_to_end(oid)
-                done(ObjectState(cached.data, dict(cached.xattrs),
-                                 dict(cached.omap)))
-                return
+        # the copy happens INSIDE the lru lock; `done` runs without it
+        # (it may execute ops and send replies — never under a mutex)
+        cached = self._obc.get(oid, copy=lambda s: ObjectState(
+            s.data, dict(s.xattrs), dict(s.omap)))
+        if cached is not None:
+            done(cached)
+            return
+        # generation tag: an EC read completing on a network/timer
+        # thread AFTER an invalidation must not reinsert stale state
+        gen = self._obc.generation()
 
         def fill(state: Optional[ObjectState]) -> None:
             if state is not None:
-                self._obc_put(oid, state)
+                self._obc_put(oid, state, gen=gen)
             done(state)
 
         if self.is_ec():
@@ -307,25 +308,19 @@ class PG:
             self.backend.read_object(oid, self.acting, fill)
 
     # -- object-context cache ---------------------------------------------
-    OBC_CAPACITY = 128
-
-    def _obc_put(self, oid: str, state: Optional[ObjectState]) -> None:
-        with self._obc_lock:
-            if state is None:
-                self._obc.pop(oid, None)
-                return
-            self._obc[oid] = ObjectState(state.data, dict(state.xattrs),
-                                         dict(state.omap))
-            self._obc.move_to_end(oid)
-            while len(self._obc) > self.OBC_CAPACITY:
-                self._obc.popitem(last=False)
+    def _obc_put(self, oid: str, state: Optional[ObjectState],
+                 gen: Optional[int] = None) -> None:
+        if state is None:
+            self._obc.pop(oid)
+            return
+        self._obc.put(oid, ObjectState(state.data, dict(state.xattrs),
+                                       dict(state.omap)), gen=gen)
 
     def _obc_invalidate(self, oid: Optional[str] = None) -> None:
-        with self._obc_lock:
-            if oid is None:
-                self._obc.clear()
-            else:
-                self._obc.pop(oid, None)
+        if oid is None:
+            self._obc.clear()
+        else:
+            self._obc.pop(oid)
 
     # -- hit-set tracking --------------------------------------------------
     def record_hit(self, oid: str) -> None:
@@ -1151,6 +1146,10 @@ class PG:
 
     def handle_push(self, msg: m.MPGPush, conn) -> None:
         """Apply a recovery push (replica or recovering primary)."""
+        # the push rewrites this object outside the op path: any cached
+        # context (incl. one an in-flight read is about to insert) is
+        # suspect
+        self._obc_invalidate(msg.oid)
         with self.lock:
             t = Transaction()
             g = GHObject(msg.oid, shard=msg.shard)

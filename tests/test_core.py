@@ -290,3 +290,23 @@ def test_log_ring_and_crash_dump():
     except RuntimeError as e:
         text = log.dump_on_crash(e)
     assert "boom" in text and "quiet-19" in text
+
+
+def test_lru_cache_generation_refuses_stale_fills():
+    from ceph_tpu.core.lru import LRUCache
+
+    c = LRUCache(capacity=2)
+    gen = c.generation()
+    assert c.put("a", 1, gen=gen)
+    c.clear()  # wholesale invalidation bumps the generation
+    assert not c.put("b", 2, gen=gen), "stale-generation fill must drop"
+    assert "b" not in c
+    assert c.put("b", 2, gen=c.generation())
+    c.pop("nope")  # single-key invalidation also bumps
+    assert not c.put("c", 3, gen=gen)
+    # capacity eviction, LRU order
+    g = c.generation()
+    c.put("x", 1, gen=g); c.put("y", 2, gen=g)
+    c.get("x")
+    c.put("z", 3, gen=g)
+    assert "y" not in c and "x" in c and "z" in c

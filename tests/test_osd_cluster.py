@@ -366,16 +366,15 @@ def test_object_context_cache_serves_and_invalidates(cluster, client):
     _up, _upp, acting, primary = cluster.osdmap.pg_to_up_acting(pgid)
     pg = cluster.osds[primary].pgs[pgid]
     assert io.read("obc1") == b"v1"
-    with pg._obc_lock:
-        assert "obc1" in pg._obc  # cached after the write/read
+    assert "obc1" in pg._obc  # cached after the write/read
     io.write_full("obc1", b"v2-longer")
     assert io.read("obc1") == b"v2-longer"  # read-your-writes
     io.remove("obc1")
-    with pg._obc_lock:
-        assert "obc1" not in pg._obc  # delete drops the context
+    assert "obc1" not in pg._obc  # delete drops the context
     # interval change clears the cache wholesale
     io.write_full("obc2", b"x")
     io.read("obc2")
+    gen_before = pg._obc.generation()
     pg.update_acting(pg.acting, pg.primary)
-    with pg._obc_lock:
-        assert pg._obc == {}
+    assert len(pg._obc) == 0
+    assert pg._obc.generation() > gen_before  # stale fills now refused
