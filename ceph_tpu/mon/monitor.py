@@ -982,6 +982,32 @@ class Monitor(Dispatcher):
                     self.down_stamp[osd] = time.time()
                 self._mutate_map(mut)
             return 0, {}
+        if prefix == "osd pool set":
+            var, val = cmd["var"], int(cmd["val"])
+            if var not in ("pg_num", "pgp_num", "size", "min_size"):
+                return -22, {"error": f"cannot set {var!r}"}
+            with self.lock:
+                if self.osdmap is None:
+                    return -2, {"error": "no osdmap"}
+                name_or_id = cmd["pool"]
+                by_name = {p.name: pid
+                           for pid, p in self.osdmap.pools.items()}
+                pid = by_name.get(name_or_id,
+                                  int(name_or_id)
+                                  if str(name_or_id).isdigit() else -1)
+                pool = self.osdmap.pools.get(pid)
+                if pool is None:
+                    return -2, {"error": f"no pool {name_or_id!r}"}
+                if var == "pg_num" and val < pool.pg_num:
+                    return -22, {"error": "pg_num may only grow"}
+                if var == "pgp_num" and val > pool.pg_num:
+                    return -22, {"error": "pgp_num cannot exceed pg_num"}
+
+                def mut(nm: OSDMap) -> None:
+                    setattr(nm.pools[pid], var, val)
+
+                self._mutate_map(mut)
+            return 0, {"pool_id": pid, var: val}
         if prefix == "osd reweight":
             osd = int(cmd["id"])
             weight = float(cmd["weight"])

@@ -56,6 +56,8 @@ class OSDService(Dispatcher):
         self.osdmap = osdmap
         self.codec_factory = codec_factory
         self.pgs: Dict[PGId, PG] = {}
+        # pool_id -> epoch of its most recent pg_num split (stale-op gate)
+        self._pool_split_epoch: Dict[int, int] = {}
         self.msgr = Messenger(ctx, EntityName("osd", whoami))
         self.msgr.add_dispatcher(self)
         # dedicated heartbeat endpoint (reference hb_front/back
@@ -268,6 +270,18 @@ class OSDService(Dispatcher):
         self.osdmap = osdmap
         if addr_book:
             self.addr_book.update(addr_book)
+        if old is not None:
+            # pg_num growth splits parents IN PLACE (reference PG::split
+            # discipline): with pgp_num unchanged, children fold to the
+            # parent's pps (raw_pg_to_pps stable_mods ps by pgp_num), so
+            # they place on the SAME osds and the split is purely local;
+            # a later pgp_num bump migrates whole child PGs through
+            # ordinary peering/backfill
+            for pool_id, newp in osdmap.pools.items():
+                oldp = old.pools.get(pool_id)
+                if oldp is not None and newp.pg_num > oldp.pg_num:
+                    self._split_pool_pgs(pool_id, oldp, newp)
+                    self._pool_split_epoch[pool_id] = osdmap.epoch
         for pool_id, pool in osdmap.pools.items():
             for seed in range(pool.pg_num):
                 pgid = (pool_id, seed)
@@ -282,6 +296,53 @@ class OSDService(Dispatcher):
                     self.pgs[pgid] = pg
                 elif pg is not None:
                     pg.update_acting(acting, acting_p)
+
+    def _split_pool_pgs(self, pool_id: int, oldp, newp) -> None:
+        """Move this osd's parent-PG objects into their child PGs.
+
+        Deterministic on every member (same hash, same mod), so all
+        replicas/shard-holders split identically with no messages.
+        Children inherit the parent's version horizon; their pg log
+        starts empty at the split boundary (the reference splits the
+        log too — resend dedup for moved objects restarts here).
+        """
+        from ceph_tpu.osd.osdmap import stable_mod
+        from ceph_tpu.store.objectstore import Transaction
+
+        for (pid, ps), pg in list(self.pgs.items()):
+            if pid != pool_id or ps >= oldp.pg_num:
+                continue
+            moves: Dict[int, list] = {}
+            try:
+                objs = self.store.collection_list(pg.coll)
+            except Exception:
+                continue
+            for g in objs:
+                if g.name == "_pgmeta_":
+                    continue
+                new_ps = stable_mod(newp.hash_key(g.name), newp.pg_num,
+                                    newp.pg_num_mask_)
+                if new_ps != ps:
+                    moves.setdefault(new_ps, []).append(g)
+            for child_ps, gs in sorted(moves.items()):
+                child_pgid = (pool_id, child_ps)
+                child = self.pgs.get(child_pgid)
+                if child is None:
+                    child = self._make_pg(child_pgid)
+                    child.create_onstore()
+                    child.load_from_store()
+                    self.pgs[child_pgid] = child
+                t = Transaction()
+                for g in gs:
+                    t.coll_move_rename(pg.coll, g, child.coll, g)
+                self.store.queue_transaction(t)
+                child.info.last_update = pg.info.last_update
+                child.info.last_complete = pg.info.last_complete
+                child._persist_meta()
+                self._log(1, f"split pg {pid}.{ps}: {len(gs)} objects "
+                             f"-> {pid}.{child_ps}")
+            if moves:
+                pg._obc_invalidate()
 
     def activate_pgs(self) -> None:
         for pg in list(self.pgs.values()):
@@ -353,6 +414,18 @@ class OSDService(Dispatcher):
                 w.add(msg)
             return True
         if isinstance(msg, m.MOSDOp):
+            split_e = self._pool_split_epoch.get(msg.pgid[0], 0)
+            if split_e and getattr(msg, "epoch", 0) < split_e:
+                # the pool split at split_e: a pgid computed from an
+                # older map may target the PARENT of the object's new
+                # PG — refuse retryably; the client retargets with its
+                # refreshed map (reference require_same_or_newer_map +
+                # force-op-resend on split)
+                rep = m.MOSDOpReply(msg.pgid, self.epoch(), msg.oid,
+                                    msg.ops, result=-116)  # ESTALE
+                rep.tid = msg.tid
+                conn.send(rep)
+                return True
             pg = self.pgs.get(msg.pgid)
             if pg is None:
                 rep = m.MOSDOpReply(msg.pgid, self.epoch(), msg.oid,

@@ -1,0 +1,129 @@
+"""PG split on pg_num growth (reference PG::split_colls /
+OSD::split_pgs + OSDMonitor pool set pg_num).
+
+Design under test: with pgp_num unchanged, a child pg folds to its
+parent's pps (raw_pg_to_pps stable_mods ps by pgp_num), so children
+place on the SAME osds and the split is purely local and
+deterministic on every member.
+"""
+
+import sys, os
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_osd_cluster import MiniCluster, LibClient, REP_POOL, EC_POOL
+
+from ceph_tpu.osd import map_codec
+from ceph_tpu.osd.osdmap import stable_mod
+
+
+@pytest.fixture
+def cluster():
+    c = MiniCluster()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def client(cluster):
+    cl = LibClient(cluster)
+    yield cl
+    cl.shutdown()
+
+
+def _grow_pg_num(cluster, pool_id, new_pg_num):
+    newmap = map_codec.decode_osdmap(
+        map_codec.encode_osdmap(cluster.osdmap))
+    newmap.epoch = cluster.osdmap.epoch + 1
+    newmap.pools[pool_id].pg_num = new_pg_num  # pgp_num unchanged
+    cluster.osdmap = newmap
+    cluster.refresh()
+    cluster.activate()
+
+
+def test_children_colocate_with_parent(cluster):
+    m = cluster.osdmap
+    pool = m.pools[REP_POOL]
+    old_n = pool.pg_num
+    _grow_pg_num(cluster, REP_POOL, old_n * 2)
+    m2 = cluster.osdmap
+    for child in range(old_n, old_n * 2):
+        parent = stable_mod(child, old_n, pool.pg_num_mask_)
+        up_c, _p1, _a1, _ap1 = m2.pg_to_up_acting((REP_POOL, child))
+        up_p, _p2, _a2, _ap2 = m2.pg_to_up_acting((REP_POOL, parent))
+        assert up_c == up_p, (child, parent)
+
+
+def test_split_moves_objects_and_serves_io(cluster, client):
+    io_names = [f"obj{i}" for i in range(40)]
+    for n in io_names:
+        client.put(REP_POOL, n, (n * 50).encode())
+    old_n = cluster.osdmap.pools[REP_POOL].pg_num
+    _grow_pg_num(cluster, REP_POOL, old_n * 2)
+    newp = cluster.osdmap.pools[REP_POOL]
+    # every object is now resident in the pg its NEW ps names
+    moved = 0
+    for n in io_names:
+        pgid = cluster.osdmap.object_to_pg(REP_POOL, n)
+        if pgid[1] >= old_n:
+            moved += 1
+        _up, _upp, acting, primary = cluster.osdmap.pg_to_up_acting(pgid)
+        pg = cluster.osds[primary].pgs[pgid]
+        names = pg.backend.object_names()
+        assert n in names, f"{n} not resident in its new pg {pgid}"
+    assert moved > 0, "doubling pg_num must move some objects"
+    # reads and writes keep working through the client after the split
+    for n in io_names:
+        assert client.get(REP_POOL, n) == (n * 50).encode()
+    client.put(REP_POOL, "post-split", b"fresh")
+    assert client.get(REP_POOL, "post-split") == b"fresh"
+
+
+def test_split_ec_pool_moves_all_shards(cluster, client):
+    names = [f"ec{i}" for i in range(24)]
+    for n in names:
+        client.put(EC_POOL, n, (n * 99).encode())
+    old_n = cluster.osdmap.pools[EC_POOL].pg_num
+    _grow_pg_num(cluster, EC_POOL, old_n * 2)
+    for n in names:
+        pgid = cluster.osdmap.object_to_pg(EC_POOL, n)
+        _up, _upp, acting, _ap = cluster.osdmap.pg_to_up_acting(pgid)
+        holders = [o for o in acting if o >= 0]
+        for osd_id in holders:
+            pg = cluster.osds[osd_id].pgs.get(pgid)
+            assert pg is not None
+            assert n in pg.backend.object_names(), (n, pgid, osd_id)
+        assert client.get(EC_POOL, n) == (n * 99).encode()
+
+
+def test_pool_set_pg_num_end_to_end():
+    """tier-3: `osd pool set pg_num` through the mon -> incremental map
+    -> subscription push -> local split on every OSD -> client IO keeps
+    working (stale-epoch ops are ESTALE'd and transparently retried)."""
+    from ceph_tpu.vstart import VStartCluster
+
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        pool = c.create_pool("grow", size=2, pg_num=4)
+        io = c.client().ioctx(pool)
+        names = [f"g{i}" for i in range(30)]
+        for n in names:
+            io.write_full(n, (n * 20).encode())
+        code, out = c.command({"prefix": "osd pool set", "pool": "grow",
+                               "var": "pg_num", "val": 8})
+        assert code == 0 and out["pg_num"] == 8
+
+        def split_done():
+            m = c.leader().osdmap
+            return m is not None and m.pools[pool].pg_num == 8
+
+        c.wait_for(split_done, what="pg_num growth")
+        for n in names:
+            assert io.read(n) == (n * 20).encode()
+        io.write_full("after", b"ok")
+        assert io.read("after") == b"ok"
+        assert sorted(io.list_objects()) == sorted(names + ["after"])
+        # shrinking is refused
+        code, _ = c.command({"prefix": "osd pool set", "pool": "grow",
+                             "var": "pg_num", "val": 4})
+        assert code == -22
