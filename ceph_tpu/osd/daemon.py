@@ -282,6 +282,27 @@ class OSDService(Dispatcher):
                 if oldp is not None and newp.pg_num > oldp.pg_num:
                     self._split_pool_pgs(pool_id, oldp, newp)
                     self._pool_split_epoch[pool_id] = osdmap.epoch
+        from ceph_tpu.osd.osdmap import stable_mod
+
+        def _prior_acting(pgid):
+            """This pgid's holders under the OLD map (past_intervals
+            role); a child pgid that didn't exist yet falls back to its
+            split parent's placement (the data was split locally on
+            the parent's members)."""
+            if old is None:
+                return None
+            pool_id, ps = pgid
+            oldp = old.pools.get(pool_id)
+            if oldp is None:
+                return None
+            if ps >= oldp.pg_num:
+                ps = stable_mod(ps, oldp.pg_num, oldp.pg_num_mask_)
+            try:
+                _u, _up, pa, _pap = old.pg_to_up_acting((pool_id, ps))
+                return pa
+            except Exception:
+                return None
+
         for pool_id, pool in osdmap.pools.items():
             for seed in range(pool.pg_num):
                 pgid = (pool_id, seed)
@@ -290,12 +311,14 @@ class OSDService(Dispatcher):
                 pg = self.pgs.get(pgid)
                 if member and pg is None:
                     pg = self._make_pg(pgid)
-                    pg.update_acting(acting, acting_p)
+                    pg.update_acting(acting, acting_p,
+                                     prior=_prior_acting(pgid))
                     pg.create_onstore()
                     pg.load_from_store()
                     self.pgs[pgid] = pg
                 elif pg is not None:
-                    pg.update_acting(acting, acting_p)
+                    pg.update_acting(acting, acting_p,
+                                     prior=_prior_acting(pgid))
 
     def _split_pool_pgs(self, pool_id: int, oldp, newp) -> None:
         """Move this osd's parent-PG objects into their child PGs.

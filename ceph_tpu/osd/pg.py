@@ -61,6 +61,7 @@ class PG:
         self.info = PGInfo(pgid=pgid, epoch_created=osd.epoch())
         self.log = PGLog()
         self.acting: List[int] = []
+        self.prior_acting: List[int] = []  # past_intervals role
         self.primary: int = -1
         from ceph_tpu.core.lockdep import make_lock
 
@@ -136,8 +137,19 @@ class PG:
         txn = pg_meta_txn(self.coll, extra_omap or {}, e.bytes())
         self.osd.store.queue_transaction(txn)
 
-    def update_acting(self, acting: Sequence[int], primary: int) -> None:
+    def update_acting(self, acting: Sequence[int], primary: int,
+                      prior: Optional[Sequence[int]] = None) -> None:
         with self.lock:
+            if prior is not None:
+                # prior-interval holders (the past_intervals role): when
+                # placement moves wholesale (pgp_num change, crush
+                # edits), the data lives on these strays until peering
+                # pulls it over
+                self.prior_acting = [o for o in prior
+                                     if o >= 0 and o != CRUSH_ITEM_NONE]
+            elif list(acting) != self.acting and self.acting:
+                self.prior_acting = [o for o in self.acting
+                                     if o >= 0 and o != CRUSH_ITEM_NONE]
             self.acting = list(acting)
             self.primary = primary
         # recovery/peering may rewrite local objects outside the op
@@ -983,7 +995,9 @@ class PG:
             if not self.is_primary():
                 self.state = STATE_ACTIVE  # replicas follow the primary
                 return
-            peers = [o for o in self.acting
+            # query prior-interval holders too: a wholesale remap
+            # (pgp_num bump, crush edit) can leave every byte on strays
+            peers = [o for o in {*self.acting, *self.prior_acting}
                      if o not in (self.osd.whoami, CRUSH_ITEM_NONE)
                      and o >= 0]
         infos = self.osd.collect_pg_infos(self, peers)
@@ -1016,6 +1030,8 @@ class PG:
 
     def _push_laggards(self, infos: Dict[int, PGInfo]) -> None:
         for osd_id, info in infos.items():
+            if osd_id not in self.acting:
+                continue  # strays are not pushed forward (they drain)
             if info.last_update >= self.info.last_update:
                 continue
             changed = self.log.objects_changed_after(info.last_update)

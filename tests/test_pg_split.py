@@ -127,3 +127,54 @@ def test_pool_set_pg_num_end_to_end():
         code, _ = c.command({"prefix": "osd pool set", "pool": "grow",
                              "var": "pg_num", "val": 4})
         assert code == -22
+
+
+def test_pgp_num_growth_migrates_children():
+    """The split follow-on: raising pgp_num un-folds child placement —
+    children remap to their own CRUSH positions and (re)peering moves
+    the data; client IO survives the whole sequence."""
+    from ceph_tpu.vstart import VStartCluster
+
+    with VStartCluster(n_mons=1, n_osds=4) as c:
+        pool = c.create_pool("mig", size=2, pg_num=4)
+        io = c.client().ioctx(pool)
+        names = [f"m{i}" for i in range(24)]
+        for n in names:
+            io.write_full(n, (n * 31).encode())
+        code, _ = c.command({"prefix": "osd pool set", "pool": "mig",
+                             "var": "pg_num", "val": 8})
+        assert code == 0
+        c.wait_for(lambda: c.leader().osdmap.pools[pool].pg_num == 8,
+                   what="pg_num growth")
+        code, _ = c.command({"prefix": "osd pool set", "pool": "mig",
+                             "var": "pgp_num", "val": 8})
+        assert code == 0
+        c.wait_for(lambda: c.leader().osdmap.pools[pool].pgp_num == 8,
+                   what="pgp_num growth")
+
+        def children_replaced():
+            m = c.leader().osdmap
+            # at least one child now places differently from its parent
+            for child in range(4, 8):
+                up_c, _1, _2, _3 = m.pg_to_up_acting((pool, child))
+                up_p, _4, _5, _6 = m.pg_to_up_acting((pool, child - 4))
+                if up_c != up_p:
+                    return True
+            return False
+
+        assert children_replaced(), "pgp bump should re-place children"
+        # every object still readable after migration/peering settles
+        deadline_names = list(names)
+
+        def all_readable():
+            for n in deadline_names:
+                try:
+                    if io.read(n) != (n * 31).encode():
+                        return False
+                except Exception:
+                    return False
+            return True
+
+        c.wait_for(all_readable, timeout=60.0, what="post-migration reads")
+        io.write_full("post-mig", b"ok")
+        assert io.read("post-mig") == b"ok"
