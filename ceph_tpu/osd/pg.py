@@ -941,6 +941,15 @@ class PG:
         remote = [(s, o) for s, o in enumerate(acting)
                   if o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
                   and o not in self.stale_peers]  # stale shards can't serve
+        # wholesale remap: a freshly-placed member has nothing yet — ask
+        # the prior-interval holder of each shard too (first valid
+        # answer wins per shard)
+        prior = list(self.prior_acting[:n])
+        for s in range(min(n, len(prior))):
+            o = prior[s]
+            if (o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
+                    and s not in avail and (s, o) not in remote):
+                remote.append((s, o))
         if not remote or len(avail) >= be.k:
             done(be.reconstruct(oid, avail, meta_box[0])
                  if avail else None)
@@ -949,8 +958,10 @@ class PG:
         # every live shard answered; a watchdog fires with whatever we
         # have if a peer never replies (a hung shard must not hang the
         # client op — minimum_to_decode only NEEDS k)
-        pending = {s for s, _ in remote}
-        lock = threading.Lock()
+        pending: Dict[int, int] = {}
+        for s, _o in remote:  # per-shard candidate counts: a miss from
+            pending[s] = pending.get(s, 0) + 1  # acting must not mask a
+        lock = threading.Lock()                 # prior holder's answer
         fired = [False]
 
         def finish() -> None:
@@ -966,11 +977,15 @@ class PG:
             with lock:
                 if fired[0]:
                     return
-                pending.discard(rep.shard)
                 if rep.result == 0 and rep.oid == oid:
                     avail[rep.shard] = rep.data
+                    pending.pop(rep.shard, None)
                     if meta_box[0] is None and "hinfo" in rep.attrs:
                         meta_box[0] = (dict(rep.attrs), dict(rep.omap))
+                elif rep.shard in pending:
+                    pending[rep.shard] -= 1
+                    if pending[rep.shard] <= 0:
+                        del pending[rep.shard]
                 ready = not pending or len(avail) >= be.k
             if ready:
                 finish()

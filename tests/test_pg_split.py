@@ -178,3 +178,37 @@ def test_pgp_num_growth_migrates_children():
         c.wait_for(all_readable, timeout=60.0, what="post-migration reads")
         io.write_full("post-mig", b"ok")
         assert io.read("post-mig") == b"ok"
+
+
+def test_pgp_num_growth_migrates_ec_children():
+    """EC twin of the migration test: displaced EC children rebuild
+    their shards by reading from prior-interval holders."""
+    from ceph_tpu.vstart import VStartCluster
+
+    with VStartCluster(n_mons=1, n_osds=5) as c:
+        pool = c.create_pool(
+            "ecmig", size=3, pool_type="erasure", pg_num=4,
+            ec_profile="plugin=isa k=2 m=1 technique=reed_sol_van")
+        io = c.client().ioctx(pool)
+        names = [f"e{i}" for i in range(16)]
+        for n in names:
+            io.write_full(n, (n * 41).encode())
+        for var, val in (("pg_num", 8), ("pgp_num", 8)):
+            code, _ = c.command({"prefix": "osd pool set",
+                                 "pool": "ecmig", "var": var,
+                                 "val": val})
+            assert code == 0
+        c.wait_for(lambda: c.leader().osdmap.pools[pool].pgp_num == 8,
+                   what="pgp growth")
+
+        def all_readable():
+            try:
+                return all(io.read(n) == (n * 41).encode()
+                           for n in names)
+            except Exception:
+                return False
+
+        c.wait_for(all_readable, timeout=90.0,
+                   what="post-migration EC reads")
+        io.write_full("ec-post", b"ok")
+        assert io.read("ec-post") == b"ok"
