@@ -166,6 +166,25 @@ class IoCtx:
         if self.snap_seq == snapid:
             self.snap_seq = max(self.snaps, default=0)
 
+    def selfmanaged_snap_trim(self, snapid: int,
+                              timeout: float = 60.0) -> dict:
+        """Pool-wide snap trim: one SNAPTRIMPG per PG, each walking its
+        SnapMapper index (the reference snap-trimmer, queued per PG)."""
+        import json
+
+        osdmap = self.client.objecter.osdmap
+        pool = osdmap.pools[self.pool]
+        total = {"trimmed": 0, "failed": 0}
+        for ps in range(pool.pg_num):
+            rep = self.client.objecter.op_submit(
+                self.pool, "", [OSDOp(t_.OP_SNAPTRIMPG, off=snapid)],
+                timeout=timeout, pgid=(self.pool, ps)).result(timeout)
+            if rep.ops and rep.ops[0].out_data:
+                got = json.loads(rep.ops[0].out_data.decode())
+                total["trimmed"] += got.get("trimmed", 0)
+                total["failed"] += got.get("failed", 0)
+        return total
+
     def _check(self, rep) -> None:
         if rep.result < 0:
             raise RadosError(rep.result, f"{rep.oid}")

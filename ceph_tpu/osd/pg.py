@@ -197,6 +197,9 @@ class PG:
         if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_SNAPTRIM:
             self._do_snaptrim(msg, reply)
             return
+        if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_SNAPTRIMPG:
+            self._do_snaptrim_pg(msg, reply)
+            return
         with self.lock:
             writes = any(o.is_write() or self._call_is_write(o)
                          for o in msg.ops)
@@ -467,6 +470,9 @@ class PG:
         state.xattrs["snapset"] = json.dumps(ss).encode()
         pre = Transaction()
         pre.try_remove(self.coll, GHObject(msg.oid, snap=snapid))
+        # drop the SnapMapper row in the same txn as the clone removal
+        pre.omap_rmkeys(self.coll, GHObject("_pgmeta_"),
+                        [self._snap_key(snapid, msg.oid)])
         committed = threading.Event()
         _replied = [False]
         _rlock = threading.Lock()
@@ -486,6 +492,32 @@ class PG:
             reply_once(m.MOSDOpReply(self.pgid, self.osd.epoch(),
                                      msg.oid, msg.ops, result=EAGAIN))
 
+    def _do_snaptrim_pg(self, msg, reply) -> None:
+        """Trim EVERY clone of one snap in this PG, fed by the
+        SnapMapper index (the reference snap-trimmer work queue:
+        PrimaryLogPG::AwaitAsyncWork over get_next_objects_to_trim)."""
+        import json
+        from types import SimpleNamespace
+
+        snapid = int(msg.ops[0].off)
+        trimmed, failed = 0, 0
+        for oid in self.snap_objects(snapid):
+            shim = SimpleNamespace(
+                oid=oid, ops=[OSDOp(t_.OP_SNAPTRIM, off=snapid)],
+                reqid=f"{getattr(msg, 'reqid', 'snaptrim')}/{oid}",
+                snap_seq=0, snaps=[], snapid=0)
+            box: List = []
+            self._do_snaptrim(shim, box.append)
+            if box and box[0].result == 0:
+                trimmed += 1
+            else:
+                failed += 1
+        msg.ops[0].out_data = json.dumps(
+            {"trimmed": trimmed, "failed": failed}).encode()
+        reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                            msg.ops, result=0 if not failed else EAGAIN,
+                            version=self.info.last_update))
+
     def _snap_pre_txn(self, msg, state: Optional[ObjectState],
                       work: ObjectState):
         """Clone-on-write: first write after a new snap clones the head
@@ -500,12 +532,33 @@ class PG:
         pre = Transaction()
         pre.clone(self.coll, GHObject(msg.oid),
                   GHObject(msg.oid, snap=snap_seq))
+        # SnapMapper index (reference src/osd/SnapMapper.h:101 — the
+        # snap -> objects omap rows the trimmer walks): same txn as the
+        # clone, so index and clone can never diverge
+        pre.touch(self.coll, GHObject("_pgmeta_"))
+        pre.omap_setkeys(self.coll, GHObject("_pgmeta_"),
+                         {self._snap_key(snap_seq, msg.oid): b"1"})
         ss["clones"] = sorted(set(ss["clones"]) | {snap_seq})
         ss["seq"] = snap_seq
         import json
 
         work.xattrs["snapset"] = json.dumps(ss).encode()
         return pre
+
+    # -- SnapMapper (snap -> objects index) --------------------------------
+    @staticmethod
+    def _snap_key(snapid: int, oid: str) -> str:
+        return f"snap_{snapid:016x}/{oid}"
+
+    def snap_objects(self, snapid: int) -> List[str]:
+        """Objects holding a clone of `snapid` (SnapMapper get_next_
+        objects_to_trim role)."""
+        g = GHObject("_pgmeta_")
+        if not self.osd.store.exists(self.coll, g):
+            return []
+        pre = f"snap_{snapid:016x}/"
+        omap = self.osd.store.omap_get(self.coll, g)
+        return sorted(k[len(pre):] for k in omap if k.startswith(pre))
 
     # -- cls object classes (reference ClassHandler / do_osd_ops
     # CEPH_OSD_OP_CALL, PrimaryLogPG.cc:5651) --------------------------

@@ -137,6 +137,8 @@ OP_NOTIFY = 18
 OP_WATCH = 19
 OP_SNAPTRIM = 20      # drop one clone of one object (snap trimmer role)
 OP_PGLS = 21          # list this PG's objects (reference CEPH_OSD_OP_PGLS)
+OP_SNAPTRIMPG = 22    # trim EVERY clone of one snap in this PG
+                      # (the snap-trimmer work queue role, SnapMapper-fed)
 
 WRITE_OPS = {OP_WRITE, OP_WRITEFULL, OP_APPEND, OP_DELETE, OP_TRUNCATE,
              OP_ZERO, OP_SETXATTR, OP_RMXATTR, OP_OMAP_SET, OP_OMAP_RM,

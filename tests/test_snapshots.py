@@ -128,3 +128,28 @@ def test_delete_preserves_snapshots_via_whiteout(cluster, client):
     io.write_full("wh", b"after-s2")
     assert io.snap_read("wh", s2) == b"reborn"
     assert io.snap_read("wh", s) == b"precious"
+
+
+def test_snapmapper_pool_wide_trim(client):
+    """SnapMapper-fed trim (reference SnapMapper.h:101 + the snap
+    trimmer): one call trims every clone of the snap across the pool,
+    and the index rows vanish with the clones."""
+    io = client.rc.ioctx(REP_POOL)
+    names = [f"sm{i}" for i in range(12)]
+    for n in names:
+        io.write_full(n, b"v1-" + n.encode())
+    snap = io.selfmanaged_snap_create()
+    for n in names:
+        io.write_full(n, b"v2-" + n.encode())  # clones v1 under `snap`
+    # clones readable via the snap
+    for n in names[:3]:
+        assert io.snap_read(n, snap) == b"v1-" + n.encode()
+    got = io.selfmanaged_snap_trim(snap)
+    assert got["trimmed"] == len(names)
+    assert got["failed"] == 0
+    # clones gone: snap reads now serve head
+    for n in names[:3]:
+        assert io.snap_read(n, snap) == b"v2-" + n.encode()
+    # idempotent: nothing left to trim
+    again = io.selfmanaged_snap_trim(snap)
+    assert again == {"trimmed": 0, "failed": 0}
