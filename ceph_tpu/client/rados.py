@@ -137,10 +137,18 @@ class IoCtx:
     # -- self-managed snapshots -------------------------------------------
     def selfmanaged_snap_create(self) -> int:
         """Allocate a snap id (atomic cls counter — the mon snap-seq
-        allocator role) and fold it into this ioctx's write context."""
-        snapid = int(self.call("rados.snapmeta", "counter", "alloc",
-                               b"snapseq"))
-        self.set_snap_context(snapid, [snapid] + self.snaps)
+        allocator role) and fold it into this ioctx's write context.
+        The allocation itself runs OUTSIDE the snap context: the mon
+        allocator never snapshots its own bookkeeping, and cloning the
+        counter object would pollute the SnapMapper index."""
+        saved_seq, saved_snaps = self.snap_seq, list(self.snaps)
+        self.snap_seq, self.snaps = 0, []
+        try:
+            snapid = int(self.call("rados.snapmeta", "counter", "alloc",
+                                   b"snapseq"))
+        finally:
+            self.snap_seq, self.snaps = saved_seq, saved_snaps
+        self.set_snap_context(snapid, [snapid] + saved_snaps)
         return snapid
 
     def set_snap_context(self, seq: int, snaps: List[int]) -> None:
@@ -166,23 +174,30 @@ class IoCtx:
         if self.snap_seq == snapid:
             self.snap_seq = max(self.snaps, default=0)
 
-    def selfmanaged_snap_trim(self, snapid: int,
-                              timeout: float = 60.0) -> dict:
-        """Pool-wide snap trim: one SNAPTRIMPG per PG, each walking its
-        SnapMapper index (the reference snap-trimmer, queued per PG)."""
+    def selfmanaged_snap_trim(self, snapid: int, timeout: float = 60.0,
+                              batch: int = 16) -> dict:
+        """Pool-wide snap trim: chunked SNAPTRIMPG per PG, looping on
+        `remaining` (the reference snap-trimmer, queued per PG).
+        Raises on an unreachable PG instead of under-counting."""
         import json
 
         osdmap = self.client.objecter.osdmap
         pool = osdmap.pools[self.pool]
-        total = {"trimmed": 0, "failed": 0}
+        total = {"trimmed": 0, "failed": 0, "stale_dropped": 0}
         for ps in range(pool.pg_num):
-            rep = self.client.objecter.op_submit(
-                self.pool, "", [OSDOp(t_.OP_SNAPTRIMPG, off=snapid)],
-                timeout=timeout, pgid=(self.pool, ps)).result(timeout)
-            if rep.ops and rep.ops[0].out_data:
+            while True:
+                rep = self.client.objecter.op_submit(
+                    self.pool, "",
+                    [OSDOp(t_.OP_SNAPTRIMPG, off=snapid, length=batch)],
+                    timeout=timeout, pgid=(self.pool, ps)).result(timeout)
+                self._check(rep)
                 got = json.loads(rep.ops[0].out_data.decode())
-                total["trimmed"] += got.get("trimmed", 0)
-                total["failed"] += got.get("failed", 0)
+                for k in ("trimmed", "failed", "stale_dropped"):
+                    total[k] += got.get(k, 0)
+                progressed = got.get("trimmed", 0) + got.get(
+                    "stale_dropped", 0)
+                if not got.get("remaining", 0) or not progressed:
+                    break  # done, or stuck (failures repeat: don't spin)
         return total
 
     def _check(self, rep) -> None:

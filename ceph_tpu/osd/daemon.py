@@ -347,6 +347,14 @@ class OSDService(Dispatcher):
                                     newp.pg_num_mask_)
                 if new_ps != ps:
                     moves.setdefault(new_ps, []).append(g)
+            # SnapMapper rows follow their objects to the children
+            try:
+                from ceph_tpu.store.objectstore import GHObject as _G
+
+                meta_omap = self.store.omap_get(pg.coll, _G("_pgmeta_"))
+            except Exception:
+                meta_omap = {}
+            snap_rows = {k for k in meta_omap if k.startswith("snap_")}
             for child_ps, gs in sorted(moves.items()):
                 child_pgid = (pool_id, child_ps)
                 child = self.pgs.get(child_pgid)
@@ -358,6 +366,16 @@ class OSDService(Dispatcher):
                 t = Transaction()
                 for g in gs:
                     t.coll_move_rename(pg.coll, g, child.coll, g)
+                moved_names = {g.name for g in gs}
+                rows = [k for k in snap_rows
+                        if k.split("/", 1)[1] in moved_names]
+                if rows:
+                    from ceph_tpu.store.objectstore import GHObject as _G
+
+                    t.touch(child.coll, _G("_pgmeta_"))
+                    t.omap_setkeys(child.coll, _G("_pgmeta_"),
+                                   {k: meta_omap[k] for k in rows})
+                    t.omap_rmkeys(pg.coll, _G("_pgmeta_"), rows)
                 self.store.queue_transaction(t)
                 child.info.last_update = pg.info.last_update
                 child.info.last_complete = pg.info.last_complete

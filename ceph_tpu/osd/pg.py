@@ -462,17 +462,33 @@ class PG:
                                 msg.ops, result=ENOENT))
             return
         ss = self._snapset_of(state)
-        if snapid not in ss.get("clones", []):
+        cs = ss.setdefault("clone_snaps", {})
+        # the clone covering `snapid`: a clone with no coverage entry is
+        # legacy and covers exactly its own id
+        clone = None
+        for c in sorted(ss.get("clones", [])):
+            snaps = cs.get(str(c), [c])
+            if snapid in snaps:
+                clone = c
+                remaining = [s for s in snaps if s != snapid]
+                break
+        if clone is None:
             reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                 msg.ops, result=ENOENT))
             return
-        ss["clones"] = [c for c in ss["clones"] if c != snapid]
-        state.xattrs["snapset"] = json.dumps(ss).encode()
         pre = Transaction()
-        pre.try_remove(self.coll, GHObject(msg.oid, snap=snapid))
-        # drop the SnapMapper row in the same txn as the clone removal
+        # the SnapMapper row for THIS snap goes regardless; the clone
+        # itself only goes when no other live snap still needs it
+        # (reference trim_object: clone removed when snaps empties)
         pre.omap_rmkeys(self.coll, GHObject("_pgmeta_"),
                         [self._snap_key(snapid, msg.oid)])
+        if remaining:
+            cs[str(clone)] = remaining
+        else:
+            ss["clones"] = [c for c in ss["clones"] if c != clone]
+            cs.pop(str(clone), None)
+            pre.try_remove(self.coll, GHObject(msg.oid, snap=clone))
+        state.xattrs["snapset"] = json.dumps(ss).encode()
         committed = threading.Event()
         _replied = [False]
         _rlock = threading.Lock()
@@ -493,29 +509,55 @@ class PG:
                                      msg.oid, msg.ops, result=EAGAIN))
 
     def _do_snaptrim_pg(self, msg, reply) -> None:
-        """Trim EVERY clone of one snap in this PG, fed by the
-        SnapMapper index (the reference snap-trimmer work queue:
-        PrimaryLogPG::AwaitAsyncWork over get_next_objects_to_trim)."""
+        """Trim clones of one snap in this PG, fed by the SnapMapper
+        index (the reference snap-trimmer work queue:
+        PrimaryLogPG::AwaitAsyncWork over get_next_objects_to_trim).
+
+        CHUNKED: at most op.length objects per call (the caller loops
+        on `remaining`) so one op never monopolizes the PG's queue
+        shard for minutes.  Always replies result=0 with the counts in
+        the payload — EAGAIN here would make the objecter silently
+        retry the whole sweep.  Dangling index rows (object gone, snap
+        not in its set) are dropped, not failed (reference SnapMapper
+        tolerates stale mappings)."""
         import json
         from types import SimpleNamespace
 
         snapid = int(msg.ops[0].off)
-        trimmed, failed = 0, 0
-        for oid in self.snap_objects(snapid):
+        batch = int(msg.ops[0].length) or 16
+        oids = self.snap_objects(snapid)
+        trimmed, failed, stale = 0, 0, 0
+        for oid in oids[:batch]:
             shim = SimpleNamespace(
                 oid=oid, ops=[OSDOp(t_.OP_SNAPTRIM, off=snapid)],
                 reqid=f"{getattr(msg, 'reqid', 'snaptrim')}/{oid}",
                 snap_seq=0, snaps=[], snapid=0)
             box: List = []
             self._do_snaptrim(shim, box.append)
-            if box and box[0].result == 0:
+            rc = box[0].result if box else EAGAIN
+            if rc == 0:
                 trimmed += 1
+            elif rc == ENOENT:
+                # dangling mapping: drop the row so it can't poison
+                # every future sweep (local drop; a failed-over primary
+                # converges the same way on its next sweep)
+                t = Transaction()
+                t.omap_rmkeys(self.coll, GHObject("_pgmeta_"),
+                              [self._snap_key(snapid, oid)])
+                try:
+                    self.osd.store.queue_transaction(t)
+                except Exception:
+                    pass
+                stale += 1
             else:
                 failed += 1
+        done_now = trimmed + failed + stale
         msg.ops[0].out_data = json.dumps(
-            {"trimmed": trimmed, "failed": failed}).encode()
+            {"trimmed": trimmed, "failed": failed,
+             "stale_dropped": stale,
+             "remaining": max(0, len(oids) - done_now)}).encode()
         reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
-                            msg.ops, result=0 if not failed else EAGAIN,
+                            msg.ops, result=0,
                             version=self.info.last_update))
 
     def _snap_pre_txn(self, msg, state: Optional[ObjectState],
@@ -532,13 +574,21 @@ class PG:
         pre = Transaction()
         pre.clone(self.coll, GHObject(msg.oid),
                   GHObject(msg.oid, snap=snap_seq))
+        # the ONE clone covers every live snap newer than the previous
+        # seq (reference SnapSet::clone_snaps): trimming any one of
+        # them must not destroy the clone while others still need it
+        covered = sorted({s for s in [snap_seq, *getattr(msg, "snaps", [])]
+                          if s > ss["seq"]})
         # SnapMapper index (reference src/osd/SnapMapper.h:101 — the
         # snap -> objects omap rows the trimmer walks): same txn as the
-        # clone, so index and clone can never diverge
+        # clone, so index and clone can never diverge; one row per
+        # covered snap
         pre.touch(self.coll, GHObject("_pgmeta_"))
         pre.omap_setkeys(self.coll, GHObject("_pgmeta_"),
-                         {self._snap_key(snap_seq, msg.oid): b"1"})
+                         {self._snap_key(s, msg.oid): b"1"
+                          for s in covered})
         ss["clones"] = sorted(set(ss["clones"]) | {snap_seq})
+        ss.setdefault("clone_snaps", {})[str(snap_seq)] = covered
         ss["seq"] = snap_seq
         import json
 

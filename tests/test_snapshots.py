@@ -152,4 +152,52 @@ def test_snapmapper_pool_wide_trim(client):
         assert io.snap_read(n, snap) == b"v2-" + n.encode()
     # idempotent: nothing left to trim
     again = io.selfmanaged_snap_trim(snap)
-    assert again == {"trimmed": 0, "failed": 0}
+    assert again["trimmed"] == 0 and again["failed"] == 0
+
+
+
+def test_shared_clone_survives_newer_snap_trim(client):
+    """One clone can cover several live snaps (reference
+    SnapSet::clone_snaps): trimming the newer snap must NOT destroy
+    the data an older snap still needs."""
+    io = client.rc.ioctx(REP_POOL)
+    io.write_full("shared", b"v1")
+    s1 = io.selfmanaged_snap_create()
+    s2 = io.selfmanaged_snap_create()  # no write between: s1,s2 share
+    io.write_full("shared", b"v2")     # ONE clone covering {s1, s2}
+    assert io.snap_read("shared", s1) == b"v1"
+    assert io.snap_read("shared", s2) == b"v1"
+    got = io.selfmanaged_snap_trim(s2)
+    assert got["trimmed"] == 1 and got["failed"] == 0, (s1, s2, got)
+    # s1 still serves v1 — the clone survived the s2 trim
+    assert io.snap_read("shared", s1) == b"v1"
+    got = io.selfmanaged_snap_trim(s1)
+    assert got["trimmed"] == 1
+    assert io.snap_read("shared", s1) == b"v2"  # clone gone -> head
+
+
+def test_snap_rows_follow_objects_through_pg_split():
+    """SnapMapper rows migrate with their objects on pg_num growth, so
+    clones in child PGs stay trimmable."""
+    from ceph_tpu.vstart import VStartCluster
+
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        pool = c.create_pool("snapsplit", size=2, pg_num=4)
+        io = c.client().ioctx(pool)
+        names = [f"ss{i}" for i in range(20)]
+        for n in names:
+            io.write_full(n, b"v1")
+        snap = io.selfmanaged_snap_create()
+        for n in names:
+            io.write_full(n, b"v2")  # one clone per object
+        code, _ = c.command({"prefix": "osd pool set",
+                             "pool": "snapsplit", "var": "pg_num",
+                             "val": 8})
+        assert code == 0
+        c.wait_for(lambda: c.leader().osdmap.pools[pool].pg_num == 8,
+                   what="split")
+        got = io.selfmanaged_snap_trim(snap)
+        assert got["trimmed"] == len(names), got
+        assert got["failed"] == 0
+        for n in names:
+            assert io.snap_read(n, snap) == b"v2"  # clones gone
