@@ -124,8 +124,13 @@ class RadosStriper:
             + stripepos
         return stripeno * self.su + rem
 
-    def read(self, soid: str, length: int = 0, off: int = 0) -> bytes:
-        total = self.size(soid)
+    def read(self, soid: str, length: int = 0, off: int = 0,
+             snapid: int = 0, size: int = 0) -> bytes:
+        """snapid reads the striped extents AS OF that snap (librbd
+        snapshot reads); `size` overrides the head's size xattr (the
+        caller supplies the at-snap logical size, since the size xattr
+        tracks head)."""
+        total = size or self.size(soid)
         if off >= total:
             return b""
         if length == 0 or off + length > total:
@@ -136,7 +141,8 @@ class RadosStriper:
             n = sum(u[2] for u in units)
             ops.append((units, self.io.aio_operate(
                 self._obj_name(soid, objno),
-                [OSDOp(t_.OP_READ, off=o, length=n)])))
+                [OSDOp(t_.OP_READ, off=o, length=n)],
+                snapid=snapid)))
         for units, op in ops:
             rep = op.result(30.0)
             if rep.result == -2:

@@ -104,8 +104,67 @@ class Image:
             io, stripe_unit=self.meta["stripe_unit"],
             stripe_count=self.meta["stripe_count"],
             object_size=1 << self.meta["order"])
+        # restore the image's snap context on this ioctx (librbd keeps
+        # the SnapContext in the header): writes after reopen must keep
+        # cloning for the existing snaps
+        snaps = sorted((s["id"] for s in self.meta.get("snaps",
+                                                       {}).values()),
+                       reverse=True)
+        if snaps:
+            io.set_snap_context(snaps[0], snaps)
         if exclusive:
             self._take_lock()
+
+    # -- snapshots (librbd snap_create/list/rollback/remove over the
+    # pool's self-managed snaps; snapshot metadata lives in the image
+    # header exactly like the reference) ----------------------------------
+    def snap_create(self, name: str) -> int:
+        snaps = self.meta.setdefault("snaps", {})
+        if name in snaps:
+            raise RadosError(-17, f"snap {name!r} exists")  # EEXIST
+        snapid = self.io.selfmanaged_snap_create()
+        snaps[name] = {"id": snapid, "size": self.size}
+        self.io.write_full(_header_oid(self.name),
+                           json.dumps(self.meta).encode())
+        return snapid
+
+    def snap_list(self) -> List[dict]:
+        return [{"name": n, **info}
+                for n, info in sorted(self.meta.get("snaps", {}).items())]
+
+    def _snap_info(self, name: str) -> dict:
+        snaps = self.meta.get("snaps", {})
+        if name not in snaps:
+            raise RadosError(-2, f"no snap {name!r}")
+        return snaps[name]
+
+    def read_at_snap(self, name: str, off: int, length: int) -> bytes:
+        info = self._snap_info(name)
+        if off >= info["size"]:
+            return b""
+        length = min(length, info["size"] - off)
+        got = self.striper.read(self.meta["data_prefix"], length, off,
+                                snapid=info["id"], size=info["size"])
+        if len(got) < length:
+            got += b"\0" * (length - len(got))
+        return got
+
+    def snap_rollback(self, name: str, chunk: int = 4 << 20) -> None:
+        """Rewrite head from the snap's content (librbd snap_rollback)."""
+        info = self._snap_info(name)
+        self.resize(info["size"])
+        for off in range(0, info["size"], chunk):
+            n = min(chunk, info["size"] - off)
+            self.write(off, self.read_at_snap(name, off, n))
+
+    def snap_remove(self, name: str) -> dict:
+        info = self._snap_info(name)
+        got = self.io.selfmanaged_snap_trim(info["id"])
+        self.io.selfmanaged_snap_remove(info["id"])
+        del self.meta["snaps"][name]
+        self.io.write_full(_header_oid(self.name),
+                           json.dumps(self.meta).encode())
+        return got
 
     # -- exclusive lock (the cls_lock-backed feature) ---------------------
     def _take_lock(self) -> None:

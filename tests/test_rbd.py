@@ -155,3 +155,34 @@ def test_journal_trim_drops_committed_rings(rbd, client):
         # journal still usable after trim
         j.write(9000, b"post-trim")
         assert img.read(9000, 9) == b"post-trim"
+
+
+def test_image_snapshots_full_lifecycle(rbd, client):
+    """librbd snapshots over self-managed pool snaps: create, read at
+    snap, rollback, remove (+ context restore across reopen)."""
+    io = client.rc.ioctx(REP_POOL)
+    rbd.create(io, "snapvol", 1 << 20, order=16)
+    with rbd.open(io, "snapvol") as img:
+        img.write(0, b"generation-1" * 100)
+        s1 = img.snap_create("s1")
+        img.write(0, b"generation-2" * 100)
+        assert img.read(0, 12) == b"generation-2"
+        assert img.read_at_snap("s1", 0, 12) == b"generation-1"
+        names = [s["name"] for s in img.snap_list()]
+        assert names == ["s1"]
+    # REOPEN: the snap context restores from the header, so new writes
+    # still clone for s1
+    with rbd.open(io, "snapvol") as img2:
+        img2.write(4096, b"late-write" * 10)
+        assert img2.read_at_snap("s1", 0, 12) == b"generation-1"
+        # rollback head to s1
+        img2.snap_rollback("s1")
+        assert img2.read(0, 12) == b"generation-1"
+        got = img2.snap_remove("s1")
+        assert got["failed"] == 0
+        assert img2.snap_list() == []
+        import pytest as _pytest
+        from ceph_tpu.client.rados import RadosError
+
+        with _pytest.raises(RadosError):
+            img2.read_at_snap("s1", 0, 1)
