@@ -76,6 +76,70 @@ def roundtrip(cls: type) -> bytes:
     return blob
 
 
+# -- struct corpus (versioned non-message encodings) -----------------------
+# The frame-versioned structs (crush map v2, pool v2, incremental) get
+# the same golden-blob discipline as messages: a future build must keep
+# decoding today's bytes.
+
+
+def _sample_crush_bytes() -> bytes:
+    from ceph_tpu.core.encoding import Encoder as _E
+    from ceph_tpu.crush import map as cmap
+    from ceph_tpu.osd.map_codec import encode_crush
+
+    m = cmap.CrushMap()
+    m.add_bucket(cmap.ALG_STRAW2, 1, [0, 1], [0x10000, 0x20000], id=-1)
+    m.add_bucket(cmap.ALG_LIST, 1, [2, 3], [0x10000, 0x10000], id=-2)
+    m.add_bucket(cmap.ALG_STRAW2, 10, [-1, -2], [0x30000, 0x20000],
+                 id=-3)
+    m.bucket_names = {-1: "host-a", -2: "host-b", -3: "default"}
+    m.add_rule(cmap.Rule("corpus", [(cmap.OP_TAKE, -3, 0),
+                                    (cmap.OP_CHOOSELEAF_FIRSTN, 0, 1),
+                                    (cmap.OP_EMIT, 0, 0)],
+                         min_size=1, max_size=10))
+    m.choose_args = {"0": {-3: [0x10000, 0x40000]}}
+    e = _E()
+    encode_crush(e, m)
+    return e.bytes()
+
+
+def _decode_crush_bytes(blob: bytes) -> None:
+    from ceph_tpu.core.encoding import Decoder as _D
+    from ceph_tpu.osd.map_codec import decode_crush
+
+    m = decode_crush(_D(blob))
+    assert m.bucket_names[-3] == "default"
+    assert m.choose_args["0"][-3] == [0x10000, 0x40000]
+    assert m.rules[0].max_size == 10
+
+
+def _sample_pool_bytes() -> bytes:
+    from ceph_tpu.core.encoding import Encoder as _E
+    from ceph_tpu.osd.map_codec import _enc_pool
+    from ceph_tpu.osd.osdmap import PGPool
+
+    e = _E()
+    _enc_pool(e, PGPool(pool_id=7, pg_num=16, pgp_num=8, name="corpus",
+                        hit_set_count=4, hit_set_period=1.5,
+                        hit_set_target_size=777, hit_set_fpp=0.02))
+    return e.bytes()
+
+
+def _decode_pool_bytes(blob: bytes) -> None:
+    from ceph_tpu.core.encoding import Decoder as _D
+    from ceph_tpu.osd.map_codec import _dec_pool
+
+    p = _dec_pool(_D(blob))
+    assert p.name == "corpus" and p.hit_set_count == 4
+    assert p.pgp_num == 8
+
+
+STRUCTS = {
+    "struct_CrushMap": (_sample_crush_bytes, _decode_crush_bytes),
+    "struct_PGPool": (_sample_pool_bytes, _decode_pool_bytes),
+}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ceph-dencoder")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -134,6 +198,23 @@ def main(argv=None) -> int:
                 except Exception as ex:
                     print(f"FAIL {cls.__name__}: {ex!r}")
                     bad += 1
+        for name, (gen, check) in sorted(STRUCTS.items()):
+            path = os.path.join(args.dir, name + ".bin")
+            if args.action == "generate":
+                with open(path, "wb") as f:
+                    f.write(gen())
+                print(f"wrote {path}")
+            elif os.path.exists(path):
+                with open(path, "rb") as f:
+                    blob = f.read()
+                try:
+                    check(blob)
+                    print(f"{name}: decodes ok")
+                except Exception as ex:
+                    print(f"FAIL {name}: {ex!r}")
+                    bad += 1
+            else:
+                print(f"skip {name}: no archived encoding")
         return 1 if bad else 0
     return 1
 
