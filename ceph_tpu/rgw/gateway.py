@@ -161,12 +161,25 @@ class RGW:
 
     def get_object(self, bucket: str, key: str) -> Tuple[bytes, Dict]:
         head = self.head_object(bucket, key)
-        data = self.striper.read(self._data_oid(bucket, key),
-                                 head["size"])
+        manifest = head.get("manifest")
+        if manifest:
+            # multipart object: stitch the parts in order
+            data = b"".join(
+                self.striper.read(
+                    self._mp_oid(bucket, seg["upload_id"], seg["part"]),
+                    seg["size"])
+                for seg in manifest)
+        else:
+            data = self.striper.read(self._data_oid(bucket, key),
+                                     head["size"])
         return data, head
 
     def delete_object(self, bucket: str, key: str) -> None:
         self._require_bucket(bucket)
+        try:
+            head = self.head_object(bucket, key)
+        except NoSuchKey:
+            head = {}
         try:
             self.io.call(self._index_oid(bucket), "rgw", "index_rm",
                          key.encode())
@@ -174,10 +187,91 @@ class RGW:
             if e.rc == -2:
                 raise NoSuchKey(f"{bucket}/{key}")
             raise
+        for seg in head.get("manifest", []):
+            try:
+                self.striper.remove(self._mp_oid(
+                    bucket, seg["upload_id"], seg["part"]))
+            except RadosError:
+                pass
         try:
             self.striper.remove(self._data_oid(bucket, key))
         except RadosError:
             pass
+
+    # -- multipart upload (reference rgw_multipart.* / RGWMultipart*:
+    # parts land as separate striped objects; complete writes a
+    # manifest entry whose ETag is md5(part-md5s)-N, and GET stitches
+    # the parts in order) --------------------------------------------------
+    def create_multipart_upload(self, bucket: str, key: str,
+                                metadata: Optional[Dict] = None) -> str:
+        self._require_bucket(bucket)
+        import secrets
+
+        upload_id = secrets.token_hex(8)
+        self.io.call(self._index_oid(bucket), "rgw", "index_put",
+                     json.dumps({"key": f"_mp_/{key}/{upload_id}",
+                                 "entry": {"size": 0, "etag": "",
+                                           "mtime": time.time(),
+                                           "meta": metadata or {},
+                                           "parts": {}}}).encode())
+        return upload_id
+
+    def _mp_oid(self, bucket: str, upload_id: str, part: int) -> str:
+        return f"rgw.mp.{bucket}/{upload_id}/{part}"
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_number: int, data: bytes) -> str:
+        self._require_bucket(bucket)
+        if not 1 <= part_number <= 10000:
+            raise ValueError("part number out of range")
+        etag = hashlib.md5(data).hexdigest()
+        self.striper.write(self._mp_oid(bucket, upload_id, part_number),
+                           data)
+        # part bookkeeping rides the same atomic index
+        mp_key = f"_mp_/{key}/{upload_id}"
+        head = self.head_object(bucket, mp_key)
+        head["parts"][str(part_number)] = {"size": len(data),
+                                           "etag": etag}
+        self.io.call(self._index_oid(bucket), "rgw", "index_put",
+                     json.dumps({"key": mp_key,
+                                 "entry": head}).encode())
+        return etag
+
+    def complete_multipart_upload(self, bucket: str, key: str,
+                                  upload_id: str) -> str:
+        self._require_bucket(bucket)
+        mp_key = f"_mp_/{key}/{upload_id}"
+        head = self.head_object(bucket, mp_key)
+        parts = sorted(((int(n), p) for n, p in head["parts"].items()))
+        if not parts:
+            raise NoSuchKey(f"no parts for upload {upload_id}")
+        # S3 multipart etag: md5 of the concatenated binary part md5s,
+        # suffixed with the part count
+        md5s = b"".join(bytes.fromhex(p["etag"]) for _, p in parts)
+        etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
+        entry = {"size": sum(p["size"] for _, p in parts), "etag": etag,
+                 "mtime": time.time(), "meta": head.get("meta", {}),
+                 "manifest": [{"upload_id": upload_id, "part": n,
+                               "size": p["size"]} for n, p in parts]}
+        self.io.call(self._index_oid(bucket), "rgw", "index_put",
+                     json.dumps({"key": key, "entry": entry}).encode())
+        self.io.call(self._index_oid(bucket), "rgw", "index_rm",
+                     mp_key.encode())
+        return etag
+
+    def abort_multipart_upload(self, bucket: str, key: str,
+                               upload_id: str) -> None:
+        self._require_bucket(bucket)
+        mp_key = f"_mp_/{key}/{upload_id}"
+        head = self.head_object(bucket, mp_key)
+        for n in head["parts"]:
+            try:
+                self.striper.remove(self._mp_oid(bucket, upload_id,
+                                                 int(n)))
+            except RadosError:
+                pass
+        self.io.call(self._index_oid(bucket), "rgw", "index_rm",
+                     mp_key.encode())
 
     def list_objects(self, bucket: str, prefix: str = "",
                      marker: str = "", max_keys: int = 1000
@@ -191,6 +285,8 @@ class RGW:
         out = json.loads(got.decode())
         entries = []
         for k, blob in out["entries"]:
+            if k.startswith("_mp_/"):
+                continue  # in-progress multipart bookkeeping is hidden
             e = json.loads(blob)
             entries.append({"Key": k, "Size": e["size"],
                             "ETag": e["etag"], "Meta": e.get("meta", {})})
