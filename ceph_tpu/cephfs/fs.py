@@ -221,6 +221,41 @@ class CephFS:
     def stat(self, path: str) -> Dict:
         return dict(self._lookup(path))
 
+    # -- symlinks (reference Client::symlink/readlink; the target lives
+    # in the dentry inode like the MDS's inline symlink target) -----------
+    def symlink(self, target: str, linkpath: str) -> None:
+        parent, name = self._split(linkpath)
+        if self._lookup(parent)["type"] != "dir":
+            raise NotADirectory(parent)
+        try:
+            self._lookup(linkpath)
+            raise FSError(-17, f"{linkpath} exists")  # EEXIST
+        except NoSuchEntry:
+            pass
+        self._link(parent, name, {"type": "symlink",
+                                  "ino": self._next_ino(),
+                                  "target": target,
+                                  "mtime": time.time()})
+
+    def readlink(self, path: str) -> str:
+        ent = self._lookup(path)
+        if ent["type"] != "symlink":
+            raise FSError(-22, f"{path} is not a symlink")
+        return ent["target"]
+
+    def resolve(self, path: str, _depth: int = 0) -> str:
+        """Follow symlinks to the real path (bounded, ELOOP past 16)."""
+        if _depth > 16:
+            raise FSError(-40, f"symlink loop at {path}")  # ELOOP
+        ent = self._lookup(path)
+        if ent["type"] != "symlink":
+            return self._norm(path)
+        target = ent["target"]
+        if not target.startswith("/"):
+            parent, _name = self._split(path)
+            target = parent.rstrip("/") + "/" + target
+        return self.resolve(target, _depth + 1)
+
     def unlink(self, path: str) -> None:
         ent = self._lookup(path)
         if ent["type"] == "dir":

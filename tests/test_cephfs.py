@@ -116,3 +116,29 @@ def test_rename_deep_tree(fs):
         fs.listdir("/t1")
     with pytest.raises(NoSuchEntry):
         fs.listdir("/t1/sub")
+
+
+def test_symlinks_resolve_and_loop_guard(fs):
+    fs.mkdir("/sym")
+    fs.write("/sym/real.txt", b"pointed-at")
+    fs.symlink("/sym/real.txt", "/sym/abs-link")
+    fs.symlink("real.txt", "/sym/rel-link")
+    assert fs.readlink("/sym/abs-link") == "/sym/real.txt"
+    assert fs.resolve("/sym/abs-link") == "/sym/real.txt"
+    assert fs.resolve("/sym/rel-link") == "/sym/real.txt"
+    assert fs.read(fs.resolve("/sym/rel-link")) == b"pointed-at"
+    assert fs.stat("/sym/abs-link")["type"] == "symlink"
+    # link-to-link chains resolve; loops raise
+    fs.symlink("/sym/abs-link", "/sym/chain")
+    assert fs.resolve("/sym/chain") == "/sym/real.txt"
+    fs.symlink("/sym/loop-b", "/sym/loop-a")
+    fs.symlink("/sym/loop-a", "/sym/loop-b")
+    import pytest as _pytest
+
+    from ceph_tpu.cephfs.fs import FSError
+
+    with _pytest.raises(FSError):
+        fs.resolve("/sym/loop-a")
+    with _pytest.raises(FSError):
+        fs.symlink("/x", "/sym/abs-link")  # EEXIST
+    fs.unlink("/sym/abs-link")  # symlinks unlink like files
