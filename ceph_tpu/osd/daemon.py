@@ -211,6 +211,52 @@ class OSDService(Dispatcher):
             name=f"osd{self.whoami}-hb")
         self._hb_thread.start()
 
+    def start_scrub_scheduler(self,
+                              interval: Optional[float] = None) -> None:
+        """Background periodic scrub (reference OSD::sched_scrub +
+        osd_scrub_min/max_interval): round-robins this osd's primary
+        PGs, scrubbing the one whose last scrub is oldest once per
+        interval; inconsistencies go to the cluster log hook."""
+        iv = (interval if interval is not None
+              else self.ctx.conf.get("osd_scrub_interval"))
+        self._scrub_stamps: Dict[PGId, float] = {}
+
+        def _loop() -> None:
+            while not self._hb_stop.wait(iv):
+                if not self.up:
+                    return
+                due = None
+                now = time.time()
+                for pgid, pg in list(self.pgs.items()):
+                    if not pg.is_primary() or pg.state == "peering":
+                        continue
+                    last = self._scrub_stamps.get(pgid, 0.0)
+                    if now - last >= iv and (
+                            due is None
+                            or last < self._scrub_stamps.get(due, 0.0)):
+                        due = pgid
+                if due is None:
+                    continue
+                pg = self.pgs.get(due)
+                if pg is None:
+                    continue
+                self._scrub_stamps[due] = now
+                try:
+                    errors = pg.scrub()
+                except Exception as e:
+                    self._log(0, f"scheduled scrub {due} failed: {e}")
+                    continue
+                if errors:
+                    self.ctx.log.cluster(
+                        "ERR", f"pg {due} scrub: {len(errors)} "
+                               f"inconsistent objects: "
+                               f"{sorted(errors)[:5]}")
+                else:
+                    self._log(2, f"scheduled scrub {due}: clean")
+
+        threading.Thread(target=_loop, daemon=True,
+                         name=f"osd{self.whoami}-scrub").start()
+
     def shutdown(self) -> None:
         self.up = False
         self._hb_stop.set()

@@ -378,3 +378,33 @@ def test_object_context_cache_serves_and_invalidates(cluster, client):
     pg.update_acting(pg.acting, pg.primary)
     assert len(pg._obc) == 0
     assert pg._obc.generation() > gen_before  # stale fills now refused
+
+
+def test_scheduled_scrub_detects_corruption(cluster, client):
+    """Background scrub scheduler (OSD::sched_scrub role): runs on its
+    own, reports injected bitrot to the cluster log."""
+    import threading
+
+    io = client.rc.ioctx(REP_POOL)
+    io.write_full("scrubme", b"pristine" * 100)
+    pgid = cluster.osdmap.object_to_pg(REP_POOL, "scrubme")
+    _u, _up, acting, primary = cluster.osdmap.pg_to_up_acting(pgid)
+    # corrupt a replica copy behind the cluster's back
+    replica = next(o for o in acting if o != primary)
+    svc = cluster.osds[replica]
+    from ceph_tpu.store.objectstore import GHObject, Transaction
+
+    pg_r = svc.pgs[pgid]
+    t = Transaction()
+    t.write(pg_r.coll, GHObject("scrubme"), 0, b"CORRUPTED")
+    svc.store.queue_transaction(t)
+
+    hits = []
+    ev = threading.Event()
+    psvc = cluster.osds[primary]
+    psvc.ctx.log.cluster_cb = lambda lvl, msg: (
+        hits.append((lvl, msg)), ev.set())
+    psvc.start_scrub_scheduler(interval=0.2)
+    assert ev.wait(timeout=15.0), "scrub scheduler never reported"
+    lvl, msg = hits[0]
+    assert lvl == "ERR" and "scrubme" in msg and str(pgid[1]) in msg
