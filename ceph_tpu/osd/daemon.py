@@ -94,6 +94,7 @@ class OSDService(Dispatcher):
         self.hb_replied: set = set()  # peers that ever answered a ping
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        self._scrub_thread: Optional[threading.Thread] = None
         pc = ctx.perf.create(f"osd.{whoami}")
         pc.add_u64_counter("op_w", "client writes")
         pc.add_u64_counter("op_r", "client reads")
@@ -219,7 +220,10 @@ class OSDService(Dispatcher):
         interval; inconsistencies go to the cluster log hook."""
         iv = (interval if interval is not None
               else self.ctx.conf.get("osd_scrub_interval"))
+        if self._scrub_thread is not None and self._scrub_thread.is_alive():
+            return  # one scheduler per daemon
         self._scrub_stamps: Dict[PGId, float] = {}
+        from ceph_tpu.osd.pg import STATE_ACTIVE
 
         def _loop() -> None:
             while not self._hb_stop.wait(iv):
@@ -228,7 +232,10 @@ class OSDService(Dispatcher):
                 due = None
                 now = time.time()
                 for pgid, pg in list(self.pgs.items()):
-                    if not pg.is_primary() or pg.state == "peering":
+                    # only clean active PGs: a degraded/recovering PG's
+                    # replicas legitimately lack objects and would
+                    # raise spurious inconsistency ERRs
+                    if not pg.is_primary() or pg.state != STATE_ACTIVE:
                         continue
                     last = self._scrub_stamps.get(pgid, 0.0)
                     if now - last >= iv and (
@@ -254,14 +261,18 @@ class OSDService(Dispatcher):
                 else:
                     self._log(2, f"scheduled scrub {due}: clean")
 
-        threading.Thread(target=_loop, daemon=True,
-                         name=f"osd{self.whoami}-scrub").start()
+        self._scrub_thread = threading.Thread(
+            target=_loop, daemon=True, name=f"osd{self.whoami}-scrub")
+        self._scrub_thread.start()
 
     def shutdown(self) -> None:
         self.up = False
         self._hb_stop.set()
         if self._hb_thread:
             self._hb_thread.join(timeout=5)
+        if self._scrub_thread:
+            self._scrub_thread.join(timeout=5)
+            self._scrub_thread = None
         self.wq.stop()
         self.msgr.shutdown()
         self.hb_msgr.shutdown()

@@ -380,31 +380,40 @@ def test_object_context_cache_serves_and_invalidates(cluster, client):
     assert pg._obc.generation() > gen_before  # stale fills now refused
 
 
-def test_scheduled_scrub_detects_corruption(cluster, client):
+def test_scheduled_scrub_detects_corruption():
     """Background scrub scheduler (OSD::sched_scrub role): runs on its
-    own, reports injected bitrot to the cluster log."""
+    own, reports injected bitrot to the cluster log.  Dedicated
+    cluster: the module-scoped one carries unrepaired corruption from
+    earlier tests, and the scheduler round-robins EVERY primary PG."""
     import threading
 
-    io = client.rc.ioctx(REP_POOL)
-    io.write_full("scrubme", b"pristine" * 100)
-    pgid = cluster.osdmap.object_to_pg(REP_POOL, "scrubme")
-    _u, _up, acting, primary = cluster.osdmap.pg_to_up_acting(pgid)
-    # corrupt a replica copy behind the cluster's back
-    replica = next(o for o in acting if o != primary)
-    svc = cluster.osds[replica]
-    from ceph_tpu.store.objectstore import GHObject, Transaction
+    c = MiniCluster()
+    cl = LibClient(c)
+    try:
+        io = cl.rc.ioctx(REP_POOL)
+        io.write_full("scrubme", b"pristine" * 100)
+        pgid = c.osdmap.object_to_pg(REP_POOL, "scrubme")
+        _u, _up, acting, primary = c.osdmap.pg_to_up_acting(pgid)
+        # corrupt a replica copy behind the cluster's back
+        replica = next(o for o in acting if o != primary)
+        svc = c.osds[replica]
+        from ceph_tpu.store.objectstore import GHObject, Transaction
 
-    pg_r = svc.pgs[pgid]
-    t = Transaction()
-    t.write(pg_r.coll, GHObject("scrubme"), 0, b"CORRUPTED")
-    svc.store.queue_transaction(t)
+        pg_r = svc.pgs[pgid]
+        t = Transaction()
+        t.write(pg_r.coll, GHObject("scrubme"), 0, b"CORRUPTED")
+        svc.store.queue_transaction(t)
 
-    hits = []
-    ev = threading.Event()
-    psvc = cluster.osds[primary]
-    psvc.ctx.log.cluster_cb = lambda lvl, msg: (
-        hits.append((lvl, msg)), ev.set())
-    psvc.start_scrub_scheduler(interval=0.2)
-    assert ev.wait(timeout=15.0), "scrub scheduler never reported"
-    lvl, msg = hits[0]
-    assert lvl == "ERR" and "scrubme" in msg and str(pgid[1]) in msg
+        hits = []
+        ev = threading.Event()
+        psvc = c.osds[primary]
+        psvc.ctx.log.cluster_cb = lambda lvl, msg: (
+            hits.append((lvl, msg)), ev.set())
+        psvc.start_scrub_scheduler(interval=0.2)
+        psvc.start_scrub_scheduler(interval=0.2)  # idempotent
+        assert ev.wait(timeout=15.0), "scrub scheduler never reported"
+        lvl, msg = hits[0]
+        assert lvl == "ERR" and "scrubme" in msg and str(pgid[1]) in msg
+    finally:
+        cl.shutdown()
+        c.shutdown()
