@@ -102,6 +102,8 @@ def _opts() -> List[Option]:
         O("osd_recovery_chunk_size", int, 8 << 20,
           "bytes per recovery push chunk (resumable progress unit)"),
         O("osd_scrub_interval", float, 86400.0, "seconds between scrubs"),
+        O("osd_pg_stats_interval", float, 2.0,
+          "seconds between MPGStats reports to the mon"),
         O("osd_client_op_priority", int, 63, "client op priority"),
         O("osd_recovery_op_priority", int, 3, "recovery op priority"),
         # -- erasure code / device -----------------------------------------

@@ -181,3 +181,9 @@ class MonClient(Dispatcher):
         for rank in self.monmap.live_ranks():
             self.msgr.send_message(mm.MOSDFailure(target, failed_for),
                                    self.monmap.addrs[rank])
+
+    def send_pg_stats(self, osd_id: int, epoch: int, pgs: list) -> None:
+        """MPGStats feed (every mon keeps a transient mgr-style copy)."""
+        for rank in self.monmap.live_ranks():
+            self.msgr.send_message(mm.MPGStats(osd_id, epoch, pgs),
+                                   self.monmap.addrs[rank])

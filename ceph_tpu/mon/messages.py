@@ -264,3 +264,34 @@ class MAuthReply(Message):
         self.challenge = d.blob()
         self.sealed_client = d.blob()
         self.ticket_blob = d.blob()
+
+
+@register
+class MPGStats(Message):
+    """Per-OSD PG stats report (reference MPGStats, the mgr/mon stats
+    feed behind `ceph pg dump` and the PG health checks).  Stats are
+    TRANSIENT on the mon (mgr-style), never paxos-committed."""
+
+    TYPE = 40
+
+    def __init__(self, osd: int = -1, epoch: int = 0,
+                 pgs: Optional[list] = None) -> None:
+        super().__init__()
+        self.osd = osd
+        self.epoch = epoch
+        # [(pool, ps, state, num_objects, last_update_epoch,
+        #   last_update_version, is_primary)]
+        self.pgs = pgs or []
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.osd).u32(self.epoch)
+        e.seq(self.pgs, lambda en, p: (
+            en.s64(p[0]), en.u32(p[1]), en.string(p[2]), en.u64(p[3]),
+            en.u32(p[4]), en.u64(p[5]), en.u8(1 if p[6] else 0)))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.osd = d.s32()
+        self.epoch = d.u32()
+        self.pgs = d.seq(lambda dd: (
+            dd.s64(), dd.u32(), dd.string(), dd.u64(), dd.u32(),
+            dd.u64(), bool(dd.u8())))

@@ -135,6 +135,9 @@ class Monitor(Dispatcher):
 
         # osdmonitor state
         self.osdmap = initial_map
+        # transient per-OSD PG stats (mgr-style, NOT paxos-committed;
+        # reference: the MPGStats feed behind `ceph pg dump`)
+        self.pg_stats: Dict[int, Tuple[float, list]] = {}
         self.failure_reports: Dict[int, Dict[int, float]] = {}
         self.down_stamp: Dict[int, float] = {}
         self.subscribers: Dict[Addr, int] = {}  # addr -> last epoch sent
@@ -982,6 +985,21 @@ class Monitor(Dispatcher):
                     self.down_stamp[osd] = time.time()
                 self._mutate_map(mut)
             return 0, {}
+        if prefix == "pg dump":
+            with self.lock:
+                # primary-reported rows win; replicas fill gaps
+                rows: Dict[Tuple[int, int], dict] = {}
+                for osd, (stamp, pgs) in self.pg_stats.items():
+                    for (pool, ps, state, n, lu_e, lu_v, prim) in pgs:
+                        key = (pool, ps)
+                        if prim or key not in rows:
+                            rows[key] = {
+                                "pgid": f"{pool}.{ps}", "state": state,
+                                "num_objects": n,
+                                "last_update": [lu_e, lu_v],
+                                "reported_by": osd, "primary": prim}
+                return 0, {"num_pg_stats": len(rows),
+                           "pg_stats": [rows[k] for k in sorted(rows)]}
         if prefix == "osd pool set":
             var, val = cmd["var"], int(cmd["val"])
             if var not in ("pg_num", "pgp_num", "size", "min_size"):
@@ -1091,6 +1109,10 @@ class Monitor(Dispatcher):
             return self._handle_subscribe(conn, msg)
         if isinstance(msg, mm.MOSDBoot):
             self._handle_boot(msg)
+            return True
+        if isinstance(msg, mm.MPGStats):
+            with self.lock:
+                self.pg_stats[msg.osd] = (time.time(), msg.pgs)
             return True
         if isinstance(msg, mm.MOSDFailure):
             self._handle_failure(msg)

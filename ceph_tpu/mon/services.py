@@ -245,6 +245,33 @@ class HealthMonitor(PaxosService):
                     "summary": f"{len(out)} osds out",
                     "detail": [f"osd.{i} is out" for i in out],
                 }
+        # PG states from the transient MPGStats feed (primary-reported)
+        import time as _time
+
+        degraded, peering = [], []
+        now = _time.time()
+        for osd, (stamp, pgs) in self.mon.pg_stats.items():
+            if now - stamp > 30.0:
+                continue  # stale report
+            for (pool, ps, state, _n, _e, _v, prim) in pgs:
+                if not prim:
+                    continue
+                if "degraded" in state:
+                    degraded.append(f"{pool}.{ps}")
+                elif state == "peering":
+                    peering.append(f"{pool}.{ps}")
+        if degraded:
+            checks["PG_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(degraded)} pgs degraded",
+                "detail": sorted(degraded)[:10],
+            }
+        if peering:
+            checks["PG_PEERING"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(peering)} pgs peering",
+                "detail": sorted(peering)[:10],
+            }
         for svc in self.mon.services.values():
             if svc is not self:
                 checks.update(svc.health_checks())

@@ -172,12 +172,22 @@ class OSDService(Dispatcher):
             # re-assert itself — so keep watching the map and re-boot
             # whenever it shows us down (reference OSD::start_boot +
             # the "wrongly marked me down" path of handle_osd_map)
+            last_stats = 0.0
             while self.up:
                 m_ = self.osdmap
                 if m_ is None or not m_.is_up(self.whoami):
                     self.monc.send_boot(self.whoami,
                                         hb_addr=self.hb_msgr.addr)
                 self._maybe_renew_ticket()
+                now = time.time()
+                if now - last_stats >= self.ctx.conf.get(
+                        "osd_pg_stats_interval"):
+                    last_stats = now
+                    try:
+                        self.monc.send_pg_stats(
+                            self.whoami, self.epoch(), self.pg_stats())
+                    except Exception:
+                        pass
                 time.sleep(1.0)
 
         threading.Thread(target=_boot_loop, daemon=True,
@@ -441,6 +451,19 @@ class OSDService(Dispatcher):
                              f"-> {pid}.{child_ps}")
             if moves:
                 pg._obc_invalidate()
+
+    def pg_stats(self) -> list:
+        """This osd's per-PG stat rows (the MPGStats payload)."""
+        out = []
+        for pgid, pg in list(self.pgs.items()):
+            try:
+                n = len(pg.backend.object_names())
+            except Exception:
+                n = 0
+            lu = pg.info.last_update
+            out.append((pgid[0], pgid[1], pg.state, n,
+                        lu.epoch, lu.version, pg.is_primary()))
+        return out
 
     def activate_pgs(self) -> None:
         for pg in list(self.pgs.values()):

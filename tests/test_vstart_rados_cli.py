@@ -200,3 +200,35 @@ def test_cephfs_shell_cli(tmp_path):
     assert "d docs" in out
     assert "fs payload" in out
     assert out.strip().splitlines()[-1] != "docs"  # rmdir removed it
+
+
+def test_pg_dump_and_pg_health():
+    """MPGStats feed: `pg dump` shows every PG active with object
+    counts; killing an OSD surfaces PG_DEGRADED in health."""
+    from ceph_tpu.vstart import VStartCluster
+
+    with VStartCluster(n_mons=1, n_osds=3,
+                       conf={"osd_pg_stats_interval": 0.5}) as c:
+        pool = c.create_pool("stats", size=3, pg_num=4)
+        io = c.client().ioctx(pool)
+        for i in range(8):
+            io.write_full(f"s{i}", b"x" * 100)
+
+        def dumped():
+            code, out = c.command({"prefix": "pg dump"})
+            if code != 0 or out["num_pg_stats"] < 4:
+                return False
+            rows = [r for r in out["pg_stats"]
+                    if r["pgid"].startswith(f"{pool}.")]
+            return (len(rows) == 4
+                    and all(r["state"] == "active" for r in rows)
+                    and sum(r["num_objects"] for r in rows) == 8)
+
+        c.wait_for(dumped, what="pg dump active + counts")
+        c.kill_osd(2)
+
+        def degraded():
+            code, out = c.command({"prefix": "health"})
+            return code == 0 and "PG_DEGRADED" in out["checks"]
+
+        c.wait_for(degraded, timeout=30.0, what="PG_DEGRADED")
