@@ -549,8 +549,14 @@ class OSDService(Dispatcher):
                 return True
             pg = self.pgs.get(msg.pgid)
             if pg is None:
+                # we don't hold this pg (yet): the client's map may be
+                # ahead of ours (pool just created) or behind (remap).
+                # Either way the answer is RETRYABLE — the reference
+                # waits for the map / forces a client resend; a hard
+                # ENOENT here loses a race the client can win by simply
+                # resending after the next map push
                 rep = m.MOSDOpReply(msg.pgid, self.epoch(), msg.oid,
-                                    msg.ops, result=-2)
+                                    msg.ops, result=-116)  # ESTALE
                 rep.tid = msg.tid
                 conn.send(rep)
                 return True

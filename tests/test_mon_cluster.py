@@ -92,34 +92,25 @@ class Tier3Cluster:
             mon.shutdown()
 
 
-class Objecter(Dispatcher):
-    """Minimal client: subscribe to maps, place, send ops (full
-    librados equivalent lands in ceph_tpu/rados)."""
+class Objecter:
+    """The REAL client library (RadosClient/Objecter does placement,
+    map-change retarget and EAGAIN/ESTALE retries), with the thin
+    pool_id/op compat surface these tests use."""
 
     def __init__(self, ctx, monmap) -> None:
-        self.msgr = Messenger(ctx, EntityName("client", 7))
-        self.msgr.start()
-        self.monc = MonClient(self.msgr, monmap)
-        self.msgr.add_dispatcher(self)
-        self.osdmap = None
-        self.map_ev = threading.Event()
-        self.monc.subscribe_osdmap(self._new_map)
-        self._waiters = {}
-        self._tid = 0
-        self._lock = threading.Lock()
+        from ceph_tpu.client import RadosClient
 
-    def _new_map(self, osdmap) -> None:
-        self.osdmap = osdmap
-        self.map_ev.set()
+        self.rc = RadosClient(ctx)
+        self.rc.connect(monmap)
+        self.monc = self.rc.monc
 
-    def ms_dispatch(self, conn, msg) -> bool:
-        if isinstance(msg, m.MOSDOpReply):
-            w = self._waiters.get(msg.tid)
-            if w is not None:
-                w[1] = msg
-                w[0].set()
-            return True
-        return False
+    @property
+    def osdmap(self):
+        return self.rc.objecter.osdmap
+
+    @property
+    def msgr(self):
+        return self.rc.msgr
 
     def pool_id(self, name: str) -> int:
         for pid, p in self.osdmap.pools.items():
@@ -128,23 +119,10 @@ class Objecter(Dispatcher):
         raise KeyError(name)
 
     def op(self, pool: int, oid: str, ops, timeout=15.0):
-        pgid = self.osdmap.object_to_pg(pool, oid)
-        _, _, acting, primary = self.osdmap.pg_to_up_acting(pgid)
-        assert primary >= 0, f"no primary for {oid}"
-        addr = tuple(self.osdmap.osd_addrs[primary])
-        with self._lock:
-            self._tid += 1
-            tid = self._tid
-        msg = m.MOSDOp(pgid, self.osdmap.epoch, oid, ops)
-        msg.tid = tid
-        ev = threading.Event()
-        self._waiters[tid] = [ev, None]
-        self.msgr.send_message(msg, addr)
-        assert ev.wait(timeout), f"op on {oid} timed out"
-        return self._waiters.pop(tid)[1]
+        return self.rc.ioctx(pool).operate(oid, ops, timeout=timeout)
 
     def shutdown(self) -> None:
-        self.msgr.shutdown()
+        self.rc.shutdown()
 
 
 @pytest.fixture(scope="module")
