@@ -182,8 +182,10 @@ class MonClient(Dispatcher):
             self.msgr.send_message(mm.MOSDFailure(target, failed_for),
                                    self.monmap.addrs[rank])
 
-    def send_pg_stats(self, osd_id: int, epoch: int, pgs: list) -> None:
+    def send_pg_stats(self, osd_id: int, epoch: int, pgs: list,
+                      used_bytes: int = 0, total_bytes: int = 0) -> None:
         """MPGStats feed (every mon keeps a transient mgr-style copy)."""
         for rank in self.monmap.live_ranks():
-            self.msgr.send_message(mm.MPGStats(osd_id, epoch, pgs),
-                                   self.monmap.addrs[rank])
+            self.msgr.send_message(
+                mm.MPGStats(osd_id, epoch, pgs, used_bytes, total_bytes),
+                self.monmap.addrs[rank])

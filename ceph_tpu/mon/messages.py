@@ -275,19 +275,24 @@ class MPGStats(Message):
     TYPE = 40
 
     def __init__(self, osd: int = -1, epoch: int = 0,
-                 pgs: Optional[list] = None) -> None:
+                 pgs: Optional[list] = None, used_bytes: int = 0,
+                 total_bytes: int = 0) -> None:
         super().__init__()
         self.osd = osd
         self.epoch = epoch
         # [(pool, ps, state, num_objects, last_update_epoch,
         #   last_update_version, is_primary)]
         self.pgs = pgs or []
+        # store fullness (ObjectStore::statfs — the nearfull/full feed)
+        self.used_bytes = used_bytes
+        self.total_bytes = total_bytes
 
     def encode_payload(self, e: Encoder) -> None:
         e.s32(self.osd).u32(self.epoch)
         e.seq(self.pgs, lambda en, p: (
             en.s64(p[0]), en.u32(p[1]), en.string(p[2]), en.u64(p[3]),
             en.u32(p[4]), en.u64(p[5]), en.u8(1 if p[6] else 0)))
+        e.u64(self.used_bytes).u64(self.total_bytes)
 
     def decode_payload(self, d: Decoder) -> None:
         self.osd = d.s32()
@@ -295,3 +300,5 @@ class MPGStats(Message):
         self.pgs = d.seq(lambda dd: (
             dd.s64(), dd.u32(), dd.string(), dd.u64(), dd.u32(),
             dd.u64(), bool(dd.u8())))
+        self.used_bytes = d.u64()
+        self.total_bytes = d.u64()

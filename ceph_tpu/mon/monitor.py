@@ -138,6 +138,7 @@ class Monitor(Dispatcher):
         # transient per-OSD PG stats (mgr-style, NOT paxos-committed;
         # reference: the MPGStats feed behind `ceph pg dump`)
         self.pg_stats: Dict[int, Tuple[float, list]] = {}
+        self.osd_fullness: Dict[int, Tuple[int, int]] = {}
         self.failure_reports: Dict[int, Dict[int, float]] = {}
         self.down_stamp: Dict[int, float] = {}
         self.subscribers: Dict[Addr, int] = {}  # addr -> last epoch sent
@@ -1113,6 +1114,8 @@ class Monitor(Dispatcher):
         if isinstance(msg, mm.MPGStats):
             with self.lock:
                 self.pg_stats[msg.osd] = (time.time(), msg.pgs)
+                self.osd_fullness[msg.osd] = (msg.used_bytes,
+                                              msg.total_bytes)
             return True
         if isinstance(msg, mm.MOSDFailure):
             self._handle_failure(msg)

@@ -272,6 +272,28 @@ class HealthMonitor(PaxosService):
                 "summary": f"{len(peering)} pgs peering",
                 "detail": sorted(peering)[:10],
             }
+        # store fullness (reference OSDMap full/nearfull flags)
+        nearfull, full = [], []
+        for osd, (used, total) in self.mon.osd_fullness.items():
+            if not total:
+                continue
+            ratio = used / total
+            if ratio >= 0.95:
+                full.append(f"osd.{osd} ({ratio:.0%})")
+            elif ratio >= 0.85:
+                nearfull.append(f"osd.{osd} ({ratio:.0%})")
+        if full:
+            checks["OSD_FULL"] = {
+                "severity": "HEALTH_ERR",
+                "summary": f"{len(full)} osds full",
+                "detail": sorted(full),
+            }
+        if nearfull:
+            checks["OSD_NEARFULL"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(nearfull)} osds nearfull",
+                "detail": sorted(nearfull),
+            }
         for svc in self.mon.services.values():
             if svc is not self:
                 checks.update(svc.health_checks())

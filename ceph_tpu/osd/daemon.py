@@ -184,8 +184,13 @@ class OSDService(Dispatcher):
                         "osd_pg_stats_interval"):
                     last_stats = now
                     try:
+                        try:
+                            used, total = self.store.statfs()
+                        except Exception:
+                            used, total = 0, 0
                         self.monc.send_pg_stats(
-                            self.whoami, self.epoch(), self.pg_stats())
+                            self.whoami, self.epoch(), self.pg_stats(),
+                            used, total)
                     except Exception:
                         pass
                 time.sleep(1.0)

@@ -682,6 +682,14 @@ class BlockStore(ObjectStore):
             return {k[len(key):]: v
                     for k, v in self._kv.iterate_prefix(P_OMAP, key)}
 
+    def statfs(self):
+        """used = allocated blocks; total = the device size (the
+        BlueStore statfs shape: allocator-accurate)."""
+        with self._lock:
+            used = sum(self._alloc.bits) * BLOCK
+            total = self._alloc.nblocks() * BLOCK
+        return used, max(total, 1)
+
     def list_collections(self) -> List[Collection]:
         with self._lock:
             return [Collection(k) for k, _ in self._kv.iterate(P_COLL)]

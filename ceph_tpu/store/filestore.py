@@ -491,6 +491,23 @@ class FileStore(ObjectStore):
                 if k.startswith(key)
             }
 
+    def statfs(self):
+        """used = bytes under the store dir; total = the filesystem's
+        (reference FileStore::statfs via ::statfs)."""
+        used = 0
+        for dirpath, _dn, files in os.walk(self.path):
+            for fn in files:
+                try:
+                    used += os.path.getsize(os.path.join(dirpath, fn))
+                except OSError:
+                    pass
+        try:
+            st = os.statvfs(self.path)
+            total = st.f_frsize * st.f_blocks
+        except OSError:
+            total = 1 << 30
+        return used, total
+
     def list_collections(self) -> List[Collection]:
         with self._lock:
             return [Collection(k) for k, _ in self._kv.iterate(P_COLL)]

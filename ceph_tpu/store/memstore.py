@@ -214,6 +214,13 @@ class MemStore(ObjectStore):
         with self._lock:
             return dict(self._obj(cid, oid).omap)
 
+    def statfs(self):
+        """Nominal 1 GiB device; used = logical bytes held."""
+        with self._lock:
+            used = sum(len(o.data) for coll in self._colls.values()
+                       for o in coll.values())
+        return used, 1 << 30
+
     def list_collections(self) -> List[Collection]:
         with self._lock:
             return sorted(self._colls.keys())

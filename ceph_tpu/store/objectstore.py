@@ -358,6 +358,11 @@ class ObjectStore:
     def queue_transaction(self, t: Transaction) -> None:
         raise NotImplementedError
 
+    def statfs(self) -> Tuple[int, int]:
+        """(used_bytes, total_bytes) — the reference ObjectStore::statfs.
+        Backends without a fixed device report a nominal capacity."""
+        raise NotImplementedError
+
     # -- reads ------------------------------------------------------------
     def exists(self, cid: Collection, oid: GHObject) -> bool:
         raise NotImplementedError
