@@ -194,8 +194,12 @@ def test_snap_rows_follow_objects_through_pg_split():
                              "pool": "snapsplit", "var": "pg_num",
                              "val": 8})
         assert code == 0
-        c.wait_for(lambda: c.leader().osdmap.pools[pool].pg_num == 8,
-                   what="split")
+        # the CLIENT's subscribed map must show the split too: the trim
+        # fans out one op per pg of the client's pg_num
+        c.wait_for(lambda: (c.leader().osdmap.pools[pool].pg_num == 8
+                            and io.client.objecter.osdmap
+                            .pools[pool].pg_num == 8),
+                   what="split visible to client")
         got = io.selfmanaged_snap_trim(snap)
         assert got["trimmed"] == len(names), got
         assert got["failed"] == 0
