@@ -921,12 +921,18 @@ class Monitor(Dispatcher):
             with self.lock:
                 m = self.osdmap
                 n_up = int(m.osd_state_up.sum()) if m is not None else 0
+                pg_states: Dict[str, int] = {}
+                for _osd, (_stamp, pgs) in self.pg_stats.items():
+                    for (_pool, _ps, state, _n, _e, _v, prim) in pgs:
+                        if prim:
+                            pg_states[state] = pg_states.get(state, 0) + 1
                 return 0, {
                     "quorum_leader": self.leader,
                     "election_epoch": self.election_epoch,
                     "osdmap_epoch": m.epoch if m else 0,
                     "num_osds": m.max_osd if m else 0,
                     "num_up_osds": n_up,
+                    "pg_states": pg_states,
                     "pools": {p.name or str(pid): pid
                               for pid, p in (m.pools if m else {}).items()},
                 }
@@ -986,6 +992,17 @@ class Monitor(Dispatcher):
                     self.down_stamp[osd] = time.time()
                 self._mutate_map(mut)
             return 0, {}
+        if prefix == "osd df":
+            with self.lock:
+                rows = []
+                for osd in sorted(self.osd_fullness):
+                    used, total = self.osd_fullness[osd]
+                    rows.append({
+                        "osd": osd, "used_bytes": used,
+                        "total_bytes": total,
+                        "utilization": round(used / total, 4)
+                        if total else 0.0})
+                return 0, {"nodes": rows}
         if prefix == "pg dump":
             with self.lock:
                 # primary-reported rows win; replicas fill gaps

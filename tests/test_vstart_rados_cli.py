@@ -267,3 +267,25 @@ def test_osd_fullness_health():
         assert "OSD_NEARFULL" in out["checks"]
         assert "OSD_FULL" in out["checks"]
         assert out["status"] == "HEALTH_ERR"
+
+
+def test_osd_df_and_status_pg_states():
+    from ceph_tpu.vstart import VStartCluster
+
+    with VStartCluster(n_mons=1, n_osds=2,
+                       conf={"osd_pg_stats_interval": 0.3}) as c:
+        pool = c.create_pool("dfp", size=2, pg_num=4)
+        io = c.client().ioctx(pool)
+        io.write_full("a", b"z" * 1000)
+
+        def ready():
+            code, out = c.command({"prefix": "osd df"})
+            if code != 0 or len(out["nodes"]) != 2:
+                return False
+            code, st = c.command({"prefix": "status"})
+            return (code == 0
+                    and st["pg_states"].get("active", 0) >= 4)
+
+        c.wait_for(ready, what="osd df + pg states")
+        code, out = c.command({"prefix": "osd df"})
+        assert all(n["total_bytes"] > 0 for n in out["nodes"])

@@ -47,6 +47,10 @@ def _parse(tokens):
                 "weight": float(t[3])}
     if t[0] == "osd" and t[1] == "dump":
         return {"prefix": "osd dump"}
+    if t[0] == "osd" and t[1] == "df":
+        return {"prefix": "osd df"}
+    if t[0] == "pg" and t[1] == "dump":
+        return {"prefix": "pg dump"}
     if t[0] == "osd" and t[1] == "tree":
         return {"prefix": "osd tree"}
     if t[0] == "status":
