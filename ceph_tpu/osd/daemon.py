@@ -754,6 +754,13 @@ class OSDService(Dispatcher):
                 self.store.queue_transaction(t)
                 # deleted objects must not survive in the context cache
                 pg._obc_invalidate()
+        with pg.lock:
+            for oid, en in latest.items():
+                if en.op != t_.LOG_DELETE:
+                    # our local copy/shards are STALE for these objects
+                    # until recovery completes (the reference's missing
+                    # set); reads must not trust them
+                    pg.missing[oid] = en.version
         if pg.is_ec():
             # reconstruct my shard(s) from surviving peers
             for oid, en in latest.items():
@@ -784,6 +791,13 @@ class OSDService(Dispatcher):
             pg._persist_meta(pg.log.omap_additions(pg.log.entries))
 
     def _ec_self_recover(self, pg: PG, oid: str, en) -> None:
+        """Rebuild this osd's shard(s) of one object.  The oid is in
+        pg.missing while this runs, so _ec_read_object excludes OUR
+        stale local shards from the reconstruction (mixing a stale
+        shard with fresh peers' shards produced silently wrong bytes);
+        success clears the missing entry, failure leaves it for the
+        next interval's retry (a peer holding fresh shards may return).
+        """
         from ceph_tpu.osd.backend import ECBackend
         from ceph_tpu.store.objectstore import GHObject, Transaction
 
@@ -795,6 +809,8 @@ class OSDService(Dispatcher):
             for shard in my_shards:
                 t.try_remove(pg.coll, GHObject(oid, shard=shard))
             self.store.queue_transaction(t)
+            with pg.lock:
+                pg.missing.pop(oid, None)
             return
         done = threading.Event()
         box: List[Optional[object]] = [None]
@@ -807,7 +823,7 @@ class OSDService(Dispatcher):
         done.wait(timeout=30.0)
         state = box[0]
         if state is None:
-            return
+            return  # not enough fresh shards: stays missing, retried
         chunks, _ = be._encode_object(state.data)
         from ceph_tpu.osd.backend import _hinfo
 
@@ -823,6 +839,8 @@ class OSDService(Dispatcher):
             if state.omap:
                 t.omap_setkeys(pg.coll, g, state.omap)
         self.store.queue_transaction(t)
+        with pg.lock:
+            pg.missing.pop(oid, None)
         self.perf.inc("recovery_pushes")
 
     def list_peer_objects(self, pg: PG, osd_id: int) -> Optional[set]:

@@ -386,6 +386,14 @@ class PG:
                 continue
 
     def _do_read(self, msg, reply):
+        with self.lock:
+            if msg.oid in self.missing:
+                # known-newer object we haven't recovered yet: serving
+                # local state would be STALE, "not found" would be a
+                # lie — retryable, the client waits out recovery
+                reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                    msg.oid, msg.ops, result=EAGAIN))
+                return
         if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_PGLS:
             # PG-scoped listing (reference do_pg_op / CEPH_OSD_OP_PGLS):
             # head objects only, meta excluded
@@ -1035,12 +1043,15 @@ class PG:
             n - len(self.acting))
         avail: Dict[int, bytes] = {}
         meta_box: List = [None]  # (attrs, omap) from whichever shard
-        for shard in be.local_shards(acting):
-            c = be.read_local_chunk(oid, shard)
-            if c is not None:
-                avail[shard] = c
-                if meta_box[0] is None:
-                    meta_box[0] = be.shard_meta(oid, shard)
+        with self.lock:
+            local_stale = oid in self.missing
+        if not local_stale:
+            for shard in be.local_shards(acting):
+                c = be.read_local_chunk(oid, shard)
+                if c is not None:
+                    avail[shard] = c
+                    if meta_box[0] is None:
+                        meta_box[0] = be.shard_meta(oid, shard)
         remote = [(s, o) for s, o in enumerate(acting)
                   if o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
                   and o not in self.stale_peers]  # stale shards can't serve
@@ -1137,10 +1148,19 @@ class PG:
                 if info.last_update < self.info.last_update
             }
         self._push_laggards(infos)
+        # objects still missing from an EARLIER interval (recovery was
+        # short of fresh shards then): retry now — a peer holding them
+        # may have returned with this interval
+        with self.lock:
+            retry = dict(self.missing) if self.is_ec() else {}
+        for oid, ver in retry.items():
+            self.osd._ec_self_recover(
+                self, oid, LogEntry(op=t_.LOG_MODIFY, oid=oid,
+                                    version=ver, prior_version=ver))
         with self.lock:
             degraded = any(o == CRUSH_ITEM_NONE or o < 0
                            for o in self.acting) or (
-                len(self.acting) < self._want_size())
+                len(self.acting) < self._want_size()) or bool(self.missing)
             self.state = STATE_DEGRADED if degraded else STATE_ACTIVE
 
     def _want_size(self) -> int:
