@@ -243,6 +243,33 @@ class CephFS:
             raise FSError(-22, f"{path} is not a symlink")
         return ent["target"]
 
+    # -- file locks (reference Client::flock over the MDS filelock; here
+    # the in-OSD lock class on the file's data object — the same
+    # primitive librbd's exclusive lock uses) -----------------------------
+    def flock(self, path: str, owner: str,
+              shared: bool = False) -> None:
+        ent = self._lookup(path)
+        if ent["type"] == "dir":
+            raise IsADirectory(path)
+        self.io.call(self._data_oid(ent["ino"]), "lock", "lock",
+                     json.dumps({"name": "flock", "owner": owner,
+                                 "type": "shared" if shared
+                                 else "exclusive"}).encode())
+
+    def funlock(self, path: str, owner: str) -> None:
+        ent = self._lookup(path)
+        self.io.call(self._data_oid(ent["ino"]), "lock", "unlock",
+                     json.dumps({"name": "flock",
+                                 "owner": owner}).encode())
+
+    def flock_info(self, path: str) -> Optional[Dict]:
+        ent = self._lookup(path)
+        got = self.io.call(self._data_oid(ent["ino"]), "lock",
+                           "get_info",
+                           json.dumps({"name": "flock"}).encode())
+        info = json.loads(got.decode()) if got else None
+        return info or None
+
     def resolve(self, path: str, _depth: int = 0) -> str:
         """Follow symlinks to the real path (bounded, ELOOP past 16)."""
         if _depth > 16:

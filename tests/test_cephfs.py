@@ -142,3 +142,31 @@ def test_symlinks_resolve_and_loop_guard(fs):
     with _pytest.raises(FSError):
         fs.symlink("/x", "/sym/abs-link")  # EEXIST
     fs.unlink("/sym/abs-link")  # symlinks unlink like files
+
+
+def test_file_locks(fs):
+    """flock over the in-OSD lock class (Client::flock role):
+    exclusive excludes, shared shares, unlock releases."""
+    import pytest as _pytest
+
+    from ceph_tpu.client.rados import RadosError
+
+    fs.write("/locked.txt", b"contents")
+    fs.flock("/locked.txt", "alice")
+    info = fs.flock_info("/locked.txt")
+    assert info["owners"] == ["alice"] and info["type"] == "exclusive"
+    with _pytest.raises(RadosError):
+        fs.flock("/locked.txt", "bob")
+    fs.flock("/locked.txt", "alice")  # re-entrant for the owner
+    fs.funlock("/locked.txt", "alice")
+    # shared locks coexist
+    fs.flock("/locked.txt", "bob", shared=True)
+    fs.flock("/locked.txt", "carol", shared=True)
+    with _pytest.raises(RadosError):
+        fs.flock("/locked.txt", "dave")  # exclusive blocked by shared
+    info = fs.flock_info("/locked.txt")
+    assert sorted(info["owners"]) == ["bob", "carol"]
+    fs.funlock("/locked.txt", "bob")
+    fs.funlock("/locked.txt", "carol")
+    fs.flock("/locked.txt", "dave")  # now free
+    fs.funlock("/locked.txt", "dave")
