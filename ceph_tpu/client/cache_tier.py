@@ -129,8 +129,16 @@ class CacheTier:
         self.cache.setxattr(oid, DIRTY_XATTR, b"0")
 
     def evict(self, oid: str) -> None:
-        """Drop a CLEAN cached copy (dirty objects must flush first)."""
-        if self.cache.getxattr(oid, DIRTY_XATTR) == b"1":
+        """Drop a CLEAN cached copy (dirty objects must flush first).
+        A missing dirty xattr means clean: read-promoted copies never
+        get the xattr set."""
+        try:
+            dirty = self.cache.getxattr(oid, DIRTY_XATTR) == b"1"
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            dirty = False
+        if dirty:
             raise RadosError(-16, f"{oid} is dirty")  # EBUSY
         self.cache.remove(oid)
 

@@ -266,7 +266,8 @@ def compile_text(text: str) -> cmap.CrushMap:
             # bucket block: "<type-name> <name> { ... }"
             tname = take()
             bname = take()
-            bid, alg, items, weights = _parse_bucket(take, resolve_item)
+            bid, alg, items, weights = _parse_bucket(take, peek,
+                                                     resolve_item)
             btype = type_ids.get(tname)
             if btype is None:
                 # type used before declaration: allocate one
@@ -292,13 +293,12 @@ def compile_text(text: str) -> cmap.CrushMap:
     return cm
 
 
-def _parse_bucket(take, resolve_item
+def _parse_bucket(take, peek, resolve_item
                   ) -> Tuple[Optional[int], int, List[int], List[int]]:
     take("{")
     bid: Optional[int] = None
     alg = cmap.ALG_STRAW2
-    items: List[int] = []
-    weights: List[int] = []
+    entries: List[Tuple[int, int, int]] = []  # (pos or -1, item, weight)
     while (tok := take()) != "}":
         if tok == "id":
             val = take()
@@ -314,14 +314,36 @@ def _parse_bucket(take, resolve_item
             name = take()
             item = resolve_item(name)
             w = 0x10000
-            if take() == "weight":
+            pos = -1
+            # weight/pos are optional per the reference CrushCompiler
+            # grammar ("item osd.N" alone is legal) — peek, don't eat
+            if peek() == "weight":
+                take()
                 w = _f_to_w(take())
-            items.append(item)
-            weights.append(w)
+            if peek() == "pos":
+                take()
+                pos = int(take())
+            entries.append((pos, item, w))
         elif tok == "weight":  # bucket-level weight comment form
             take()
         else:
             raise CompileError(f"unexpected bucket token {tok!r}")
+    # honor explicit positions (item order feeds CRUSH placement —
+    # reference CrushCompiler parse_bucket item_id/pos bookkeeping):
+    # positioned items claim their slot, the rest fill gaps in file order
+    n = len(entries)
+    slots: List[Optional[Tuple[int, int]]] = [None] * n
+    for pos, item, w in entries:
+        if pos >= 0:
+            if pos >= n or slots[pos] is not None:
+                raise CompileError(f"bad item pos {pos}")
+            slots[pos] = (item, w)
+    free = iter([i for i in range(n) if slots[i] is None])
+    for pos, item, w in entries:
+        if pos < 0:
+            slots[next(free)] = (item, w)
+    items = [s[0] for s in slots]  # type: ignore[index]
+    weights = [s[1] for s in slots]  # type: ignore[index]
     return bid, alg, items, weights
 
 

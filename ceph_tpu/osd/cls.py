@@ -247,4 +247,31 @@ def _register_builtins(h: ClassHandler) -> None:
         ctx.omap_set({key: str(cur + 1).encode()})
         return str(cur + 1).encode()
 
+    def counter_get(ctx: MethodContext, indata: bytes) -> bytes:
+        key = (indata.decode() or "seq")
+        try:
+            cur = (int(ctx.omap_get([key]).get(key, b"0"))
+                   if ctx.exists else 0)
+        except ValueError:
+            raise ClsError(-22, f"counter {key!r} holds a non-number")
+        return str(cur).encode()
+
+    def counter_max(ctx: MethodContext, indata: bytes) -> bytes:
+        # "key value": atomically raise the counter to value (monotonic
+        # watermark — commit positions, applied-up-to markers).
+        # Malformed input must surface as EINVAL, not an escaped
+        # exception (which would leave the client op unanswered).
+        try:
+            key, val = indata.decode().split(" ", 1)
+            want = int(val)
+            cur = (int(ctx.omap_get([key]).get(key, b"0"))
+                   if ctx.exists else 0)
+        except (ValueError, UnicodeDecodeError):
+            raise ClsError(-22, "counter.max wants 'key <int>'")
+        new = max(cur, want)
+        ctx.omap_set({key: str(new).encode()})
+        return str(new).encode()
+
     h.register("counter", "alloc", CLS_RD | CLS_WR, counter_alloc)
+    h.register("counter", "get", CLS_RD, counter_get)
+    h.register("counter", "max", CLS_RD | CLS_WR, counter_max)

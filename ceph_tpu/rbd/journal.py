@@ -42,49 +42,42 @@ class Journaler:
         self.meta_oid = f"journal.{name}"
 
     # -- metadata ----------------------------------------------------------
-    def _meta(self) -> Dict[str, int]:
-        try:
-            raw = self.io.read(self.meta_oid)
-            return json.loads(raw.decode()) if raw else {}
-        except RadosError:
-            return {}
-
-    def _set_meta(self, meta: Dict[str, int]) -> None:
-        self.io.write_full(self.meta_oid, json.dumps(meta).encode())
+    # Every meta field lives in atomic in-PG cls counters on the meta
+    # object: seq minting is counter.alloc, head/commit are monotonic
+    # counter.max watermarks.  No read-modify-write anywhere, so
+    # concurrent appenders/committers (journaling is not gated on the
+    # image exclusive lock) can neither mint duplicate seqs nor regress
+    # head/commit and hide durable entries from replay.
 
     def create(self) -> None:
-        if not self._meta():
-            self._set_meta({"commit": 0, "head": 0})
+        self.io.call(self.meta_oid, "counter", "max", b"commit 0")
 
     def head(self) -> int:
-        return self._meta().get("head", 0)
+        return int(self.io.call(self.meta_oid, "counter", "get", b"jseq"))
 
     def committed(self) -> int:
-        return self._meta().get("commit", 0)
+        return int(self.io.call(self.meta_oid, "counter", "get", b"commit"))
 
     def _data_oid(self, seq: int) -> str:
         return f"journal_data.{self.name}.{seq % self.splay}"
 
     # -- write side --------------------------------------------------------
     def append(self, payload: bytes) -> int:
-        """Durably append one entry; returns its seq.  The entry frame
-        lands in the data object BEFORE head advances, so a torn append
-        is invisible (head never points past a full frame)."""
-        meta = self._meta()
-        seq = meta.get("head", 0) + 1
+        """Durably append one entry; returns its seq.  head() (= the
+        seq counter) may briefly run ahead of a mid-flight frame, so
+        readers tolerate a not-yet-durable tail: entries() scans frames
+        and simply doesn't see seqs whose frame hasn't landed; the crc
+        guards torn tails."""
+        seq = int(self.io.call(self.meta_oid, "counter", "alloc", b"jseq"))
         frame = _FRAME.pack(seq, len(payload), crc32c(payload)) + payload
         self.io.append(self._data_oid(seq), frame)
-        meta["head"] = seq
-        meta.setdefault("commit", 0)
-        self._set_meta(meta)
         return seq
 
     def commit(self, seq: int) -> None:
-        """Advance the commit position (events <= seq are applied)."""
-        meta = self._meta()
-        if seq > meta.get("commit", 0):
-            meta["commit"] = seq
-            self._set_meta(meta)
+        """Advance the commit position (events <= seq are applied);
+        atomic monotonic max, never a regression."""
+        self.io.call(self.meta_oid, "counter", "max",
+                     f"commit {seq}".encode())
 
     # -- read side ---------------------------------------------------------
     def _entries_of(self, oid: str) -> List[Tuple[int, bytes]]:

@@ -214,9 +214,23 @@ class BitmapAllocator:
 
 class BlockStore(ObjectStore):
     def __init__(self, path: str, compression: str | None = None,
-                 device_blocks: int = 1024) -> None:
+                 device_blocks: int = 1024, o_sync: bool = False,
+                 kv_kind: str = "log") -> None:
         self.path = path
-        self._kv = LogKV(os.path.join(path, "meta.kv"))
+        # o_sync=True gives BlueStore's full fsync discipline (data
+        # durably on media before the KV commit that references it —
+        # survives OS crash/power loss).  The default False only
+        # flushes userspace buffers: data-before-metadata ordering
+        # holds across PROCESS crash but not power loss.
+        self._o_sync = o_sync
+        if kv_kind == "lsm":
+            # spill-to-disk metadata: onode/blob tables can exceed RAM
+            # (the BlueStore-over-RocksDB pairing)
+            from ceph_tpu.store.lsm import LSMStore
+
+            self._kv = LSMStore(os.path.join(path, "meta.lsm"))
+        else:
+            self._kv = LogKV(os.path.join(path, "meta.kv"))
         self._dev_path = os.path.join(path, "block")
         self._dev_fh = None
         self._lock = threading.RLock()
@@ -374,8 +388,11 @@ class BlockStore(ObjectStore):
                 self._alloc_rollback(ctx)
                 raise
             # BlueStore commit order: data pages reach the device before
-            # the metadata batch that references them
+            # the metadata batch that references them (fsync only under
+            # o_sync — see __init__ for the exact guarantee)
             self._dev_fh.flush()
+            if self._o_sync:
+                os.fsync(self._dev_fh.fileno())
             for key in ctx.dirty_onodes:
                 on = self._onodes.get(key)
                 if on is None:
@@ -392,7 +409,7 @@ class BlockStore(ObjectStore):
             batch.set(P_META, "next_blob", str(self._next_blob).encode())
             batch.set(P_META, "blocks",
                       str(self._alloc.nblocks()).encode())
-            self._kv.submit(batch)
+            self._kv.submit(batch, sync=self._o_sync)
             # deferred release: freed blocks rejoin the allocator only
             # after the commit that stops referencing them is durable
             self._alloc.release(ctx.deferred_free)
