@@ -1003,6 +1003,27 @@ class Monitor(Dispatcher):
                         "utilization": round(used / total, 4)
                         if total else 0.0})
                 return 0, {"nodes": rows}
+        if prefix in ("pg scrub", "pg deep-scrub", "pg repair"):
+            # relay to the PG's primary OSD (the reference mon builds an
+            # MOSDScrub for `ceph pg repair`, src/mon/MonCmds.h) — the
+            # actual scrub/repair runs there asynchronously
+            try:
+                pool_id, ps = (int(x) for x in str(cmd["pgid"]).split("."))
+            except (KeyError, ValueError):
+                return -22, {"error": "need pgid as <pool>.<ps>"}
+            with self.lock:
+                if self.osdmap is None:
+                    return -2, {"error": "no osdmap"}
+                _, _, _, primary = self.osdmap.pg_to_up_acting(
+                    (pool_id, ps))
+                addr = self.osdmap.osd_addrs.get(primary)
+            if primary < 0 or not addr:
+                return -11, {"error": "pg has no live primary"}
+            action = "repair" if prefix == "pg repair" else "scrub"
+            from ceph_tpu.osd import messages as om
+            self.msgr.send_message(
+                om.MPGCommand((pool_id, ps), 0, action), tuple(addr))
+            return 0, {"instructed": f"osd.{primary}", "action": action}
         if prefix == "pg dump":
             with self.lock:
                 # primary-reported rows win; replicas fill gaps

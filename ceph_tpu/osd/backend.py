@@ -450,7 +450,13 @@ class ECBackend(PGBackend):
         g = GHObject(oid, shard=shard)
         if not self.store.exists(self.coll, g):
             return None
-        data = self.store.read(self.coll, g)
+        try:
+            data = self.store.read(self.coll, g)
+        except Exception:
+            # at-rest corruption surfaced by the store itself (BlockStore
+            # crc32c-at-rest raises): the shard reads as missing and is
+            # reconstructed / repaired from its peers
+            return None
         # verify the stored crc before serving (handle_sub_read's
         # HashInfo check, ECBackend.cc:955); overwritten chunks carry an
         # invalidated crc and are vetted by scrub's parity check instead

@@ -539,6 +539,40 @@ class OSDService(Dispatcher):
             if w:
                 w.add(msg)
             return True
+        if isinstance(msg, m.MPGCommand):
+            # operator maintenance (`ceph pg scrub|repair` relayed by
+            # the mon — reference MOSDScrub): runs on its own thread;
+            # scrub/repair issue blocking peer RPCs and must not hold
+            # the dispatch loop
+            pg = self.pgs.get(msg.pgid)
+            # one maintenance op per PG at a time (the reference gates
+            # via the scrub reservation): a re-issued `pg repair` while
+            # one is mid-flight is dropped, not stacked.  Every drop is
+            # logged — the mon already told the operator "instructed",
+            # so a silent drop here would vanish without a trace.
+            if pg is None or not pg.is_primary():
+                self._log(1, f"pg {msg.pgid} {msg.action}: not primary "
+                             "here (stale mon map?) — dropped")
+                return True
+            if not pg.maintenance_guard.acquire(blocking=False):
+                self._log(1, f"pg {msg.pgid} {msg.action}: already "
+                             "running — dropped")
+                return True
+
+            def run(pg=pg, action=msg.action) -> None:
+                try:
+                    if action == "repair":
+                        pg.repair()
+                    else:
+                        pg.scrub()
+                except Exception as e:
+                    self._log(1, f"pg {pg.pgid} {action} failed: {e!r}")
+                finally:
+                    pg.maintenance_guard.release()
+
+            threading.Thread(target=run, name=f"pg-{msg.action}",
+                             daemon=True).start()
+            return True
         if isinstance(msg, m.MOSDOp):
             split_e = self._pool_split_epoch.get(msg.pgid[0], 0)
             if split_e and getattr(msg, "epoch", 0) < split_e:
@@ -620,8 +654,9 @@ class OSDService(Dispatcher):
             elif isinstance(msg, m.MPGQuery):
                 pg.handle_query(msg, conn)
             elif isinstance(msg, m.MScrub):
+                digests, unreadable = pg.local_scrub_map()
                 rep = m.MScrubMap(msg.pgid, self.epoch(),
-                                  pg.local_scrub_map())
+                                  digests, unreadable)
                 rep.tid = msg.tid
                 conn.send(rep)
             return True
@@ -849,28 +884,41 @@ class OSDService(Dispatcher):
         (skipping backfill deletions on a lost reply resurrects data)."""
         reps = self._rpc([(osd_id, m.MScrub(pg.pgid, self.epoch()))])
         if reps and isinstance(reps[0], m.MScrubMap):
-            return set(reps[0].digests)
+            return set(reps[0].digests) | set(reps[0].unreadable)
         return None
 
     def collect_scrub_maps(self, pg: PG) -> Dict[int, Dict[str, int]]:
+        """{osd: {oid: digest}} with store-unreadable objects merged in
+        as SCRUB_UNREADABLE sentinels (exists, but never authoritative)."""
+        from ceph_tpu.osd.pg import SCRUB_UNREADABLE
+
         peers = [o for o in set(pg.acting)
                  if o not in (self.whoami, 0x7FFFFFFF) and o >= 0]
-        out = {self.whoami: pg.local_scrub_map()}
+        digests, unreadable = pg.local_scrub_map()
+        digests.update({o: SCRUB_UNREADABLE for o in unreadable})
+        out = {self.whoami: digests}
         if peers:
             reps = self._rpc([(p, m.MScrub(pg.pgid, self.epoch()))
                               for p in peers])
             for rep in reps:
                 if isinstance(rep, m.MScrubMap):
-                    out[self._osd_of(rep)] = rep.digests
+                    dm = dict(rep.digests)
+                    dm.update({o: SCRUB_UNREADABLE
+                               for o in rep.unreadable})
+                    out[self._osd_of(rep)] = dm
         return out
 
-    def fetch_remote_chunk(self, pg: PG, osd_id: int, shard: int,
-                           oid: str) -> Optional[bytes]:
+    def fetch_remote_chunk_full(self, pg: PG, osd_id: int, shard: int,
+                                oid: str):
+        """(data, attrs, omap) of a remote shard, or None — the shard's
+        metadata rides the read reply so scrub/repair never depend on
+        the primary holding a local shard (reference handle_sub_read
+        returns attrs, ECBackend.cc:955)."""
         reps = self._rpc([(osd_id, m.MECSubRead(pg.pgid, self.epoch(),
                                                 shard, oid, 0, 0))])
         for rep in reps:
             if isinstance(rep, m.MECSubReadReply) and rep.result == 0:
-                return rep.data
+                return rep.data, dict(rep.attrs), dict(rep.omap)
         return None
 
 

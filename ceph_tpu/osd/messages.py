@@ -494,18 +494,28 @@ class MScrubMap(_PGMessage):
     TYPE = 25
 
     def __init__(self, pgid=(0, 0), epoch=0,
-                 digests: Optional[Dict[str, int]] = None) -> None:
+                 digests: Optional[Dict[str, int]] = None,
+                 unreadable: Optional[List[str]] = None) -> None:
         super().__init__(pgid, epoch)
         self.digests = digests or {}
+        # objects present but the store refused the read (at-rest csum
+        # failure): distinct from absent — they vote "exists" during
+        # repair auth selection but can never be authoritative
+        self.unreadable = unreadable or []
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
         e.mapping(self.digests, lambda enc, k: enc.string(k),
                   lambda enc, v: enc.u32(v))
+        e.seq(self.unreadable, lambda enc, s: enc.string(s))
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.digests = d.mapping(lambda dd: dd.string(), lambda dd: dd.u32())
+        if d.remaining_in_frame():
+            self.unreadable = d.seq(lambda dd: dd.string())
+        else:
+            self.unreadable = []
 
 
 @register
@@ -564,3 +574,24 @@ class MWatchNotifyAck(_PGMessage):
         self.notify_id = d.u64()
         self.cookie = d.u64()
         self.reply = d.blob()
+
+
+@register
+class MPGCommand(_PGMessage):
+    """mon/operator -> primary OSD: run a maintenance action on one PG
+    ("scrub" | "repair" — the reference's MOSDScrub instructing the
+    primary, src/messages/MOSDScrub.h, issued by `ceph pg repair`)."""
+
+    TYPE = 41
+
+    def __init__(self, pgid=(0, 0), epoch=0, action: str = "scrub") -> None:
+        super().__init__(pgid, epoch)
+        self.action = action
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.string(self.action)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.action = d.string()

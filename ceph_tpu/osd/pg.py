@@ -42,6 +42,11 @@ from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp, PGId, PGInfo
 from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
 
 EPERM, ENOENT, EIO, EAGAIN, EINVAL = -1, -2, -5, -11, -22
+# sentinel digest in merged scrub maps: the object exists on that osd
+# but its store refused the read (at-rest corruption) — votes "exists"
+# for repair auth selection, can never be authoritative (real crc32c
+# digests are u32 >= 0, so -1 cannot collide)
+SCRUB_UNREADABLE = -1
 # "I'm not the primary" — a *retryable* mistargeting signal, distinct
 # from EPERM op failures (e.g. exclusive create) the client must surface
 ESTALE = -116
@@ -67,6 +72,9 @@ class PG:
 
         self.lock = make_lock(
             f"osd{osd.whoami}.pg{t_.pgid_str(pgid)}")
+        # serializes operator scrub/repair (the reference's scrub
+        # reservation role): acquired non-blocking by MPGCommand
+        self.maintenance_guard = threading.Lock()
         self.missing: Dict[str, EVersion] = {}  # objects this osd lacks
         self.peer_info: Dict[int, PGInfo] = {}
         # reqid -> committed version: completed-op replay so client
@@ -367,6 +375,19 @@ class PG:
         self.hit_set.encode(e)
         key = f"hitset_{now:.6f}"
         self._persist_meta(extra_omap={key: e.bytes()})
+        # trim aged archives beyond the kept ring in the same meta
+        # object (reference hit_set_trim) so PG meta omap stays bounded
+        # on hot pools
+        g = GHObject("_pgmeta_")
+        if self.osd.store.exists(self.coll, g):
+            rows = sorted(k for k in self.osd.store.omap_get(self.coll, g)
+                          if k.startswith("hitset_"))
+            stale = rows[:-self.pool.hit_set_count] \
+                if len(rows) > self.pool.hit_set_count else []
+            if stale:
+                t = Transaction()
+                t.omap_rmkeys(self.coll, g, stale)
+                self.osd.store.queue_transaction(t)
         self.hit_set = None
 
     def load_hit_set_history(self) -> None:
@@ -1421,41 +1442,60 @@ class PG:
         for oid in sorted(all_oids):
             digests = {o: dm.get(oid) for o, dm in maps.items()}
             vals = set(digests.values())
-            if len(vals) > 1:
+            # every copy unreadable is the WORST case, not a clean one
+            if len(vals) > 1 or vals == {SCRUB_UNREADABLE}:
                 errors[oid] = [
                     f"osd.{o}: digest "
-                    f"{'missing' if d is None else hex(d)}"
+                    + ("missing" if d is None
+                       else "unreadable" if d == SCRUB_UNREADABLE
+                       else hex(d))
                     for o, d in sorted(digests.items())
                 ]
 
+    def _ec_gather(self, oid: str):
+        """(avail chunks, per-shard (attrs, omap) metas, lost shards)
+        across the acting set; remote shard metadata rides the read
+        replies, so nothing here depends on the primary holding a
+        local shard."""
+        be: ECBackend = self.backend  # type: ignore[assignment]
+        n = be.k + be.m
+        acting = list(self.acting[:n])
+        avail: Dict[int, bytes] = {}
+        metas: Dict[int, Tuple[Dict[str, bytes], Dict[str, bytes]]] = {}
+        lost: List[int] = []
+        for shard, osd_id in enumerate(acting):
+            if osd_id in (CRUSH_ITEM_NONE, -1):
+                continue
+            if osd_id == self.osd.whoami:
+                c = be.read_local_chunk(oid, shard)
+                if c is None:
+                    lost.append(shard)
+                else:
+                    avail[shard] = c
+                    metas[shard] = be.shard_meta(oid, shard)
+            else:
+                full = self.osd.fetch_remote_chunk_full(
+                    self, osd_id, shard, oid)
+                if full is None:
+                    lost.append(shard)
+                else:
+                    avail[shard] = full[0]
+                    metas[shard] = (full[1], full[2])
+        return avail, metas, lost
+
     def _scrub_ec(self, errors) -> None:
         be: ECBackend = self.backend  # type: ignore[assignment]
+        n = be.k + be.m
+        acting = list(self.acting[:n])
         for oid in be.object_names():
-            bad: List[str] = []
-            n = be.k + be.m
-            acting = list(self.acting[:n])
-            avail: Dict[int, bytes] = {}
-            for shard, osd_id in enumerate(acting):
-                if osd_id in (CRUSH_ITEM_NONE, -1):
-                    continue
-                if osd_id == self.osd.whoami:
-                    c = be.read_local_chunk(oid, shard)
-                    if c is None:
-                        bad.append(f"shard {shard} (osd.{osd_id}): "
-                                   "missing or crc mismatch")
-                    else:
-                        avail[shard] = c
-                else:
-                    c = self.osd.fetch_remote_chunk(self, osd_id, shard, oid)
-                    if c is None:
-                        bad.append(f"shard {shard} (osd.{osd_id}): "
-                                   "missing or crc mismatch")
-                    else:
-                        avail[shard] = c
+            avail, metas, lost = self._ec_gather(oid)
+            bad = [f"shard {s} (osd.{acting[s]}): missing or crc mismatch"
+                   for s in lost]
             # deep-scrub analog: decode from k and re-encode to verify
             # parity consistency
             if len(avail) >= be.k and not bad:
-                st = be.reconstruct(oid, avail)
+                st = be.reconstruct(oid, avail,
+                                    meta=metas[min(avail)])
                 if st is not None:
                     chunks, _ = be._encode_object(st.data)
                     for shard, have in avail.items():
@@ -1464,20 +1504,250 @@ class PG:
             if bad:
                 errors[oid] = bad
 
-    def local_scrub_map(self) -> Dict[str, int]:
-        """oid -> digest of (data, xattrs, omap) on this osd."""
+    # -- scrub repair (reference repair/auto_repair scrub mode,
+    # src/osd/PG.cc:5042, PG.h:1586,1591) -------------------------------
+    def repair(self) -> Dict[str, List[str]]:
+        """Scrub, rewrite divergent replicas/shards from the
+        authoritative copy, re-scrub to verify.  Returns the POST-repair
+        scrub errors (empty = everything repaired clean)."""
+        with self.lock:
+            assert self.is_primary(), "repair runs on the primary"
+        if self.is_ec():
+            self._repair_ec()
+        else:
+            self._repair_replicated()
+        return self.scrub()
+
+    def _repair_replicated(self) -> None:
+        """Authoritative state = majority vote over every copy's
+        observation — a real digest, "absent" (None: a missed delete is
+        a legitimate winner; resurrecting deleted objects from one
+        stale copy is the classic repair bug), or "unreadable"
+        (SCRUB_UNREADABLE: votes exists, never wins).  Digest ties
+        prefer the primary's copy; a tie between "absent" and a digest
+        is ambiguous and skipped.  The primary repairs itself first
+        (pull from an authoritative peer), then pushes to every
+        divergent peer (reference auth-selection + repair shape,
+        PrimaryLogPG::_scrub / PG.cc:5042)."""
+        from collections import Counter
+
+        maps = self.osd.collect_scrub_maps(self)
+        all_oids = set()
+        for dm in maps.values():
+            all_oids |= set(dm)
+        for oid in sorted(all_oids):
+            digests = {o: dm.get(oid) for o, dm in maps.items()}
+            if len(set(digests.values())) <= 1:
+                continue
+            # candidates: real digests and "absent"; unreadable copies
+            # vote for repair-needed but can never be authoritative
+            counts = Counter(d for d in digests.values()
+                             if d != SCRUB_UNREADABLE)
+            if not counts:
+                continue  # unreadable everywhere: unrepairable
+            top = counts.most_common(1)[0][1]
+            tied = [d for d, c in counts.items() if c == top]
+            if None in tied:
+                if len(tied) > 1:
+                    continue  # absent vs digest dead heat: refuse
+                auth_digest = None
+            else:
+                mine = digests.get(self.osd.whoami)
+                auth_digest = (mine if mine in tied
+                               else sorted(tied)[0])
+            divergent = sorted(o for o, d in digests.items()
+                               if d != auth_digest)
+            if auth_digest is None:
+                self._repair_to_deleted(oid, divergent, digests)
+                continue
+            auth_osds = sorted(o for o, d in digests.items()
+                               if d == auth_digest)
+            if self.osd.whoami in divergent:
+                # heal the primary first: ask an authoritative peer to
+                # push its copy to us (the MPGPull recovery channel) —
+                # UNLOCKED, our own handle_push needs the PG lock
+                self._obc_invalidate(oid)
+                self.osd.rpc([(auth_osds[0], m.MPGPull(
+                    self.pgid, self.osd.epoch(), [oid]))], timeout=30.0)
+            with self.lock:
+                # serialize write-back against the client op path
+                # (reference write_blocked_by_scrub): a client write
+                # since the scrub maps were collected changes the local
+                # digest -> skip, the next scrub re-judges
+                if self._local_object_digest(oid) != auth_digest:
+                    continue
+                for osd_id in divergent:
+                    if osd_id != self.osd.whoami:
+                        self.push_object(oid, osd_id)
+
+    def _repair_to_deleted(self, oid: str, holders: List[int],
+                           observed: Dict[int, Optional[int]]) -> None:
+        """Majority says the object does not exist: remove the stale
+        copies (the anti-resurrection half of repair)."""
+        with self.lock:
+            # all client writes route through this primary: if OUR state
+            # moved since the scrub maps were collected, a write/create
+            # raced the repair and the deletion vote is stale
+            if self._local_object_digest(oid) != \
+                    observed.get(self.osd.whoami):
+                return
+            for osd_id in holders:
+                if osd_id == self.osd.whoami:
+                    self._obc_invalidate(oid)
+                    t = Transaction()
+                    t.try_remove(self.coll, GHObject(oid))
+                    self.osd.store.queue_transaction(t)
+                else:
+                    self.osd.rpc([(osd_id, m.MPGPush(
+                        self.pgid, self.osd.epoch(), oid, self.log.head,
+                        deleted=True, shard=-1))], timeout=30.0)
+
+    def _repair_ec(self) -> None:
+        be: ECBackend = self.backend  # type: ignore[assignment]
+        n = be.k + be.m
+        for oid in be.object_names():
+            # the whole per-object gather->consensus->write-back runs
+            # under the PG lock so client writes (which take it in
+            # _do_write) cannot interleave and leave a mixed-generation
+            # stripe (reference write_blocked_by_scrub; peers answer
+            # sub-reads/pushes without taking THEIR primary-side lock,
+            # so holding ours across the RPCs cannot deadlock — scrub
+            # already relies on this)
+            with self.lock:
+                acting = list(self.acting[:n])
+                avail, metas, lost = self._ec_gather(oid)
+                state, inconsistent = self._ec_consensus(oid, avail, metas)
+                if state is None:
+                    continue  # clean PG has nothing in `lost` either
+                bad = sorted(set(lost) | inconsistent)
+                if not bad:
+                    continue
+                chunks, _ = be._encode_object(state.data)
+                for shard in bad:
+                    osd_id = acting[shard]
+                    if osd_id in (CRUSH_ITEM_NONE, -1):
+                        continue
+                    self._write_repaired_shard(oid, shard, osd_id,
+                                               chunks[shard], state)
+
+    def _ec_consensus(self, oid: str, avail: Dict[int, bytes],
+                      metas: Dict[int, Tuple[Dict[str, bytes],
+                                             Dict[str, bytes]]]
+                      ) -> Tuple[Optional[ObjectState], set]:
+        """Decode + re-encode to find shards inconsistent with the
+        consensus content.
+
+        A corrupt-but-crc-valid shard inside the decode set poisons the
+        decode: the re-encode then reproduces the corrupt inputs
+        exactly and mismatches the HEALTHY shards instead, so the raw
+        mismatch set of any single decode cannot be trusted.  Instead
+        every leave-one-out decode proposes an explanation, and the one
+        consistent with the MOST shards wins (for one bad shard, the
+        true explanation keeps len-1 shards consistent; every poisoned
+        one keeps <= len-m).  Ambiguity — tied explanations, as with
+        m=1 parity where content alone cannot say which side is wrong —
+        refuses rather than guesses."""
+        be: ECBackend = self.backend  # type: ignore[assignment]
+        ids = sorted(avail)
+        if len(ids) < be.k:
+            return None, set()
+
+        def check(subset):
+            # meta (size/attrs) from a shard inside the hypothesis's
+            # trusted subset, NOT the primary's local shard
+            st = be.reconstruct(oid, {i: avail[i] for i in subset},
+                                meta=metas[subset[0]])
+            if st is None:
+                return None, set()
+            enc, _ = be._encode_object(st.data)
+            return st, {s for s in ids if enc[s][: len(avail[s])]
+                        != avail[s]}
+
+        st, mism = check(ids[: be.k])
+        if st is not None and not mism:
+            return st, set()
+        best = None  # (n_consistent, state, bad_set)
+        ambiguous = False
+        seen_subsets = {tuple(ids[: be.k])}
+        if st is not None and len(mism) <= be.m:
+            best = (len(ids) - len(mism), st, mism)
+        for x in ids:
+            rest = tuple([i for i in ids if i != x][: be.k])
+            if rest in seen_subsets:
+                continue  # x beyond the first k re-derives ids[:k]
+            seen_subsets.add(rest)
+            st2, mism2 = check(rest)
+            if st2 is None or len(mism2) > be.m:
+                continue
+            score = len(ids) - len(mism2)
+            if best is None or score > best[0]:
+                best = (score, st2, mism2)
+                ambiguous = False
+            elif score == best[0] and mism2 != best[2]:
+                ambiguous = True
+        if best is None or ambiguous:
+            return None, set()
+        return best[1], best[2]
+
+    def _write_repaired_shard(self, oid: str, shard: int, osd_id: int,
+                              chunk: bytes, state: ObjectState) -> None:
+        from ceph_tpu.osd.backend import _hinfo
+
+        self._obc_invalidate(oid)
+        if osd_id == self.osd.whoami:
+            g = GHObject(oid, shard=shard)
+            t = Transaction()
+            t.try_remove(self.coll, g)
+            t.touch(self.coll, g)
+            t.write(self.coll, g, 0, chunk)
+            attrs = dict(state.xattrs)
+            attrs["hinfo"] = _hinfo(chunk, len(state.data))
+            t.setattrs(self.coll, g, attrs)
+            if state.omap:
+                t.omap_setkeys(self.coll, g, state.omap)
+            self.osd.store.queue_transaction(t)
+            return
+        attrs = dict(state.xattrs)
+        attrs["_size_hint"] = len(state.data).to_bytes(8, "little")
+        self.osd.rpc([(osd_id, m.MPGPush(
+            self.pgid, self.osd.epoch(), oid, self.log.head,
+            chunk, attrs, dict(state.omap), shard=shard))], timeout=30.0)
+
+    def _local_object_digest(self, oid) -> Optional[int]:
+        """Digest of one local object's (data, xattrs, omap); None when
+        absent, SCRUB_UNREADABLE when the store refuses the read."""
+        g = oid if isinstance(oid, GHObject) else GHObject(oid)
+        if not self.osd.store.exists(self.coll, g):
+            return None
+        try:
+            data = self.osd.store.read(self.coll, g)
+        except Exception:
+            return SCRUB_UNREADABLE
+        d = crc32c(data)
+        for k in sorted(self.osd.store.getattrs(self.coll, g)):
+            d = crc32c(k.encode(), d)
+            d = crc32c(self.osd.store.getattr(self.coll, g, k), d)
+        om = self.osd.store.omap_get(self.coll, g)
+        for k in sorted(om):
+            d = crc32c(k.encode(), d)
+            d = crc32c(om[k], d)
+        return d
+
+    def local_scrub_map(self) -> Tuple[Dict[str, int], List[str]]:
+        """(oid -> digest of (data, xattrs, omap), [unreadable oids]).
+        An object the store itself refuses to read (at-rest csum
+        failure) lands in the unreadable list: it still votes "exists"
+        during repair auth selection but can never be authoritative —
+        and a PG where EVERY copy is unreadable scrubs inconsistent,
+        not clean."""
         out: Dict[str, int] = {}
+        unreadable: List[str] = []
         for o in self.osd.store.collection_list(self.coll):
             if o.name == "_pgmeta_":
                 continue
-            data = self.osd.store.read(self.coll, o)
-            d = crc32c(data)
-            for k in sorted(self.osd.store.getattrs(self.coll, o)):
-                d = crc32c(k.encode(), d)
-                d = crc32c(self.osd.store.getattr(self.coll, o, k), d)
-            om = self.osd.store.omap_get(self.coll, o)
-            for k in sorted(om):
-                d = crc32c(k.encode(), d)
-                d = crc32c(om[k], d)
-            out[o.name] = d
-        return out
+            d = self._local_object_digest(o)
+            if d == SCRUB_UNREADABLE:
+                unreadable.append(o.name)
+            elif d is not None:
+                out[o.name] = d
+        return out, unreadable

@@ -24,7 +24,9 @@ from ceph_tpu.store.memstore import MemStore
 N_OSDS = 6
 REP_POOL = 1
 EC_POOL = 2
+EC22_POOL = 3
 EC_PROFILE = "plugin=isa k=2 m=1 technique=reed_sol_van"
+EC22_PROFILE = "plugin=isa k=2 m=2 technique=reed_sol_van"
 
 
 def build_map() -> OSDMap:
@@ -37,19 +39,25 @@ def build_map() -> OSDMap:
     osdmap.add_pool(PGPool(EC_POOL, POOL_ERASURE, size=3, min_size=2,
                            pg_num=8, pgp_num=8, crush_rule=1,
                            erasure_code_profile=EC_PROFILE))
+    # m=2 pool: enough parity for content-consensus repair to identify
+    # a corrupt-but-crc-valid shard unambiguously (m=1 must refuse)
+    osdmap.add_pool(PGPool(EC22_POOL, POOL_ERASURE, size=4, min_size=3,
+                           pg_num=8, pgp_num=8, crush_rule=1,
+                           erasure_code_profile=EC22_PROFILE))
     return osdmap
 
 
 class MiniCluster:
     """N OSDService instances over memstores + one shared map."""
 
-    def __init__(self) -> None:
+    def __init__(self, store_factory=None) -> None:
         self.ctx = Context("osd.cluster")
         self.osdmap = build_map()
         self.osds = {}
         self.watchers = []  # clients notified on every map refresh
+        make_store = store_factory or (lambda i: MemStore())
         for i in range(N_OSDS):
-            svc = OSDService(self.ctx, i, MemStore(), self.osdmap,
+            svc = OSDService(self.ctx, i, make_store(i), self.osdmap,
                              codec_from_profile)
             svc.store.mkfs()
             svc.init()
@@ -267,6 +275,140 @@ def test_scrub_clean_and_detects_corruption(cluster, client):
     errors = pg.scrub()
     assert "eobj4" in errors
     assert any("crc" in e or "parity" in e for e in errors["eobj4"])
+
+
+def test_repair_ec_rewrites_corrupt_shard(cluster, client):
+    """Scrub-repair (reference repair scrub mode, src/osd/PG.cc:5042):
+    a byte-flipped EC shard is reconstructed via decode and rewritten
+    in place; post-repair scrub is clean and the shard holder's store
+    carries correct bytes again."""
+    from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
+
+    payload = b"repair-me" * 1000
+    client.put(EC_POOL, "eobj_rep", payload)
+    pgid, acting, primary = cluster.primary_of(EC_POOL, "eobj_rep")
+    pg = cluster.osds[primary].pgs[pgid]
+    assert pg.scrub().get("eobj_rep") is None
+
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    victim_shard = next(s for s, o in enumerate(acting)
+                        if o != primary and 0 <= o < N_OSDS)
+    victim = acting[victim_shard]
+    g = GHObject("eobj_rep", shard=victim_shard)
+    good = cluster.osds[victim].store.read(coll, g)
+    t = Transaction()
+    t.write(coll, g, 0, b"\xff" * 8)
+    cluster.osds[victim].store.queue_transaction(t)
+    assert "eobj_rep" in pg.scrub()
+
+    post = pg.repair()
+    assert post.get("eobj_rep") is None, post
+    assert cluster.osds[victim].store.read(coll, g) == good
+    assert client.get(EC_POOL, "eobj_rep") == payload
+
+
+def test_repair_ec_crc_valid_corruption_consensus(cluster, client):
+    """A shard corrupted WITH a forged matching hinfo passes the crc
+    gate and poisons any decode that includes it; repair's
+    leave-one-out consensus must still identify the true culprit (the
+    explanation consistent with the most shards) and rewrite only it —
+    not the healthy shards the poisoned decode disagrees with."""
+    from ceph_tpu.osd.backend import _hinfo
+    from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
+
+    payload = b"consensus" * 1000
+    client.put(EC22_POOL, "epoison", payload)
+    pgid, acting, primary = cluster.primary_of(EC22_POOL, "epoison")
+    pg = cluster.osds[primary].pgs[pgid]
+    assert pg.scrub().get("epoison") is None
+
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    victim_shard = 0  # a DATA shard, inside the canonical decode set
+    victim = acting[victim_shard]
+    g = GHObject("epoison", shard=victim_shard)
+    store = cluster.osds[victim].store
+    good = store.read(coll, g)
+    evil = bytes(b ^ 0x5A for b in good)
+    t = Transaction()
+    t.write(coll, g, 0, evil)
+    t.setattrs(coll, g, {"hinfo": _hinfo(evil, len(payload))})
+    store.queue_transaction(t)
+
+    assert "epoison" in pg.scrub()
+    post = pg.repair()
+    assert post.get("epoison") is None, post
+    assert store.read(coll, g) == good
+    # the healthy shards were left alone and the object reads clean
+    assert client.get(EC22_POOL, "epoison") == payload
+
+
+def test_repair_ec_m1_parity_ambiguity_refuses(cluster, client):
+    """With m=1 a crc-valid corruption is content-ambiguous (any 2 of
+    3 shards are a consistent codeword): repair must refuse to guess
+    rather than rewrite a possibly-healthy shard."""
+    from ceph_tpu.osd.backend import _hinfo
+    from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
+
+    payload = b"ambiguous" * 900
+    client.put(EC_POOL, "eambig", payload)
+    pgid, acting, primary = cluster.primary_of(EC_POOL, "eambig")
+    pg = cluster.osds[primary].pgs[pgid]
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    victim = acting[0]
+    g = GHObject("eambig", shard=0)
+    store = cluster.osds[victim].store
+    good = store.read(coll, g)
+    evil = bytes(b ^ 0x5A for b in good)
+    t = Transaction()
+    t.write(coll, g, 0, evil)
+    t.setattrs(coll, g, {"hinfo": _hinfo(evil, len(payload))})
+    store.queue_transaction(t)
+
+    assert "eambig" in pg.scrub()
+    post = pg.repair()
+    assert "eambig" in post  # still inconsistent: refused, not guessed
+    # no healthy shard was clobbered
+    for s in (1, 2):
+        holder = acting[s]
+        chunk = cluster.osds[holder].pgs[pgid].backend.read_local_chunk(
+            "eambig", s)
+        assert chunk is not None
+    # restore so later tests see a clean pool
+    t = Transaction()
+    t.write(coll, g, 0, good)
+    t.setattrs(coll, g, {"hinfo": _hinfo(good, len(payload))})
+    store.queue_transaction(t)
+    assert pg.scrub().get("eambig") is None
+
+
+def test_repair_replicated_majority_wins(cluster, client):
+    """A divergent replica is overwritten from the majority copy; a
+    divergent PRIMARY heals itself from an authoritative peer first."""
+    from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
+
+    payload = b"authoritative" * 500
+    client.put(REP_POOL, "robj_rep", payload)
+    pgid, acting, primary = cluster.primary_of(REP_POOL, "robj_rep")
+    pg = cluster.osds[primary].pgs[pgid]
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    g = GHObject("robj_rep")
+
+    replica = next(o for o in acting if o != primary and 0 <= o < N_OSDS)
+    t = Transaction()
+    t.write(coll, g, 0, b"ROT")
+    cluster.osds[replica].store.queue_transaction(t)
+    assert "robj_rep" in pg.scrub()
+    assert pg.repair().get("robj_rep") is None
+    assert cluster.osds[replica].store.read(coll, g) == payload
+
+    # now corrupt the PRIMARY's copy: majority = the two replicas
+    t = Transaction()
+    t.write(coll, g, 0, b"BADPRIMARY")
+    cluster.osds[primary].store.queue_transaction(t)
+    assert "robj_rep" in pg.scrub()
+    assert pg.repair().get("robj_rep") is None
+    assert cluster.osds[primary].store.read(coll, g) == payload
+    assert client.get(REP_POOL, "robj_rep") == payload
 
 
 def test_delete_propagates(cluster, client):
