@@ -1,0 +1,139 @@
+"""RGW HTTP frontend end-to-end: a SigV4-signing client speaks real
+HTTP to a real listening socket backed by a real mini-cluster
+(reference: rgw_asio_frontend.cc + the S3 REST surface of
+rgw_rest_s3.cc; auth completion rgw_rest_s3.cc:938)."""
+
+import json
+
+import pytest
+
+from ceph_tpu.rgw.frontend import RGWFrontend, SigV4Session
+
+
+@pytest.fixture(scope="module")
+def stack():
+    from ceph_tpu.vstart import VStartCluster
+
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        pool = c.create_pool("rgw", size=2)
+        io_ = c.client().ioctx(pool)
+        fe = RGWFrontend(io_).start()
+        user = fe.users.user_create("alice", "Alice")
+        sess = SigV4Session(fe.addr, user["access_key"],
+                            user["secret_key"])
+        yield fe, sess, user
+        fe.stop()
+
+
+def test_bucket_lifecycle_over_http(stack):
+    fe, s, _ = stack
+    assert s.request("PUT", "/mybucket")[0] == 200
+    code, _, body = s.request("GET", "/")
+    assert code == 200 and b"<Name>mybucket</Name>" in body
+    # duplicate create is a clean 409
+    assert s.request("PUT", "/mybucket")[0] == 409
+
+
+def test_object_roundtrip_over_http(stack):
+    fe, s, _ = stack
+    s.request("PUT", "/data")
+    payload = b"hello over real http" * 100
+    code, hdrs, _ = s.request("PUT", "/data/greeting.txt", body=payload,
+                              headers={"x-amz-meta-color": "blue"})
+    assert code == 200 and hdrs.get("ETag")
+    code, hdrs, body = s.request("GET", "/data/greeting.txt")
+    assert code == 200 and body == payload
+    assert hdrs.get("x-amz-meta-color") == "blue"
+    code, hdrs, _ = s.request("HEAD", "/data/greeting.txt")
+    assert code == 200 and int(hdrs["Content-Length"]) == len(payload)
+    # listing
+    code, _, body = s.request("GET", "/data", query="prefix=greet")
+    assert code == 200 and b"greeting.txt" in body
+    # delete -> 404 afterwards
+    assert s.request("DELETE", "/data/greeting.txt")[0] == 204
+    assert s.request("GET", "/data/greeting.txt")[0] == 404
+
+
+def test_multipart_over_http(stack):
+    fe, s, _ = stack
+    s.request("PUT", "/mp")
+    code, _, body = s.request("POST", "/mp/big.bin", query="uploads")
+    assert code == 200
+    upload_id = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0]
+    uid = upload_id.decode()
+    p1, p2 = b"A" * 70000, b"B" * 30000
+    assert s.request("PUT", "/mp/big.bin", body=p1,
+                     query=f"partNumber=1&uploadId={uid}")[0] == 200
+    assert s.request("PUT", "/mp/big.bin", body=p2,
+                     query=f"partNumber=2&uploadId={uid}")[0] == 200
+    code, _, body = s.request("POST", "/mp/big.bin",
+                              query=f"uploadId={uid}")
+    assert code == 200 and b"-2" in body  # N-part etag
+    code, _, body = s.request("GET", "/mp/big.bin")
+    assert code == 200 and body == p1 + p2
+
+
+def test_auth_rejections(stack):
+    fe, s, user = stack
+    # wrong secret -> SignatureDoesNotMatch
+    bad = SigV4Session(fe.addr, user["access_key"], "wrong-secret")
+    code, _, body = bad.request("GET", "/")
+    assert code == 403 and b"SignatureDoesNotMatch" in body
+    # unknown access key
+    ghost = SigV4Session(fe.addr, "AKDEADBEEF", "nope")
+    assert ghost.request("GET", "/")[0] == 403
+    # no auth header at all
+    import http.client
+
+    conn = http.client.HTTPConnection(*fe.addr, timeout=10)
+    try:
+        conn.request("GET", "/")
+        assert conn.getresponse().status == 403
+    finally:
+        conn.close()
+    # suspended user
+    fe.users.user_suspend(user["uid"])
+    try:
+        assert s.request("GET", "/")[0] == 403
+    finally:
+        fe.users.user_suspend(user["uid"], False)
+    assert s.request("GET", "/")[0] == 200
+
+
+def test_tampered_payload_rejected(stack):
+    """The content hash is part of the signature: a body that doesn't
+    match x-amz-content-sha256 must be rejected."""
+    import hashlib
+    import http.client
+    import time as _t
+
+    fe, s, user = stack
+    s.request("PUT", "/tamper")
+    # sign for one body, send another (simulating in-flight tampering)
+    body_signed = b"genuine"
+    body_sent = b"tampered"
+    amz_date = _t.strftime("%Y%m%dT%H%M%SZ", _t.gmtime())
+    from ceph_tpu.rgw import frontend as fr
+
+    payload_hash = hashlib.sha256(body_signed).hexdigest()
+    host = f"{fe.addr[0]}:{fe.addr[1]}"
+    hdrs = {"host": host, "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amz_date}
+    signed = ";".join(sorted(hdrs))
+    canonical = fr._canonical_request("PUT", "/tamper/x", "", hdrs,
+                                      signed, payload_hash)
+    scope = f"{amz_date[:8]}/{fr.REGION}/s3/aws4_request"
+    sts = fr._string_to_sign(amz_date, scope, canonical)
+    import hmac as _hmac
+
+    key = fr._derive_key(user["secret_key"], amz_date[:8], fr.REGION, "s3")
+    sig = _hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    hdrs["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={user['access_key']}/{scope}, "
+        f"SignedHeaders={signed}, Signature={sig}")
+    conn = http.client.HTTPConnection(*fe.addr, timeout=10)
+    try:
+        conn.request("PUT", "/tamper/x", body=body_sent, headers=hdrs)
+        assert conn.getresponse().status == 400
+    finally:
+        conn.close()
