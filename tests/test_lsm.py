@@ -1,0 +1,148 @@
+"""LSMStore: spill-to-disk ordered KV (the RocksDB role,
+src/kv/RocksDBStore.cc) — dataset larger than the memtable bound,
+restart-replay, tombstone shadowing, merge iteration, compaction."""
+
+import os
+
+import pytest
+
+from ceph_tpu.store.kv import WriteBatch
+from ceph_tpu.store.lsm import LSMStore
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = LSMStore(str(tmp_path / "lsm"), memtable_bytes=16 << 10,
+                 compact_tables=4)
+    d.open()
+    yield d
+    d.close()
+
+
+def _put(db, prefix, key, val):
+    b = WriteBatch()
+    b.set(prefix, key, val)
+    db.submit(b)
+
+
+def test_dataset_exceeds_memtable_and_survives_restart(tmp_path):
+    """The VERDICT-r3 'done' scenario: dataset >> memtable bound, with
+    RAM holding only the active memtable + sparse indexes; restart
+    reopens tables from MANIFEST and replays the WAL tail."""
+    path = str(tmp_path / "big")
+    db = LSMStore(path, memtable_bytes=8 << 10, compact_tables=100)
+    db.open()
+    n = 2000  # ~2000 * (9 + 64) bytes >> 8 KiB memtable
+    for i in range(n):
+        _put(db, "P", f"k{i:06d}", f"v{i}".encode() * 16)
+    st = db.stats()
+    assert st["tables"] >= 2, st  # it spilled
+    assert st["memtable_bytes"] <= 8 << 10
+    db.close()
+
+    db2 = LSMStore(path, memtable_bytes=8 << 10)
+    db2.open()
+    for i in (0, 1, 777, n - 1):
+        assert db2.get("P", f"k{i:06d}") == f"v{i}".encode() * 16
+    keys = [k for k, _ in db2.iterate("P")]
+    assert len(keys) == n and keys == sorted(keys)
+    db2.close()
+
+
+def test_tombstones_shadow_older_tables(db):
+    _put(db, "A", "x", b"first")
+    db.flush()  # value now lives in a table
+    b = WriteBatch()
+    b.rmkey("A", "x")
+    db.submit(b)
+    assert db.get("A", "x") is None  # memtable tombstone shadows table
+    db.flush()
+    assert db.get("A", "x") is None  # tombstone table shadows value table
+    assert list(db.iterate("A")) == []
+
+
+def test_newest_table_wins(db):
+    _put(db, "A", "k", b"old")
+    db.flush()
+    _put(db, "A", "k", b"new")
+    db.flush()
+    assert db.get("A", "k") == b"new"
+    assert list(db.iterate("A")) == [("k", b"new")]
+
+
+def test_compaction_collapses_tables_and_drops_tombstones(db):
+    for i in range(8):
+        _put(db, "C", f"k{i}", b"v%d" % i)
+        db.flush()
+    b = WriteBatch()
+    b.rmkey("C", "k3")
+    db.submit(b)
+    db.compact()
+    assert db.stats()["tables"] == 1
+    assert db.get("C", "k3") is None
+    assert [k for k, _ in db.iterate("C")] == [
+        f"k{i}" for i in range(8) if i != 3]
+    # tombstone physically gone: the single table has 7 records
+    t = db._tables[0]
+    assert sum(1 for _ in t.iterate()) == 7
+
+
+def test_wal_torn_tail_truncated(tmp_path):
+    path = str(tmp_path / "torn")
+    db = LSMStore(path)
+    db.open()
+    _put(db, "T", "good", b"ok")
+    db.close()
+    with open(os.path.join(path, "wal.log"), "ab") as f:
+        f.write(b"\x40\x00\x00\x00garbage-torn-tail")
+    db2 = LSMStore(path)
+    db2.open()
+    assert db2.get("T", "good") == b"ok"
+    _put(db2, "T", "after", b"fine")  # log still appendable
+    db2.close()
+
+
+def test_snapshot_stable_against_flush_and_writes(db):
+    _put(db, "S", "a", b"1")
+    snap = db.snapshot()
+    _put(db, "S", "a", b"2")
+    _put(db, "S", "b", b"3")
+    db.flush()
+    assert snap.get("S", "a") == b"1"
+    assert [k for k, _ in snap.iterate("S")] == ["a"]
+    assert db.get("S", "a") == b"2"
+
+
+def test_seekable_iterator(db):
+    for k in ("aa", "bb", "cc", "dd"):
+        _put(db, "I", k, k.encode())
+    db.flush()
+    it = db.get_iterator("I")
+    it.lower_bound("bb")
+    assert it.valid() and it.key() == "bb"
+    it.next()
+    assert it.key() == "cc"
+
+
+def test_blockstore_on_lsm(tmp_path):
+    """BlockStore metadata over the LSM store: object write/read
+    roundtrip + remount (the BlueStore-over-RocksDB pairing)."""
+    from ceph_tpu.store.blockstore import BlockStore
+    from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
+
+    bs = BlockStore(str(tmp_path / "bs"), kv_kind="lsm")
+    bs.mkfs()
+    bs.mount()
+    coll = Collection("1.0_head")
+    t = Transaction()
+    t.create_collection(coll)
+    t.touch(coll, GHObject("o1"))
+    t.write(coll, GHObject("o1"), 0, b"lsm-backed" * 100)
+    bs.queue_transaction(t)
+    assert bs.read(coll, GHObject("o1")) == b"lsm-backed" * 100
+    bs.umount()
+    bs2 = BlockStore(str(tmp_path / "bs"), kv_kind="lsm")
+    bs2.mount()
+    assert bs2.read(coll, GHObject("o1")) == b"lsm-backed" * 100
+    assert bs2.fsck() == []
+    bs2.umount()
