@@ -251,6 +251,18 @@ class Messenger:
                 c._close()
             if self._server is not None:
                 self._server.close()
+                await self._server.wait_closed()
+            # cancel and await every task this messenger spawned
+            # (reconnect sleepers, send-queue waiters, frame readers):
+            # abandoning them leaks "Task was destroyed but it is
+            # pending!" warnings at interpreter exit and can mask real
+            # shutdown bugs.  Each messenger owns its loop+thread, so
+            # all_tasks() here is exactly our own task set.
+            me = asyncio.current_task()
+            tasks = [t for t in asyncio.all_tasks() if t is not me]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
 
         asyncio.run_coroutine_threadsafe(_stop(), self._loop).result(timeout=10)
         self._loop.call_soon_threadsafe(self._loop.stop)
@@ -368,6 +380,13 @@ class Messenger:
                     ):
                         raise exc
             finally:
+                # retrieve BOTH tasks' outcomes even when this coroutine
+                # is itself cancelled mid-wait (messenger shutdown):
+                # an unretrieved _send_loop exception warns at GC
+                reader_task.cancel()
+                sender_task.cancel()
+                await asyncio.gather(reader_task, sender_task,
+                                     return_exceptions=True)
                 try:
                     writer.close()
                 except Exception:

@@ -1,0 +1,93 @@
+"""Minimal TPU bench: the two north-star engines, nothing else.
+
+Designed to finish in well under a minute of chip time so that even a
+brief tunnel-alive window yields a hardware number (the round-3 failure
+mode was a wedge window erasing the whole round's perf story).  Runs:
+
+- SWAR GF(2^8) RS k=8,m=4 encode+decode at 1 MiB (BASELINE metric 2,
+  reference harness src/test/erasure-code/ceph_erasure_code_benchmark.cc)
+- u32-limb vmapped straw2 CRUSH sweep, 1M ids over a 1024-OSD map
+  (BASELINE metric 6, reference src/crush/mapper.c:900)
+
+Prints ONE JSON line; also writes it to the path in argv[1] if given.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench(fn, warmup=2, iters=10):
+    out = None
+    for _ in range(warmup):
+        out = fn()
+    if hasattr(out, "block_until_ready"):
+        out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    if hasattr(out, "block_until_ready"):
+        out.block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import jax
+
+    out = {"backend": jax.default_backend(),
+           "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+
+    from ceph_tpu import _native
+    from ceph_tpu.ec import matrices
+    from ceph_tpu.ec.codec import RSMatrixCodec
+    from ceph_tpu.ops import gf256_swar
+
+    K, M = 8, 4
+    coding = matrices.isa_cauchy(K, M)
+    codec = RSMatrixCodec(K, M, coding)
+    rng = np.random.default_rng(0)
+    size = 1 << 20
+    x = rng.integers(0, 256, size=(K, size // K), dtype=np.uint8)
+    xd = jax.device_put(x)
+    enc = lambda: gf256_swar.gf_matmul_bytes(coding, xd)  # noqa: E731
+    coded = np.asarray(enc())
+    want = _native.rs_encode(coding.astype(np.uint8), x[:, :4096])
+    assert np.array_equal(coded[:, :4096], want), "encode != oracle"
+    out["encode_1mib_gbps"] = round(size / bench(enc) / 1e9, 3)
+
+    survivors = [0, 1, 2, 3, 4, 5, 8, 9]
+    rec, _ = codec.recovery_matrix(survivors)
+    surv = np.stack([x[s] if s < K else coded[s - K] for s in survivors])
+    sd = jax.device_put(surv)
+    dec = lambda: gf256_swar.gf_matmul_bytes(rec, sd)  # noqa: E731
+    assert np.array_equal(np.asarray(dec()), x), "decode != data"
+    out["decode_1mib_gbps"] = round(size / bench(dec) / 1e9, 3)
+
+    from ceph_tpu.crush import map as cmap
+    from ceph_tpu.crush import mapper
+
+    n_osds, nrep = 1024, 3
+    m, root = cmap.build_flat_cluster(n_osds, hosts=64)
+    steps = [(cmap.OP_TAKE, root, 0),
+             (cmap.OP_CHOOSELEAF_FIRSTN, nrep, 1),
+             (cmap.OP_EMIT, 0, 0)]
+    fn = mapper.compile_rule(m.flatten(), steps, nrep)
+    w_d = jax.device_put(np.full(n_osds, 0x10000, dtype=np.uint32))
+    n_x = 1_000_000
+    xs = jax.device_put(np.arange(n_x, dtype=np.int32))
+    fn(xs, w_d).block_until_ready()
+    dt = bench(lambda: fn(xs, w_d), warmup=0, iters=3)
+    out["crush_1m_mplacements_per_s"] = round(n_x / dt / 1e6, 2)
+
+    line = json.dumps(out)
+    print(line)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""Background TPU watcher.
+
+Probes the attached accelerator every PROBE_INTERVAL seconds (subprocess
+probe — a wedged axon tunnel hangs jax.devices() forever in-process).
+On the FIRST successful probe it immediately:
+
+1. runs tools/tpu_minibench.py -> BENCH_TPU_MINI.json  (<1 min of chip)
+2. runs bench.py              -> BENCH_TPU_EARLY.json  (full sweep)
+
+then keeps watching and refreshes the artifacts on later successes, so
+a brief tunnel-alive window mid-session still leaves hardware numbers
+for the round artifact (VERDICT r3 next-round item #1).  All attempts
+are logged with timestamps to tpu_watch.log.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "tpu_watch.log")
+PROBE_INTERVAL = float(os.environ.get("TPU_WATCH_INTERVAL", "600"))
+PROBE_TIMEOUT = float(os.environ.get("TPU_WATCH_PROBE_TIMEOUT", "90"))
+
+
+def log(msg):
+    line = f"{time.strftime('%H:%M:%S', time.gmtime())} {msg}"
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe():
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); "
+             "print('ok', d[0].platform)"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT,
+            cwd=REPO)
+        if p.returncode == 0 and "ok" in p.stdout:
+            return p.stdout.split()[-1]
+    except subprocess.TimeoutExpired:
+        return None
+    return None
+
+
+def run_capture(script, out_path, timeout):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.setdefault("CEPH_TPU_PROBE_TIMEOUT", "120")
+    try:
+        p = subprocess.run([sys.executable, script], capture_output=True,
+                           text=True, timeout=timeout, cwd=REPO, env=env)
+        line = p.stdout.strip().splitlines()[-1] if p.stdout.strip() else ""
+        if p.returncode == 0 and line.startswith("{"):
+            with open(out_path, "w") as f:
+                f.write(line + "\n")
+            return json.loads(line)
+        log(f"{script} rc={p.returncode} stderr tail: "
+            + "|".join(p.stderr.strip().splitlines()[-3:]))
+    except subprocess.TimeoutExpired:
+        log(f"{script} TIMED OUT after {timeout}s (tunnel wedged mid-run?)")
+    return None
+
+
+def main():
+    log(f"watcher start pid={os.getpid()} interval={PROBE_INTERVAL}s")
+    mini_done = full_done = False
+    while True:
+        plat = probe()
+        if plat is None:
+            log("probe: wedged/timeout")
+        elif plat != "tpu":
+            log(f"probe: backend={plat} (not tpu) — waiting")
+        else:
+            log("probe: TPU ALIVE")
+            if not mini_done:
+                r = run_capture(os.path.join(REPO, "tools/tpu_minibench.py"),
+                                os.path.join(REPO, "BENCH_TPU_MINI.json"),
+                                timeout=900)
+                if r and r.get("backend") == "tpu":
+                    mini_done = True
+                    log(f"MINI captured: {json.dumps(r)}")
+            if mini_done and not full_done:
+                r = run_capture(os.path.join(REPO, "bench.py"),
+                                os.path.join(REPO, "BENCH_TPU_EARLY.json"),
+                                timeout=3600)
+                if r and r.get("backend") == "tpu":
+                    full_done = True
+                    log(f"FULL captured: value={r.get('value')}")
+            if mini_done and full_done:
+                log("both artifacts captured on TPU; watcher exiting")
+                return 0
+        time.sleep(PROBE_INTERVAL)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
