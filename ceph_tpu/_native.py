@@ -29,8 +29,16 @@ def lib() -> ctypes.CDLL:
     if _lib is None:
         if not os.path.exists(_LIB_PATH):
             build()
-        _lib = ctypes.CDLL(_LIB_PATH)
-        _configure(_lib)
+        L = ctypes.CDLL(_LIB_PATH)
+        try:
+            _configure(L)
+        except AttributeError:
+            # stale .so from an older source tree (missing newer
+            # symbols): rebuild once and reload
+            build()
+            L = ctypes.CDLL(_LIB_PATH)
+            _configure(L)
+        _lib = L
     return _lib
 
 
@@ -46,6 +54,11 @@ def _configure(L: ctypes.CDLL) -> None:
     L.gf256_rs_encode.restype = None
     L.gf256_rs_encode.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p, u8p,
                                   ctypes.c_int64]
+    L.gf256_rs_encode_simd.restype = None
+    L.gf256_rs_encode_simd.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p,
+                                       u8p, ctypes.c_int64]
+    L.gf256_simd_available.restype = ctypes.c_int
+    L.gf256_simd_available.argtypes = []
     L.gf256_mat_invert.restype = ctypes.c_int
     L.gf256_mat_invert.argtypes = [u8p, u8p, ctypes.c_int]
     L.gf256_rs_decode_data.restype = ctypes.c_int
@@ -103,6 +116,23 @@ def rs_encode(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
     coding = np.zeros((m, length), dtype=np.uint8)
     lib().gf256_rs_encode(_u8(matrix), k, m, _u8(data), _u8(coding), length)
     return coding
+
+
+def rs_encode_simd(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """ISA-L-class encode (AVX2 split-nibble PSHUFB when compiled in,
+    scalar fallback otherwise) — the honest CPU bench baseline."""
+    m, k = matrix.shape
+    length = data.shape[1]
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    coding = np.zeros((m, length), dtype=np.uint8)
+    lib().gf256_rs_encode_simd(_u8(matrix), k, m, _u8(data), _u8(coding),
+                               length)
+    return coding
+
+
+def simd_available() -> bool:
+    return bool(lib().gf256_simd_available())
 
 
 def rs_decode_data(full_gen: np.ndarray, k: int, m: int,

@@ -87,6 +87,10 @@ _OK = 0
 _REJECT = 1  # empty bucket mid-descent: retry with higher ftotal
 _SKIP = 2  # bad item / bad type: give up on this replica slot
 
+# draw-table fast path: one 256 KiB table pair per distinct weight value
+# (real maps quantize weights to a handful of device sizes)
+_MAX_DRAW_TABS = 64
+
 
 class _DeviceMap:
     """FlatMap lowered to device arrays (captured by the compiled rule).
@@ -128,6 +132,32 @@ class _DeviceMap:
         self.sizes = jnp.asarray(flat.sizes, dtype=jnp.int32)
         self.algs = jnp.asarray(flat.algs, dtype=jnp.int32)
         self.types = jnp.asarray(flat.types, dtype=jnp.int32)
+        # ---- straw2 DRAW TABLES (the fast path) -----------------------
+        # weights are map constants, so the exact truncating draw
+        # q = floor(n/w) is PRECOMPUTED per distinct weight as two u32
+        # planes (q < 2^49): the per-item choose collapses to one hash
+        # + two table gathers + a lexicographic argmin — no limb
+        # arithmetic at all.  Maps with pathological weight diversity
+        # (> _MAX_DRAW_TABS distinct values) fall back to the exact
+        # u32-limb magic-reciprocal path below.
+        w_all = np.asarray(flat.weights, dtype=np.uint64)
+        distinct = np.unique(w_all[w_all > 0])
+        self.table_mode = 0 < len(distinct) <= _MAX_DRAW_TABS
+        if self.table_mode:
+            n64 = (-ln.ln16_table()).astype(np.uint64)
+            thi = np.empty((len(distinct), 65536), dtype=np.uint32)
+            tlo = np.empty((len(distinct), 65536), dtype=np.uint32)
+            for i, w in enumerate(distinct):
+                q = n64 // w
+                thi[i] = (q >> 32).astype(np.uint32)
+                tlo[i] = (q & 0xFFFFFFFF).astype(np.uint32)
+            self.draw_hi = jnp.asarray(thi)
+            self.draw_lo = jnp.asarray(tlo)
+            # per-(bucket, item) index into the tables (0 for w==0
+            # slots; those are masked invalid in the choose)
+            self.w_idx = jnp.asarray(
+                np.searchsorted(distinct, np.maximum(w_all, 1)
+                                ).astype(np.int32))
         # n = -(crush_ln(u) - 2^48) in [1, 2^48] — note u=0 hits 2^48
         # EXACTLY, so limbs must cover 49 bits: 4x16-bit tables
         n = (-ln.ln16_table()).astype(np.uint64)
@@ -139,6 +169,10 @@ class _DeviceMap:
         self.max_size = int(flat.items.shape[1])
         self.max_devices = int(flat.max_devices)
         self.depth = _tree_depth(flat)
+        # host-side copies for static descent planning
+        self._np_items = np.asarray(flat.items)
+        self._np_sizes = np.asarray(flat.sizes)
+        self._np_types = np.asarray(flat.types)
         # legacy bucket algorithm support: aux planes are materialized
         # only for algs the map actually uses (straw2-only maps — the
         # modern default — pay nothing)
@@ -160,6 +194,41 @@ class _DeviceMap:
             self.tree_depth_max = max(
                 1, int(np.asarray(flat.tree_weights).shape[1]
                        ).bit_length() - 1)
+
+
+def _descent_plan(dm: "_DeviceMap", frontier, want_type: int):
+    """Static unroll plan for a descent whose possible start buckets
+    are known at trace time: per level, the max bucket width actually
+    reachable.  A take->chooseleaf walk on a root(64 hosts) ->
+    host(16 osds) map plans [64, 16] instead of paying the global
+    max_size at every level AND the global tree depth — for typical
+    2-level maps this halves the straw2 work per choose.
+
+    frontier: iterable of bucket indices possibly holding the walk at
+    level 0.  Returns a list of per-level widths (len == levels the
+    unroll needs); falls back to the conservative global plan when the
+    frontier is unknown."""
+    frontier = {b for b in frontier if 0 <= b < dm.n_buckets}
+    if not frontier:
+        return [dm.max_size] * dm.depth
+    plan = []
+    for _ in range(dm.depth):
+        width = max(int(dm._np_sizes[b]) for b in frontier)
+        plan.append(max(width, 1))
+        nxt = set()
+        for b in frontier:
+            for j in range(int(dm._np_sizes[b])):
+                it = int(dm._np_items[b, j])
+                if it >= 0:
+                    continue  # device: walk ends here
+                sub = -1 - it
+                if 0 <= sub < dm.n_buckets and \
+                        int(dm._np_types[sub]) != want_type:
+                    nxt.add(sub)
+        if not nxt:
+            break
+        frontier = nxt
+    return plan
 
 
 def _tree_depth(flat: FlatMap) -> int:
@@ -196,28 +265,47 @@ _U16 = jnp.uint32(0xFFFF)
 _UMAX = jnp.uint32(0xFFFFFFFF)
 
 
-def _straw2_choose(dm: _DeviceMap, bno, x, r):
+def _straw2_choose(dm: _DeviceMap, bno, x, r, width=None):
     """Vectorized bucket_straw2_choose (reference: mapper.c:361-384),
     exact and 64-bit-free.
 
     The C computes draw = div64_s64(ln, w) per item and keeps the
     strictly-greatest draw (first index on ties).  ln is negative with
     |ln| = n < 2^48, so argmax(draw) == lexicographic argmin of the
-    positive quotient q = floor(n / w).  q is computed exactly in
-    uint32: q_est = floor(n * floor((2^64-1)/w) / 2^64) via 16-bit limb
-    products (never overflowing u32), then one upward correction
+    positive quotient q = floor(n / w).
+
+    Fast path (table_mode): weights are map constants, so q is
+    precomputed per distinct weight as (hi, lo) u32 planes over all
+    2^16 hash values — the choose is one hash + two gathers + a
+    lexicographic argmin.  Fallback: q computed exactly in uint32 limb
+    arithmetic: q_est = floor(n * floor((2^64-1)/w) / 2^64) via 16-bit
+    limb products (never overflowing u32), then one upward correction
     (q_est is provably in {q-1, q} for n < 2^48).
     """
-    items = dm.items[bno]
-    wts = dm.weights[bno]
+    width = width or dm.max_size
+    items = dm.items[:, :width][bno]
+    wts = dm.weights[:, :width][bno]
     size = dm.sizes[bno]
     u = hashes.hash32_3(
         x.astype(jnp.uint32), items.astype(jnp.uint32), r.astype(jnp.uint32),
         xp=jnp,
     ) & _U16
+    if dm.table_mode:
+        ui = u.astype(jnp.int32)
+        wi = dm.w_idx[:, :width][bno]
+        q_hi = dm.draw_hi[wi, ui]
+        q_lo = dm.draw_lo[wi, ui]
+        valid = (jnp.arange(width) < size) & (wts > 0)
+        q_hi = jnp.where(valid, q_hi, _UMAX)
+        q_lo = jnp.where(valid, q_lo, _UMAX)
+        min_hi = jnp.min(q_hi)
+        cand = q_hi == min_hi
+        min_lo = jnp.min(jnp.where(cand, q_lo, _UMAX))
+        sel = cand & (q_lo == min_lo)
+        return items[jnp.argmax(sel)]
     ui = u.astype(jnp.int32)
     nl = [dm.ln_l[i][ui] for i in range(4)]  # n in 4x16-bit limbs
-    ml = [mlj[bno] for mlj in dm.magic_l]  # magic in 4x16-bit limbs
+    ml = [mlj[:, :width][bno] for mlj in dm.magic_l]  # magic, 16-bit limbs
 
     # P = n * magic: 16-bit-limb column accumulation; per-column sums
     # stay < 2^19 (<= 4 lo + 4 hi terms of < 2^16 each)
@@ -269,7 +357,7 @@ def _straw2_choose(dm: _DeviceMap, bno, x, r):
     q_lo = q_lo2
 
     # winner = first index of the minimal (q_hi, q_lo) among valid items
-    valid = (jnp.arange(dm.max_size) < size) & (wts > 0)
+    valid = (jnp.arange(width) < size) & (wts > 0)
     q_hi = jnp.where(valid, q_hi, _UMAX)
     q_lo = jnp.where(valid, q_lo, _UMAX)
     min_hi = jnp.min(q_hi)
@@ -384,12 +472,14 @@ def _uniform_choose(dm: _DeviceMap, bno, x, r):
     return dm.items[bno][perm[pr]]
 
 
-def _bucket_choose(dm: _DeviceMap, bno, x, r):
+def _bucket_choose(dm: _DeviceMap, bno, x, r, width=None):
     """Per-alg dispatch; straw2-only maps trace straight through the
-    straw2 path with zero overhead."""
+    straw2 path with zero overhead.  `width` is the static per-level
+    bucket-width bound from the descent plan (straw2 only; the legacy
+    algs are rare enough to always run at full width)."""
     if dm.only_straw2:
-        return _straw2_choose(dm, bno, x, r)
-    out = _straw2_choose(dm, bno, x, r)
+        return _straw2_choose(dm, bno, x, r, width)
+    out = _straw2_choose(dm, bno, x, r, width)
     alg = dm.algs[bno]
     if ALG_STRAW in dm.algs_present:
         out = jnp.where(alg == ALG_STRAW, _straw_choose(dm, bno, x, r),
@@ -429,6 +519,7 @@ def _descend(
     *,
     indep_numrep: Optional[object] = None,
     ftotal=None,
+    plan=None,
 ):
     """Walk intervening buckets until an item of want_type is chosen.
 
@@ -454,9 +545,10 @@ def _descend(
     done = jnp.asarray(False)
     status = jnp.int32(_OK)
 
-    for _ in range(dm.depth):
+    levels = plan if plan is not None else [dm.max_size] * dm.depth
+    for width in levels:
         empty = dm.sizes[bno] == 0
-        it = _bucket_choose(dm, bno, x, r_for(bno))
+        it = _bucket_choose(dm, bno, x, r_for(bno), width)
         bad_item = it >= dm.max_devices
         sub_bno = -1 - it
         valid_sub = (it < 0) & (sub_bno < dm.n_buckets)
@@ -490,10 +582,10 @@ def _descend(
     return item, status
 
 
-def _leaf_attempt(dm, dev_weights, bno, x, r, outpos, out2):
+def _leaf_attempt(dm, dev_weights, bno, x, r, outpos, out2, plan=None):
     """One recursive chooseleaf descent attempt (type-0 target)."""
     nslots = out2.shape[0]
-    item, status = _descend(dm, bno, x, r, 0)
+    item, status = _descend(dm, bno, x, r, 0, plan=plan)
     collide = jnp.any((jnp.arange(nslots) < outpos) & (out2 == item))
     reject = (status == _REJECT) | _is_out(
         dev_weights, dm.max_devices, item, x
@@ -513,6 +605,7 @@ def _leaf_firstn(
     sub_r,
     recurse_tries: int,
     stable: int,
+    plan=None,
 ):
     """The chooseleaf recursion: pick ONE device under bucket_item.
 
@@ -529,7 +622,7 @@ def _leaf_firstn(
 
     if recurse_tries == 1:
         item, placed, _, _ = _leaf_attempt(
-            dm, dev_weights, bno, x, rep + sub_r, outpos, out2
+            dm, dev_weights, bno, x, rep + sub_r, outpos, out2, plan
         )
         return item, placed
 
@@ -540,7 +633,8 @@ def _leaf_firstn(
     def body(c):
         ftotal, _, placed, give_up = c
         item, ok, skip, fail = _leaf_attempt(
-            dm, dev_weights, bno, x, rep + sub_r + ftotal, outpos, out2
+            dm, dev_weights, bno, x, rep + sub_r + ftotal, outpos, out2,
+            plan,
         )
         nf = ftotal + 1
         return (nf, item, ok, skip | (fail & (nf >= recurse_tries)))
@@ -548,6 +642,68 @@ def _leaf_firstn(
     init = (jnp.int32(0), jnp.int32(0), jnp.asarray(False), jnp.asarray(False))
     _, item, placed, _ = jax.lax.while_loop(cond, body, init)
     return item, placed
+
+
+def _choose_firstn_oneshot(
+    dm: _DeviceMap,
+    dev_weights,
+    bucket_bno,
+    x,
+    numrep: int,
+    want_type: int,
+    recurse_to_leaf: bool,
+    vary_r: int,
+    plan,
+    leaf_plan,
+):
+    """One-attempt-per-rep firstn (the two-stage sweep's fast pass,
+    stable-chooseleaf profile): every rep's descent is INDEPENDENT at
+    ftotal=0, so all numrep descents run as one vmapped [numrep, width]
+    block (XLA fuses the hashes/gathers wide) and only the cheap
+    accept/collision logic stays sequential.  Bit-identical to the
+    tries=1 sequential body: retries only change results on failure,
+    and failures here mean the lane is re-run by the full program."""
+    reps = jnp.arange(numrep, dtype=jnp.int32)
+    items, statuses = jax.vmap(
+        lambda r: _descend(dm, bucket_bno, x, r, want_type, plan=plan)
+    )(reps)
+    if recurse_to_leaf:
+        sub_rs = (reps >> (vary_r - 1)) if vary_r else jnp.zeros_like(reps)
+        # stable profile: leaf rep is 0 for every slot
+        leaf_items, leaf_statuses = jax.vmap(
+            lambda it, sr: _descend(
+                dm, -1 - jnp.minimum(it, -1), x, sr, 0, plan=leaf_plan)
+        )(items, sub_rs)
+
+    out = jnp.full((numrep,), ITEM_NONE, dtype=jnp.int32)
+    out2 = jnp.full((numrep,), ITEM_NONE, dtype=jnp.int32)
+    outpos = jnp.int32(0)
+    for rep in range(numrep):
+        item, status = items[rep], statuses[rep]
+        collide = jnp.any((jnp.arange(numrep) < outpos) & (out == item))
+        reject = status == _REJECT
+        skip = status == _SKIP
+        leaf = item
+        if recurse_to_leaf:
+            is_bucket = item < 0
+            l_item, l_status = leaf_items[rep], leaf_statuses[rep]
+            l_collide = jnp.any((jnp.arange(numrep) < outpos)
+                                & (out2 == l_item))
+            l_ok = ((l_status == _OK) & (~l_collide)
+                    & ~_is_out(dev_weights, dm.max_devices, l_item, x))
+            leaf = jnp.where(is_bucket, l_item, item)
+            leaf_fail = is_bucket & (~l_ok) & (~collide) & (status == _OK)
+            reject = reject | leaf_fail
+        if want_type == 0:
+            reject = reject | (
+                (status == _OK) & (~collide)
+                & _is_out(dev_weights, dm.max_devices, item, x))
+        placed = (status == _OK) & (~reject) & (~collide) & (~skip)
+        out = jnp.where(placed, out.at[outpos].set(item), out)
+        out2 = jnp.where(placed, out2.at[outpos].set(leaf), out2)
+        outpos = outpos + placed.astype(jnp.int32)
+    values = out2 if recurse_to_leaf else out
+    return values, outpos
 
 
 def _choose_firstn(
@@ -562,6 +718,8 @@ def _choose_firstn(
     recurse_to_leaf: bool,
     vary_r: int,
     stable: int,
+    plan=None,
+    leaf_plan=None,
 ):
     """crush_choose_firstn for one source bucket (outpos starts at 0).
 
@@ -580,7 +738,8 @@ def _choose_firstn(
         def body(c, rep=rep):
             ftotal, item_prev, leaf_prev, placed, give_up = c
             r = rep + ftotal
-            item, status = _descend(dm, bucket_bno, x, r, want_type)
+            item, status = _descend(dm, bucket_bno, x, r, want_type,
+                                    plan=plan)
             collide = jnp.any((jnp.arange(numrep) < outpos) & (out == item))
             reject = status == _REJECT
             skip = status == _SKIP
@@ -590,7 +749,7 @@ def _choose_firstn(
                 is_bucket = item < 0
                 leaf_item, leaf_ok = _leaf_firstn(
                     dm, dev_weights, jnp.minimum(item, -1), x, outpos,
-                    out2, sub_r, recurse_tries, stable,
+                    out2, sub_r, recurse_tries, stable, leaf_plan,
                 )
                 leaf = jnp.where(is_bucket, leaf_item, item)
                 leaf_fail = is_bucket & (~leaf_ok) & (~collide) & (status == _OK)
@@ -618,7 +777,12 @@ def _choose_firstn(
             jnp.asarray(False),
             jnp.asarray(False),
         )
-        _, item, leaf, placed, _ = jax.lax.while_loop(cond, body, init)
+        if tries == 1:
+            # one-shot trace (the two-stage sweep's fast pass): a single
+            # inline attempt, no while_loop round-trips
+            _, item, leaf, placed, _ = body(init)
+        else:
+            _, item, leaf, placed, _ = jax.lax.while_loop(cond, body, init)
         out = jnp.where(placed, out.at[outpos].set(item), out)
         out2 = jnp.where(placed, out2.at[outpos].set(leaf), out2)
         outpos = outpos + placed.astype(jnp.int32)
@@ -628,14 +792,14 @@ def _choose_firstn(
 
 
 def _leaf_indep(dm, dev_weights, bucket_item, x, numrep, parent_r,
-                recurse_tries: int):
+                recurse_tries: int, plan=None):
     """Recursive indep leaf choice: one slot, r' = parent_r + n*ftotal."""
     bno = -1 - bucket_item
 
     def attempt(ftotal):
         item, status = _descend(
             dm, bno, x, parent_r, 0,
-            indep_numrep=jnp.int32(numrep), ftotal=ftotal,
+            indep_numrep=jnp.int32(numrep), ftotal=ftotal, plan=plan,
         )
         bad = status != _OK
         outed = _is_out(dev_weights, dm.max_devices, item, x)
@@ -666,6 +830,8 @@ def _choose_indep(
     tries: int,
     recurse_tries: int,
     recurse_to_leaf: bool,
+    plan=None,
+    leaf_plan=None,
 ):
     """crush_choose_indep for one source bucket (positional, out_size
     slots).  Returns values[left0] with CRUSH_ITEM_NONE holes."""
@@ -681,7 +847,7 @@ def _choose_indep(
             vacant = out[rep] == ITEM_UNDEF
             item, status = _descend(
                 dm, bucket_bno, x, jnp.int32(rep), want_type,
-                indep_numrep=jnp.int32(numrep), ftotal=ftotal,
+                indep_numrep=jnp.int32(numrep), ftotal=ftotal, plan=plan,
             )
             collide = jnp.any(out == item)
             hard_fail = status == _SKIP
@@ -697,6 +863,7 @@ def _choose_indep(
                 leaf_val = _leaf_indep(
                     dm, dev_weights, jnp.minimum(item, -1), x,
                     numrep, jnp.int32(rep) + r_parent, recurse_tries,
+                    leaf_plan,
                 )
                 leaf = jnp.where(is_bucket, leaf_val, item)
                 soft_fail = soft_fail | (
@@ -766,6 +933,7 @@ def compile_rule(
     steps: Sequence[Tuple[int, int, int]],
     result_max: int,
     choose_args=None,
+    one_shot: bool = False,
 ):
     """Build fn(xs[int32 N], device_weights[uint32 D]) -> int32 [N, result_max].
 
@@ -776,11 +944,22 @@ def compile_rule(
     ({bucket_id: [weights]}) bakes straw2 weight-set overrides into the
     compiled rule (reference crush_do_rule's choose_args parameter).
 
+    one_shot=True builds the two-stage sweep's FAST pass: every choose
+    gets exactly one attempt (tries=1, no retry while_loops) and the
+    function returns (result, clean[bool N]).  clean lanes are exactly
+    the lanes whose every placement succeeded at first attempt — for
+    those the full algorithm provably produces the identical result
+    (retries only trigger on failure).  Unclean lanes must be re-run
+    through the full-semantics program (see sweep()); under vmap this
+    removes the dominant cost of the full program, where every lane
+    pays the batch's WORST-CASE retry rounds.
+
     Compiled programs are cached process-wide by map content: rebuilding
     an identical map (common in tests and in OSDMap churn that leaves
     the crush tree untouched) costs a digest, not a ~10s XLA compile.
     """
-    digest = _rule_digest(flat, steps, result_max, choose_args)
+    digest = _rule_digest(flat, steps, result_max, choose_args) + (
+        ":one_shot" if one_shot else "")
     cached = _compiled_rules.get(digest)
     if cached is not None:
         return cached
@@ -794,18 +973,25 @@ def compile_rule(
         wsize = jnp.int32(0)
         result = jnp.full((result_max,), ITEM_NONE, dtype=jnp.int32)
         result_len = jnp.int32(0)
+        clean = jnp.asarray(True)  # every choose succeeded first try
 
         choose_tries = tun.choose_total_tries + 1
         choose_leaf_tries = 0
         vary_r = tun.chooseleaf_vary_r
         stable = tun.chooseleaf_stable
         wsize_bound = 0  # static upper bound on wsize, tracked at trace time
+        # static frontier: the set of buckets the NEXT choose could
+        # start from, known at trace time (take args are static; after
+        # a typed choose, every bucket of that type).  Drives the
+        # per-level width/depth descent plans.
+        static_frontier = None
 
         for op, arg1, arg2 in steps:
             if op == OP_TAKE:
                 w_buf = w_buf.at[0].set(arg1)
                 wsize = jnp.int32(1)
                 wsize_bound = 1
+                static_frontier = [-1 - arg1]
             elif op == OP_SET_CHOOSE_TRIES:
                 if arg1 > 0:
                     choose_tries = arg1
@@ -831,6 +1017,22 @@ def compile_rule(
                     )
                 else:
                     recurse_tries = choose_leaf_tries or 1
+                use_tries = 1 if one_shot else choose_tries
+                use_recurse = 1 if one_shot else recurse_tries
+                plan = (_descent_plan(dm, static_frontier, arg2)
+                        if static_frontier is not None else None)
+                leaf_plan = None
+                if recurse and arg2 > 0:
+                    # the leaf recursion starts from a bucket of type
+                    # arg2 (whichever one the outer choose picked)
+                    leaf_starts = [b for b in range(dm.n_buckets)
+                                   if int(dm._np_types[b]) == arg2]
+                    leaf_plan = _descent_plan(dm, leaf_starts, 0)
+                # after this choose the walk holds items of type arg2
+                static_frontier = (
+                    [b for b in range(dm.n_buckets)
+                     if int(dm._np_types[b]) == arg2]
+                    if arg2 > 0 else None)
 
                 o_buf = jnp.full((result_max,), ITEM_NONE, dtype=jnp.int32)
                 osize = jnp.int32(0)
@@ -843,16 +1045,27 @@ def compile_rule(
                     active = src_active & bno_ok
                     bno_safe = jnp.clip(bno, 0, dm.n_buckets - 1)
                     if firstn:
-                        vals, cnt = _choose_firstn(
-                            dm, dev_weights, bno_safe, x, numrep, arg2,
-                            choose_tries, recurse_tries, recurse, vary_r,
-                            stable,
-                        )
+                        if one_shot and (stable or not recurse):
+                            # rep-vectorized fast pass (see helper)
+                            vals, cnt = _choose_firstn_oneshot(
+                                dm, dev_weights, bno_safe, x, numrep,
+                                arg2, recurse, vary_r, plan, leaf_plan,
+                            )
+                        else:
+                            vals, cnt = _choose_firstn(
+                                dm, dev_weights, bno_safe, x, numrep,
+                                arg2, use_tries, use_recurse, recurse,
+                                vary_r, stable, plan, leaf_plan,
+                            )
+                        step_clean = cnt == numrep
                     else:
                         vals, cnt = _choose_indep(
                             dm, dev_weights, bno_safe, x, numrep, numrep,
-                            arg2, choose_tries, recurse_tries, recurse,
+                            arg2, use_tries, use_recurse, recurse,
+                            plan, leaf_plan,
                         )
+                        step_clean = jnp.all(vals != ITEM_NONE)
+                    clean = clean & ((~active) | step_clean)
                     cnt = jnp.where(active, cnt, 0)
                     # append vals[:cnt] at o_buf[osize:]
                     for jj in range(vals.shape[0]):
@@ -880,6 +1093,8 @@ def compile_rule(
                     )
                     result_len = result_len + valid.astype(jnp.int32)
                 wsize = jnp.int32(0)
+        if one_shot:
+            return result, clean
         return result
 
     mapped = jax.jit(jax.vmap(one_x, in_axes=(0, None)))
@@ -894,3 +1109,55 @@ def compile_rule(
     if len(_compiled_rules) > 256:  # bound trace/executable retention
         _compiled_rules.pop(next(iter(_compiled_rules)))
     return run
+
+
+def sweep(
+    flat: FlatMap,
+    steps: Sequence[Tuple[int, int, int]],
+    result_max: int,
+    xs: np.ndarray,
+    dev_weights: np.ndarray,
+    choose_args=None,
+    chunk: int = 1 << 19,
+) -> np.ndarray:
+    """Full-cluster placement sweep (the ParallelPGMapper workload,
+    reference src/osd/OSDMapMapping.h:17) as a TWO-STAGE program:
+
+    1. the one-shot trace maps every id with exactly one attempt per
+       choose — the overwhelmingly common case on healthy maps — and
+       reports which lanes were clean;
+    2. only the unclean lanes (collisions/rejections, typically <5%)
+       re-run through the full-retry-semantics trace, padded to a
+       power-of-two batch so the slow program compiles for O(log)
+       distinct shapes.
+
+    Chunked so live device temps stay bounded at 10M+ ids.  Bit-exact
+    with running the full program on everything: a clean lane's result
+    is identical by construction (retries only fire on failure).
+    """
+    xs = np.asarray(xs, dtype=np.int32)
+    n = len(xs)
+    if n == 0:
+        return np.empty((0, result_max), dtype=np.int32)
+    fast = compile_rule(flat, steps, result_max, choose_args,
+                        one_shot=True)
+    slow = compile_rule(flat, steps, result_max, choose_args)
+    chunk = min(chunk, n)
+    outs = []
+    for off in range(0, n, chunk):
+        sub = xs[off: off + chunk]
+        if len(sub) < chunk:  # uniform shape: ONE compiled fast program
+            sub = np.concatenate(
+                [sub, np.full(chunk - len(sub), sub[-1], np.int32)])
+        res, clean = fast(sub, dev_weights)
+        res = np.array(res)  # writable host copy
+        bad = np.nonzero(~np.asarray(clean))[0]
+        if bad.size:
+            # power-of-two padding: O(log chunk) slow-program shapes
+            n_pad = 1 << max(0, int(bad.size - 1).bit_length())
+            padded = np.full(n_pad, sub[bad[0]], dtype=np.int32)
+            padded[: bad.size] = sub[bad]
+            fixed = np.asarray(slow(padded, dev_weights))
+            res[bad] = fixed[: bad.size]
+        outs.append(res[: len(xs) - off])
+    return np.concatenate(outs) if len(outs) > 1 else outs[0]

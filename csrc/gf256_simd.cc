@@ -1,0 +1,81 @@
+// SIMD GF(2^8) region kernels — the honest CPU baseline.
+//
+// This is the ISA-L-class technique (split-nibble PSHUFB lookups; see
+// the reference's src/erasure-code/isa/ plugin whose ec_encode_data
+// rides exactly this shape in x86 asm, and gf-complete's SPLIT_TABLE
+// w=8): each coefficient becomes two 16-entry tables (products of the
+// low/high nibble), applied 32 bytes per vpshufb pair.  Falls back to
+// the scalar table loop when AVX2 is not compiled in, so the same
+// build works on any bench host.
+//
+// Kept separate from gf256.cc: that file is the *conformance oracle*
+// (deliberately simple); this one exists to make vs_baseline honest
+// (VERDICT r3 weak #3 — a scalar-loop baseline overstates the TPU
+// engines' progress toward the >=10x-ISA-L north star).
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+extern "C" {
+uint8_t gf256_mul(uint8_t a, uint8_t b);           // gf256.cc
+void gf256_muladd_region(uint8_t c, const uint8_t* in, uint8_t* out,
+                         int64_t n);                // gf256.cc (scalar)
+
+// out[i] ^= c * in[i], vectorized.
+void gf256_muladd_region_simd(uint8_t c, const uint8_t* in, uint8_t* out,
+                              int64_t n) {
+  if (c == 0) return;
+#if defined(__AVX2__)
+  uint8_t lo[16], hi[16];
+  for (int x = 0; x < 16; ++x) {
+    lo[x] = gf256_mul(c, static_cast<uint8_t>(x));
+    hi[x] = gf256_mul(c, static_cast<uint8_t>(x << 4));
+  }
+  const __m256i vlo =
+      _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)lo));
+  const __m256i vhi =
+      _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)hi));
+  const __m256i nib = _mm256_set1_epi8(0x0F);
+  int64_t i = 0;
+  for (; i + 32 <= n; i += 32) {
+    __m256i x = _mm256_loadu_si256((const __m256i*)(in + i));
+    __m256i pl = _mm256_shuffle_epi8(vlo, _mm256_and_si256(x, nib));
+    __m256i ph = _mm256_shuffle_epi8(
+        vhi, _mm256_and_si256(_mm256_srli_epi16(x, 4), nib));
+    __m256i o = _mm256_loadu_si256((const __m256i*)(out + i));
+    _mm256_storeu_si256((__m256i*)(out + i),
+                        _mm256_xor_si256(o, _mm256_xor_si256(pl, ph)));
+  }
+  for (; i < n; ++i) out[i] ^= gf256_mul(c, in[i]);
+#else
+  gf256_muladd_region(c, in, out, n);
+#endif
+}
+
+// Systematic RS encode over the SIMD region kernel (layout identical
+// to gf256_rs_encode: row-major k x len data, m x len coding).
+void gf256_rs_encode_simd(const uint8_t* matrix, int k, int m,
+                          const uint8_t* data, uint8_t* coding,
+                          int64_t len) {
+  memset(coding, 0, static_cast<size_t>(m) * len);
+  for (int i = 0; i < m; ++i)
+    for (int j = 0; j < k; ++j)
+      gf256_muladd_region_simd(matrix[i * k + j], data + j * len,
+                               coding + i * len, len);
+}
+
+// 1 when the build carries the AVX2 path (so artifacts can label the
+// baseline's actual strength on the bench host).
+int gf256_simd_available(void) {
+#if defined(__AVX2__)
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+}  // extern "C"
