@@ -63,10 +63,20 @@ class RBD:
 
     def remove(self, io: IoCtx, name: str) -> None:
         img = Image(io, name)
+        if img.meta.get("children"):
+            raise RadosError(  # ENOTEMPTY, as the reference refuses
+                -39, f"image {name!r} has {len(img.meta['children'])} "
+                "clone children")
         try:
             img.striper.remove(img.meta["data_prefix"])
         except RadosError:
             pass
+        from ceph_tpu.rbd.objectmap import ObjectMap
+
+        ObjectMap(io, name, 0).remove()  # head map, clone or not
+        parent = img.meta.get("parent")
+        if parent:
+            _deregister_child(io, parent["image"], name)
         io.remove(_header_oid(name))
         try:
             io.operate(DIR_OID, [_omap_rm(name)])
@@ -78,12 +88,53 @@ class RBD:
              owner: str = "client") -> "Image":
         return Image(io, name, exclusive=exclusive, owner=owner)
 
+    # -- clone / layering (reference librbd::RBD::clone,
+    # src/librbd/librbd.cc:506; children bookkeeping = cls_rbd's
+    # children keys on the parent header) ---------------------------------
+    def clone(self, io: IoCtx, parent: str, snap: str, child: str,
+              order: Optional[int] = None,
+              stripe_unit: Optional[int] = None,
+              stripe_count: Optional[int] = None) -> None:
+        """Copy-on-write child of a PROTECTED parent snapshot."""
+        # fresh ioctx: opening the parent must not clobber the caller's
+        # snap context
+        with Image(io.client.ioctx(io.pool), parent) as p:
+            info = p._snap_info(snap)
+            if not info.get("protected"):
+                raise RadosError(-22, f"snap {snap!r} is not protected")
+            self.create(io, child, info["size"],
+                        order=order or p.meta["order"],
+                        stripe_unit=stripe_unit or p.meta["stripe_unit"],
+                        stripe_count=stripe_count or p.meta["stripe_count"])
+            raw = io.read(_header_oid(child))
+            meta = json.loads(raw.decode())
+            meta["parent"] = {"image": parent, "snap": snap,
+                              "snapid": info["id"], "size": info["size"]}
+            io.write_full(_header_oid(child), json.dumps(meta).encode())
+            # register the child on the parent header (unprotect and
+            # parent removal must see it)
+            p.meta.setdefault("children", []).append(
+                {"image": child, "snap": snap})
+            p._save_header()
+
 
 def _omap_rm(key: str):
     from ceph_tpu.osd import types as t_
     from ceph_tpu.osd.types import OSDOp
 
     return OSDOp(t_.OP_OMAP_RM, keys=[key])
+
+
+def _deregister_child(io: IoCtx, parent_image: str, child: str) -> None:
+    """Drop `child` from the parent's children list (cls_rbd children
+    bookkeeping role); parent already gone is fine."""
+    try:
+        with Image(io.client.ioctx(io.pool), parent_image) as p:
+            p.meta["children"] = [c for c in p.meta.get("children", [])
+                                  if c["image"] != child]
+            p._save_header()
+    except RadosError:
+        pass
 
 
 class Image:
@@ -112,8 +163,48 @@ class Image:
                        reverse=True)
         if snaps:
             io.set_snap_context(snaps[0], snaps)
+        # layering: clones carry a parent link + an object map whose
+        # clear bits route reads to the parent snapshot and trigger
+        # copy-up on first write (reference ObjectMap.h:26 + the
+        # copyup path of io/ObjectRequest)
+        self.objmap = None
+        self._parent_img: Optional["Image"] = None
+        if self.meta.get("parent"):
+            from ceph_tpu.rbd.objectmap import ObjectMap
+
+            self.objmap = ObjectMap(io, name, self._num_blocks())
         if exclusive:
             self._take_lock()
+
+    def _num_blocks(self) -> int:
+        bs = 1 << self.meta["order"]
+        return (self.meta["size"] + bs - 1) // bs
+
+    def _snap_objmap(self, info: dict, bs: int):
+        """Cached frozen per-snap object map, sized by the SNAP's
+        geometry (a later head shrink must not clip it)."""
+        from ceph_tpu.rbd.objectmap import ObjectMap
+
+        cache = getattr(self, "_snap_maps", None)
+        if cache is None:
+            cache = self._snap_maps = {}
+        om = cache.get(info["id"])
+        if om is None:
+            nblocks = (info["size"] + bs - 1) // bs
+            om = ObjectMap(self.io, self.name, nblocks,
+                           snapid=info["id"])
+            cache[info["id"]] = om
+        return om
+
+    def _parent(self) -> "Image":
+        if self._parent_img is None:
+            # a FRESH ioctx: Image.__init__ installs the opened image's
+            # SnapContext on its ioctx, and the parent's must never
+            # clobber the child's write context (silent snapshot
+            # corruption otherwise)
+            pio = self.io.client.ioctx(self.io.pool)
+            self._parent_img = Image(pio, self.meta["parent"]["image"])
+        return self._parent_img
 
     # -- snapshots (librbd snap_create/list/rollback/remove over the
     # pool's self-managed snaps; snapshot metadata lives in the image
@@ -126,6 +217,10 @@ class Image:
         snaps[name] = {"id": snapid, "size": self.size}
         self.io.write_full(_header_oid(self.name),
                            json.dumps(self.meta).encode())
+        if self.objmap is not None:
+            # freeze the block-existence map alongside the snap so
+            # snap reads route parent/child correctly forever
+            self.objmap.save_snap_copy(snapid)
         return snapid
 
     def snap_list(self) -> List[dict]:
@@ -143,11 +238,40 @@ class Image:
         if off >= info["size"]:
             return b""
         length = min(length, info["size"] - off)
+        if self.meta.get("parent"):
+            return self._layered_snap_read(info, off, length)
         got = self.striper.read(self.meta["data_prefix"], length, off,
                                 snapid=info["id"], size=info["size"])
         if len(got) < length:
             got += b"\0" * (length - len(got))
         return got
+
+    def _layered_snap_read(self, info: dict, off: int,
+                           length: int) -> bytes:
+        """Snap read on a CLONE: route per block via the snap's frozen
+        object map — blocks unwritten at snap time come from the
+        parent (whose snap is immutable), written ones from this
+        image's objects at that snapid."""
+        bs = 1 << self.meta["order"]
+        om = self._snap_objmap(info, bs)
+        out = []
+        pos = off
+        end = off + length
+        while pos < end:
+            block = pos // bs
+            seg_end = min(end, (block + 1) * bs)
+            n = seg_end - pos
+            if om.exists(block):
+                got = self.striper.read(
+                    self.meta["data_prefix"], n, pos,
+                    snapid=info["id"], size=info["size"])
+                if len(got) < n:
+                    got += b"\0" * (n - len(got))
+                out.append(got)
+            else:
+                out.append(self._read_parent(pos, n))
+            pos = seg_end
+        return b"".join(out)
 
     def snap_rollback(self, name: str, chunk: int = 4 << 20) -> None:
         """Rewrite head from the snap's content (librbd snap_rollback)."""
@@ -159,12 +283,49 @@ class Image:
 
     def snap_remove(self, name: str) -> dict:
         info = self._snap_info(name)
+        if info.get("protected"):
+            raise RadosError(-16, f"snap {name!r} is protected")  # EBUSY
         got = self.io.selfmanaged_snap_trim(info["id"])
         self.io.selfmanaged_snap_remove(info["id"])
+        if self.objmap is not None:
+            from ceph_tpu.rbd.objectmap import ObjectMap
+
+            ObjectMap(self.io, self.name, 0,
+                      snapid=info["id"]).remove()
+            getattr(self, "_snap_maps", {}).pop(info["id"], None)
         del self.meta["snaps"][name]
         self.io.write_full(_header_oid(self.name),
                            json.dumps(self.meta).encode())
         return got
+
+    # -- snap protection (clone precondition; reference librbd
+    # snap_protect/snap_unprotect + cls_rbd children refcounting) ---------
+    def _save_header(self) -> None:
+        self.io.write_full(_header_oid(self.name),
+                           json.dumps(self.meta).encode())
+
+    def snap_protect(self, name: str) -> None:
+        self._snap_info(name)["protected"] = True
+        self._save_header()
+
+    def snap_unprotect(self, name: str) -> None:
+        info = self._snap_info(name)
+        kids = [c for c in self.meta.get("children", [])
+                if c.get("snap") == name]
+        if kids:
+            raise RadosError(-16, f"snap {name!r} has {len(kids)} "
+                             "clone children")  # EBUSY
+        info["protected"] = False
+        self._save_header()
+
+    def snap_is_protected(self, name: str) -> bool:
+        return bool(self._snap_info(name).get("protected"))
+
+    def list_children(self) -> List[dict]:
+        return list(self.meta.get("children", []))
+
+    def parent_info(self) -> Optional[dict]:
+        return self.meta.get("parent")
 
     # -- exclusive lock (the cls_lock-backed feature) ---------------------
     def _take_lock(self) -> None:
@@ -179,6 +340,9 @@ class Image:
             raise
 
     def close(self) -> None:
+        if self._parent_img is not None:
+            self._parent_img.close()
+            self._parent_img = None
         if self.locked:
             try:
                 self.io.call(_header_oid(self.name), "lock", "unlock",
@@ -208,18 +372,63 @@ class Image:
         self.meta["size"] = new_size
         self.io.write_full(_header_oid(self.name),
                            json.dumps(self.meta).encode())
+        if self.objmap is not None:
+            self.objmap.resize(self._num_blocks())
 
     # -- block IO ----------------------------------------------------------
     def write(self, off: int, data: bytes) -> int:
         if off + len(data) > self.size:
             raise RadosError(-27, "write past image end")  # EFBIG
+        if self.objmap is not None and self.meta.get("parent"):
+            self._cow_write(off, data)
+            return len(data)
         self.striper.write(self.meta["data_prefix"], data, off=off)
         return len(data)
+
+    def _cow_write(self, off: int, data: bytes) -> None:
+        """Copy-on-write: any block touched for the first time is
+        materialized as parent content overlaid with the new bytes in
+        ONE write per block (the reference's copyup before the object
+        write), then marked in the object map."""
+        bs = 1 << self.meta["order"]
+        pos = off
+        end = off + len(data)
+        while pos < end:
+            block = pos // bs
+            bstart = block * bs
+            blen = min(bs, self.size - bstart)
+            seg_end = min(end, bstart + blen)
+            seg = data[pos - off: seg_end - off]
+            if self.objmap.exists(block):
+                self.striper.write(self.meta["data_prefix"], seg, off=pos)
+            else:
+                base = bytearray(self._read_parent(bstart, blen))
+                base[pos - bstart: pos - bstart + len(seg)] = seg
+                self.striper.write(self.meta["data_prefix"], bytes(base),
+                                   off=bstart)
+                self.objmap.set_exists(block)
+            pos = seg_end
+
+    def _read_parent(self, off: int, length: int) -> bytes:
+        """Parent-snap content backing [off, off+length) (zeros past
+        the snap size); parents may themselves be clones — their own
+        read() recurses up the chain."""
+        p = self.meta["parent"]
+        psize = p["size"]
+        if off >= psize:
+            return b"\0" * length
+        n = min(length, psize - off)
+        got = self._parent().read_at_snap(p["snap"], off, n)
+        if len(got) < length:
+            got += b"\0" * (length - len(got))
+        return got
 
     def read(self, off: int, length: int) -> bytes:
         if off >= self.size:
             return b""
         length = min(length, self.size - off)
+        if self.objmap is not None and self.meta.get("parent"):
+            return self._layered_read(off, length)
         try:
             got = self.striper.read(self.meta["data_prefix"], length, off)
         except RadosError as e:
@@ -230,6 +439,67 @@ class Image:
             got = got + b"\0" * (length - len(got))  # sparse tail zeros
         return got
 
+    def _layered_read(self, off: int, length: int) -> bytes:
+        """Per-block dispatch on the object map: a set bit reads the
+        child's objects, a clear bit reads the parent snapshot — the
+        child never pays an object lookup for unwritten blocks
+        (reference ObjectMap fast-diff read path)."""
+        bs = 1 << self.meta["order"]
+        out = []
+        pos = off
+        end = off + length
+        while pos < end:
+            block = pos // bs
+            bstart = block * bs
+            seg_end = min(end, bstart + bs)
+            n = seg_end - pos
+            if self.objmap.exists(block):
+                try:
+                    got = self.striper.read(self.meta["data_prefix"],
+                                            n, pos)
+                except RadosError as e:
+                    if e.rc != -2:
+                        raise
+                    got = b""
+                if len(got) < n:
+                    got += b"\0" * (n - len(got))
+                out.append(got)
+            else:
+                out.append(self._read_parent(pos, n))
+            pos = seg_end
+        return b"".join(out)
+
+    def flatten(self, chunk_blocks: int = 16) -> None:
+        """Copy every parent-backed block into the child and sever the
+        parent link (reference librbd flatten).  Refused while the
+        clone has snapshots: their frozen object maps route unwritten
+        blocks to the parent, which flatten would sever."""
+        if not self.meta.get("parent"):
+            return
+        if self.meta.get("snaps"):
+            raise RadosError(-16, "clone has snapshots; remove them "
+                             "before flatten")  # EBUSY
+        bs = 1 << self.meta["order"]
+        for block in range(self._num_blocks()):
+            if self.objmap.exists(block):
+                continue
+            bstart = block * bs
+            blen = min(bs, self.size - bstart)
+            self.striper.write(self.meta["data_prefix"],
+                               self._read_parent(bstart, blen),
+                               off=bstart)
+            self.objmap.set_exists(block)
+        parent = self.meta.pop("parent")
+        self._save_header()
+        _deregister_child(self.io, parent["image"], self.name)
+        if self._parent_img is not None:
+            self._parent_img.close()
+            self._parent_img = None
+        # the bitmap is meaningless for a non-clone: remove it so a
+        # future same-name clone can never load stale bits
+        self.objmap.remove()
+        self.objmap = None  # no longer a clone: plain reads from here
+
     def discard(self, off: int, length: int) -> None:
         """Zero a range without materializing it in one buffer: chunked
         zero writes, and a tail discard truncates the striped data
@@ -238,7 +508,12 @@ class Image:
         length = min(length, self.size - off)
         if length <= 0:
             return
-        if off + length >= self.size:
+        if off + length >= self.size and self.objmap is None:
+            # tail discard on a NON-clone: drop the extents outright.
+            # A clone cannot take this shortcut — truncating child
+            # objects leaves clear-bit blocks routed to the PARENT, so
+            # the "discarded" range would read back parent data; the
+            # zero-write path below COWs zeros over it instead.
             try:
                 self.striper.truncate(self.meta["data_prefix"], off)
             except RadosError:

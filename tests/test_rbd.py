@@ -221,3 +221,153 @@ def test_mirror_daemon_streams_and_resumes(rbd, client):
         # nothing left: cursor caught up, no re-application
         assert d2.sync_once() == 0
         assert d2.applied + applied_before >= 2
+
+
+def test_clone_layering_full_lifecycle(rbd, client):
+    """create -> write -> snap -> protect -> clone -> child reads fall
+    through -> child COW write -> flatten -> severed from parent
+    (reference librbd::RBD::clone, src/librbd/librbd.cc:506;
+    ObjectMap.h:26 consulted on child reads)."""
+    io = client.rc.ioctx(REP_POOL)
+    rbd.create(io, "base", size=1 << 20, order=16)
+    with rbd.open(io, "base") as base:
+        base.write(0, b"P" * 70_000)          # spans blocks 0-1
+        base.write(500_000, b"Z" * 1_000)
+        base.snap_create("s1")
+        # unprotected snaps cannot be cloned
+        with pytest.raises(Exception):
+            rbd.clone(io, "base", "s1", "early")
+        base.snap_protect("s1")
+        assert base.snap_is_protected("s1")
+        # post-snap writes must NOT leak into the clone
+        base.write(0, b"M" * 10)
+
+    rbd.clone(io, "base", "s1", "child")
+    assert "child" in rbd.list(io)
+    with rbd.open(io, "child") as child:
+        assert child.parent_info()["image"] == "base"
+        # reads fall through to the parent SNAPSHOT (pre-mutation data)
+        assert child.read(0, 10) == b"P" * 10
+        assert child.read(500_000, 1_000) == b"Z" * 1_000
+        assert child.read(900_000, 16) == b"\0" * 16
+        # the object map has no blocks yet
+        assert not child.objmap.exists(0)
+        # COW write: block materializes as parent content + new bytes
+        child.write(5, b"xyz")
+        assert child.objmap.exists(0)
+        assert child.read(0, 10) == b"P" * 5 + b"xyz" + b"P" * 2
+        # parent unchanged
+    with rbd.open(io, "base") as base:
+        assert base.read_at_snap("s1", 0, 10) == b"P" * 10
+        assert base.list_children() == [{"image": "child", "snap": "s1"}]
+        # protected snap can't be removed; unprotect refused with kids
+        with pytest.raises(Exception):
+            base.snap_remove("s1")
+        with pytest.raises(Exception):
+            base.snap_unprotect("s1")
+    # parent can't be removed while the child exists
+    with pytest.raises(Exception):
+        rbd.remove(io, "base")
+
+
+def test_clone_survives_reopen_and_flatten(rbd, client):
+    io = client.rc.ioctx(REP_POOL)
+    # child state (objmap + parent link) survives reopen
+    with rbd.open(io, "child") as child:
+        assert child.objmap.exists(0)
+        assert child.read(0, 10) == b"P" * 5 + b"xyz" + b"P" * 2
+        before = child.read(0, 1 << 20)
+        child.flatten()
+        assert child.parent_info() is None
+        assert child.read(0, 1 << 20) == before
+    # flatten deregistered the child; unprotect + full teardown now works
+    with rbd.open(io, "base") as base:
+        assert base.list_children() == []
+        base.snap_unprotect("s1")
+        base.snap_remove("s1")
+    with rbd.open(io, "child") as child:
+        assert child.read(0, 10) == b"P" * 5 + b"xyz" + b"P" * 2
+    rbd.remove(io, "base")
+    rbd.remove(io, "child")
+    assert "base" not in rbd.list(io)
+
+
+def test_clone_of_clone_chain(rbd, client):
+    """Grandchild reads recurse up a two-level parent chain."""
+    io = client.rc.ioctx(REP_POOL)
+    rbd.create(io, "g0", size=1 << 19, order=16)
+    with rbd.open(io, "g0") as g0:
+        g0.write(0, b"A" * 100)
+        g0.snap_create("s")
+        g0.snap_protect("s")
+    rbd.clone(io, "g0", "s", "g1")
+    with rbd.open(io, "g1") as g1:
+        g1.write(50, b"B" * 100)   # COW block 0
+        g1.snap_create("s")
+        g1.snap_protect("s")
+    rbd.clone(io, "g1", "s", "g2")
+    with rbd.open(io, "g2") as g2:
+        assert g2.read(0, 50) == b"A" * 50       # from g0 via g1
+        assert g2.read(50, 100) == b"B" * 100    # from g1
+        g2.write(0, b"C" * 10)
+        assert g2.read(0, 60) == b"C" * 10 + b"A" * 40 + b"B" * 10
+
+
+def test_clone_snap_read_routes_via_frozen_objmap(rbd, client):
+    """A clone's snapshot must read parent content for blocks that
+    were COW'd only AFTER the snap (the head objmap would lie; the
+    frozen per-snap map routes correctly — reference per-snap
+    rbd_object_map.<id>.<snapid>)."""
+    io = client.rc.ioctx(REP_POOL)
+    rbd.create(io, "srcx", size=1 << 19, order=16)
+    with rbd.open(io, "srcx") as src:
+        src.write(0, b"H" * 200)
+        src.snap_create("p")
+        src.snap_protect("p")
+    rbd.clone(io, "srcx", "p", "cx")
+    with rbd.open(io, "cx") as cx:
+        cx.write(70_000, b"c" * 10)      # COW block 1 only
+        cx.snap_create("csnap")          # freeze: block 0 parent-backed
+        cx.write(0, b"N" * 5)            # COW block 0 AFTER the snap
+        # head: new bytes; snap: original parent content
+        assert cx.read(0, 8) == b"N" * 5 + b"H" * 3
+        assert cx.read_at_snap("csnap", 0, 8) == b"H" * 8
+        assert cx.read_at_snap("csnap", 70_000, 10) == b"c" * 10
+        # flatten refused while the snap pins parent routing
+        with pytest.raises(Exception):
+            cx.flatten()
+        cx.snap_remove("csnap")
+        cx.flatten()
+        assert cx.read(0, 8) == b"N" * 5 + b"H" * 3
+
+
+def test_clone_discard_and_stale_objmap_regressions(rbd, client):
+    """(review findings) discard on a clone must hide parent data, and
+    a flattened-then-removed name must not leave a stale object map
+    for a future same-name clone."""
+    io = client.rc.ioctx(REP_POOL)
+    rbd.create(io, "dp", size=1 << 19, order=16)
+    with rbd.open(io, "dp") as p:
+        p.write(0, b"D" * 100_000)
+        p.snap_create("s")
+        p.snap_protect("s")
+    rbd.clone(io, "dp", "s", "dc")
+    with rbd.open(io, "dc") as c:
+        assert c.read(0, 16) == b"D" * 16
+        c.discard(0, c.size)  # full discard on the CLONE
+        assert c.read(0, 16) == b"\0" * 16       # parent data hidden
+        assert c.read(99_000, 16) == b"\0" * 16
+        c.write(0, b"W" * 8)
+        c.flatten()
+    rbd.remove(io, "dc")
+    # a NEW clone under the same name starts with a fresh object map
+    rbd.clone(io, "dp", "s", "dc")
+    with rbd.open(io, "dc") as c2:
+        assert not c2.objmap.exists(0)
+        assert c2.read(0, 16) == b"D" * 16  # parent visible again
+        c2.flatten()
+    rbd.remove(io, "dc")
+    with rbd.open(io, "dp") as p:
+        p.snap_unprotect("s")
+        p.snap_remove("s")
+    rbd.remove(io, "dp")

@@ -117,10 +117,47 @@ def cmd_journal_replay(rbd, io, args) -> int:
     return 0
 
 
+def cmd_snap(rbd, io, args) -> int:
+    """snap create|protect|unprotect|rm|ls <image> [<snap>]"""
+    sub, image = args[0], args[1]
+    with rbd.open(io, image) as img:
+        if sub == "ls":
+            for s in img.snap_list():
+                prot = " (protected)" if s.get("protected") else ""
+                print(f"{s['id']}\t{s['name']}\t{s['size']}{prot}")
+            return 0
+        snap = args[2]
+        {"create": img.snap_create, "protect": img.snap_protect,
+         "unprotect": img.snap_unprotect,
+         "rm": img.snap_remove}[sub](snap)
+    return 0
+
+
+def cmd_clone(rbd, io, args) -> int:
+    """clone <parent> <snap> <child>"""
+    rbd.clone(io, args[0], args[1], args[2])
+    return 0
+
+
+def cmd_flatten(rbd, io, args) -> int:
+    with rbd.open(io, args[0]) as img:
+        img.flatten()
+    return 0
+
+
+def cmd_children(rbd, io, args) -> int:
+    with rbd.open(io, args[0]) as img:
+        for c in img.list_children():
+            print(f"{c['image']} (from snap {c['snap']})")
+    return 0
+
+
 COMMANDS = {
     "create": cmd_create, "ls": cmd_ls, "info": cmd_info, "rm": cmd_rm,
     "resize": cmd_resize, "import": cmd_import, "export": cmd_export,
     "bench": cmd_bench, "journal-replay": cmd_journal_replay,
+    "snap": cmd_snap, "clone": cmd_clone, "flatten": cmd_flatten,
+    "children": cmd_children,
 }
 
 
