@@ -1,0 +1,188 @@
+"""CephFS client speaking to the MDS daemon.
+
+Reference: src/client/Client.cc (the userspace client) sized down:
+metadata ops travel to the MDS as MClientRequest over the framework
+Messenger; file DATA is striped straight to RADOS by the client (the
+file_layout discipline — the MDS never touches data).  Capabilities
+arrive with open/create replies; MDS-initiated revokes invoke
+`on_cap_revoke` (after flushing any buffered state) and are acked so
+the MDS can grant the conflicting client.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ceph_tpu.cephfs import messages as cm
+from ceph_tpu.cephfs.fs import CephFS
+from ceph_tpu.client.rados import IoCtx, RadosError
+from ceph_tpu.client.striper import RadosStriper
+from ceph_tpu.msg.message import EntityName, Message
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
+
+CAP_RD, CAP_WR, CAP_EXCL = cm.CAP_RD, cm.CAP_WR, cm.CAP_EXCL
+
+
+class MDSError(OSError):
+    def __init__(self, rc: int, what: str = "") -> None:
+        super().__init__(rc, what or f"mds error {rc}")
+        self.rc = rc
+
+
+class _Waiter:
+    def __init__(self) -> None:
+        self.ev = threading.Event()
+        self.reply: Optional[cm.MClientReply] = None
+
+
+class FSClient(Dispatcher):
+    """One mounted client (reference Client.cc role)."""
+
+    def __init__(self, ctx, ioctx: IoCtx, mds_addr: Tuple[str, int],
+                 name: str = "client") -> None:
+        self.ctx = ctx
+        self.io = ioctx
+        self.name = name
+        self.mds_addr = tuple(mds_addr)
+        self.striper = RadosStriper(ioctx, stripe_unit=65536,
+                                    stripe_count=4, object_size=4 << 20)
+        self.caps: Dict[str, int] = {}  # path -> held caps
+        self.revocations: List[Tuple[str, int]] = []  # observed revokes
+        self.on_cap_revoke: Optional[Callable[[str, int], None]] = None
+        self._waiters: Dict[int, _Waiter] = {}
+        self.request_timeout = 30.0
+        self._tid = 0
+        self._lock = threading.Lock()
+        self.msgr = Messenger(ctx, EntityName("client", id(self) & 0xFFFF))
+        self.msgr.add_dispatcher(self)
+        self.msgr.start()
+        self._request("session_open", "/", {"client": name})
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+
+    # -- transport ---------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, cm.MClientReply):
+            w = self._waiters.get(msg.tid)
+            if w:
+                w.reply = msg
+                w.ev.set()
+            return True
+        if isinstance(msg, cm.MClientCaps) and msg.op == "revoke":
+            # flush-then-ack (the client half of Locker's revoke):
+            # buffered state must be visible before the MDS lets a
+            # conflicting client in
+            self.caps[msg.path] = msg.caps
+            self.revocations.append((msg.path, msg.caps))
+            if self.on_cap_revoke:
+                try:
+                    self.on_cap_revoke(msg.path, msg.caps)
+                except Exception:
+                    pass
+            conn.send(cm.MClientCaps("ack", msg.path, msg.caps,
+                                     self.name))
+            return True
+        return False
+
+    def _request(self, op: str, path: str, args: Optional[dict] = None,
+                 timeout: Optional[float] = None) -> cm.MClientReply:
+        timeout = timeout if timeout is not None else self.request_timeout
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+        w = _Waiter()
+        self._waiters[tid] = w
+        try:
+            msg = cm.MClientRequest(op, path, args or {})
+            msg.tid = tid
+            self.msgr.send_message(msg, self.mds_addr)
+            if not w.ev.wait(timeout):
+                raise MDSError(-110, f"mds request {op} timed out")
+            rep = w.reply
+        finally:
+            self._waiters.pop(tid, None)
+        if rep.result < 0:
+            raise MDSError(rep.result, str(rep.data.get("error", "")))
+        return rep
+
+    # -- metadata surface --------------------------------------------------
+    def mkdir(self, path: str) -> None:
+        self._request("mkdir", path)
+
+    def listdir(self, path: str) -> List[str]:
+        return self._request("listdir", path).data["names"]
+
+    def rmdir(self, path: str) -> None:
+        self._request("rmdir", path)
+
+    def stat(self, path: str) -> dict:
+        return self._request("stat", path).data["inode"]
+
+    def unlink(self, path: str) -> None:
+        self._request("unlink", path)
+        self.caps.pop(path, None)
+
+    def rename(self, src: str, dst: str) -> None:
+        self._request("rename", src, {"dst": dst})
+
+    def symlink(self, target: str, linkpath: str) -> None:
+        self._request("symlink", linkpath, {"target": target})
+
+    def readlink(self, path: str) -> str:
+        return self._request("readlink", path).data["target"]
+
+    # -- files + caps ------------------------------------------------------
+    def create(self, path: str, wants: int = CAP_RD | CAP_WR | CAP_EXCL,
+               mode: int = 0o644) -> dict:
+        rep = self._request("create", path,
+                            {"client": self.name, "wants": wants,
+                             "mode": mode})
+        self.caps[path] = rep.data["caps"]
+        return rep.data["inode"]
+
+    def open(self, path: str,
+             wants: int = CAP_RD | CAP_WR | CAP_EXCL) -> dict:
+        rep = self._request("open", path,
+                            {"client": self.name, "wants": wants})
+        self.caps[path] = rep.data["caps"]
+        return rep.data["inode"]
+
+    def close(self, path: str) -> None:
+        self._request("close", path, {"client": self.name})
+        self.caps.pop(path, None)
+
+    def held_caps(self, path: str) -> int:
+        return self.caps.get(path, 0)
+
+    # -- data IO (client-direct striping; size via MDS setattr) -----------
+    def write(self, path: str, data: bytes, off: int = 0) -> int:
+        inode = self.stat(path)
+        if inode["type"] != "file":
+            raise MDSError(-21, "is a directory")  # EISDIR
+        self.striper.write(CephFS._data_oid(inode["ino"]), data, off=off)
+        new_size = max(inode.get("size", 0), off + len(data))
+        self._request("setattr", path,
+                      {"attrs": {"size": new_size, "mtime": time.time()}})
+        return len(data)
+
+    def read(self, path: str, length: int = 0, off: int = 0) -> bytes:
+        inode = self.stat(path)
+        size = inode.get("size", 0)
+        if length <= 0:
+            length = max(0, size - off)
+        length = min(length, max(0, size - off))
+        if length == 0:
+            return b""
+        try:
+            got = self.striper.read(CephFS._data_oid(inode["ino"]),
+                                    length, off)
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            got = b""
+        if len(got) < length:
+            got += b"\0" * (length - len(got))
+        return got

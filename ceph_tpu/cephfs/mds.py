@@ -1,0 +1,327 @@
+"""MDS — the metadata server daemon with journaled metadata + caps.
+
+Reference roles re-derived (not ported):
+
+- **Journaled metadata with crash replay** (src/mds/journal.cc +
+  MDLog): every metadata mutation is appended to a RADOS-backed
+  write-ahead journal (EUpdate role) BEFORE it is applied to the
+  backing dentry store, and the journal's commit pointer advances only
+  every `commit_every` events.  A crashed MDS (kill -9 between journal
+  append and a multi-step apply, e.g. mid-rename) replays the
+  uncommitted tail on restart: events are idempotent, so replay
+  converges on exactly the intended tree.
+- **Client capabilities** (src/mds/Locker.cc:106 handle_client_caps,
+  collapsed to the RD/WR/EXCL trio): clients acquire caps at open;
+  conflicting acquisitions revoke the EXCL of other holders
+  (MClientCaps "revoke" -> client flushes -> "ack"), and an EXCL
+  grant is downgraded when other clients hold the file.  This is the
+  consistency contract that lets a sole client buffer writes.
+- Sessions ride the framework Messenger (MClientRequest/Reply), the
+  same transport every other daemon family uses.
+
+Data IO stays client-direct (clients stripe file data straight to
+RADOS, exactly like CephFS clients do) — only metadata routes here.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.cephfs import messages as cm
+from ceph_tpu.cephfs.fs import CephFS, FSError, NoSuchEntry
+from ceph_tpu.client.rados import IoCtx, RadosError
+from ceph_tpu.msg.message import EntityName, Message
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
+from ceph_tpu.rbd.journal import Journaler
+
+EPERM, ENOENT, EEXIST, EBUSY, EINVAL, ENOTDIR, ENOTEMPTY = (
+    -1, -2, -17, -16, -22, -20, -39)
+
+
+class MDSDaemon(Dispatcher):
+    """Single active MDS (rank 0).  `commit_every` is the journal
+    commit lag — the window a crash leaves for replay to heal."""
+
+    def __init__(self, ctx, ioctx: IoCtx, bind_port: int = 0,
+                 commit_every: int = 16) -> None:
+        self.ctx = ctx
+        self.io = ioctx
+        self.fs = CephFS(ioctx)
+        self.commit_every = commit_every
+        self.journal = Journaler(ioctx, "mds0")
+        self.journal.create()
+        self._log = ctx.log.dout("mds")
+        self.lock = threading.RLock()
+        # caps[path] = {client: caps bits}; client -> session conn
+        self.caps: Dict[str, Dict[str, int]] = {}
+        self._grant_locks: Dict[str, threading.Lock] = {}
+        self.sessions: Dict[str, Connection] = {}
+        self._cap_acks: Dict[Tuple[str, str], threading.Event] = {}
+        self._uncommitted = 0
+        self._applied_seq = 0
+        # fault injection for crash tests: apply only the first N
+        # backing-store steps of the next event, then die
+        self._apply_steps_left: Optional[int] = None
+        self.msgr = Messenger(ctx, EntityName("mds", 0),
+                              bind_port=bind_port)
+        self.msgr.add_dispatcher(self)
+        self.msgr.start()
+        self.addr = self.msgr.addr
+        self.replay()
+
+    # -- lifecycle / journal ----------------------------------------------
+    def replay(self) -> None:
+        """Crash recovery (reference MDLog replay): re-apply every
+        journaled event past the commit pointer.  Events are
+        idempotent, so re-applying an already-half-applied suffix
+        converges."""
+        entries = self.journal.entries(after=self.journal.committed())
+        for seq, payload in entries:
+            ev = json.loads(payload.decode())
+            try:
+                self._apply(ev)
+            except (FSError, RadosError):
+                pass  # already fully applied before the crash
+            self._applied_seq = seq
+        if entries:
+            self._log(1, f"mds: replayed {len(entries)} journal events")
+            self.journal.commit(self._applied_seq)
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+
+    def kill(self) -> None:
+        """Crash (no journal commit, no flush) — the test hook."""
+        self.msgr.shutdown()
+
+    # -- journaled mutation pipeline --------------------------------------
+    def _submit(self, ev: dict) -> None:
+        """EUpdate discipline: journal first, then apply; commit lazily."""
+        seq = self.journal.append(json.dumps(ev).encode())
+        self._apply(ev)
+        self._applied_seq = seq
+        self._uncommitted += 1
+        if self._uncommitted >= self.commit_every:
+            self.journal.commit(seq)
+            self._uncommitted = 0
+
+    def _step(self) -> None:
+        """Fault-injection gate between backing-store steps."""
+        if self._apply_steps_left is not None:
+            if self._apply_steps_left <= 0:
+                raise _Crashed()
+            self._apply_steps_left -= 1
+
+    def _apply(self, ev: dict) -> None:
+        op = ev["op"]
+        fs = self.fs
+        if op == "mkdir":
+            self._step()
+            try:
+                fs.mkdir(ev["path"])
+            except (FSError, RadosError):
+                pass  # already exists: replayed event
+        elif op == "create":
+            # idempotent create: link only when absent
+            try:
+                fs._lookup(ev["path"])
+            except NoSuchEntry:
+                self._step()
+                parent, name = fs._split(ev["path"])
+                fs._link(parent, name, ev["inode"])
+        elif op == "unlink":
+            self._step()
+            try:
+                fs.unlink(ev["path"])
+            except FSError:
+                pass
+        elif op == "rmdir":
+            self._step()
+            try:
+                fs.rmdir(ev["path"])
+            except FSError:
+                pass
+        elif op == "rename":
+            # two backing steps: unlink src, link dst — the torn-crash
+            # case replay exists for
+            src, dst = ev["src"], ev["dst"]
+            try:
+                inode = fs._lookup(src)
+                self._step()
+                sp, sn = fs._split(src)
+                fs._unlink(sp, sn)
+            except NoSuchEntry:
+                inode = ev.get("inode")  # src already gone: use journaled
+            if inode is not None:
+                self._step()
+                dp, dn = fs._split(dst)
+                fs._link(dp, dn, inode, replace=True)
+        elif op == "setattr":
+            self._step()
+            try:
+                parent, name = fs._split(ev["path"])
+                inode = fs._lookup(ev["path"])
+                inode.update(ev["attrs"])
+                fs._link(parent, name, inode, replace=True)
+            except NoSuchEntry:
+                pass
+        elif op == "symlink":
+            try:
+                fs._lookup(ev["path"])
+            except NoSuchEntry:
+                self._step()
+                fs.symlink(ev["target"], ev["path"])
+        else:
+            self._log(1, f"mds: unknown journal op {op!r}")
+
+    # -- capabilities (Locker role) ---------------------------------------
+    def _grant_caps(self, path: str, client: str, wants: int) -> int:
+        """Arbitrate `wants` against current holders; revokes other
+        holders' EXCL synchronously (they flush, then ack).  The whole
+        revoke+grant sequence is serialized PER PATH: two concurrent
+        EXCL opens must arbitrate against each other, not race past
+        the holder scan (requests run on their own threads)."""
+        with self.lock:
+            plock = self._grant_locks.setdefault(path, threading.Lock())
+        with plock:
+            return self._grant_caps_locked(path, client, wants)
+
+    def _grant_caps_locked(self, path: str, client: str,
+                           wants: int) -> int:
+        with self.lock:
+            holders = self.caps.setdefault(path, {})
+            to_revoke: List[Tuple[str, int]] = []
+            for other, held in holders.items():
+                if other == client:
+                    continue
+                if held & cm.CAP_EXCL:
+                    # any second holder breaks exclusivity
+                    to_revoke.append((other, held & ~cm.CAP_EXCL))
+        for other, newcaps in to_revoke:
+            self._revoke(path, other, newcaps)
+        with self.lock:
+            holders = self.caps.setdefault(path, {})
+            grant = wants
+            if any(o != client for o in holders):
+                grant &= ~cm.CAP_EXCL  # shared file: nobody buffers
+            holders[client] = holders.get(client, 0) | grant
+            return grant
+
+    def _revoke(self, path: str, client: str, newcaps: int) -> None:
+        conn = self.sessions.get(client)
+        if conn is None:
+            with self.lock:
+                self.caps.get(path, {}).pop(client, None)
+            return
+        ev = threading.Event()
+        self._cap_acks[(path, client)] = ev
+        try:
+            conn.send(cm.MClientCaps("revoke", path, newcaps, client))
+            if not ev.wait(timeout=10.0):
+                self._log(1, f"mds: cap revoke timeout {client} {path}")
+            with self.lock:
+                self.caps.setdefault(path, {})[client] = newcaps
+                if newcaps == 0:
+                    self.caps[path].pop(client, None)
+        finally:
+            self._cap_acks.pop((path, client), None)
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, cm.MClientCaps):
+            if msg.op == "ack":
+                ev = self._cap_acks.get((msg.path, msg.client))
+                if ev:
+                    ev.set()
+            elif msg.op == "release":
+                with self.lock:
+                    self.caps.get(msg.path, {}).pop(msg.client, None)
+            return True
+        if not isinstance(msg, cm.MClientRequest):
+            return False
+        # requests may block on cap revokes (peer round-trips): run
+        # them off the dispatch thread
+        threading.Thread(target=self._handle_request, daemon=True,
+                         args=(conn, msg)).start()
+        return True
+
+    def _handle_request(self, conn: Connection,
+                        msg: cm.MClientRequest) -> None:
+        try:
+            rep = self._do_op(conn, msg)
+        except _Crashed:
+            return  # injected crash: no reply, daemon is "dead"
+        except NoSuchEntry:
+            rep = cm.MClientReply(ENOENT)
+        except FSError as e:
+            rep = cm.MClientReply(EINVAL, {"error": str(e)})
+        except RadosError as e:
+            rep = cm.MClientReply(e.rc, {"error": str(e)})
+        rep.tid = msg.tid
+        conn.send(rep)
+
+    def _do_op(self, conn, msg) -> cm.MClientReply:
+        op, path, args = msg.op, msg.path, msg.args
+        if op == "session_open":
+            client = args["client"]
+            self.sessions[client] = conn
+            return cm.MClientReply(0, {"mds": 0})
+        if op == "mkdir":
+            self._submit({"op": "mkdir", "path": path})
+            return cm.MClientReply(0)
+        if op == "create":
+            ino = self.fs._next_ino()
+            inode = {"type": "file", "ino": ino, "size": 0,
+                     "mtime": time.time(), "mode": args.get("mode", 0o644)}
+            self._submit({"op": "create", "path": path, "inode": inode})
+            grant = self._grant_caps(path, args["client"],
+                                     args.get("wants", cm.CAP_RD))
+            return cm.MClientReply(0, {"inode": inode, "caps": grant})
+        if op == "open":
+            inode = self.fs._lookup(path)
+            grant = self._grant_caps(path, args["client"],
+                                     args.get("wants", cm.CAP_RD))
+            return cm.MClientReply(0, {"inode": inode, "caps": grant})
+        if op == "close":
+            with self.lock:
+                self.caps.get(path, {}).pop(args["client"], None)
+            return cm.MClientReply(0)
+        if op == "stat":
+            return cm.MClientReply(0, {"inode": self.fs._lookup(path)})
+        if op == "listdir":
+            return cm.MClientReply(0, {"names": self.fs.listdir(path)})
+        if op == "unlink":
+            self.fs._lookup(path)  # ENOENT surfaces before journaling
+            self._submit({"op": "unlink", "path": path})
+            with self.lock:
+                self.caps.pop(path, None)
+            return cm.MClientReply(0)
+        if op == "rmdir":
+            if self.fs.listdir(path):
+                return cm.MClientReply(ENOTEMPTY)
+            self._submit({"op": "rmdir", "path": path})
+            return cm.MClientReply(0)
+        if op == "rename":
+            inode = self.fs._lookup(path)
+            self._submit({"op": "rename", "src": path,
+                          "dst": args["dst"], "inode": inode})
+            return cm.MClientReply(0)
+        if op == "setattr":
+            self.fs._lookup(path)
+            self._submit({"op": "setattr", "path": path,
+                          "attrs": args["attrs"]})
+            return cm.MClientReply(0)
+        if op == "symlink":
+            self._submit({"op": "symlink", "path": path,
+                          "target": args["target"]})
+            return cm.MClientReply(0)
+        if op == "readlink":
+            return cm.MClientReply(0, {"target": self.fs.readlink(path)})
+        return cm.MClientReply(EINVAL, {"error": f"unknown op {op!r}"})
+
+
+class _Crashed(Exception):
+    pass

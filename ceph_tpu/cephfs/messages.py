@@ -1,0 +1,88 @@
+"""CephFS wire messages (reference src/messages/MClientRequest.h,
+MClientReply.h, MClientCaps.h — the client<->MDS protocol, sized to
+this framework's MDS)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.msg.message import Message, register
+
+# capability bits (reference CEPH_CAP_* collapsed to the file-level
+# trio the Locker arbitration needs)
+CAP_RD = 1    # may read (and cache reads)
+CAP_WR = 2    # may write through
+CAP_EXCL = 4  # sole client: may buffer writes / cache aggressively
+
+
+@register
+class MClientRequest(Message):
+    """client -> MDS: one metadata op (mkdir/stat/open/...)."""
+
+    TYPE = 42
+
+    def __init__(self, op: str = "", path: str = "",
+                 args: Optional[Dict[str, Any]] = None) -> None:
+        super().__init__()
+        self.op = op
+        self.path = path
+        self.args = args or {}
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.op).string(self.path)
+        e.blob(json.dumps(self.args).encode())
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.op = d.string()
+        self.path = d.string()
+        self.args = json.loads(d.blob().decode())
+
+
+@register
+class MClientReply(Message):
+    TYPE = 43
+
+    def __init__(self, result: int = 0,
+                 data: Optional[Dict[str, Any]] = None) -> None:
+        super().__init__()
+        self.result = result
+        self.data = data or {}
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.result)
+        e.blob(json.dumps(self.data).encode())
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.result = d.s32()
+        self.data = json.loads(d.blob().decode())
+
+
+@register
+class MClientCaps(Message):
+    """Bidirectional cap traffic (reference MClientCaps):
+    op="revoke":  MDS -> client: your caps on `path` shrink to `caps`
+    op="ack":     client -> MDS: flushed + accepted the shrink
+    op="release": client -> MDS: dropping caps voluntarily (close)
+    """
+
+    TYPE = 44
+
+    def __init__(self, op: str = "", path: str = "", caps: int = 0,
+                 client: str = "") -> None:
+        super().__init__()
+        self.op = op
+        self.path = path
+        self.caps = caps
+        self.client = client
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.string(self.op).string(self.path).u32(self.caps)
+        e.string(self.client)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.op = d.string()
+        self.path = d.string()
+        self.caps = d.u32()
+        self.client = d.string()

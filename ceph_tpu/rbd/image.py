@@ -63,17 +63,25 @@ class RBD:
 
     def remove(self, io: IoCtx, name: str) -> None:
         img = Image(io, name)
-        if img.meta.get("children"):
+        kids = _children_of(io, name)
+        if kids:
             raise RadosError(  # ENOTEMPTY, as the reference refuses
-                -39, f"image {name!r} has {len(img.meta['children'])} "
-                "clone children")
+                -39, f"image {name!r} has {len(kids)} clone children")
         try:
             img.striper.remove(img.meta["data_prefix"])
         except RadosError:
             pass
-        from ceph_tpu.rbd.objectmap import ObjectMap
+        from ceph_tpu.rbd import objectmap as om_
 
-        ObjectMap(io, name, 0).remove()  # head map, clone or not
+        for oid in [om_._oid(name)] + [
+                om_._oid(name, sinfo["id"])
+                for sinfo in img.meta.get("snaps", {}).values()]:
+            # direct removes: no reason to read a bitmap to delete it,
+            # and per-snap frozen maps would otherwise leak forever
+            try:
+                io.remove(oid)
+            except RadosError:
+                pass
         parent = img.meta.get("parent")
         if parent:
             _deregister_child(io, parent["image"], name)
@@ -111,11 +119,12 @@ class RBD:
             meta["parent"] = {"image": parent, "snap": snap,
                               "snapid": info["id"], "size": info["size"]}
             io.write_full(_header_oid(child), json.dumps(meta).encode())
-            # register the child on the parent header (unprotect and
-            # parent removal must see it)
-            p.meta.setdefault("children", []).append(
-                {"image": child, "snap": snap})
-            p._save_header()
+            # register the child as an OMAP key on the parent header
+            # (cls_rbd children keys): atomic server-side, so a stale
+            # in-memory header on some other open handle can never
+            # erase the registration with a full-header rewrite
+            io.omap_set(_header_oid(parent),
+                        {f"child.{child}": snap.encode()})
 
 
 def _omap_rm(key: str):
@@ -126,15 +135,27 @@ def _omap_rm(key: str):
 
 
 def _deregister_child(io: IoCtx, parent_image: str, child: str) -> None:
-    """Drop `child` from the parent's children list (cls_rbd children
+    """Drop `child` from the parent's children omap (cls_rbd children
     bookkeeping role); parent already gone is fine."""
     try:
-        with Image(io.client.ioctx(io.pool), parent_image) as p:
-            p.meta["children"] = [c for c in p.meta.get("children", [])
-                                  if c["image"] != child]
-            p._save_header()
+        io.stat(_header_oid(parent_image))  # write ops create-on-miss:
+        # a removed parent must stay removed, not come back as an
+        # empty header object
+        io.operate(_header_oid(parent_image),
+                   [_omap_rm(f"child.{child}")])
     except RadosError:
         pass
+
+
+def _children_of(io: IoCtx, image: str) -> List[dict]:
+    try:
+        om = io.omap_get(_header_oid(image))
+    except RadosError as e:
+        if e.rc != -2:
+            raise  # transient IO failure must not read as "no children"
+        return []
+    return [{"image": k[len("child."):], "snap": v.decode()}
+            for k, v in sorted(om.items()) if k.startswith("child.")]
 
 
 class Image:
@@ -215,6 +236,11 @@ class Image:
             raise RadosError(-17, f"snap {name!r} exists")  # EEXIST
         snapid = self.io.selfmanaged_snap_create()
         snaps[name] = {"id": snapid, "size": self.size}
+        if self.meta.get("parent"):
+            # freeze the parent overlap: a later head shrink clips the
+            # LIVE overlap but must never change what this snapshot
+            # reads (reference: per-snap parent overlap in snap_info)
+            snaps[name]["parent_overlap"] = self.meta["parent"]["size"]
         self.io.write_full(_header_oid(self.name),
                            json.dumps(self.meta).encode())
         if self.objmap is not None:
@@ -269,7 +295,8 @@ class Image:
                     got += b"\0" * (n - len(got))
                 out.append(got)
             else:
-                out.append(self._read_parent(pos, n))
+                out.append(self._read_parent(
+                    pos, n, overlap=info.get("parent_overlap")))
             pos = seg_end
         return b"".join(out)
 
@@ -310,7 +337,7 @@ class Image:
 
     def snap_unprotect(self, name: str) -> None:
         info = self._snap_info(name)
-        kids = [c for c in self.meta.get("children", [])
+        kids = [c for c in _children_of(self.io, self.name)
                 if c.get("snap") == name]
         if kids:
             raise RadosError(-16, f"snap {name!r} has {len(kids)} "
@@ -322,7 +349,7 @@ class Image:
         return bool(self._snap_info(name).get("protected"))
 
     def list_children(self) -> List[dict]:
-        return list(self.meta.get("children", []))
+        return _children_of(self.io, self.name)
 
     def parent_info(self) -> Optional[dict]:
         return self.meta.get("parent")
@@ -369,6 +396,13 @@ class Image:
                 self.striper.truncate(self.meta["data_prefix"], new_size)
             except RadosError:
                 pass
+            if self.meta.get("parent"):
+                # a shrink destroys the range: parent data must not
+                # re-appear if the image later grows back (the same
+                # hazard discard() guards against) — clip the LIVE
+                # parent overlap (snapshots keep their frozen one)
+                self.meta["parent"]["size"] = min(
+                    self.meta["parent"]["size"], new_size)
         self.meta["size"] = new_size
         self.io.write_full(_header_oid(self.name),
                            json.dumps(self.meta).encode())
@@ -409,12 +443,14 @@ class Image:
                 self.objmap.set_exists(block)
             pos = seg_end
 
-    def _read_parent(self, off: int, length: int) -> bytes:
+    def _read_parent(self, off: int, length: int,
+                     overlap: Optional[int] = None) -> bytes:
         """Parent-snap content backing [off, off+length) (zeros past
-        the snap size); parents may themselves be clones — their own
-        read() recurses up the chain."""
+        the overlap); parents may themselves be clones — their own
+        read() recurses up the chain.  `overlap` overrides the live
+        parent coverage (snap reads pass their frozen value)."""
         p = self.meta["parent"]
-        psize = p["size"]
+        psize = p["size"] if overlap is None else overlap
         if off >= psize:
             return b"\0" * length
         n = min(length, psize - off)
@@ -469,7 +505,7 @@ class Image:
             pos = seg_end
         return b"".join(out)
 
-    def flatten(self, chunk_blocks: int = 16) -> None:
+    def flatten(self) -> None:
         """Copy every parent-backed block into the child and sever the
         parent link (reference librbd flatten).  Refused while the
         clone has snapshots: their frozen object maps route unwritten

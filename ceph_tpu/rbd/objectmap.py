@@ -39,7 +39,11 @@ class ObjectMap:
         self.num_blocks = num_blocks
         try:
             raw = bytearray(io.read(_oid(image, snapid)))
-        except RadosError:
+        except RadosError as e:
+            if e.rc != -2:
+                raise  # a real IO failure must surface: an all-clear
+                # map would route reads to the parent and let the next
+                # write copy parent data OVER existing child objects
             raw = bytearray()
         want = (num_blocks + 7) // 8
         if len(raw) < want:

@@ -1,0 +1,186 @@
+"""MDS daemon: journaled metadata with crash replay + client caps.
+
+Reference: src/mds/journal.cc (EUpdate/MDLog replay), Locker.cc:106
+(handle_client_caps).  VERDICT-r3 done criteria: two clients
+contending on one file observe cap revocation; killing and restarting
+the MDS replays the journal to an identical tree.
+"""
+
+import threading
+
+import pytest
+
+from ceph_tpu.cephfs import messages as cm
+from ceph_tpu.cephfs.client import CAP_EXCL, CAP_RD, CAP_WR, FSClient, MDSError
+from ceph_tpu.cephfs.fs import CephFS
+from ceph_tpu.cephfs.mds import MDSDaemon
+
+from tests.test_osd_cluster import REP_POOL, LibClient, MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def rc(cluster):
+    cl = LibClient(cluster)
+    yield cl
+    cl.shutdown()
+
+
+@pytest.fixture()
+def mds(cluster, rc):
+    d = MDSDaemon(cluster.ctx, rc.rc.ioctx(REP_POOL), commit_every=1000)
+    yield d
+    d.shutdown()
+
+
+def _mount(cluster, rc, mds, name):
+    return FSClient(cluster.ctx, rc.rc.ioctx(REP_POOL), mds.addr,
+                    name=name)
+
+
+def _tree(io) -> dict:
+    """Full tree walk straight off the backing store (no MDS)."""
+    fs = CephFS(io)
+
+    def walk(path):
+        out = {}
+        for name in fs.listdir(path):
+            p = f"{path.rstrip('/')}/{name}"
+            ent = fs._lookup(p)
+            if ent["type"] == "dir":
+                out[name] = walk(p)
+            else:
+                out[name] = (ent["type"], ent.get("size", 0))
+        return out
+
+    return walk("/")
+
+
+def test_metadata_ops_through_mds(cluster, rc, mds):
+    c = _mount(cluster, rc, mds, "cl1")
+    try:
+        c.mkdir("/a")
+        c.mkdir("/a/b")
+        c.create("/a/b/f", wants=CAP_RD | CAP_WR)
+        c.write("/a/b/f", b"hello mds" * 100)
+        assert c.read("/a/b/f") == b"hello mds" * 100
+        assert c.listdir("/a") == ["b"]
+        assert c.stat("/a/b/f")["size"] == 900
+        c.symlink("/a/b/f", "/a/lnk")
+        assert c.readlink("/a/lnk") == "/a/b/f"
+        c.rename("/a/b/f", "/a/g")
+        assert c.listdir("/a/b") == []
+        assert c.read("/a/g") == b"hello mds" * 100  # data followed ino
+        with pytest.raises(MDSError):
+            c.rmdir("/a")  # not empty
+        with pytest.raises(MDSError):
+            c.stat("/nope")
+    finally:
+        c.shutdown()
+
+
+def test_cap_revocation_between_clients(cluster, rc, mds):
+    """Client A holds EXCL; client B opening the same file forces a
+    revoke A observes (and must flush on) before B's grant."""
+    a = _mount(cluster, rc, mds, "A")
+    b = _mount(cluster, rc, mds, "B")
+    flushed = threading.Event()
+    try:
+        a.create("/shared", wants=CAP_RD | CAP_WR | CAP_EXCL)
+        assert a.held_caps("/shared") & CAP_EXCL
+
+        a.on_cap_revoke = lambda path, caps: flushed.set()
+        got = b.open("/shared", wants=CAP_RD)
+        # A saw the revoke and its EXCL is gone
+        assert flushed.wait(5), "A never observed the revoke"
+        assert a.revocations and a.revocations[0][0] == "/shared"
+        assert not (a.held_caps("/shared") & CAP_EXCL)
+        assert a.held_caps("/shared") & (CAP_RD | CAP_WR)
+        # B's grant on a shared file excludes EXCL
+        assert b.held_caps("/shared") & CAP_RD
+        assert not (b.held_caps("/shared") & CAP_EXCL)
+        # once B releases, a fresh EXCL open by A succeeds again
+        b.close("/shared")
+        a.close("/shared")
+        a.open("/shared", wants=CAP_RD | CAP_WR | CAP_EXCL)
+        assert a.held_caps("/shared") & CAP_EXCL
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_mds_crash_replay_identical_tree(cluster, rc):
+    """Build a tree, hard-kill the MDS (journal uncommitted), restart:
+    replay reproduces the identical tree."""
+    io = rc.rc.ioctx(REP_POOL)
+    mds = MDSDaemon(cluster.ctx, io, commit_every=1000)
+    c = _mount(cluster, rc, mds, "crasher")
+    try:
+        c.mkdir("/crash")
+        c.mkdir("/crash/d1")
+        c.create("/crash/d1/f1", wants=CAP_RD | CAP_WR)
+        c.write("/crash/d1/f1", b"x" * 1234)
+        c.rename("/crash/d1/f1", "/crash/f1moved")
+        c.symlink("/crash/f1moved", "/crash/ln")
+        before = _tree(io)
+        assert mds.journal.committed() < mds.journal.head()
+    finally:
+        c.shutdown()
+        mds.kill()  # no commit, no graceful anything
+
+    mds2 = MDSDaemon(cluster.ctx, io, commit_every=1000)
+    try:
+        assert _tree(io) == before
+        # post-replay the commit pointer caught up
+        assert mds2.journal.committed() == mds2.journal.head()
+        # and the restarted MDS serves the same namespace
+        c2 = _mount(cluster, rc, mds2, "survivor")
+        try:
+            assert sorted(c2.listdir("/crash")) == ["d1", "f1moved", "ln"]
+            assert c2.stat("/crash/f1moved")["size"] == 1234
+        finally:
+            c2.shutdown()
+    finally:
+        mds2.shutdown()
+
+
+def test_mds_torn_rename_healed_by_replay(cluster, rc):
+    """Crash BETWEEN the two backing-store steps of a rename (after
+    unlink-src, before link-dst): the file is in NEITHER directory on
+    disk.  Replay completes the journaled intent — this is the crash
+    window the journal exists for (reference EUpdate replay)."""
+    io = rc.rc.ioctx(REP_POOL)
+    mds = MDSDaemon(cluster.ctx, io, commit_every=1000)
+    c = _mount(cluster, rc, mds, "tearer")
+    try:
+        c.mkdir("/torn")
+        c.create("/torn/src", wants=CAP_RD | CAP_WR)
+        c.write("/torn/src", b"survive me" * 10)
+        # crash after exactly ONE backing step of the next event
+        mds._apply_steps_left = 1
+        c.request_timeout = 3.0
+        with pytest.raises(MDSError):  # request dies with the daemon
+            c.rename("/torn/src", "/torn/dst")
+    finally:
+        c.shutdown()
+        mds.kill()
+
+    fs = CephFS(io)
+    assert fs.listdir("/torn") == []  # torn: file vanished on disk
+
+    mds2 = MDSDaemon(cluster.ctx, io, commit_every=1000)
+    try:
+        assert fs.listdir("/torn") == ["dst"]  # replay healed it
+        c2 = _mount(cluster, rc, mds2, "checker")
+        try:
+            assert c2.read("/torn/dst") == b"survive me" * 10
+        finally:
+            c2.shutdown()
+    finally:
+        mds2.shutdown()

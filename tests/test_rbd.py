@@ -272,6 +272,18 @@ def test_clone_layering_full_lifecycle(rbd, client):
 
 def test_clone_survives_reopen_and_flatten(rbd, client):
     io = client.rc.ioctx(REP_POOL)
+    # continues the lifecycle test's images on purpose: reopen must see
+    # state PERSISTED by a different Image instance.  Recreate them if
+    # running standalone.
+    if "base" not in rbd.list(io):
+        rbd.create(io, "base", size=1 << 20, order=16)
+        with rbd.open(io, "base") as b:
+            b.write(0, b"P" * 70_000)
+            b.snap_create("s1")
+            b.snap_protect("s1")
+        rbd.clone(io, "base", "s1", "child")
+        with rbd.open(io, "child") as c:
+            c.write(5, b"xyz")
     # child state (objmap + parent link) survives reopen
     with rbd.open(io, "child") as child:
         assert child.objmap.exists(0)
@@ -371,3 +383,31 @@ def test_clone_discard_and_stale_objmap_regressions(rbd, client):
         p.snap_unprotect("s")
         p.snap_remove("s")
     rbd.remove(io, "dp")
+
+
+def test_clone_shrink_preserves_snapshot_and_hides_regrown(rbd, client):
+    """(review) A clone snapshot's parent overlap freezes at
+    snap_create: a later head shrink must not change what the snap
+    reads; and a shrink+regrow must read zeros, not parent data."""
+    io = client.rc.ioctx(REP_POOL)
+    rbd.create(io, "rp", size=1 << 19, order=16)
+    with rbd.open(io, "rp") as p:
+        p.write(0, b"R" * (1 << 19))
+        p.snap_create("s")
+        p.snap_protect("s")
+    rbd.clone(io, "rp", "s", "rc")
+    with rbd.open(io, "rc") as c:
+        c.snap_create("keep")
+        c.resize(1 << 16)            # shrink clips LIVE overlap only
+        c.resize(1 << 19)            # regrow
+        # snapshot still sees the parent content it saw at snap time
+        assert c.read_at_snap("keep", 300_000, 8) == b"R" * 8
+        # head reads zeros in the destroyed+regrown range
+        assert c.read(300_000, 8) == b"\0" * 8
+        c.snap_remove("keep")
+        c.flatten()
+    rbd.remove(io, "rc")
+    with rbd.open(io, "rp") as p:
+        p.snap_unprotect("s")
+        p.snap_remove("s")
+    rbd.remove(io, "rp")
