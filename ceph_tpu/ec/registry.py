@@ -81,7 +81,57 @@ class ErasureCodePluginRegistry:
     def factory(self, plugin: str, profile: dict) -> ErasureCode:
         if plugin not in self._factories:
             raise ErasureCodeError(f"unknown erasure-code plugin {plugin!r}")
-        return self._factories[plugin](dict(profile))
+        try:
+            return self._factories[plugin](dict(profile))
+        except ErasureCodeError:
+            raise
+        except Exception as e:
+            # a plugin whose init throws must surface as a clean load
+            # failure, never a raw traceback into the daemon (reference
+            # negative fixture ErasureCodePluginFailToInitialize.cc)
+            raise ErasureCodeError(
+                f"erasure-code plugin {plugin!r} failed to "
+                f"initialize: {e!r}") from e
+
+    ENTRY_POINT = "ec_plugin_create"
+
+    def load_module(self, name: str, module: str,
+                    timeout_s: float = 10.0) -> None:
+        """Third-party plugin loading — the dlopen analog (reference
+        ErasureCodePlugin.cc:126-186): import `module`, resolve the
+        well-known entry point, register it under `name`.  Mirrors the
+        reference's deliberately-broken fixtures: a module without the
+        entry point is a clean error (…MissingEntryPoint.cc), and an
+        import that HANGS past timeout_s fails the load instead of
+        wedging the daemon (…Hangs.cc)."""
+        import importlib
+        import threading as _t
+
+        box: list = [None, None]  # (module, exc)
+
+        def _imp():
+            try:
+                box[0] = importlib.import_module(module)
+            except BaseException as e:  # noqa: BLE001
+                box[1] = e
+
+        th = _t.Thread(target=_imp, daemon=True)
+        th.start()
+        th.join(timeout_s)
+        if th.is_alive():
+            raise ErasureCodeError(
+                f"plugin {name!r} ({module}) hung during load "
+                f"(> {timeout_s}s)")
+        if box[1] is not None:
+            raise ErasureCodeError(
+                f"plugin {name!r} ({module}) failed to load: "
+                f"{box[1]!r}") from box[1]
+        entry = getattr(box[0], self.ENTRY_POINT, None)
+        if entry is None or not callable(entry):
+            raise ErasureCodeError(
+                f"plugin {name!r} ({module}) has no "
+                f"{self.ENTRY_POINT!r} entry point")
+        self.add(name, entry)
 
 
 def _lazy(module: str, cls: str) -> Factory:

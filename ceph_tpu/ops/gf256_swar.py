@@ -82,13 +82,12 @@ def _build_network(matrix: np.ndarray) -> Callable[[jax.Array], jax.Array]:
 _cache: Dict[Tuple[bytes, Tuple[int, int]], Callable] = {}
 
 
-def _compiled(matrix: np.ndarray) -> Callable:
-    key = (matrix.tobytes(), matrix.shape)
+def _compiled(matrix: np.ndarray, donate: bool = False) -> Callable:
+    key = (matrix.tobytes(), matrix.shape, donate)
     fn = _cache.get(key)
     if fn is None:
         net = _build_network(matrix)
 
-        @jax.jit
         def run(x: jax.Array) -> jax.Array:
             k, n = x.shape
             words = jax.lax.bitcast_convert_type(
@@ -99,15 +98,23 @@ def _compiled(matrix: np.ndarray) -> Callable:
                 matrix.shape[0], n
             )
 
-        fn = run
+        # donate=True aliases the input planes for scratch: once
+        # encoded, the source buffer is dead weight, so HBM holds ~one
+        # batch instead of two.  Only for callers handing over a fresh
+        # per-batch buffer (the StripeBatchQueue pipeline) — a donated
+        # buffer cannot be reused by the caller afterwards.
+        fn = (jax.jit(run, donate_argnums=(0,)) if donate
+              else jax.jit(run))
         _cache[key] = fn
     return fn
 
 
-def gf_matmul_bytes(matrix: np.ndarray, x) -> jax.Array:
+def gf_matmul_bytes(matrix: np.ndarray, x, donate: bool = False) -> jax.Array:
     """Apply a GF(2^8) coefficient matrix (R x k) to byte rows [k, n].
 
     n is padded to a word multiple internally; returns uint8 [R, n].
+    `donate` hands the input buffer to XLA (see _compiled) — pass True
+    only when `x` is a fresh buffer this call may consume.
     """
     matrix = np.asarray(matrix, dtype=np.uint8)
     x = jnp.asarray(x, dtype=jnp.uint8)
@@ -115,7 +122,7 @@ def gf_matmul_bytes(matrix: np.ndarray, x) -> jax.Array:
     pad = (-n) % 4
     if pad:
         x = jnp.pad(x, ((0, 0), (0, pad)))
-    out = _compiled(matrix)(x)
+    out = _compiled(matrix, donate)(x)
     if pad:
         out = out[:, :n]
     return out

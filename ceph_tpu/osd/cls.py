@@ -106,6 +106,7 @@ class ClassHandler:
     def __init__(self) -> None:
         self._methods: Dict[str, Tuple[int, Callable]] = {}
         _register_builtins(self)
+        _register_extended_families(self)
 
     @classmethod
     def instance(cls) -> "ClassHandler":
@@ -275,3 +276,172 @@ def _register_builtins(h: ClassHandler) -> None:
     h.register("counter", "alloc", CLS_RD | CLS_WR, counter_alloc)
     h.register("counter", "get", CLS_RD, counter_get)
     h.register("counter", "max", CLS_RD | CLS_WR, counter_max)
+
+
+def _guard_input(fn):
+    """Malformed client payloads surface as EINVAL, never as an escaped
+    exception (the PG op path catches only ClsError; anything else
+    leaves the client op unanswered)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(ctx, indata):
+        try:
+            return fn(ctx, indata)
+        except ClsError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise ClsError(EINVAL, f"bad input: {e!r}")
+
+    return wrapped
+
+
+def _register_extended_families(h: ClassHandler) -> None:
+    """The remaining reference cls families this framework models
+    (reference /root/reference/src/cls/: journal, numops, timeindex —
+    user/otp/lua have no meaningful analog here)."""
+    import json as _json
+    import time as _time
+
+    # cls_journal (reference src/cls/journal/): journal CLIENT
+    # registration + per-client commit positions on the journal's
+    # metadata object — the bookkeeping rbd-mirror peers use so a
+    # journal knows how far every consumer has replayed (and what may
+    # be trimmed)
+    @_guard_input
+    def journal_client_register(ctx: MethodContext, indata: bytes) -> bytes:
+        req = _json.loads(indata.decode())
+        key = f"jclient.{req['id']}"
+        if ctx.exists and key in ctx.omap_get([key]):
+            raise ClsError(-17, "client exists")
+        ctx.omap_set({key: _json.dumps(
+            {"id": req["id"], "commit": int(req.get("commit", 0)),
+             "data": req.get("data", "")}).encode()})
+        return b""
+
+    def journal_client_unregister(ctx: MethodContext,
+                                  indata: bytes) -> bytes:
+        key = f"jclient.{indata.decode()}"
+        if key not in ctx.omap_get([key]):
+            raise ClsError(-2, "no such client")
+        ctx.omap_rm([key])
+        return b""
+
+    @_guard_input
+    def journal_client_commit(ctx: MethodContext, indata: bytes) -> bytes:
+        req = _json.loads(indata.decode())
+        key = f"jclient.{req['id']}"
+        got = ctx.omap_get([key])
+        if key not in got:
+            raise ClsError(-2, "no such client")
+        cl = _json.loads(got[key].decode())
+        # commit positions are monotonic watermarks
+        cl["commit"] = max(int(cl.get("commit", 0)), int(req["commit"]))
+        ctx.omap_set({key: _json.dumps(cl).encode()})
+        return str(cl["commit"]).encode()
+
+    @_guard_input
+    def journal_client_list(ctx: MethodContext, indata: bytes) -> bytes:
+        if not ctx.exists:
+            return b"[]"
+        out = [_json.loads(v.decode())
+               for k, v in sorted(ctx.omap_get().items())
+               if k.startswith("jclient.")]
+        return _json.dumps(out).encode()
+
+    @_guard_input
+    def journal_get_client(ctx: MethodContext, indata: bytes) -> bytes:
+        key = f"jclient.{indata.decode()}"
+        got = ctx.omap_get([key])
+        if key not in got:
+            raise ClsError(-2, "no such client")
+        return got[key]
+
+    h.register("journal", "client_register", CLS_RD | CLS_WR,
+               journal_client_register)
+    h.register("journal", "client_unregister", CLS_RD | CLS_WR,
+               journal_client_unregister)
+    h.register("journal", "client_commit", CLS_RD | CLS_WR,
+               journal_client_commit)
+    h.register("journal", "client_list", CLS_RD, journal_client_list)
+    h.register("journal", "get_client", CLS_RD, journal_get_client)
+
+    # cls_numops (reference src/cls/numops/): atomic arithmetic on a
+    # numeric omap value; non-numeric stored values are EINVAL exactly
+    # like the reference's strtod guard
+    def _numops(ctx: MethodContext, indata: bytes, op: str) -> bytes:
+        try:
+            key, val = indata.decode().split(" ", 1)
+            delta = float(val)
+        except (ValueError, UnicodeDecodeError):
+            raise ClsError(-22, f"numops.{op} wants 'key <number>'")
+        raw = ctx.omap_get([key]).get(key) if ctx.exists else None
+        try:
+            cur = float(raw.decode()) if raw is not None else 0.0
+        except ValueError:
+            raise ClsError(-22, "stored value is not a number")
+        import math
+
+        new = cur + delta if op == "add" else cur * delta
+        if not math.isfinite(new):
+            raise ClsError(-22, "result is not finite")
+        out = repr(int(new)) if new == int(new) else repr(new)
+        ctx.omap_set({key: out.encode()})
+        return out.encode()
+
+    h.register("numops", "add", CLS_RD | CLS_WR,
+               lambda c, d: _numops(c, d, "add"))
+    h.register("numops", "mul", CLS_RD | CLS_WR,
+               lambda c, d: _numops(c, d, "mul"))
+
+    # cls_timeindex (reference src/cls/timeindex/): time-keyed entries
+    # with ranged list + trim — the log/usage-record index shape
+    @_guard_input
+    def timeindex_add(ctx: MethodContext, indata: bytes) -> bytes:
+        req = _json.loads(indata.decode())
+        ts = float(req.get("ts", _time.time()))
+        key = f"ti.{ts:020.6f}.{req['key']}"
+        ctx.omap_set({key: req.get("value", "").encode()})
+        return key.encode()
+
+    @_guard_input
+    def timeindex_list(ctx: MethodContext, indata: bytes) -> bytes:
+        if not ctx.exists:
+            return b"[]"
+        req = _json.loads(indata.decode()) if indata else {}
+        lo = float(req.get("from", 0.0))
+        hi = float(req.get("to", 1e18))
+        limit = int(req.get("max", 1000))
+        out = []
+        for k, v in sorted(ctx.omap_get().items()):
+            if not k.startswith("ti."):
+                continue
+            parts = k.split(".", 3)
+            ts = float(parts[1] + "." + parts[2])
+            if lo <= ts < hi:
+                out.append({"ts": ts, "key": parts[3],
+                            "value": v.decode()})
+                if len(out) >= limit:
+                    break
+        return _json.dumps(out).encode()
+
+    @_guard_input
+    def timeindex_trim(ctx: MethodContext, indata: bytes) -> bytes:
+        if not ctx.exists:
+            return b"0"
+        req = _json.loads(indata.decode())
+        upto = float(req["to"])
+        doomed = []
+        for k in ctx.omap_get():
+            if k.startswith("ti."):
+                parts = k.split(".", 3)
+                if float(parts[1] + "." + parts[2]) < upto:
+                    doomed.append(k)
+        if doomed:
+            ctx.omap_rm(doomed)
+        return str(len(doomed)).encode()
+
+    h.register("timeindex", "add", CLS_RD | CLS_WR, timeindex_add)
+    h.register("timeindex", "list", CLS_RD, timeindex_list)
+    h.register("timeindex", "trim", CLS_RD | CLS_WR, timeindex_trim)
+

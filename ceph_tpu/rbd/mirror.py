@@ -4,10 +4,12 @@ Reference: src/tools/rbd_mirror/ — the mirror daemon tails a primary
 image's journal and replays its events onto the secondary, persisting
 the replay position so a restarted daemon resumes instead of
 re-applying history (the reference's MirrorPeerClientMeta commit
-position).  Here the cursor lives in the SECONDARY image's header
-(`mirror_cursor.<src>`), written after every applied batch — replay is
-idempotent, so a crash between apply and cursor persist re-applies at
-most one batch.
+position).  The cursor is a cls_journal CLIENT registered on the
+SOURCE journal's metadata object (reference src/cls/journal client
+registration — the journal knows every consumer's replay position, so
+trim decisions can consult them), committed after every applied
+batch — replay is idempotent, so a crash between apply and cursor
+persist re-applies at most one batch.
 """
 
 from __future__ import annotations
@@ -30,20 +32,41 @@ class MirrorDaemon:
         self._thread: Optional[threading.Thread] = None
         self.applied = 0
 
-    # -- cursor persistence ------------------------------------------------
+    # -- cursor persistence (cls_journal client on the src journal) --------
     @property
-    def _cursor_key(self) -> str:
-        return f"mirror_cursor.{self.src.name}"
+    def _client_id(self) -> str:
+        return f"mirror.{self.dst.name}"
+
+    def _ensure_registered(self) -> None:
+        from ceph_tpu.client.rados import RadosError
+
+        j = self.journal.journaler
+        try:
+            j.io.call(j.meta_oid, "journal", "client_register",
+                      json.dumps({"id": self._client_id}).encode())
+        except RadosError as e:
+            if e.rc != -17:  # already registered is the common case
+                raise
 
     def _load_cursor(self) -> int:
-        return int(self.dst.meta.get(self._cursor_key, 0))
+        from ceph_tpu.client.rados import RadosError
+
+        j = self.journal.journaler
+        try:
+            got = j.io.call(j.meta_oid, "journal", "get_client",
+                            self._client_id.encode())
+        except RadosError as e:
+            if e.rc == -2:
+                self._ensure_registered()
+                return 0
+            raise
+        return int(json.loads(got.decode()).get("commit", 0))
 
     def _save_cursor(self, seq: int) -> None:
-        self.dst.meta[self._cursor_key] = seq
-        from ceph_tpu.rbd.image import _header_oid
-
-        self.dst.io.write_full(_header_oid(self.dst.name),
-                               json.dumps(self.dst.meta).encode())
+        j = self.journal.journaler
+        j.io.call(j.meta_oid, "journal", "client_commit",
+                  json.dumps({"id": self._client_id,
+                              "commit": seq}).encode())
 
     # -- replay ------------------------------------------------------------
     def sync_once(self) -> int:

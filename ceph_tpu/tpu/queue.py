@@ -155,7 +155,10 @@ class StripeBatchQueue:
                     np.asarray(rec, dtype=np.uint8), stacked)
             from ceph_tpu.ops import gf256_swar
 
-            return np.asarray(gf256_swar.gf_matmul_bytes(rec, stacked))
+            # the stacked buffer is freshly built per batch: donate it
+            # so live HBM stays ~one batch deep through the pipeline
+            return np.asarray(gf256_swar.gf_matmul_bytes(
+                rec, stacked, donate=True))
         coding_mat = getattr(codec, "coding", None)
         if self.mesh is not None and coding_mat is not None:
             self.mesh_batches += 1

@@ -1,0 +1,178 @@
+"""Extended cls families (journal / numops / timeindex — reference
+src/cls/) + EC plugin load-failure negative fixtures (reference
+src/test/erasure-code/ErasureCodePluginFailToInitialize.cc,
+…MissingEntryPoint.cc, …Hangs.cc)."""
+
+import sys
+import types
+
+import pytest
+
+from ceph_tpu.ec import instance
+from ceph_tpu.ec.interface import ErasureCodeError
+
+from tests.test_osd_cluster import REP_POOL, LibClient, MiniCluster
+
+import json
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def io(cluster):
+    cl = LibClient(cluster)
+    yield cl.rc.ioctx(REP_POOL)
+    cl.shutdown()
+
+
+# -- cls_journal ------------------------------------------------------------
+
+def test_cls_journal_clients(io):
+    oid = "jmeta"
+    io.call(oid, "journal", "client_register",
+            json.dumps({"id": "mirrorA"}).encode())
+    io.call(oid, "journal", "client_register",
+            json.dumps({"id": "mirrorB", "commit": 5}).encode())
+    # duplicate registration is EEXIST
+    from ceph_tpu.client.rados import RadosError
+
+    with pytest.raises(RadosError):
+        io.call(oid, "journal", "client_register",
+                json.dumps({"id": "mirrorA"}).encode())
+    # commit positions are monotonic
+    io.call(oid, "journal", "client_commit",
+            json.dumps({"id": "mirrorA", "commit": 9}).encode())
+    io.call(oid, "journal", "client_commit",
+            json.dumps({"id": "mirrorA", "commit": 3}).encode())  # no-op
+    got = json.loads(io.call(oid, "journal", "get_client",
+                             b"mirrorA").decode())
+    assert got["commit"] == 9
+    clients = json.loads(io.call(oid, "journal", "client_list",
+                                 b"").decode())
+    assert [c["id"] for c in clients] == ["mirrorA", "mirrorB"]
+    io.call(oid, "journal", "client_unregister", b"mirrorB")
+    clients = json.loads(io.call(oid, "journal", "client_list",
+                                 b"").decode())
+    assert [c["id"] for c in clients] == ["mirrorA"]
+
+
+# -- cls_numops -------------------------------------------------------------
+
+def test_cls_numops(io):
+    oid = "nums"
+    assert io.call(oid, "numops", "add", b"x 5") == b"5"
+    assert io.call(oid, "numops", "add", b"x 2.5") == b"7.5"
+    assert io.call(oid, "numops", "mul", b"x 2") == b"15"
+    from ceph_tpu.client.rados import RadosError
+
+    with pytest.raises(RadosError):
+        io.call(oid, "numops", "add", b"garbage")
+    # non-numeric stored value is EINVAL, like the reference
+    io.omap_set(oid, {"bad": b"not-a-number"})
+    with pytest.raises(RadosError):
+        io.call(oid, "numops", "add", b"bad 1")
+
+
+# -- cls_timeindex ----------------------------------------------------------
+
+def test_cls_timeindex(io):
+    oid = "tindex"
+    for i, ts in enumerate((10.0, 20.0, 30.0, 40.0)):
+        io.call(oid, "timeindex", "add",
+                json.dumps({"ts": ts, "key": f"e{i}",
+                            "value": f"v{i}"}).encode())
+    got = json.loads(io.call(
+        oid, "timeindex", "list",
+        json.dumps({"from": 15, "to": 35}).encode()).decode())
+    assert [e["key"] for e in got] == ["e1", "e2"]
+    trimmed = int(io.call(oid, "timeindex", "trim",
+                          json.dumps({"to": 25}).encode()))
+    assert trimmed == 2
+    got = json.loads(io.call(oid, "timeindex", "list", b"").decode())
+    assert [e["key"] for e in got] == ["e2", "e3"]
+
+
+# -- EC plugin load-failure fixtures ---------------------------------------
+
+def test_ec_plugin_unknown_and_failing_init():
+    reg = instance()
+    with pytest.raises(ErasureCodeError, match="unknown"):
+        reg.factory("no-such-plugin", {})
+
+    def exploding_factory(profile):
+        raise RuntimeError("boom at init")
+
+    reg._factories.setdefault("explodes", exploding_factory)
+    try:
+        with pytest.raises(ErasureCodeError, match="failed to initialize"):
+            reg.factory("explodes", {"k": "2", "m": "1"})
+    finally:
+        reg._factories.pop("explodes", None)
+
+
+def test_ec_plugin_missing_entry_point():
+    mod = types.ModuleType("fake_ec_no_entry")
+    sys.modules["fake_ec_no_entry"] = mod
+    try:
+        with pytest.raises(ErasureCodeError, match="entry point"):
+            instance().load_module("broken", "fake_ec_no_entry")
+    finally:
+        del sys.modules["fake_ec_no_entry"]
+
+
+def test_ec_plugin_import_failure_and_hang():
+    reg = instance()
+    with pytest.raises(ErasureCodeError, match="failed to load"):
+        reg.load_module("ghost", "definitely_not_a_module_xyz")
+
+    mod = types.ModuleType("fake_ec_hangs")
+    # a module whose import hangs: simulate via an entry module that
+    # sleeps in top-level code
+    mod.__dict__["__loader__"] = None
+    import textwrap
+
+    src = textwrap.dedent("""
+        import time
+        time.sleep(60)
+    """)
+    import os
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    with open(os.path.join(d, "fake_ec_hangs.py"), "w") as f:
+        f.write(src)
+    sys.path.insert(0, d)
+    try:
+        with pytest.raises(ErasureCodeError, match="hung"):
+            reg.load_module("hangs", "fake_ec_hangs", timeout_s=1.0)
+    finally:
+        sys.path.remove(d)
+        sys.modules.pop("fake_ec_hangs", None)
+
+
+def test_ec_plugin_successful_third_party_load():
+    mod = types.ModuleType("fake_ec_good")
+
+    class _Fake:
+        pass
+
+    def ec_plugin_create(profile):
+        f = _Fake()
+        f.profile = profile
+        return f
+
+    mod.ec_plugin_create = ec_plugin_create
+    sys.modules["fake_ec_good"] = mod
+    reg = instance()
+    try:
+        reg.load_module("thirdparty", "fake_ec_good")
+        got = reg.factory("thirdparty", {"k": "4"})
+        assert got.profile == {"k": "4"}
+    finally:
+        del sys.modules["fake_ec_good"]
+        reg._factories.pop("thirdparty", None)
