@@ -319,6 +319,7 @@ def _register_extended_families(h: ClassHandler) -> None:
              "data": req.get("data", "")}).encode()})
         return b""
 
+    @_guard_input
     def journal_client_unregister(ctx: MethodContext,
                                   indata: bytes) -> bytes:
         key = f"jclient.{indata.decode()}"

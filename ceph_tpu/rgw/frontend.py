@@ -162,9 +162,141 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<Message>{escape(str(e))}</Message></Error>").encode()
         self._reply(e.status, body)
 
+    # -- Swift dialect (reference rgw Swift API + tempauth) ---------------
+    def _swift_auth(self) -> None:
+        """GET /auth/v1.0 with X-Auth-User/X-Auth-Key -> token + URL
+        (the tempauth handshake Swift clients start with)."""
+        user = self.headers.get("X-Auth-User", "")
+        key = self.headers.get("X-Auth-Key", "")
+        fe = self.server.frontend
+        try:
+            info = fe.users.resolve_key(user)
+        except Exception:
+            raise _S3Error(403, "AccessDenied", "bad swift credentials")
+        import hmac as _hmac
+
+        if not _hmac.compare_digest(info["secret_key"], key) \
+                or info.get("suspended"):
+            raise _S3Error(403, "AccessDenied", "bad swift credentials")
+        import secrets as _secrets
+        import time as _time
+
+        token = "AUTH_tk" + _secrets.token_hex(16)
+        now = _time.time()
+        # expire stale tokens on issue so the table stays bounded
+        fe._swift_tokens = {t: (u, exp) for t, (u, exp)
+                            in fe._swift_tokens.items() if exp > now}
+        fe._swift_tokens[token] = (info["uid"],
+                                   now + fe.swift_token_ttl)
+        host, port = self.server.server_address[:2]
+        self._reply(204, extra={
+            "X-Auth-Token": token,
+            "X-Storage-Url": f"http://{host}:{port}/swift/v1"})
+
+    def _swift_route(self, body: bytes) -> None:
+        """Swift REST verbs (reference rgw_rest_swift.cc): containers
+        and objects over the SAME bucket/object store as S3."""
+        fe = self.server.frontend
+        token = self.headers.get("X-Auth-Token", "")
+        import time as _time
+
+        entry = fe._swift_tokens.get(token)
+        if entry is None or entry[1] < _time.time():
+            fe._swift_tokens.pop(token, None)
+            raise _S3Error(401, "Unauthorized", "bad or missing token")
+        # suspension takes effect on USE, not only at issue time
+        try:
+            if fe.users.user_info(entry[0]).get("suspended"):
+                raise _S3Error(401, "Unauthorized", "user suspended")
+        except _S3Error:
+            raise
+        except Exception:
+            raise _S3Error(401, "Unauthorized", "unknown user")
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query,
+                                        keep_blank_values=True))
+        parts = parsed.path[len("/swift/v1"):].lstrip("/").split("/", 1)
+        container = urllib.parse.unquote(parts[0]) if parts[0] else ""
+        obj = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        rgw = fe.rgw
+        meth = self.command
+        try:
+            if not container:
+                if meth not in ("GET", "HEAD"):
+                    raise _S3Error(405, "MethodNotAllowed")
+                names = rgw.list_buckets()
+                self._reply(200, "\n".join(names).encode() + b"\n",
+                            ctype="text/plain")
+            elif not obj:
+                if meth == "PUT":
+                    try:
+                        rgw.create_bucket(container)
+                        self._reply(201)
+                    except gw.BucketExists:
+                        self._reply(202)  # swift: idempotent PUT
+                elif meth == "DELETE":
+                    rgw.delete_bucket(container)
+                    self._reply(204)
+                elif meth in ("GET", "HEAD"):
+                    entries, _tr = rgw.list_objects(
+                        container, prefix=q.get("prefix", ""),
+                        marker=q.get("marker", ""),
+                        max_keys=int(q.get("limit", 1000)))
+                    if q.get("format") == "json":
+                        rows = json.dumps(
+                            [{"name": e["Key"], "bytes": e["Size"],
+                              "hash": e["ETag"]} for e in entries])
+                        self._reply(200, rows.encode(),
+                                    ctype="application/json")
+                    else:
+                        listing = "\n".join(e["Key"] for e in entries)
+                        self._reply(200, listing.encode() + b"\n",
+                                    ctype="text/plain")
+                else:
+                    raise _S3Error(405, "MethodNotAllowed")
+            else:
+                if meth == "PUT":
+                    meta = {k[len("x-object-meta-"):]: v
+                            for k, v in self.headers.items()
+                            if k.lower().startswith("x-object-meta-")}
+                    etag = rgw.put_object(container, obj, body,
+                                          metadata=meta)
+                    self._reply(201, extra={"ETag": etag})
+                elif meth == "GET":
+                    data, head = rgw.get_object(container, obj)
+                    extra = {"ETag": head["etag"]}
+                    extra.update({f"X-Object-Meta-{k}": v for k, v in
+                                  head.get("meta", {}).items()})
+                    self._reply(200, data,
+                                ctype="application/octet-stream",
+                                extra=extra)
+                elif meth == "HEAD":
+                    head = rgw.head_object(container, obj)
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(head["size"]))
+                    self.send_header("ETag", head["etag"])
+                    self.end_headers()
+                elif meth == "DELETE":
+                    rgw.delete_object(container, obj)
+                    self._reply(204)
+                else:
+                    raise _S3Error(405, "MethodNotAllowed")
+        except gw.NoSuchBucket:
+            raise _S3Error(404, "NoSuchContainer")
+        except gw.NoSuchKey:
+            raise _S3Error(404, "NoSuchObject")
+        except gw.BucketNotEmpty:
+            raise _S3Error(409, "Conflict")
+
     def _route(self) -> None:
         body = self._read_body()
         try:
+            if self.path.startswith("/auth/v1.0"):
+                self._swift_auth()
+                return
+            if self.path.startswith("/swift/v1"):
+                self._swift_route(body)
+                return
             self._authenticate(body)
             parsed = urllib.parse.urlsplit(self.path)
             q = dict(urllib.parse.parse_qsl(parsed.query,
@@ -298,6 +430,10 @@ class RGWFrontend:
         self._srv = ThreadingHTTPServer((host, port), _Handler)
         self._srv.daemon_threads = True
         self._srv.frontend = self
+        # swift tempauth tokens: token -> (uid, expiry); transient and
+        # TTL-bounded like the reference's
+        self._swift_tokens: Dict[str, Tuple[str, float]] = {}
+        self.swift_token_ttl = 3600.0
         self._thread: Optional[threading.Thread] = None
         self._log = log or (lambda lvl, msg: None)
 

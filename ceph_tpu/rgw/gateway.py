@@ -50,9 +50,29 @@ def _register_rgw_cls() -> None:
     if h.get("rgw.index_put") is not None:
         return
 
+    # the bucket-index CHANGE LOG rides the same omap under a reserved
+    # prefix and is appended in the SAME atomic cls call as the index
+    # mutation (reference cls_rgw's bucket index log — the feed
+    # multisite data sync replays); "~" is reserved, like the
+    # reference's \\x80-prefixed special index entries
+    BILOG = "~bilog."
+    BILOG_SEQ = "~bilog_seq"
+
+    def _bilog_append(ctx, op: str, key: str) -> None:
+        seq = int(ctx.omap_get([BILOG_SEQ]).get(BILOG_SEQ, b"0")) + 1
+        ctx.omap_set({
+            BILOG_SEQ: str(seq).encode(),
+            f"{BILOG}{seq:020d}": json.dumps(
+                {"op": op, "key": key}).encode()})
+
     def index_put(ctx, indata: bytes) -> bytes:
         req = json.loads(indata.decode())
+        if req["key"].startswith("~"):
+            # "~" is the reserved index namespace (bilog + counters) —
+            # the reference escapes user keys out of its \x80 space
+            raise ClsError(-22, "object keys may not start with '~'")
         ctx.omap_set({req["key"]: json.dumps(req["entry"]).encode()})
+        _bilog_append(ctx, "put", req["key"])
         return b""
 
     def index_rm(ctx, indata: bytes) -> bytes:
@@ -60,6 +80,7 @@ def _register_rgw_cls() -> None:
         if key not in ctx.omap_get([key]):
             raise ClsError(-2, "no such key")
         ctx.omap_rm([key])
+        _bilog_append(ctx, "rm", key)
         return b""
 
     def index_list(ctx, indata: bytes) -> bytes:
@@ -69,6 +90,8 @@ def _register_rgw_cls() -> None:
         maxk = int(req.get("max_keys", 1000))
         out = []
         for k in sorted(ctx.omap_get()):
+            if k.startswith("~"):  # reserved: bilog + counters
+                continue
             if k <= marker or not k.startswith(prefix):
                 continue
             out.append((k, ctx.omap_get([k])[k].decode()))
@@ -78,9 +101,37 @@ def _register_rgw_cls() -> None:
         return json.dumps({"entries": out[:maxk],
                            "truncated": truncated}).encode()
 
+    def bilog_list(ctx, indata: bytes) -> bytes:
+        req = json.loads(indata.decode() or "{}")
+        after = int(req.get("after", 0))
+        maxk = int(req.get("max", 1000))
+        out = []
+        if ctx.exists:
+            for k in sorted(ctx.omap_get()):
+                if not k.startswith(BILOG):
+                    continue
+                seq = int(k[len(BILOG):])
+                if seq <= after:
+                    continue
+                out.append({"seq": seq, **json.loads(
+                    ctx.omap_get([k])[k].decode())})
+                if len(out) >= maxk:
+                    break
+        return json.dumps(out).encode()
+
+    def bilog_trim(ctx, indata: bytes) -> bytes:
+        upto = int(indata.decode() or "0")
+        doomed = [k for k in ctx.omap_get()
+                  if k.startswith(BILOG) and int(k[len(BILOG):]) <= upto]
+        if doomed:
+            ctx.omap_rm(doomed)
+        return str(len(doomed)).encode()
+
     h.register("rgw", "index_put", CLS_RD | CLS_WR, index_put)
     h.register("rgw", "index_rm", CLS_RD | CLS_WR, index_rm)
     h.register("rgw", "index_list", CLS_RD, index_list)
+    h.register("rgw", "bilog_list", CLS_RD, bilog_list)
+    h.register("rgw", "bilog_trim", CLS_RD | CLS_WR, bilog_trim)
 
 
 _register_rgw_cls()

@@ -137,3 +137,59 @@ def test_tampered_payload_rejected(stack):
         assert conn.getresponse().status == 400
     finally:
         conn.close()
+
+
+def test_swift_api_over_http(stack):
+    """Swift dialect on the same endpoint (reference rgw_rest_swift):
+    tempauth handshake, container + object verbs, JSON listings."""
+    import http.client
+
+    fe, s, user = stack
+
+    def req(method, path, body=b"", headers=None):
+        conn = http.client.HTTPConnection(*fe.addr, timeout=15)
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            r = conn.getresponse()
+            return r.status, dict(r.getheaders()), r.read()
+        finally:
+            conn.close()
+
+    # tempauth: bad creds refused, good creds yield a token
+    code, _, _ = req("GET", "/auth/v1.0",
+                     headers={"X-Auth-User": user["access_key"],
+                              "X-Auth-Key": "wrong"})
+    assert code == 403
+    code, hdrs, _ = req("GET", "/auth/v1.0",
+                        headers={"X-Auth-User": user["access_key"],
+                                 "X-Auth-Key": user["secret_key"]})
+    assert code == 204 and hdrs.get("X-Auth-Token", "").startswith("AUTH_")
+    tok = {"X-Auth-Token": hdrs["X-Auth-Token"]}
+
+    # tokenless requests are 401
+    assert req("GET", "/swift/v1")[0] == 401
+
+    assert req("PUT", "/swift/v1/cont", headers=tok)[0] == 201
+    assert req("PUT", "/swift/v1/cont", headers=tok)[0] == 202  # idempotent
+    payload = b"swift object payload" * 50
+    code, hdrs2, _ = req("PUT", "/swift/v1/cont/obj1", body=payload,
+                         headers={**tok, "X-Object-Meta-Color": "teal"})
+    assert code == 201
+    code, hdrs3, body = req("GET", "/swift/v1/cont/obj1", headers=tok)
+    assert code == 200 and body == payload
+    assert hdrs3.get("X-Object-Meta-Color") == "teal"
+    # json container listing
+    code, _, body = req("GET", "/swift/v1/cont?format=json", headers=tok)
+    assert code == 200
+    import json as _json
+
+    rows = _json.loads(body)
+    assert rows[0]["name"] == "obj1" and rows[0]["bytes"] == len(payload)
+    # account listing shows the container
+    code, _, body = req("GET", "/swift/v1", headers=tok)
+    assert code == 200 and b"cont" in body
+    # teardown semantics
+    assert req("DELETE", "/swift/v1/cont", headers=tok)[0] == 409  # not empty
+    assert req("DELETE", "/swift/v1/cont/obj1", headers=tok)[0] == 204
+    assert req("DELETE", "/swift/v1/cont", headers=tok)[0] == 204
+    assert req("GET", "/swift/v1/cont/obj1", headers=tok)[0] == 404

@@ -1,0 +1,94 @@
+"""RGW multisite data sync (reference rgw_data_sync.cc role): a
+secondary zone tails the primary's bucket-index change logs and
+converges, resumes from persisted cursors after a crash, and streams
+continuously as a daemon."""
+
+import time
+
+import pytest
+
+from ceph_tpu.rgw.gateway import RGW
+from ceph_tpu.rgw.sync import RGWZoneSync
+
+
+@pytest.fixture(scope="module")
+def zones():
+    from ceph_tpu.vstart import VStartCluster
+
+    # two pools on one cluster play the two zones' stores (the sync
+    # agent only ever talks through the two gateways' APIs)
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        src = RGW(c.client().ioctx(c.create_pool("zone-a", size=2)))
+        dst = RGW(c.client().ioctx(c.create_pool("zone-b", size=2)))
+        yield src, dst
+
+
+def test_initial_and_incremental_sync(zones):
+    src, dst = zones
+    src.create_bucket("photos")
+    src.put_object("photos", "a.jpg", b"JPGA" * 100,
+                   metadata={"who": "alice"})
+    src.put_object("photos", "b.jpg", b"JPGB" * 50)
+
+    s = RGWZoneSync(src, dst, zone="b1")
+    applied = s.sync_once()
+    assert applied == 2
+    assert dst.list_buckets() == ["photos"]
+    data, head = dst.get_object("photos", "a.jpg")
+    assert data == b"JPGA" * 100 and head["meta"] == {"who": "alice"}
+
+    # incremental: overwrite + delete + new key
+    src.put_object("photos", "a.jpg", b"JPGA2" * 80)
+    src.delete_object("photos", "b.jpg")
+    src.put_object("photos", "c.jpg", b"C")
+    assert s.sync_once() == 3
+    assert dst.get_object("photos", "a.jpg")[0] == b"JPGA2" * 80
+    with pytest.raises(Exception):
+        dst.get_object("photos", "b.jpg")
+    # nothing left to do
+    assert s.sync_once() == 0
+
+
+def test_cursor_survives_agent_restart(zones):
+    src, dst = zones
+    src.put_object("photos", "d.jpg", b"D" * 10)
+    # a FRESH agent instance (same zone id) resumes from the persisted
+    # cursor: only the new change applies, nothing re-copies
+    s2 = RGWZoneSync(src, dst, zone="b1")
+    assert s2.sync_once() == 1
+    assert s2.sync_once() == 0
+    # a different zone id is an independent consumer: full replay
+    s3 = RGWZoneSync(src, dst, zone="b2")
+    assert s3.sync_once() >= 4
+
+
+def test_continuous_daemon_streams(zones):
+    src, dst = zones
+    s = RGWZoneSync(src, dst, zone="b1", interval=0.05).start()
+    try:
+        src.create_bucket("stream")
+        src.put_object("stream", "live.bin", b"LIVE" * 25)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                if dst.get_object("stream", "live.bin")[0] == b"LIVE" * 25:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        assert dst.get_object("stream", "live.bin")[0] == b"LIVE" * 25
+    finally:
+        s.stop()
+
+
+def test_multipart_objects_sync_whole(zones):
+    src, dst = zones
+    src.create_bucket("mpz")
+    uid = src.create_multipart_upload("mpz", "big")
+    src.upload_part("mpz", "big", uid, 1, b"P1" * 40000)
+    src.upload_part("mpz", "big", uid, 2, b"P2" * 10000)
+    src.complete_multipart_upload("mpz", "big", uid)
+    s = RGWZoneSync(src, dst, zone="b1")
+    s.sync_once()
+    data, _ = dst.get_object("mpz", "big")
+    assert data == b"P1" * 40000 + b"P2" * 10000
