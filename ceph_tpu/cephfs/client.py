@@ -40,12 +40,20 @@ class _Waiter:
 class FSClient(Dispatcher):
     """One mounted client (reference Client.cc role)."""
 
-    def __init__(self, ctx, ioctx: IoCtx, mds_addr: Tuple[str, int],
+    def __init__(self, ctx, ioctx: IoCtx, mds_addr,
                  name: str = "client") -> None:
         self.ctx = ctx
         self.io = ioctx
         self.name = name
-        self.mds_addr = tuple(mds_addr)
+        # single addr (rank 0) or {rank: addr} for multi-MDS; requests
+        # that land on the wrong rank are redirected by the ESTALE+rank
+        # hint (the reference client follows MDS forwards the same way)
+        if isinstance(mds_addr, dict):
+            self.mds_addrs = {int(r): tuple(a)
+                              for r, a in mds_addr.items()}
+        else:
+            self.mds_addrs = {0: tuple(mds_addr)}
+        self.mds_addr = self.mds_addrs[min(self.mds_addrs)]
         self.striper = RadosStriper(ioctx, stripe_unit=65536,
                                     stripe_count=4, object_size=4 << 20)
         self.caps: Dict[str, int] = {}  # path -> held caps
@@ -58,7 +66,11 @@ class FSClient(Dispatcher):
         self.msgr = Messenger(ctx, EntityName("client", id(self) & 0xFFFF))
         self.msgr.add_dispatcher(self)
         self.msgr.start()
-        self._request("session_open", "/", {"client": name})
+        # route cache: path prefix -> rank (learned from redirects)
+        self._rank_cache: Dict[str, int] = {}
+        for rank in self.mds_addrs:
+            self._request("session_open", "/", {"client": name},
+                          rank=rank)
 
     def shutdown(self) -> None:
         self.msgr.shutdown()
@@ -88,8 +100,43 @@ class FSClient(Dispatcher):
         return False
 
     def _request(self, op: str, path: str, args: Optional[dict] = None,
-                 timeout: Optional[float] = None) -> cm.MClientReply:
+                 timeout: Optional[float] = None,
+                 rank: Optional[int] = None) -> cm.MClientReply:
         timeout = timeout if timeout is not None else self.request_timeout
+        if rank is None:
+            rank = self._route(path)
+        for hop in range(6):  # redirects converge in one hop normally
+            addr = self.mds_addrs.get(rank)
+            if addr is None:
+                raise MDSError(-22, f"redirected to unknown MDS rank "
+                               f"{rank} (pinned to a dead rank?)")
+            rep = self._request_to(addr, op, path, args, timeout)
+            if rep.result == -116 and "rank" in rep.data:  # ESTALE hint
+                rank = int(rep.data["rank"])
+                self._rank_cache[self._route_key(path)] = rank
+                if hop >= 2:
+                    # ranks briefly disagree right after a pin change
+                    # (each refreshes its table within pin_ttl): wait
+                    # out the window instead of failing a valid op
+                    time.sleep(0.2)
+                continue
+            break
+        if rep.result < 0:
+            raise MDSError(rep.result, str(rep.data.get("error", "")))
+        return rep
+
+    @staticmethod
+    def _route_key(path: str) -> str:
+        # cache by top-level component (pins are subtree-granular;
+        # deeper pins correct themselves via one extra redirect)
+        parts = [p for p in path.split("/") if p]
+        return "/" + parts[0] if parts else "/"
+
+    def _route(self, path: str) -> int:
+        return self._rank_cache.get(self._route_key(path), 0)
+
+    def _request_to(self, addr, op, path, args, timeout
+                    ) -> cm.MClientReply:
         with self._lock:
             self._tid += 1
             tid = self._tid
@@ -98,14 +145,12 @@ class FSClient(Dispatcher):
         try:
             msg = cm.MClientRequest(op, path, args or {})
             msg.tid = tid
-            self.msgr.send_message(msg, self.mds_addr)
+            self.msgr.send_message(msg, addr)
             if not w.ev.wait(timeout):
                 raise MDSError(-110, f"mds request {op} timed out")
             rep = w.reply
         finally:
             self._waiters.pop(tid, None)
-        if rep.result < 0:
-            raise MDSError(rep.result, str(rep.data.get("error", "")))
         return rep
 
     # -- metadata surface --------------------------------------------------
@@ -134,6 +179,14 @@ class FSClient(Dispatcher):
     def readlink(self, path: str) -> str:
         return self._request("readlink", path).data["target"]
 
+    def set_pin(self, path: str, rank: int) -> None:
+        """Pin a directory subtree to an MDS rank (ceph.dir.pin)."""
+        if rank not in self.mds_addrs:
+            raise MDSError(-22, f"no MDS rank {rank} in this mount")
+        self._request("set_pin", path,
+                      {"rank": rank,
+                       "known_ranks": sorted(self.mds_addrs)})
+
     # -- files + caps ------------------------------------------------------
     def create(self, path: str, wants: int = CAP_RD | CAP_WR | CAP_EXCL,
                mode: int = 0o644) -> dict:
@@ -159,7 +212,12 @@ class FSClient(Dispatcher):
 
     # -- data IO (client-direct striping; size via MDS setattr) -----------
     def write(self, path: str, data: bytes, off: int = 0) -> int:
-        inode = self.stat(path)
+        try:
+            inode = self.stat(path)
+        except MDSError as e:
+            if e.rc != -2:
+                raise
+            inode = self.create(path, wants=CAP_RD | CAP_WR)
         if inode["type"] != "file":
             raise MDSError(-21, "is a directory")  # EISDIR
         self.striper.write(CephFS._data_oid(inode["ino"]), data, off=off)

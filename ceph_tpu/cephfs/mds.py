@@ -42,17 +42,35 @@ EPERM, ENOENT, EEXIST, EBUSY, EINVAL, ENOTDIR, ENOTEMPTY = (
 
 
 class MDSDaemon(Dispatcher):
-    """Single active MDS (rank 0).  `commit_every` is the journal
-    commit lag — the window a crash leaves for replay to heal."""
+    """One MDS rank.  `commit_every` is the journal commit lag — the
+    window a crash leaves for replay to heal.
+
+    Multi-MDS: the namespace is PARTITIONED by export pins (the
+    reference's ceph.dir.pin / mds_export_pin feature, the static
+    subset of MDBalancer subtree management): a pin table in the
+    fs.meta object maps directory subtrees to ranks, every rank owns
+    the longest-prefix-pinned subtrees assigned to it (rank 0 owns the
+    rest), each rank journals its own mds<rank> WAL, and a request
+    landing on the wrong rank is answered with ESTALE + the owner so
+    the client redirects.  Cross-rank renames are EXDEV, like a POSIX
+    cross-mount rename."""
 
     def __init__(self, ctx, ioctx: IoCtx, bind_port: int = 0,
-                 commit_every: int = 16) -> None:
+                 commit_every: int = 16, rank: int = 0) -> None:
         self.ctx = ctx
         self.io = ioctx
+        self.rank = rank
         self.fs = CephFS(ioctx)
         self.commit_every = commit_every
-        self.journal = Journaler(ioctx, "mds0")
+        self.journal = Journaler(ioctx, f"mds{rank}")
         self.journal.create()
+        self._pin_cache: Tuple[float, Dict[str, int]] = (0.0, {})
+        self._pin_gen = 0
+        # ownership-table staleness bound: a pin change is visible to
+        # every rank within this window (set_pin refreshes its own rank
+        # immediately; peers discover via their next refresh, and the
+        # client's redirect loop waits it out — see FSClient._request)
+        self.pin_ttl = 0.5
         self._log = ctx.log.dout("mds")
         self.lock = threading.RLock()
         # caps[path] = {client: caps bits}; client -> session conn
@@ -177,6 +195,36 @@ class MDSDaemon(Dispatcher):
         else:
             self._log(1, f"mds: unknown journal op {op!r}")
 
+    # -- subtree ownership (export pins) ----------------------------------
+    def _pins(self) -> Dict[str, int]:
+        with self.lock:
+            stamp, table = self._pin_cache
+            gen = self._pin_gen
+        now = time.time()
+        if now - stamp > self.pin_ttl:
+            try:
+                om = self.io.omap_get("fs.meta")
+            except RadosError:
+                om = {}
+            table = {k[len("subtree."):]: int(v)
+                     for k, v in om.items() if k.startswith("subtree.")}
+            with self.lock:
+                # an invalidation that raced this refresh (set_pin bumps
+                # the generation) wins: never reinstate a stale table
+                if self._pin_gen == gen:
+                    self._pin_cache = (now, table)
+        return table
+
+    def owner_rank(self, path: str) -> int:
+        """Longest-prefix pin match; unpinned namespace is rank 0."""
+        p = self.fs._norm(path)
+        best, rank = "", 0
+        for pin_path, r in self._pins().items():
+            if (p == pin_path or p.startswith(pin_path.rstrip("/") + "/")) \
+                    and len(pin_path) > len(best):
+                best, rank = pin_path, r
+        return rank
+
     # -- capabilities (Locker role) ---------------------------------------
     def _grant_caps(self, path: str, client: str, wants: int) -> int:
         """Arbitrate `wants` against current holders; revokes other
@@ -263,12 +311,37 @@ class MDSDaemon(Dispatcher):
         rep.tid = msg.tid
         conn.send(rep)
 
+    ESTALE = -116
+
     def _do_op(self, conn, msg) -> cm.MClientReply:
         op, path, args = msg.op, msg.path, msg.args
         if op == "session_open":
             client = args["client"]
             self.sessions[client] = conn
-            return cm.MClientReply(0, {"mds": 0})
+            return cm.MClientReply(0, {"mds": self.rank})
+        if op == "set_pin":
+            # pin a subtree to a rank (ceph.dir.pin role); any rank may
+            # write the table — it lives in the shared fs.meta object
+            rank = int(args["rank"])
+            if rank not in args.get("known_ranks", [rank]):
+                return cm.MClientReply(EINVAL,
+                                       {"error": f"no MDS rank {rank}"})
+            self.fs._lookup(path)
+            self.io.omap_set("fs.meta", {
+                f"subtree.{self.fs._norm(path)}": str(rank).encode()})
+            with self.lock:
+                self._pin_gen += 1
+                self._pin_cache = (0.0, {})
+            return cm.MClientReply(0)
+        owner = self.owner_rank(path)
+        if owner != self.rank:
+            # wrong rank: redirect the client (reference forwards
+            # requests between MDSs; the hint keeps it one hop)
+            return cm.MClientReply(self.ESTALE, {"rank": owner})
+        if op == "rename" and self.owner_rank(args["dst"]) != self.rank:
+            return cm.MClientReply(
+                -18, {"error": "cross-rank rename (EXDEV): subtrees "
+                      "are pinned to different MDS ranks"})
         if op == "mkdir":
             self._submit({"op": "mkdir", "path": path})
             return cm.MClientReply(0)

@@ -87,10 +87,41 @@ class VStartCluster:
 
         self.osds: Dict[int, OSDService] = {}
         self._clients: List[RadosClient] = []
+        self.mds: Dict[int, object] = {}  # rank -> MDSDaemon
         for i in range(n_osds):
             self.osds[i] = self._spawn_osd(i)
         if wait:
             self.wait_for_up()
+
+    # -- MDS (the cephfs metadata tier; reference vstart.sh -m) -----------
+    def start_mds(self, pool_name: str = "cephfs_meta", ranks: int = 1,
+                  size: int = 2):
+        """Spin up `ranks` MDS daemons over a (created-if-missing)
+        metadata pool; returns {rank: addr} for FSClient mounts."""
+        from ceph_tpu.cephfs.mds import MDSDaemon
+
+        pools = self.leader().osdmap.pools
+        by_name = {p.name: pid for pid, p in pools.items()}
+        pool_id = by_name.get(pool_name)
+        if pool_id is None:
+            pool_id = self.create_pool(pool_name,
+                                       size=min(size, self.n_osds))
+        self._mds_pool = pool_id
+        for rank in range(ranks):
+            if rank not in self.mds:
+                self.mds[rank] = MDSDaemon(
+                    self.ctx, self.client().ioctx(pool_id), rank=rank)
+        return {r: d.addr for r, d in self.mds.items()}
+
+    def mount(self, name: str = "admin"):
+        """An FSClient mounted against every running MDS rank."""
+        from ceph_tpu.cephfs.client import FSClient
+
+        if not self.mds:
+            self.start_mds()
+        return FSClient(self.ctx, self.client().ioctx(self._mds_pool),
+                        {r: d.addr for r, d in self.mds.items()},
+                        name=name)
 
     # -- daemons -----------------------------------------------------------
     def _make_store(self, i: int):
@@ -194,6 +225,12 @@ class VStartCluster:
         self.osds[i] = svc
 
     def shutdown(self) -> None:
+        for d in self.mds.values():
+            try:
+                d.shutdown()
+            except Exception:
+                pass
+        self.mds.clear()
         for rc in self._clients:
             try:
                 rc.shutdown()

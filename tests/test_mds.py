@@ -184,3 +184,51 @@ def test_mds_torn_rename_healed_by_replay(cluster, rc):
             c2.shutdown()
     finally:
         mds2.shutdown()
+
+
+def test_multi_mds_export_pins(cluster, rc):
+    """Two MDS ranks partition the namespace by export pins
+    (reference ceph.dir.pin / subtree pinning): ops route to the
+    owning rank (one redirect max), each rank journals its own WAL,
+    cross-rank renames are EXDEV, and a crashed pinned rank replays
+    its own journal independently."""
+    io = rc.rc.ioctx(REP_POOL)
+    mds0 = MDSDaemon(cluster.ctx, io, commit_every=1000, rank=0)
+    mds1 = MDSDaemon(cluster.ctx, io, commit_every=1000, rank=1)
+    c = FSClient(cluster.ctx, rc.rc.ioctx(REP_POOL),
+                 {0: mds0.addr, 1: mds1.addr}, name="mc")
+    try:
+        c.mkdir("/mshared")       # rank 0 (unpinned)
+        c.mkdir("/pinned")
+        c.set_pin("/pinned", 1)
+        j0_before = mds0.journal.head()
+        c.mkdir("/pinned/sub")   # must land on rank 1 via redirect
+        c.create("/pinned/sub/f", wants=CAP_RD | CAP_WR)
+        c.write("/pinned/sub/f", b"rank1 data" * 20)
+        assert mds1.journal.head() >= 2     # rank 1 journaled them
+        assert mds0.journal.head() == j0_before  # rank 0 untouched
+        assert c.read("/pinned/sub/f") == b"rank1 data" * 20
+        # listing across both subtrees works from one client
+        assert c.listdir("/pinned") == ["sub"]
+        c.create("/mshared/g", wants=CAP_RD)
+        assert mds0.journal.head() > j0_before
+        # cross-rank rename is EXDEV, like a cross-mount rename
+        with pytest.raises(MDSError):
+            c.rename("/pinned/sub/f", "/mshared/f")
+        # rank-1 crash + restart replays ITS journal; rank 0 unaffected
+        mds1.kill()
+        mds1b = MDSDaemon(cluster.ctx, io, commit_every=1000, rank=1)
+        try:
+            c2 = FSClient(cluster.ctx, rc.rc.ioctx(REP_POOL),
+                          {0: mds0.addr, 1: mds1b.addr}, name="mc2")
+            try:
+                assert c2.listdir("/pinned/sub") == ["f"]
+                assert c2.read("/pinned/sub/f") == b"rank1 data" * 20
+                assert c2.listdir("/mshared") == ["g"]
+            finally:
+                c2.shutdown()
+        finally:
+            mds1b.shutdown()
+    finally:
+        c.shutdown()
+        mds0.shutdown()

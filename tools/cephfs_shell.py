@@ -25,6 +25,10 @@ def main(argv=None) -> int:
     p.add_argument("--vstart", default="1x3")
     p.add_argument("--data-dir", default=None)
     p.add_argument("--pool", default="cephfs_data")
+    p.add_argument("--mds", type=int, default=0, metavar="RANKS",
+                   help="route metadata through N MDS daemons (with "
+                        "journaled metadata + caps) instead of the "
+                        "library-direct path")
     p.add_argument("--script", default="")
     p.add_argument("command", nargs="*")
     args = p.parse_args(argv)
@@ -56,7 +60,23 @@ def main(argv=None) -> int:
             lambda: client.objecter.osdmap is not None
             and pool_id in client.objecter.osdmap.pools,
             what="pool on client")
-        fs = CephFS(client.ioctx(pool_id))
+        if args.mds > 0:
+            cluster.start_mds(ranks=args.mds)
+            fs = cluster.mount("shell")
+        else:
+            fs = CephFS(client.ioctx(pool_id))
+        try:
+            rc = _run_lines(fs, scripts, tree)
+        finally:
+            if args.mds > 0:
+                fs.shutdown()
+        return rc
+
+
+def _run_lines(fs, scripts, tree) -> int:
+    from ceph_tpu.cephfs.fs import FSError
+
+    if True:
         for line in scripts:
             t = shlex.split(line)
             cmd, rest = t[0], t[1:]
@@ -96,7 +116,7 @@ def main(argv=None) -> int:
                 else:
                     print(f"unknown command {cmd!r}", file=sys.stderr)
                     return 22
-            except FSError as e:
+            except (FSError, OSError) as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
     return 0
