@@ -90,6 +90,27 @@ class MDSDaemon(Dispatcher):
         self.addr = self.msgr.addr
         self.replay()
 
+    def boot(self, monmap, retries: int = 20,
+             interval: float = 0.25) -> None:
+        """Announce this rank to the mon quorum until the FSMap commits
+        it (reference MMDSBeacon resends periodically; a one-shot send
+        is lost during elections).  Clients discover us via
+        `fs status`."""
+        from ceph_tpu.mon import messages as mm
+
+        def send_all() -> None:
+            for addr in monmap.addrs:
+                if addr is not None:
+                    self.msgr.send_message(
+                        mm.MMDSBoot(self.rank, self.addr[0],
+                                    self.addr[1]), tuple(addr))
+
+        send_all()
+        threading.Thread(
+            target=lambda: [time.sleep(interval) or send_all()
+                            for _ in range(retries)],
+            name=f"mds{self.rank}-beacon", daemon=True).start()
+
     # -- lifecycle / journal ----------------------------------------------
     def replay(self) -> None:
         """Crash recovery (reference MDLog replay): re-apply every

@@ -302,3 +302,25 @@ class MPGStats(Message):
             dd.u64(), bool(dd.u8())))
         self.used_bytes = d.u64()
         self.total_bytes = d.u64()
+
+
+@register
+class MMDSBoot(Message):
+    """mds -> mon: rank R serves at this address (reference MMDSBeacon
+    boot, src/messages/MMDSBeacon.h — the FSMap feed)."""
+
+    TYPE = 45
+
+    def __init__(self, rank: int = -1, ip: str = "", port: int = 0) -> None:
+        super().__init__()
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.s32(self.rank).string(self.ip).u32(self.port)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self.rank = d.s32()
+        self.ip = d.string()
+        self.port = d.u32()

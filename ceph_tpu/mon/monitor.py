@@ -1088,9 +1088,12 @@ class Monitor(Dispatcher):
             if self.osdmap is None:
                 return -2, {"error": "no osdmap"}
             base = self._pending_map or self.osdmap
-            for p in base.pools.values():
+            for pid, p in base.pools.items():
                 if p.name == name:
-                    return -17, {"error": f"pool {name!r} exists"}
+                    # reference behavior: creating an existing pool is
+                    # SUCCESS (matters for re-runs over durable mon
+                    # state: "pool already exists")
+                    return 0, {"pool_id": pid, "existed": True}
             if kind == "erasure":
                 profile_name = cmd.get("erasure_code_profile", "default")
                 profile = self.ec_profiles.get(profile_name)
@@ -1148,6 +1151,13 @@ class Monitor(Dispatcher):
             return self._handle_subscribe(conn, msg)
         if isinstance(msg, mm.MOSDBoot):
             self._handle_boot(msg)
+            return True
+        if isinstance(msg, mm.MMDSBoot):
+            # FSMap feed (reference MMDSBeacon -> MDSMonitor)
+            with self.lock:
+                if self.state == STATE_LEADER:
+                    self.services["mdsmap"].handle_boot(
+                        msg.rank, (msg.ip, msg.port))
             return True
         if isinstance(msg, mm.MPGStats):
             with self.lock:

@@ -459,7 +459,80 @@ class MonmapMonitor(PaxosService):
         return None
 
 
+class MDSMonitor(PaxosService):
+    """The FSMap role (reference src/mon/MDSMonitor.cc + FSMap): a
+    paxos-committed roster of MDS ranks and their addresses.  MDS
+    daemons boot through the mon (MMDSBoot), clients discover the
+    rank->addr table with `fs status`, and `mds fail` marks a rank
+    down (its clients fail over when a replacement boots)."""
+
+    name = "mdsmap"
+
+    def __init__(self, mon) -> None:
+        super().__init__(mon)
+        self.epoch = 0
+        self.ranks: Dict[str, dict] = {}  # str(rank) -> {addr, up}
+
+    def load(self) -> None:
+        raw = self.kv.get("svc_mdsmap", "db")
+        if raw:
+            got = json.loads(raw.decode())
+            self.epoch = got["epoch"]
+            self.ranks = got["ranks"]
+
+    def _persist(self, batch: WriteBatch) -> None:
+        batch.set("svc_mdsmap", "db", json.dumps(
+            {"epoch": self.epoch, "ranks": self.ranks}).encode())
+
+    def apply(self, payload: dict, batch: WriteBatch) -> None:
+        op = payload["op"]
+        rank = str(payload["rank"])
+        if op == "boot":
+            self.ranks[rank] = {"addr": payload["addr"], "up": True}
+        elif op == "fail":
+            if rank in self.ranks:
+                self.ranks[rank]["up"] = False
+        self.epoch += 1
+        self._persist(batch)
+
+    def snapshot(self) -> Optional[dict]:
+        return {"epoch": self.epoch, "ranks": self.ranks}
+
+    def restore(self, snap: dict, batch: WriteBatch) -> None:
+        self.epoch = snap["epoch"]
+        self.ranks = {k: dict(v) for k, v in snap["ranks"].items()}
+        self._persist(batch)
+
+    def handle_boot(self, rank: int, addr) -> None:
+        cur = self.ranks.get(str(rank))
+        if cur and cur.get("up") and tuple(cur["addr"]) == tuple(addr):
+            return  # duplicate boot retry
+        self.propose({"op": "boot", "rank": rank, "addr": list(addr)})
+
+    def command(self, cmd: dict) -> Optional[Tuple[int, dict]]:
+        prefix = cmd.get("prefix", "")
+        if prefix == "fs status":
+            return 0, {"epoch": self.epoch,
+                       "ranks": {r: dict(v)
+                                 for r, v in sorted(self.ranks.items())}}
+        if prefix == "mds fail":
+            rank = str(cmd["rank"])
+            if rank not in self.ranks:
+                return -2, {"error": f"no mds rank {rank}"}
+            self.propose({"op": "fail", "rank": int(rank)})
+            return 0, {}
+        return None
+
+    def health_checks(self) -> Dict[str, dict]:
+        down = [r for r, v in self.ranks.items() if not v.get("up")]
+        if down:
+            return {"MDS_RANK_DOWN": {
+                "severity": "HEALTH_WARN",
+                "summary": f"mds ranks down: {sorted(down)}"}}
+        return {}
+
+
 def build_services(mon) -> Dict[str, PaxosService]:
     svcs = [ConfigMonitor(mon), LogMonitor(mon), HealthMonitor(mon),
-            AuthMonitor(mon), MonmapMonitor(mon)]
+            AuthMonitor(mon), MonmapMonitor(mon), MDSMonitor(mon)]
     return {s.name: s for s in svcs}

@@ -80,8 +80,16 @@ class VStartCluster:
         self.monmap = MonMap([("127.0.0.1", p) for p in ports])
         self.mons: List[Monitor] = []
         for rank in range(n_mons):
+            kv = None
+            if data_dir is not None:
+                # durable MonitorDBStore (the RocksDB role): paxos
+                # state + service DBs spill to disk via the LSM store
+                from ceph_tpu.store.lsm import LSMStore
+
+                kv = LSMStore(os.path.join(data_dir, f"mon{rank}"))
             mon = Monitor(self.ctx, rank, self.monmap, initial_map=seed,
-                          bind_port=ports[rank], keyring=self.keyring)
+                          bind_port=ports[rank], keyring=self.keyring,
+                          kv=kv)
             mon.start()
             self.mons.append(mon)
 
@@ -109,9 +117,28 @@ class VStartCluster:
         self._mds_pool = pool_id
         for rank in range(ranks):
             if rank not in self.mds:
-                self.mds[rank] = MDSDaemon(
-                    self.ctx, self.client().ioctx(pool_id), rank=rank)
+                d = MDSDaemon(self.ctx, self.client().ioctx(pool_id),
+                              rank=rank)
+                d.boot(self.monmap)  # register in the mon's FSMap
+                self.mds[rank] = d
+        # the roster is authoritative once the mon has committed THIS
+        # incarnation's addresses (a durable mon store restores stale
+        # entries from the previous run, so key presence isn't enough)
+        def committed() -> bool:
+            got = self.fs_status()["ranks"]
+            return all(
+                str(r) in got and got[str(r)].get("up")
+                and tuple(got[str(r)]["addr"]) == tuple(d.addr)
+                for r, d in self.mds.items())
+
+        self.wait_for(committed, what="mds ranks in fsmap")
         return {r: d.addr for r, d in self.mds.items()}
+
+    def fs_status(self) -> dict:
+        code, out = self.command({"prefix": "fs status"})
+        if code != 0:
+            raise RuntimeError(f"fs status failed: {out}")
+        return out
 
     def mount(self, name: str = "admin"):
         """An FSClient mounted against every running MDS rank."""
@@ -119,9 +146,15 @@ class VStartCluster:
 
         if not self.mds:
             self.start_mds()
+        # discover ranks THROUGH the mon (the FSMap path clients use),
+        # not from in-process handles
+        ranks = {int(r): tuple(info["addr"])
+                 for r, info in self.fs_status()["ranks"].items()
+                 if info.get("up")}
+        if not ranks:
+            raise RuntimeError("no up MDS ranks in the fsmap")
         return FSClient(self.ctx, self.client().ioctx(self._mds_pool),
-                        {r: d.addr for r, d in self.mds.items()},
-                        name=name)
+                        ranks, name=name)
 
     # -- daemons -----------------------------------------------------------
     def _make_store(self, i: int):

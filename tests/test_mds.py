@@ -232,3 +232,40 @@ def test_multi_mds_export_pins(cluster, rc):
     finally:
         c.shutdown()
         mds0.shutdown()
+
+
+def test_fsmap_through_mon():
+    """MDS ranks register in the mon's paxos-committed FSMap
+    (reference MDSMonitor.cc + MMDSBeacon): clients discover ranks via
+    `fs status`, `mds fail` marks one down and raises a health warn,
+    and a re-boot brings it back."""
+    from ceph_tpu.vstart import VStartCluster
+
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        c.start_mds(ranks=2)
+        code, st = c.command({"prefix": "fs status"})
+        assert code == 0 and sorted(st["ranks"]) == ["0", "1"]
+        assert all(v["up"] for v in st["ranks"].values())
+
+        # the mount path discovers addrs via the mon, not by hand
+        fs = c.mount("fsmap-client")
+        try:
+            fs.mkdir("/via")
+            fs.write("/via/f", b"routed" * 10)
+            assert fs.read("/via/f") == b"routed" * 10
+        finally:
+            fs.shutdown()
+
+        code, _ = c.command({"prefix": "mds fail", "rank": 1})
+        assert code == 0
+        c.wait_for(lambda: not c.fs_status()["ranks"]["1"]["up"],
+                   what="rank 1 marked down")
+        code, h = c.command({"prefix": "health"})
+        assert "MDS_RANK_DOWN" in h.get("checks", {})
+        # unknown rank is a clean error
+        code, _ = c.command({"prefix": "mds fail", "rank": 7})
+        assert code == -2
+        # rank re-boots: fsmap heals
+        c.mds[1].boot(c.monmap)
+        c.wait_for(lambda: c.fs_status()["ranks"]["1"]["up"],
+                   what="rank 1 back up")

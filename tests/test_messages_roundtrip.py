@@ -12,6 +12,7 @@ src/test/encoding/).
 import pytest
 
 # importing these modules populates MSG_REGISTRY
+import ceph_tpu.cephfs.messages  # noqa: F401
 import ceph_tpu.mon.messages  # noqa: F401
 import ceph_tpu.osd.messages  # noqa: F401
 from ceph_tpu.msg.message import MSG_REGISTRY, EntityName, Message
