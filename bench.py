@@ -74,10 +74,14 @@ def ec_sweep(jax, out):
     rec, _ = codec.recovery_matrix(survivors)
 
     sweep = {}
+    on_cpu = jax.default_backend() == "cpu"
     for size in (4096, 65536, 1 << 20, 4 << 20):
         n = size // K
         x = rng.integers(0, 256, size=(K, n), dtype=np.uint8)
-        xd = jax.device_put(x)
+        # TPU: pre-staged device arrays (HBM-resident pipeline); CPU:
+        # host arrays so the engine's host-view fast path engages —
+        # each backend measured the way the product drives it
+        xd = x if on_cpu else jax.device_put(x)
 
         enc = lambda: gf256_swar.gf_matmul_bytes(coding, xd)  # noqa: E731
         coded = np.asarray(enc())
@@ -86,7 +90,7 @@ def ec_sweep(jax, out):
         assert np.array_equal(coded[:, :4096], want), "encode != oracle"
 
         surv = np.stack([x[s] if s < K else coded[s - K] for s in survivors])
-        sd = jax.device_put(surv)
+        sd = surv if on_cpu else jax.device_put(surv)
         dec = lambda: gf256_swar.gf_matmul_bytes(rec, sd)  # noqa: E731
         assert np.array_equal(np.asarray(dec()), x), "decode != data"
 

@@ -109,14 +109,47 @@ def _compiled(matrix: np.ndarray, donate: bool = False) -> Callable:
     return fn
 
 
-def gf_matmul_bytes(matrix: np.ndarray, x, donate: bool = False) -> jax.Array:
+def _compiled_words(matrix: np.ndarray) -> Callable:
+    """jit of the network over PRE-PACKED u32 words [k, W] -> [R, W]
+    (no device-side bitcasts — see gf_matmul_bytes' CPU path)."""
+    key = (matrix.tobytes(), matrix.shape, "words")
+    fn = _cache.get(key)
+    if fn is None:
+        fn = _cache[key] = jax.jit(_build_network(matrix))
+    return fn
+
+
+def gf_matmul_bytes(matrix: np.ndarray, x, donate: bool = False):
     """Apply a GF(2^8) coefficient matrix (R x k) to byte rows [k, n].
 
-    n is padded to a word multiple internally; returns uint8 [R, n].
+    n is padded to a word multiple internally; returns uint8 [R, n]
+    (a jax array on accelerators; MAY be a host ndarray view on the
+    CPU backend — every consumer treats the result as array-like).
     `donate` hands the input buffer to XLA (see _compiled) — pass True
-    only when `x` is a fresh buffer this call may consume.
+    only when `x` is a fresh buffer this call may consume.  On the CPU
+    host-view path below, donate is a NO-OP (the input is a host
+    ndarray the caller keeps owning); the contract only bites on
+    accelerators.
+
+    CPU backend + host input: XLA-CPU lowers the u8<->u32
+    bitcast_convert_type pair catastrophically (measured SLOWER than
+    the entire xor network), while a numpy .view(uint32) reinterprets
+    for free — so the packing/unpacking happens host-side and the
+    device program is the pure u32 network (~6x end-to-end on CPU).
+    TPU keeps the device-side bitcasts: they are layout no-ops there
+    and the data stays resident.
     """
     matrix = np.asarray(matrix, dtype=np.uint8)
+    if isinstance(x, np.ndarray) and jax.default_backend() == "cpu":
+        x = np.ascontiguousarray(x, dtype=np.uint8)
+        k, n = x.shape
+        pad = (-n) % 4
+        if pad:
+            x = np.pad(x, ((0, 0), (0, pad)))
+        words = x.view(np.uint32)
+        out32 = np.asarray(_compiled_words(matrix)(words))
+        out = out32.view(np.uint8)
+        return out[:, :n] if pad else out
     x = jnp.asarray(x, dtype=jnp.uint8)
     k, n = x.shape
     pad = (-n) % 4
