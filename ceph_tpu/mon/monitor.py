@@ -214,20 +214,27 @@ class Monitor(Dispatcher):
             start = int(fv) if fv else 0
             from ceph_tpu.mon.services import SVC_TAG
 
+            # track how far replay actually got: the boot anchor below
+            # must never claim versions it did not fold in
+            self._replayed_v = start
             for v in range(start + 1, self.last_committed + 1):
                 data = self.kv.get("paxos_values", str(v))
                 if not data:
+                    self._replayed_v = v
                     continue
                 if data[0] == SVC_TAG:
+                    self._replayed_v = v
                     continue  # service state reloads from its own kv rows
                 try:
                     newmap = map_inc.decode_value(data, self.osdmap)
                     if (self.osdmap is None
                             or newmap.epoch > self.osdmap.epoch):
                         self.osdmap = newmap
+                    self._replayed_v = v
                 except map_inc.NeedFullMap:
                     break  # stale base: catch up from peers once live
                 except Exception:
+                    self._replayed_v = v
                     continue  # pre-framing legacy value
         # restore an accepted-but-uncommitted proposal: our promise must
         # survive restart or a new leader's collect can miss a value the
@@ -243,6 +250,22 @@ class Monitor(Dispatcher):
             self.ec_profiles = json.loads(prof.decode())
         for svc in self.services.values():
             svc.load()
+        if self.osdmap is not None and not self.kv.get("mon",
+                                                       "latest_full"):
+            # anchor the boot image: every later commit may be an
+            # incremental, and incrementals replay on top of an anchor
+            # — without this a FULL-quorum restart of a cluster that
+            # only ever committed deltas loses the osdmap entirely
+            # (no peer has a base to serve CATCHUP from).  Stamped
+            # with the version replay actually REACHED (stamping
+            # last_committed after a partial replay would permanently
+            # skip the unapplied tail on every later boot).
+            b = WriteBatch()
+            b.set("mon", "latest_full", map_codec.encode_osdmap(
+                self.osdmap))
+            b.set("mon", "latest_full_v",
+                  str(getattr(self, "_replayed_v", 0)).encode())
+            self.kv.submit(b)
 
     def _persist(self, **kv_updates) -> None:
         b = WriteBatch()

@@ -411,3 +411,56 @@ def test_clone_shrink_preserves_snapshot_and_hides_regrown(rbd, client):
         p.snap_unprotect("s")
         p.snap_remove("s")
     rbd.remove(io, "rp")
+
+
+def test_export_import_diff_chain(rbd, client):
+    """export-diff / import-diff (reference rbd export-diff +
+    DiffIterate): deltas between snapshots replay a remote copy
+    forward; chains compose; tampered streams refuse."""
+    import io as _io
+
+    from ceph_tpu.rbd.diff import DiffError, export_diff, import_diff
+
+    io_ = client.rc.ioctx(REP_POOL)
+    rbd.create(io_, "dsrc", size=1 << 19, order=16)
+    with rbd.open(io_, "dsrc") as src:
+        src.write(0, b"A" * 70_000)
+        src.snap_create("s1")
+        src.write(65_536, b"B" * 10_000)        # touches block 1
+        src.write(200_000, b"C" * 5_000)        # block 3
+        src.snap_create("s2")
+        src.write(0, b"D" * 100)                # head past s2
+
+        # full export (from None) then incremental s1 -> s2
+        full = _io.BytesIO()
+        export_diff(src, full, None, "s1")
+        inc = _io.BytesIO()
+        n = export_diff(src, inc, "s1", "s2")
+        assert 0 < n <= 3 * 65_536  # only changed blocks shipped
+
+    rbd.create(io_, "ddst", size=1 << 19, order=16)
+    with rbd.open(io_, "ddst") as dst:
+        full.seek(0)
+        hdr = import_diff(dst, full)
+        assert hdr["to_snap"] == "s1" and "s1" in dst.meta["snaps"]
+        inc.seek(0)
+        import_diff(dst, inc)
+        assert "s2" in dst.meta["snaps"]
+    # verify byte equality at both snapshots
+    with rbd.open(io_, "dsrc") as src, rbd.open(io_, "ddst") as dst:
+        for snap in ("s1", "s2"):
+            a = src.read_at_snap(snap, 0, 1 << 19)
+            b = dst.read_at_snap(snap, 0, 1 << 19)
+            assert a == b, f"divergence at snap {snap}"
+
+    # a diff whose FROM the target lacks refuses (reference rule)
+    rbd.create(io_, "dfresh", size=1 << 19, order=16)
+    with rbd.open(io_, "dfresh") as fresh:
+        inc.seek(0)
+        with pytest.raises(DiffError):
+            import_diff(fresh, inc)
+    # a torn stream refuses rather than half-applying
+    with rbd.open(io_, "ddst") as dst:
+        cut = _io.BytesIO(inc.getvalue()[:-6])
+        with pytest.raises(DiffError):
+            import_diff(dst, cut)
