@@ -675,23 +675,34 @@ class Monitor(Dispatcher):
             # incremental with no matching base (we skipped commits):
             # fetch the full map — from the leader when we're a peon,
             # from every peer when we ARE the (freshly elected, stale)
-            # leader; any mon with a newer map answers CATCHUP
-            req = mm.MMonPaxos(mm.MMonPaxos.CATCHUP_REQ, self.accepted_pn,
-                               version=self.last_committed)
-            if self.leader >= 0 and self.leader != self.rank:
-                self._send_mon(self.leader, req)
-            else:
-                for r in self._peers():
-                    self._send_mon(r, req)
+            # leader; any mon with a newer map answers CATCHUP.  The
+            # request is retried from the tick loop until a map at
+            # least this new is adopted: a one-shot send is silently
+            # dropped by a peer that is itself mid-restart (osdmap
+            # still None), which stalled full-quorum recovery forever.
+            self._catchup_want = max(
+                getattr(self, "_catchup_want", 0), version)
+            self._send_catchup_req()
             return
         except Exception as e:  # pragma: no cover
             self._plog(0, f"failed to decode committed map: {e}")
             return
         self._adopt_map(newmap, value, version)
 
+    def _send_catchup_req(self) -> None:
+        req = mm.MMonPaxos(mm.MMonPaxos.CATCHUP_REQ, self.accepted_pn,
+                           version=self.last_committed)
+        if self.leader >= 0 and self.leader != self.rank:
+            self._send_mon(self.leader, req)
+        else:
+            for r in self._peers():
+                self._send_mon(r, req)
+
     def _adopt_map(self, newmap: OSDMap, value: bytes,
                    version: int) -> None:
         self.osdmap = newmap
+        if version >= getattr(self, "_catchup_want", 0):
+            self._catchup_want = 0
         if value and value[0] == map_inc.INC_TAG:
             inc = map_inc.Incremental.decode(value[1:])
             self._recent_incs[inc.epoch] = (inc.prev_epoch, value[1:])
@@ -765,6 +776,10 @@ class Monitor(Dispatcher):
         while not self._stop.wait(iv):
             with self.lock:
                 state = self.state
+            with self.lock:
+                if getattr(self, "_catchup_want", 0):
+                    # still missing a map base: keep asking (see _learn)
+                    self._send_catchup_req()
             if state == STATE_LEADER:
                 msg = mm.MMonPaxos(mm.MMonPaxos.LEASE, self.accepted_pn,
                                    version=self.last_committed)

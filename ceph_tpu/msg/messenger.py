@@ -251,7 +251,10 @@ class Messenger:
                 c._close()
             if self._server is not None:
                 self._server.close()
-                await self._server.wait_closed()
+                # NO wait_closed(): since 3.12 it waits for every
+                # accepted-connection HANDLER to finish, and handlers
+                # blocked in reads only exit via the cancel sweep below
+                # — awaiting first deadlocks the shutdown
             # cancel and await every task this messenger spawned
             # (reconnect sleepers, send-queue waiters, frame readers):
             # abandoning them leaks "Task was destroyed but it is

@@ -11,6 +11,7 @@ mon service plugs in unchanged.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -111,6 +112,47 @@ class OSDService(Dispatcher):
         self.up = True
         if self.osdmap is not None:
             self._load_pgs()
+        if self.ctx.admin is not None:
+            # `ceph daemon osd.N bench` / `ceph tell osd.N bench` role
+            # (reference OSD::bench behind the 'bench' command): raw
+            # objectstore write throughput, no PG machinery
+            self.ctx.admin.register(
+                f"osd.{self.whoami} bench", self._admin_bench,
+                "objectstore write benchmark "
+                "(count=<total bytes> bsize=<block bytes>)")
+
+    def _admin_bench(self, cmd: dict) -> dict:
+        from ceph_tpu.store.objectstore import Collection, GHObject
+        from ceph_tpu.store.objectstore import Transaction as Txn
+
+        total = int(cmd.get("count", 16 << 20))
+        bsize = int(cmd.get("bsize", 1 << 20))
+        n = max(1, total // bsize)
+        coll = Collection("bench_meta")
+        payload = os.urandom(min(bsize, 1 << 20))
+        if len(payload) < bsize:
+            payload = (payload * (bsize // len(payload) + 1))[:bsize]
+        t = Txn()
+        t.create_collection(coll)
+        try:
+            self.store.queue_transaction(t)
+        except Exception:
+            pass  # collection may exist from a prior bench
+        t0 = time.perf_counter()
+        for i in range(n):
+            t = Txn()
+            g = GHObject(f"bench_{i}")
+            t.touch(coll, g)
+            t.write(coll, g, 0, payload)
+            self.store.queue_transaction(t)
+        elapsed = time.perf_counter() - t0
+        for i in range(n):  # clean up after ourselves
+            t = Txn()
+            t.try_remove(coll, GHObject(f"bench_{i}"))
+            self.store.queue_transaction(t)
+        return {"bytes_written": n * bsize, "blocksize": bsize,
+                "elapsed_sec": round(elapsed, 6),
+                "bytes_per_sec": round(n * bsize / max(elapsed, 1e-9))}
 
     def boot(self, monmap, keyring=None) -> None:
         """Join a mon-managed cluster: subscribe to maps, announce

@@ -310,3 +310,30 @@ def test_lru_cache_generation_refuses_stale_fills():
     c.get("x")
     c.put("z", 3, gen=g)
     assert "y" not in c and "x" in c and "z" in c
+
+
+def test_osd_bench_admin_command(tmp_path):
+    """`ceph daemon osd.N bench` role (reference OSD::bench): raw
+    objectstore write throughput over the admin socket."""
+    from ceph_tpu.core.admin_socket import admin_command
+    from ceph_tpu.core.context import Context
+    from ceph_tpu.ec import codec_from_profile
+    from ceph_tpu.osd.daemon import OSDService
+    from ceph_tpu.store.memstore import MemStore
+
+    sock = str(tmp_path / "osd.asok")
+    ctx = Context("osd.7", {"admin_socket": sock})
+    svc = OSDService(ctx, 7, MemStore(), None, codec_from_profile)
+    svc.store.mkfs()
+    svc.init()
+    try:
+        out = admin_command(sock, "osd.7 bench",
+                            count=1 << 20, bsize=1 << 16)
+        assert out["bytes_written"] == 1 << 20
+        assert out["blocksize"] == 1 << 16
+        assert out["bytes_per_sec"] > 0
+        assert "osd.7 bench" in admin_command(sock, "help")
+    finally:
+        svc.shutdown()
+        if ctx.admin is not None:
+            ctx.admin.stop()

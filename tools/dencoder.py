@@ -20,6 +20,7 @@ from ceph_tpu.core.encoding import Encoder
 from ceph_tpu.msg.message import MSG_REGISTRY, EntityName, Message
 from ceph_tpu.osd import map_codec, map_inc, messages as om  # noqa: F401
 from ceph_tpu.mon import messages as mm  # noqa: F401 (registers types)
+from ceph_tpu.cephfs import messages as cm  # noqa: F401 (registers types)
 from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp
 
 
