@@ -51,6 +51,12 @@ def _parse(tokens):
         return {"prefix": "osd df"}
     if t[0] == "pg" and t[1] == "dump":
         return {"prefix": "pg dump"}
+    if t[0] == "pg" and t[1] in ("scrub", "deep-scrub", "repair"):
+        return {"prefix": f"pg {t[1]}", "pgid": t[2]}
+    if t[0] == "fs" and t[1] == "status":
+        return {"prefix": "fs status"}
+    if t[0] == "mds" and t[1] == "fail":
+        return {"prefix": "mds fail", "rank": t[2]}
     if t[0] == "osd" and t[1] == "tree":
         return {"prefix": "osd tree"}
     if t[0] == "status":

@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""radosgw — the RGW daemon CLI (reference src/rgw/rgw_main.cc).
+
+Brings up a cluster (or attaches to a durable one via --data-dir),
+starts the HTTP frontend (S3 + Swift on one port), optionally creates
+a first user, and serves until interrupted:
+
+    radosgw --vstart 1x3 --port 8080 --create-user admin
+
+The printed access/secret keys drive any SigV4 S3 client or Swift
+tempauth client pointed at the endpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="radosgw")
+    p.add_argument("--vstart", default="1x3")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--pool", default="rgw")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--create-user", default=None, metavar="UID")
+    p.add_argument("--run-seconds", type=float, default=0.0,
+                   help="serve for N seconds then exit (0 = forever); "
+                        "used by tests/scripts")
+    args = p.parse_args(argv)
+
+    from ceph_tpu.rgw.frontend import RGWFrontend
+    from ceph_tpu.vstart import VStartCluster
+
+    n_mons, n_osds = (int(v) for v in args.vstart.split("x"))
+    with VStartCluster(n_mons=n_mons, n_osds=n_osds,
+                       data_dir=args.data_dir) as cluster:
+        pool_id = cluster.create_pool(args.pool, size=2)
+        io = cluster.client().ioctx(pool_id)
+        fe = RGWFrontend(io, port=args.port).start()
+        host, port = fe.addr
+        print(f"radosgw: serving S3 at http://{host}:{port}/ and "
+              f"Swift at http://{host}:{port}/swift/v1", flush=True)
+        if args.create_user:
+            try:
+                u = fe.users.user_create(args.create_user)
+                print(f"user {u['uid']}: access_key={u['access_key']} "
+                      f"secret_key={u['secret_key']}", flush=True)
+            except ValueError:
+                print(f"user {args.create_user} already exists",
+                      flush=True)
+        try:
+            if args.run_seconds > 0:
+                time.sleep(args.run_seconds)
+            else:
+                while True:
+                    time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            fe.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
