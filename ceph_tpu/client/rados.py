@@ -239,6 +239,12 @@ class IoCtx:
         self._check(self.operate(
             oid, [OSDOp(t_.OP_SETXATTR, name=name, data=value)]))
 
+    def getxattrs(self, oid: str) -> Dict[str, bytes]:
+        """All xattrs of one object (rados_getxattrs role)."""
+        rep = self.operate(oid, [OSDOp(t_.OP_GETXATTRS)])
+        self._check(rep)
+        return dict(rep.ops[0].out_kv)
+
     def getxattr(self, oid: str, name: str) -> bytes:
         rep = self.operate(oid, [OSDOp(t_.OP_GETXATTR, name=name)])
         self._check(rep)

@@ -92,10 +92,66 @@ def cmd_bench(io, args, cluster) -> int:
     return 0
 
 
+def cmd_export(io, args, cluster) -> int:
+    """export <dir> — archive every object (data + xattrs + omap) of
+    the pool to a directory (reference `rados export`)."""
+    import base64
+    import json as _json
+    import os as _os
+
+    out_dir = args[0]
+    _os.makedirs(out_dir, exist_ok=True)
+    names = sorted(io.list_objects())
+    index = []
+    for i, oid in enumerate(names):
+        data = io.read(oid)
+        try:
+            xattrs = {k: base64.b64encode(v).decode()
+                      for k, v in io.getxattrs(oid).items()}
+        except Exception:
+            xattrs = {}
+        try:
+            omap = {k: base64.b64encode(v).decode()
+                    for k, v in io.omap_get(oid).items()}
+        except Exception:
+            omap = {}
+        with open(_os.path.join(out_dir, f"obj_{i:08d}.bin"), "wb") as f:
+            f.write(data)
+        index.append({"oid": oid, "file": f"obj_{i:08d}.bin",
+                      "xattrs": xattrs, "omap": omap})
+    with open(_os.path.join(out_dir, "INDEX.json"), "w") as f:
+        _json.dump(index, f)
+    print(f"exported {len(names)} objects to {out_dir}")
+    return 0
+
+
+def cmd_import(io, args, cluster) -> int:
+    """import <dir> — restore an exported pool archive."""
+    import base64
+    import json as _json
+    import os as _os
+
+    src = args[0]
+    with open(_os.path.join(src, "INDEX.json")) as f:
+        index = _json.load(f)
+    for ent in index:
+        with open(_os.path.join(src, ent["file"]), "rb") as f:
+            io.write_full(ent["oid"], f.read())
+        for k, v in ent.get("xattrs", {}).items():
+            io.setxattr(ent["oid"], k, base64.b64decode(v))
+        omap = {k: base64.b64decode(v)
+                for k, v in ent.get("omap", {}).items()}
+        if omap:
+            io.omap_set(ent["oid"], omap)
+    print(f"imported {len(index)} objects from {src}")
+    return 0
+
+
 COMMANDS = {
     "put": cmd_put, "get": cmd_get, "ls": cmd_ls, "rm": cmd_rm,
     "stat": cmd_stat, "setxattr": cmd_setxattr, "getxattr": cmd_getxattr,
-    "df": cmd_df, "bench": cmd_bench,
+    "df": cmd_df, "bench": cmd_bench, "export": cmd_export,
+    "import": cmd_import,
 }
 
 
