@@ -781,13 +781,14 @@ class OSDService(Dispatcher):
         finally:
             self._waiters.pop(tid, None)
 
-    def collect_pg_infos(self, pg: PG, peers: List[int]) -> Dict[int, PGInfo]:
+    def collect_pg_infos(self, pg: PG, peers: List[int],
+                         timeout: float = 10.0) -> Dict[int, PGInfo]:
         if not peers:
             return {}
         reps = self._rpc([
             (p, m.MPGQuery(pg.pgid, self.epoch(), EVersion()))
             for p in peers
-        ])
+        ], timeout=timeout)
         out: Dict[int, PGInfo] = {}
         for rep in reps:
             if isinstance(rep, m.MPGInfo):
@@ -854,6 +855,11 @@ class OSDService(Dispatcher):
                 t = Transaction()
                 t.try_remove(pg.coll, GHObject(oid))
                 self.store.queue_transaction(t)
+                # a stale missing entry from an EARLIER interval (the
+                # pull never finished) must clear when the delete is
+                # applied, or reads of this name EAGAIN forever
+                with pg.lock:
+                    pg.missing.pop(oid, None)
             if pulls:
                 self._rpc([(best_osd,
                             m.MPGPull(pg.pgid, self.epoch(), pulls))],

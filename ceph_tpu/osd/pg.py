@@ -148,6 +148,11 @@ class PG:
     def update_acting(self, acting: Sequence[int], primary: int,
                       prior: Optional[Sequence[int]] = None) -> None:
         with self.lock:
+            if (list(acting) != self.acting
+                    or primary != self.primary):
+                # interval change: this PG must re-peer before serving
+                # ops again (the do_op peering gate keys off this)
+                self.state = STATE_PEERING
             if prior is not None:
                 # prior-interval holders (the past_intervals role): when
                 # placement moves wholesale (pgp_num change, crush
@@ -194,6 +199,17 @@ class PG:
             if not self.is_primary():
                 rep = m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                     msg.ops, result=ESTALE)
+                reply(rep)
+                return
+            if self.state == STATE_PEERING:
+                # the peering gate (reference: ops wait on the
+                # RecoveryMachine reaching Active): a freshly-remapped
+                # primary serving ops BEFORE converging on the
+                # authoritative log returns stale reads/listings and
+                # forks write history — answer retryable, the client
+                # waits out activation (found by model-under-thrash)
+                rep = m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                    msg.ops, result=EAGAIN)
                 reply(rep)
                 return
             if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_WATCH:
@@ -417,10 +433,24 @@ class PG:
                 return
         if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_PGLS:
             # PG-scoped listing (reference do_pg_op / CEPH_OSD_OP_PGLS):
-            # head objects only, meta excluded
+            # head objects only, meta excluded.  Objects this (possibly
+            # freshly-recovered) primary KNOWS about but has not pulled
+            # yet (pg.missing) exist logically and must list — found by
+            # the model-under-thrash hunt: listing only the local
+            # collection made just-written objects vanish from ls while
+            # recovery was still catching up.  Deletions the log says
+            # happened but the local store hasn't applied are excluded.
             import json
 
-            names = sorted(self.backend.object_names())
+            with self.lock:
+                names = set(self.backend.object_names())
+                for oid, _v in self.missing.items():
+                    en = self.log.latest_for(oid)
+                    if en is not None and en.op == t_.LOG_DELETE:
+                        names.discard(oid)
+                    else:
+                        names.add(oid)
+            names = sorted(names)
             msg.ops[0].out_data = json.dumps(names).encode()
             reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                 msg.ops, result=0,
@@ -1147,10 +1177,23 @@ class PG:
                 return
             # query prior-interval holders too: a wholesale remap
             # (pgp_num bump, crush edit) can leave every byte on strays
-            peers = [o for o in {*self.acting, *self.prior_acting}
-                     if o not in (self.osd.whoami, CRUSH_ITEM_NONE)
-                     and o >= 0]
-        infos = self.osd.collect_pg_infos(self, peers)
+            omap = self.osd.osdmap
+            all_peers = [o for o in {*self.acting, *self.prior_acting}
+                         if o not in (self.osd.whoami, CRUSH_ITEM_NONE)
+                         and o >= 0]
+            up_peers = [o for o in all_peers
+                        if omap is None or omap.is_up(o)]
+            down_peers = [o for o in all_peers if o not in up_peers]
+        # UP peers get the normal window.  Marked-DOWN peers are still
+        # probed — a spuriously-marked-down peer may hold the
+        # authoritative log (acked writes!), and skipping it would let
+        # this PG go active on stale data — but with a SHORT window so
+        # genuinely dead peers can't pin the PG in PEERING long enough
+        # for client ops to starve on the gate (10s x PGs did).
+        infos = self.osd.collect_pg_infos(self, up_peers)
+        if down_peers:
+            infos.update(self.osd.collect_pg_infos(
+                self, down_peers, timeout=1.0))
         with self.lock:
             self.peer_info = infos
             # authoritative log: highest last_update among self + peers

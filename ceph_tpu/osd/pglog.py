@@ -51,6 +51,15 @@ class PGLog:
         return trimmed
 
     # -- queries ----------------------------------------------------------
+    def latest_for(self, oid: str):
+        """The newest log entry touching `oid`, or None (the
+        reference's pg log objects index, used e.g. to decide whether
+        a missing object's latest state is a deletion)."""
+        for en in reversed(self.entries):
+            if en.oid == oid:
+                return en
+        return None
+
     def entries_after(self, v: EVersion) -> Optional[List[LogEntry]]:
         """Entries strictly newer than v, or None if v fell behind tail
         (=> needs backfill)."""
