@@ -242,6 +242,14 @@ class ReplicatedBackend(PGBackend):
 # ---------------------------------------------------------------------------
 
 
+def _av_stamp(v) -> bytes:
+    """Lexicographically-ordered encoding of an EVersion for the _av
+    attr (big-endian fixed width: byte compare == version compare)."""
+    import struct as _struct
+
+    return _struct.pack(">IQ", int(v.epoch), int(v.version))
+
+
 def _hinfo(chunk: bytes, total_size: int, crc_valid: bool = True) -> bytes:
     """Per-shard HashInfo xattr: (object logical size, chunk crc32c)
     (reference ECUtil::HashInfo, src/osd/ECUtil.h:101-122).
@@ -380,7 +388,8 @@ class ECBackend(PGBackend):
     def _shard_txn(self, oid: str, shard: int, chunk: Optional[bytes],
                    state: Optional[ObjectState],
                    log_omap: Dict[str, bytes],
-                   log_rm: Optional[List[str]] = None) -> Transaction:
+                   log_rm: Optional[List[str]] = None,
+                   av: Optional[bytes] = None) -> Transaction:
         t = Transaction()
         g = GHObject(oid, shard=shard)
         if state is None:
@@ -391,6 +400,13 @@ class ECBackend(PGBackend):
             t.write(self.coll, g, 0, chunk or b"")
             attrs = dict(state.xattrs)
             attrs["hinfo"] = _hinfo(chunk or b"", len(state.data))
+            if av is not None:
+                # attr-version stamp: RMW extent writes may CREATE an
+                # attr-poor shard on a behind holder (they carry no
+                # xattrs by design) — the read path must rank metas so
+                # such a shard can never supply the object's attrs
+                # while any properly-stamped shard answers
+                attrs["_av"] = av
             t.setattrs(self.coll, g, attrs)
             if state.omap:
                 t.omap_setkeys(self.coll, g, state.omap)
@@ -424,13 +440,17 @@ class ECBackend(PGBackend):
             waiting.add((shard, osd))
         op = InFlightOp(waiting, lambda: (self._done(tid), on_commit()))
         self.in_flight[tid] = op
+        av = None
+        if entries:
+            v = entries[-1].version
+            av = _av_stamp(v)
         for shard, osd in enumerate(shard_osds):
             if osd == CRUSH_ITEM_NONE or osd < 0:
                 continue
             txn = self._shard_txn(
                 oid, shard,
                 chunks[shard] if state is not None else None,
-                state, log_omap, log_rm)
+                state, log_omap, log_rm, av=av)
             if osd == self.whoami:
                 self.store.queue_transaction(txn)
                 op.ack((shard, osd))
@@ -520,6 +540,7 @@ class ECBackend(PGBackend):
         if "hinfo" in attrs:
             size, _, _ = hinfo_decode(attrs["hinfo"])
         attrs.pop("hinfo", None)
+        attrs.pop("_av", None)  # internal attr-version stamp
         if size is None:
             return None  # no shard metadata reached us: can't size it
         return ObjectState(self._deinterleave(planes, size), attrs, omap)

@@ -697,6 +697,17 @@ class OSDService(Dispatcher):
                 pg.handle_query(msg, conn)
             elif isinstance(msg, m.MScrub):
                 digests, unreadable = pg.local_scrub_map()
+                # objects this osd KNOWS exist but has not recovered
+                # (pg.missing) are exists-but-unservable: advertising
+                # them keeps a backfill consumer from treating our
+                # incomplete store listing as the authoritative object
+                # set and deleting live objects (EC thrash-hunt find)
+                with pg.lock:
+                    for oid in pg.missing:
+                        if oid not in digests and oid not in unreadable:
+                            en = pg.log.latest_for(oid)
+                            if en is None or en.op != t_.LOG_DELETE:
+                                unreadable.append(oid)
                 rep = m.MScrubMap(msg.pgid, self.epoch(),
                                   digests, unreadable)
                 rep.tid = msg.tid
@@ -812,7 +823,10 @@ class OSDService(Dispatcher):
             reps2 = self._rpc([(best_osd, m.MScrub(pg.pgid, self.epoch()))])
             if not reps2 or not isinstance(reps2[0], m.MScrubMap):
                 return  # can't list the authoritative set; retry later
-            names = set(reps2[0].digests)
+            # unreadable includes the peer's own missing set: objects
+            # it knows exist but can't serve yet must neither be
+            # deleted here nor dropped from the backfill worklist
+            names = set(reps2[0].digests) | set(reps2[0].unreadable)
             for oid in names:
                 latest[oid] = t_.LogEntry(
                     t_.LOG_MODIFY, oid, info_msg.info.last_update,
@@ -917,6 +931,7 @@ class OSDService(Dispatcher):
             t.write(pg.coll, g, 0, chunks[shard])
             attrs = dict(state.xattrs)
             attrs["hinfo"] = _hinfo(chunks[shard], len(state.data))
+            attrs["_av"] = pg._av_for(oid)
             t.setattrs(pg.coll, g, attrs)
             t.omap_clear(pg.coll, g)
             if state.omap:
@@ -943,6 +958,15 @@ class OSDService(Dispatcher):
         peers = [o for o in set(pg.acting)
                  if o not in (self.whoami, 0x7FFFFFFF) and o >= 0]
         digests, unreadable = pg.local_scrub_map()
+        # symmetric with the MScrub handler: our own known-but-
+        # unrecovered objects vote exists-but-unservable exactly like a
+        # peer's would
+        with pg.lock:
+            for oid in pg.missing:
+                if oid not in digests and oid not in unreadable:
+                    en = pg.log.latest_for(oid)
+                    if en is None or en.op != t_.LOG_DELETE:
+                        unreadable.append(oid)
         digests.update({o: SCRUB_UNREADABLE for o in unreadable})
         out = {self.whoami: digests}
         if peers:

@@ -42,6 +42,12 @@ from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp, PGId, PGInfo
 from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
 
 EPERM, ENOENT, EIO, EAGAIN, EINVAL = -1, -2, -5, -11, -22
+# EC reads that could not assemble k CURRENT chunks before the
+# watchdog fired answer with this sentinel: "retry later", never
+# "doesn't exist" (mixing a prior-interval chunk into a fresh decode
+# produced garbage; claiming ENOENT lost reads of live objects)
+READ_RETRY = object()
+
 # sentinel digest in merged scrub maps: the object exists on that osd
 # but its store refused the read (at-rest corruption) — votes "exists"
 # for repair auth selection, can never be authoritative (real crc32c
@@ -459,6 +465,10 @@ class PG:
         self.record_hit(msg.oid)
 
         def finish(state: Optional[ObjectState]) -> None:
+            if state is READ_RETRY:
+                reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                    msg.oid, msg.ops, result=EAGAIN))
+                return
             st = state
             if getattr(msg, "snapid", 0) and not self.is_ec():
                 st = self._resolve_snap(msg.oid, msg.snapid, state)
@@ -1088,78 +1098,128 @@ class PG:
     # -- EC read path (primary) -------------------------------------------
     def _ec_read_object(self, oid: str,
                         done: Callable[[Optional[ObjectState]], None]):
+        """Gather >=k chunks and one (attrs, omap) meta, then decode.
+
+        Source PRIORITY matters (found by the EC thrash hunt): a
+        prior-interval holder may hold a STALE shard (and stale attrs
+        — e.g. pre-setxattr), so its answer must never beat the
+        CURRENT acting holder's.  A prior holder's chunk/meta is used
+        only once the current holder for that shard has conclusively
+        failed (error reply, excluded as stale, or a hole)."""
         be: ECBackend = self.backend  # type: ignore[assignment]
         n = be.k + be.m
         acting = list(self.acting[:n]) + [CRUSH_ITEM_NONE] * (
             n - len(self.acting))
-        avail: Dict[int, bytes] = {}
-        meta_box: List = [None]  # (attrs, omap) from whichever shard
+        cur_avail: Dict[int, bytes] = {}     # from current holders
+        prior_avail: Dict[int, bytes] = {}   # from prior-interval holders
+        cur_meta: List = [None]
+        prior_meta: List = [None]
+
+        def _better_meta(box, attrs, omap):
+            """Keep the candidate with the HIGHEST _av stamp: an
+            RMW-recreated shard carries hinfo but no user attrs and no
+            stamp, and must never supply the object's attrs while a
+            properly-stamped shard answers (EC thrash-hunt find)."""
+            cand_av = attrs.get("_av", b"")
+            if box[0] is None or cand_av > box[0][0].get("_av", b""):
+                box[0] = (dict(attrs), dict(omap))
         with self.lock:
             local_stale = oid in self.missing
         if not local_stale:
             for shard in be.local_shards(acting):
                 c = be.read_local_chunk(oid, shard)
                 if c is not None:
-                    avail[shard] = c
-                    if meta_box[0] is None:
-                        meta_box[0] = be.shard_meta(oid, shard)
-        remote = [(s, o) for s, o in enumerate(acting)
+                    cur_avail[shard] = c
+                    attrs, omap = be.shard_meta(oid, shard)
+                    _better_meta(cur_meta, attrs, omap)
+        remote = [(s, o, True) for s, o in enumerate(acting)
                   if o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
                   and o not in self.stale_peers]  # stale shards can't serve
         # wholesale remap: a freshly-placed member has nothing yet — ask
-        # the prior-interval holder of each shard too (first valid
-        # answer wins per shard)
+        # the prior-interval holder of each shard too (fallback source)
         prior = list(self.prior_acting[:n])
         for s in range(min(n, len(prior))):
             o = prior[s]
             if (o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
-                    and s not in avail and (s, o) not in remote):
-                remote.append((s, o))
-        if not remote or len(avail) >= be.k:
-            done(be.reconstruct(oid, avail, meta_box[0])
-                 if avail else None)
+                    and s not in cur_avail
+                    and (s, o, True) not in remote):
+                remote.append((s, o, False))
+        # outstanding CURRENT-holder requests per shard: a prior
+        # holder's data for s is usable only when this drops to 0
+        pending_cur: Dict[int, int] = {}
+        pending_any: Dict[int, int] = {}
+        holder_of: Dict[Tuple[int, int], bool] = {}
+        for s, o, is_cur in remote:
+            holder_of[(s, o)] = is_cur
+            pending_any[s] = pending_any.get(s, 0) + 1
+            if is_cur:
+                pending_cur[s] = pending_cur.get(s, 0) + 1
+
+        def merged():
+            out = dict(cur_avail)
+            for s, c in prior_avail.items():
+                if s not in out and pending_cur.get(s, 0) <= 0:
+                    out[s] = c
+            return out
+
+        if not remote or len(cur_avail) >= be.k:
+            av = cur_avail
+            done(be.reconstruct(oid, av, cur_meta[0]) if av else None)
             return
-        # fan out sub-reads; complete as soon as k chunks are in hand or
-        # every live shard answered; a watchdog fires with whatever we
-        # have if a peer never replies (a hung shard must not hang the
-        # client op — minimum_to_decode only NEEDS k)
-        pending: Dict[int, int] = {}
-        for s, _o in remote:  # per-shard candidate counts: a miss from
-            pending[s] = pending.get(s, 0) + 1  # acting must not mask a
-        lock = threading.Lock()                 # prior holder's answer
+        lock = threading.Lock()
         fired = [False]
 
-        def finish() -> None:
+        def finish(timed_out: bool = False) -> None:
             with lock:
                 if fired[0]:
                     return
                 fired[0] = True
+                av = merged()
+                meta = cur_meta[0] or prior_meta[0]
+                hung_cur = any(v > 0 for v in pending_cur.values())
             timer.cancel()
-            done(be.reconstruct(oid, avail, meta_box[0])
-                 if avail else None)
+            if len(av) < be.k and timed_out and hung_cur:
+                # a current holder never answered: its shard may exist
+                # and a prior holder's chunk must not substitute (mixed
+                # generations decode to garbage) — retryable, not gone
+                done(READ_RETRY)
+                return
+            done(be.reconstruct(oid, av, meta) if av else None)
 
         def on_reply(rep: m.MECSubReadReply) -> None:
             with lock:
                 if fired[0]:
                     return
+                src = rep.src.num if rep.src else -1
+                is_cur = holder_of.get((rep.shard, src), False)
                 if rep.result == 0 and rep.oid == oid:
-                    avail[rep.shard] = rep.data
-                    pending.pop(rep.shard, None)
-                    if meta_box[0] is None and "hinfo" in rep.attrs:
-                        meta_box[0] = (dict(rep.attrs), dict(rep.omap))
-                elif rep.shard in pending:
-                    pending[rep.shard] -= 1
-                    if pending[rep.shard] <= 0:
-                        del pending[rep.shard]
-                ready = not pending or len(avail) >= be.k
+                    if is_cur:
+                        cur_avail[rep.shard] = rep.data
+                        if "hinfo" in rep.attrs:
+                            _better_meta(cur_meta, rep.attrs, rep.omap)
+                    else:
+                        prior_avail.setdefault(rep.shard, rep.data)
+                        if "hinfo" in rep.attrs:
+                            _better_meta(prior_meta, rep.attrs,
+                                         rep.omap)
+                if is_cur:
+                    pending_cur[rep.shard] = (
+                        pending_cur.get(rep.shard, 1) - 1)
+                pending_any[rep.shard] = pending_any.get(rep.shard, 1) - 1
+                if pending_any.get(rep.shard, 0) <= 0:
+                    pending_any.pop(rep.shard, None)
+                ready = (not pending_any or len(cur_avail) >= be.k
+                         or (len(merged()) >= be.k
+                             and not any(v > 0
+                                         for v in pending_cur.values())))
             if ready:
                 finish()
 
-        timer = threading.Timer(10.0, finish)
+        timer = threading.Timer(10.0, lambda: finish(timed_out=True))
         timer.daemon = True
         timer.start()
         tid = self.osd.track_reads(self.pgid, on_reply, len(remote))
-        for shard, osd in remote:
+        for shard, osd, _is_cur in remote:
             rd = m.MECSubRead(self.pgid, self.osd.epoch(), shard, oid, 0, 0)
             rd.tid = tid
             self.osd.send_to_osd(osd, rd)
@@ -1175,6 +1235,10 @@ class PG:
             if not self.is_primary():
                 self.state = STATE_ACTIVE  # replicas follow the primary
                 return
+            # interval token: a concurrent activation for a NEWER map
+            # must win — a stale activate() finishing late would open
+            # the peering gate with the old interval's peer view
+            interval = (tuple(self.acting), self.primary)
             # query prior-interval holders too: a wholesale remap
             # (pgp_num bump, crush edit) can leave every byte on strays
             omap = self.osd.osdmap
@@ -1222,6 +1286,8 @@ class PG:
                 self, oid, LogEntry(op=t_.LOG_MODIFY, oid=oid,
                                     version=ver, prior_version=ver))
         with self.lock:
+            if (tuple(self.acting), self.primary) != interval:
+                return  # interval moved on: the newer activation owns state
             degraded = any(o == CRUSH_ITEM_NONE or o < 0
                            for o in self.acting) or (
                 len(self.acting) < self._want_size()) or bool(self.missing)
@@ -1321,6 +1387,13 @@ class PG:
 
     def _build_pushes(self, oid: str, to_osd: int) -> List[m.MPGPush]:
         state = self._read_state_sync(oid)
+        if state is None and not self._known_deleted(oid):
+            # "couldn't read it right now" is NOT "it doesn't exist":
+            # pushing a deletion here destroyed the SURVIVING shards of
+            # objects that were merely unreconstructable mid-churn
+            # (< k chunks reachable) — found by the EC thrash hunt.
+            # Push nothing; recovery retries when more shards return.
+            return []
         if not self.is_ec():
             return [self._push_msg(oid, state, shard=-1)]
         n = self.backend.k + self.backend.m
@@ -1335,10 +1408,30 @@ class PG:
         for shard in shards:
             attrs = dict(state.xattrs)
             attrs["_size_hint"] = len(state.data).to_bytes(8, "little")
+            attrs["_av"] = self._av_for(oid)
             out.append(m.MPGPush(
                 self.pgid, self.osd.epoch(), oid, self.log.head,
                 chunks[shard], attrs, dict(state.omap), shard=shard))
         return out
+
+    def _av_for(self, oid: str) -> bytes:
+        """Attr-version stamp for recovery-written shards: recovered
+        attrs are as new as the object's latest log version (without
+        this, every recovered shard is unstamped and the _av meta
+        ranking stops protecting attrs after any recovery)."""
+        from ceph_tpu.osd.backend import _av_stamp
+
+        with self.lock:
+            en = self.log.latest_for(oid)
+            return _av_stamp(en.version if en is not None
+                             else self.log.head)
+
+    def _known_deleted(self, oid: str) -> bool:
+        """True only when the log's newest word on `oid` is a DELETE —
+        the sole justification for propagating a deletion push."""
+        with self.lock:
+            en = self.log.latest_for(oid)
+            return en is not None and en.op == t_.LOG_DELETE
 
     def _read_state_sync(self, oid: str,
                          timeout: float = 30.0) -> Optional[ObjectState]:
@@ -1351,7 +1444,7 @@ class PG:
 
         self._get_state(oid, got)
         done.wait(timeout)
-        return box[0]
+        return None if box[0] is READ_RETRY else box[0]
 
     def _push_msg(self, oid: str, state: Optional[ObjectState],
                   shard: int) -> m.MPGPush:
@@ -1745,6 +1838,7 @@ class PG:
             t.write(self.coll, g, 0, chunk)
             attrs = dict(state.xattrs)
             attrs["hinfo"] = _hinfo(chunk, len(state.data))
+            attrs["_av"] = self._av_for(oid)
             t.setattrs(self.coll, g, attrs)
             if state.omap:
                 t.omap_setkeys(self.coll, g, state.omap)
@@ -1752,6 +1846,7 @@ class PG:
             return
         attrs = dict(state.xattrs)
         attrs["_size_hint"] = len(state.data).to_bytes(8, "little")
+        attrs["_av"] = self._av_for(oid)
         self.osd.rpc([(osd_id, m.MPGPush(
             self.pgid, self.osd.epoch(), oid, self.log.head,
             chunk, attrs, dict(state.omap), shard=shard))], timeout=30.0)
