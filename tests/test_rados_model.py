@@ -196,3 +196,45 @@ def test_rados_model_under_thrash():
         th.join(timeout=10)
         cl.shutdown()
         c.shutdown()
+
+
+def test_rados_model_ec_under_thrash():
+    """The EC-pool model sequence under OSD thrashing: the hunt that
+    drove the round's EC consistency fixes (deletion-push guard,
+    backfill authority incl. peer missing sets, source-ranked reads
+    with _av attr-version metas, retryable watchdog reads, interval-
+    token activations).  Seed 0x1EC was a deterministic xattr-loss
+    repro before those fixes."""
+    import threading
+    import time
+
+    from tests.test_osd_cluster import N_OSDS
+
+    c = MiniCluster()
+    cl = LibClient(c)
+    stop = threading.Event()
+
+    def thrasher():
+        rng = random.Random(0x1EC ^ 3)
+        while not stop.is_set():
+            victim = rng.randrange(N_OSDS)
+            try:
+                c.kill(victim)
+                time.sleep(rng.uniform(0.4, 0.9))
+                c.revive(victim)
+                time.sleep(rng.uniform(0.6, 1.2))
+            except Exception:
+                pass
+
+    th = threading.Thread(target=thrasher, daemon=True)
+    th.start()
+    try:
+        ops = _run_model_sequence(cl.rc.ioctx(EC_POOL),
+                                  random.Random(0x1EC),
+                                  rounds=150, oid_space=16)
+        assert sum(ops.values()) >= 120
+    finally:
+        stop.set()
+        th.join(timeout=10)
+        cl.shutdown()
+        c.shutdown()
