@@ -48,7 +48,13 @@ def probe():
 
 def run_capture(script, out_path, timeout):
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO
+    # Append the repo to the AMBIENT path instead of replacing it: the
+    # axon PJRT plugin registers via a sitecustomize on the ambient
+    # PYTHONPATH (/root/.axon_site) — clobbering it makes jax fail with
+    # "backend 'axon' is not known" even when the tunnel is healthy
+    # (observed this round)
+    prior = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = f"{prior}:{REPO}" if prior else REPO
     env.setdefault("CEPH_TPU_PROBE_TIMEOUT", "120")
     try:
         p = subprocess.run([sys.executable, script], capture_output=True,
