@@ -4,24 +4,39 @@ Mirrors the reference's benchmark semantics:
 - EC: GB/s = object_bytes / seconds for encode, and for decode after
   erasing m chunks and verifying reconstructed equality
   (src/test/erasure-code/ceph_erasure_code_benchmark.cc:151-190 encode,
-  :255-328 decode), swept over 4 KiB - 4 MiB objects like
+  :255-328 decode), swept over 4 KiB - 64 MiB objects like
   qa/workunits/erasure-code/bench.sh:103-145.
-- CRUSH: placements/sec for a full-cluster sweep of object ids over a
-  1024-OSD straw2 map (BASELINE metric 6; the CrushTester/psim loop,
-  src/crush/CrushTester.cc:472, src/tools/psim.cc:64), measured against
-  the REFERENCE's own C crush_do_rule batch rate (libcrush_ref.so,
-  compiled from /root/reference/src/crush/).
+- CRUSH: placements/sec for a full-cluster sweep of ~10M object ids
+  over a 1024-OSD straw2 map (BASELINE metric 6; the CrushTester/psim
+  loop, src/crush/CrushTester.cc:472, src/tools/psim.cc:64), measured
+  against the REFERENCE's own C crush_do_rule batch rate
+  (libcrush_ref.so, compiled from /root/reference/src/crush/).
 
-Engines under test: the packed SWAR GF(2^8) xor network
-(ceph_tpu/ops/gf256_swar.py) and the vmapped straw2 interpreter
-(ceph_tpu/crush/mapper.py).  CPU baseline for EC is the native scalar
-C++ oracle (csrc/gf256.cc) — NOTE: that is a scalar C++ loop, NOT
-ISA-L; real ISA-L does multiple GB/s/core with AVX.
+MEASUREMENT MODEL (round-4 hardware finding): the attached TPU sits
+behind a tunnel with ~94 ms round-trip latency and ~5 MB/s host->device
+bandwidth, and `block_until_ready()` does not truly synchronize — so
+any per-dispatch benchmark measures the tunnel, not the chip.  On the
+TPU backend every measured region therefore keeps data DEVICE-RESIDENT,
+loops iterations INSIDE one jit (anti-hoisting seed per iteration), and
+fetches only a digest — the same measured region as the reference
+harness (a C loop over an in-RAM buffer, benchmark.cc:181-186).  The
+`envelope` section records the tunnel characteristics in the artifact
+so the numbers are self-explanatory.  On the CPU fallback backend the
+old host-path measurement is kept (there the host path IS the product
+path).  Correctness is pinned before timing: device results are fetched
+once and compared bit-for-bit against the native scalar oracle.
 
-Fault isolation: every section appends into one result dict and catches
-its own exceptions (recorded under "errors"), so a late CRUSH failure
-can never discard the EC numbers (the round-2 artifact failure mode).
-Exactly ONE JSON line is always printed:
+Engines under test: the SWAR GF(2^8) xor network, as XLA graph
+(ceph_tpu/ops/gf256_swar.py) and as a Pallas VMEM-tiled kernel
+(ceph_tpu/ops/gf256_pallas.py) — autotuned, best engine reported — and
+the vmapped straw2 interpreter via the all-on-device two-stage sweep
+(ceph_tpu/crush/mapper.py sweep_device).
+
+Fault isolation: every section appends into one result dict, catches
+its own exceptions (recorded under "errors"), and the artifact-so-far
+is flushed to BENCH_PARTIAL.json after every section; a watchdog emits
+the final JSON if a section hangs (wedged tunnel).  Exactly ONE JSON
+line is always printed:
   {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, ...}
 """
 
@@ -33,9 +48,9 @@ import traceback
 import numpy as np
 
 K, M = 8, 4
+LANES = 128
 HBM_PEAK_GBPS = 819.0  # v5e
-CRUSH_IDS = 10_000_000  # BASELINE metric 6
-CRUSH_CHUNK = 1 << 19  # ids per device dispatch: bounds live HBM temps
+CRUSH_CHUNK = 1 << 19  # ids per scan chunk: bounds live HBM temps
 
 
 def _block(out):
@@ -61,7 +76,266 @@ def _suspect(gbps, bytes_moved_per_byte=1.0):
     return bool(gbps * bytes_moved_per_byte > HBM_PEAK_GBPS)
 
 
-def ec_sweep(jax, out):
+# device/host twin data generators (bit-identical; the oracle pin
+# depends on it) live in one place: ceph_tpu/ops/mix32.py
+
+
+# ---------------------------------------------------------------------------
+# envelope: tunnel + chip characteristics (makes every artifact
+# self-explanatory about WHERE time goes on this rig)
+# ---------------------------------------------------------------------------
+
+def envelope(jax, out):
+    import jax.numpy as jnp
+    from jax import lax
+
+    if jax.default_backend() == "cpu":
+        # host-CPU "envelope" numbers describe neither a tunnel nor a
+        # chip — don't record misleading rig characteristics
+        out["envelope"] = {"skipped": "cpu fallback backend"}
+        return
+    env = {}
+    # dispatch+fetch round trip (the latency every host-path op pays)
+    f = jax.jit(lambda x: jnp.sum(x))
+    x8 = jnp.ones((8,), jnp.float32)
+    float(f(x8))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(f(x8))
+    env["scalar_rtt_ms"] = round((time.perf_counter() - t0) / 5 * 1e3, 1)
+
+    # on-device HBM rate: chained elementwise inside one jit
+    iters = 64
+    big = jnp.zeros((16, 1024, 1024), jnp.float32)  # 64 MB
+
+    @jax.jit
+    def hbm(x):
+        def body(i, acc):
+            return acc * 1.000001 + 1.0
+        return jnp.sum(lax.fori_loop(0, iters, body, x))
+
+    float(hbm(big))
+    t0 = time.perf_counter()
+    float(hbm(big))
+    dt = time.perf_counter() - t0
+    env["hbm_chained_gbps"] = round(iters * 2 * big.nbytes / dt / 1e9, 1)
+
+    # on-device MXU rate: chained matmuls inside one jit
+    n, km = 2048, 32
+    a = jnp.full((n, n), 0.001, jnp.bfloat16)
+
+    @jax.jit
+    def mxu(a):
+        def body(i, acc):
+            return (a @ acc).astype(jnp.bfloat16)
+        return jnp.sum(lax.fori_loop(0, km, body, a).astype(jnp.float32))
+
+    float(mxu(a))
+    t0 = time.perf_counter()
+    float(mxu(a))
+    dt = time.perf_counter() - t0
+    env["mxu_bf16_tflops"] = round(km * 2 * n**3 / dt / 1e12, 1)
+
+    # host->device staging rate at 1 MiB (the tunnel's data-plane rate)
+    h = np.zeros(1 << 20, np.uint8)
+    g = jax.jit(lambda x: x[0])
+    int(g(jax.device_put(h)))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        int(g(jax.device_put(h)))
+    dt = (time.perf_counter() - t0) / 3
+    env["h2d_1mib_mbps"] = round(h.nbytes / dt / 1e6, 1)
+    out["envelope"] = env
+
+
+# ---------------------------------------------------------------------------
+# EC: device-resident autotuned sweep (TPU) / host path (CPU fallback)
+# ---------------------------------------------------------------------------
+
+def _ec_device(jax, out):
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ceph_tpu import _native
+    from ceph_tpu.ec import matrices
+    from ceph_tpu.ec.codec import RSMatrixCodec
+    from ceph_tpu.ops import gf256_pallas
+    from ceph_tpu.ops.gf256_swar import _build_network
+
+    from ceph_tpu.ops.mix32 import mix_jnp, mix_np
+
+    coding = matrices.isa_cauchy(K, M)
+    codec = RSMatrixCodec(K, M, coding)
+    net = _build_network(coding)
+
+    def gen(T, k=K, interleaved=False):
+        shape = (T, k, LANES) if interleaved else (k, T, LANES)
+
+        @jax.jit
+        def g():
+            i = lax.iota(jnp.uint32, k * T * LANES).reshape(shape)
+            return mix_jnp(i)
+        return g()
+
+    def xla_engine(matrix):
+        n2 = _build_network(matrix) if matrix is not coding else net
+        R = matrix.shape[0]
+
+        def enc(w3, seed):
+            k, T, L = w3.shape
+            return n2((w3 ^ seed[0]).reshape(k, -1)).reshape(R, T, L)
+        return enc
+
+    def pallas_engine(matrix, tile):
+        def enc(w3, seed):
+            return gf256_pallas.encode_planes(matrix, w3, seed, tile=tile,
+                                              interpret=False)
+        return enc
+
+    def pallas_inter_engine(matrix, tile):
+        def enc(w3, seed):
+            return gf256_pallas.encode_planes_interleaved(
+                matrix, w3, seed, tile=tile, interpret=False)
+        return enc
+
+    # shared measurement protocol (ceph_tpu/ops/benchloop.py)
+    from ceph_tpu.ops.benchloop import seeded_loop_runner as make_run
+    from ceph_tpu.ops.benchloop import timed_best as timed
+
+    # ---- correctness pin (before any timing): 1 MiB batch ----
+    T_pin = 256  # 1 MiB object at k=8
+    w_pin = gen(T_pin)
+    i_host = np.arange(K * T_pin * LANES, dtype=np.uint32)
+    x_host = mix_np(i_host).view(np.uint8).reshape(K, -1)
+    want = _native.rs_encode(coding.astype(np.uint8), x_host)
+    zseed = jnp.zeros((1,), jnp.uint32)
+    for name, enc in (("xla", xla_engine(coding)),
+                      ("pallas", pallas_engine(coding, 256))):
+        got3 = jax.jit(enc)(w_pin, zseed)
+        got = gf256_pallas.unpack_planes(np.asarray(got3))
+        assert np.array_equal(got, want), f"{name} encode != oracle"
+    # interleaved layout: same bytes, (T, k, 128) order
+    w_pin_i = jnp.transpose(w_pin, (1, 0, 2))
+    got3 = jax.jit(pallas_inter_engine(coding, 256))(w_pin_i, zseed)
+    got = gf256_pallas.unpack_planes(
+        np.transpose(np.asarray(got3), (1, 0, 2)))
+    assert np.array_equal(got, want), "pallas_interleaved != oracle"
+    out["ec_device_pinned"] = True
+
+    # ---- autotune at 16 MiB ----
+    # candidate -> (engine factory(matrix, tile), interleaved?)
+    T_tune = 4096
+    iters_tune = 20
+    size_tune = T_tune * LANES * 4 * K
+    cands = {"xla_swar": (xla_engine, None, False)}
+    for tile in (256, 512, 1024):
+        cands[f"pallas_t{tile}"] = (pallas_engine, tile, False)
+        cands[f"pallas_inter_t{tile}"] = (pallas_inter_engine, tile, True)
+    w_tune_p = gen(T_tune)
+    w_tune_i = gen(T_tune, interleaved=True)
+    tune = {}
+    for name, (factory, tile, inter) in cands.items():
+        enc = factory(coding, tile) if tile else factory(coding)
+        w3 = w_tune_i if inter else w_tune_p
+        oshape = (T_tune, M, LANES) if inter else (M, T_tune, LANES)
+        try:
+            dt = timed(make_run(enc, oshape, iters_tune), w3)
+            tune[name] = round(iters_tune * size_tune / dt / 1e9, 2)
+        except Exception as e:  # an engine variant failing is data
+            tune[name] = f"error: {e!r}"[:120]
+    del w_tune_p, w_tune_i
+    out["ec_engine_tune_gbps"] = tune
+    numeric = {k: v for k, v in tune.items() if isinstance(v, float)}
+    if not numeric:  # every variant failed: the tune table is the data
+        raise RuntimeError(f"all EC engine candidates failed: {tune}")
+    winner = max(numeric, key=numeric.get)
+    out["ec_engine"] = winner
+    win_inter = cands[winner][2]
+
+    def winner_enc(matrix, T):
+        factory, tile, _ = cands[winner]
+        if tile and T % tile:
+            tile = max(t for t in (256, 512, 1024) if T % t == 0)
+        return factory(matrix, tile) if tile else factory(matrix)
+
+    def rate_at(matrix, T, iters, R):
+        w3 = gen(T, interleaved=win_inter)
+        oshape = (T, R, LANES) if win_inter else (R, T, LANES)
+        dt = timed(make_run(winner_enc(matrix, T), oshape, iters), w3)
+        return iters * T * LANES * 4 * K / dt / 1e9
+
+    # ---- encode sweep (device-resident) ----
+    sweep = {}
+    sizes = [(1 << 20, 256, 200), (4 << 20, 1024, 100),
+             (16 << 20, 4096, 30), (64 << 20, 16384, 10)]
+    for size, T, iters in sizes:
+        gbps = rate_at(coding, T, iters, M)
+        # loop HBM traffic per object byte: read k planes (1.0) +
+        # write m (0.5) + xor-accumulate read/read/write (1.5) = 3.0
+        sweep[str(size)] = {
+            "encode_gbps": round(gbps, 3),
+            "suspect": _suspect(gbps, 3.0),
+        }
+
+    # 4 KiB objects, device-batched: 4096 objects batched as one
+    # (K, 4096, 128) plane set are COLUMN-INDEPENDENT under the code,
+    # so the batch is bit-identical work to one 16 MiB object — the
+    # 16 MiB measurement IS the batched-4KiB rate (SURVEY §7 hard
+    # part #2: batching amortizes away the small-object penalty)
+    out["small_stripe_4k_device_batched_gbps"] = \
+        sweep[str(16 << 20)]["encode_gbps"]
+
+    # ---- decode (recovery-matrix through the same engine) ----
+    survivors = [0, 1, 2, 3, 4, 5, 8, 9]  # lose data 6,7 + coding 2,3
+    rec, _ = codec.recovery_matrix(survivors)
+    rec = np.ascontiguousarray(rec, dtype=np.uint8)
+    # pin: decode of the pinned batch reproduces the data planes
+    coded = want
+    surv_host = np.stack([x_host[s] if s < K else coded[s - K]
+                          for s in survivors])
+    sw = jnp.asarray(gf256_pallas.pack_planes(surv_host))
+    if win_inter:
+        sw = jnp.transpose(sw, (1, 0, 2))
+    dec3 = np.asarray(jax.jit(winner_enc(rec, T_pin))(sw, zseed))
+    if win_inter:
+        dec3 = np.transpose(dec3, (1, 0, 2))
+    assert np.array_equal(gf256_pallas.unpack_planes(dec3),
+                          x_host), "decode != data"
+
+    dec_sweep = {}
+    for size, T, iters in sizes:
+        # stand-in survivor planes (same shapes/throughput as data)
+        dec_sweep[str(size)] = round(rate_at(rec, T, iters, K), 3)
+    for s in sweep:
+        sweep[s]["decode_gbps"] = dec_sweep[s]
+
+    out["ec_sweep"] = sweep
+    head = sweep[str(1 << 20)]
+    out["encode_gbps"] = head["encode_gbps"]
+    out["decode_gbps"] = head["decode_gbps"]
+    big = sweep[str(64 << 20)]
+    out["encode_gbps_64mib"] = big["encode_gbps"]
+    out["encode_hbm_frac"] = round(
+        big["encode_gbps"] * (K + M) / K / HBM_PEAK_GBPS, 3)
+    out["ec_loop_traffic_note"] = (
+        "measured inside-jit loop xor-accumulates outputs; pure encode "
+        "HBM traffic is ~2x lower than the loop's, so rates are "
+        "conservative")
+
+    # host-path number for transparency (what a per-dispatch caller
+    # sees through the tunnel; the product StripeBatchQueue path).
+    # Timed with a FULL d2h fetch per call: block_until_ready does not
+    # truly synchronize on this rig, and the socket layer fetches the
+    # coding bytes anyway, so fetch-to-host IS the product round trip.
+    from ceph_tpu.ops import gf256_swar
+    xd = jax.device_put(x_host)
+    dt = _bench(lambda: np.asarray(gf256_swar.gf_matmul_bytes(coding, xd)),
+                warmup=1, iters=3)
+    out["encode_1mib_host_path_gbps"] = round((1 << 20) / dt / 1e9, 3)
+    out["encode_1mib_host_path_note"] = "includes d2h fetch (tunnel)"
+
+
+def _ec_cpu_host(jax, out):
     from ceph_tpu import _native
     from ceph_tpu.ec import matrices
     from ceph_tpu.ec.codec import RSMatrixCodec
@@ -74,30 +348,21 @@ def ec_sweep(jax, out):
     rec, _ = codec.recovery_matrix(survivors)
 
     sweep = {}
-    on_cpu = jax.default_backend() == "cpu"
     for size in (4096, 65536, 1 << 20, 4 << 20):
         n = size // K
         x = rng.integers(0, 256, size=(K, n), dtype=np.uint8)
-        # TPU: pre-staged device arrays (HBM-resident pipeline); CPU:
-        # host arrays so the engine's host-view fast path engages —
-        # each backend measured the way the product drives it
-        xd = x if on_cpu else jax.device_put(x)
 
-        enc = lambda: gf256_swar.gf_matmul_bytes(coding, xd)  # noqa: E731
+        enc = lambda: gf256_swar.gf_matmul_bytes(coding, x)  # noqa: E731
         coded = np.asarray(enc())
-        # correctness pin vs the native oracle before timing anything
         want = _native.rs_encode(coding.astype(np.uint8), x[:, :4096])
         assert np.array_equal(coded[:, :4096], want), "encode != oracle"
 
         surv = np.stack([x[s] if s < K else coded[s - K] for s in survivors])
-        sd = surv if on_cpu else jax.device_put(surv)
-        dec = lambda: gf256_swar.gf_matmul_bytes(rec, sd)  # noqa: E731
+        dec = lambda: gf256_swar.gf_matmul_bytes(rec, surv)  # noqa: E731
         assert np.array_equal(np.asarray(dec()), x), "decode != data"
 
         enc_dt = _bench(enc)
         dec_dt = _bench(dec)
-        # encode reads k/(k+m) and writes m/(k+m) of (k+m)/k*size bytes:
-        # HBM traffic ≈ size * (k+m)/k relative to the reported object GB/s
         traffic = (K + M) / K
         sweep[str(size)] = {
             "encode_gbps": round(size / enc_dt / 1e9, 3),
@@ -106,30 +371,42 @@ def ec_sweep(jax, out):
             or _suspect(size / dec_dt / 1e9, traffic),
         }
 
-    # headline at 1 MiB
     head = sweep[str(1 << 20)]
     out["ec_sweep"] = sweep
     out["encode_gbps"] = head["encode_gbps"]
     out["decode_gbps"] = head["decode_gbps"]
-    # roofline: encode moves (k+m)/k x the object bytes over HBM
-    out["encode_hbm_frac"] = round(
-        head["encode_gbps"] * (K + M) / K / HBM_PEAK_GBPS, 3)
+    out["encode_hbm_frac"] = 0.0
 
-    # CPU baseline: the same encode through the scalar native oracle
-    # (scalar C++, not ISA-L — see module docstring)
+
+def ec_section(jax, out):
+    try:
+        if jax.default_backend() == "cpu":
+            _ec_cpu_host(jax, out)
+        else:
+            _ec_device(jax, out)
+    finally:
+        # the CPU baselines must land in the artifact even if the
+        # device sweep dies mid-way (vs_baseline needs them)
+        _ec_baselines(out)
+
+
+def _ec_baselines(out):
+    """Honest CPU baselines: the scalar native oracle AND the AVX2
+    split-nibble PSHUFB kernel (csrc/gf256_simd.cc — the same technique
+    ISA-L's asm uses; the isa-l submodule is empty in the reference
+    checkout, so this is the strongest comparator buildable here)."""
+    from ceph_tpu import _native
+    from ceph_tpu.ec import matrices
+
+    rng = np.random.default_rng(5)
+    coding = matrices.isa_cauchy(K, M)
+    cm = coding.astype(np.uint8)
     n = (1 << 20) // K
     xb = rng.integers(0, 256, size=(K, n), dtype=np.uint8)
-    cm = coding.astype(np.uint8)
     base_dt = _bench(lambda: _native.rs_encode(cm, xb), warmup=1, iters=3)
     out["baseline_cpu_native_gbps"] = round((1 << 20) / base_dt / 1e9, 3)
     out["baseline_is_isal"] = False
 
-    # honest VECTORIZED CPU baseline (VERDICT r3 weak #3): the native
-    # AVX2 split-nibble PSHUFB kernel (csrc/gf256_simd.cc) — the same
-    # technique ISA-L's asm uses, measured on THIS bench host (the
-    # isa-l submodule is empty in the reference checkout, so this is
-    # the strongest comparator buildable here).  vs_baseline reports
-    # against the BEST cpu number.
     want = _native.rs_encode(cm, xb[:, :4096])
     assert np.array_equal(_native.rs_encode_simd(cm, xb[:, :4096]), want), \
         "simd encode != oracle"
@@ -144,8 +421,9 @@ def ec_sweep(jax, out):
 def small_stripe_batched(jax, out):
     """4 KiB objects driven through the StripeBatchQueue (the path
     ECBackend actually uses for small writes) under concurrency —
-    SURVEY §7 hard part #2 (reference bench sweep:
-    qa/workunits/erasure-code/bench.sh:103-145)."""
+    SURVEY §7 hard part #2.  On the axon rig this path pays the tunnel
+    (~94 ms RTT per hop), so it is labeled host_path; the
+    device-batched equivalent is measured in the EC section."""
     from ceph_tpu.ec import matrices
     from ceph_tpu.ec.codec import RSMatrixCodec
     from ceph_tpu.tpu.queue import StripeBatchQueue
@@ -153,12 +431,15 @@ def small_stripe_batched(jax, out):
     codec = RSMatrixCodec(K, M, matrices.isa_cauchy(K, M))
     q = StripeBatchQueue()
     rng = np.random.default_rng(1)
-    n_objs = 4096
+    n_objs = 1024 if jax.default_backend() != "cpu" else 4096
     objs = [rng.integers(0, 256, size=(K, 4096 // K), dtype=np.uint8)
             for _ in range(n_objs)]
 
-    # warmup (compiles the power-of-two batch shapes)
-    for f in [q.encode_async(codec, o) for o in objs[:512]]:
+    # warm with a FULL burst so every power-of-two coalesced batch
+    # shape the timed burst can produce is already compiled (the queue
+    # pads widths to powers of two; an in-region XLA compile costs
+    # many tunnel RTTs)
+    for f in [q.encode_async(codec, o) for o in objs]:
         f.result()
 
     t0 = time.perf_counter()
@@ -168,12 +449,14 @@ def small_stripe_batched(jax, out):
     q.stop()
     gbps = n_objs * 4096 / dt / 1e9
     out["small_stripe_4k_batched_gbps"] = round(gbps, 3)
+    out["small_stripe_host_path"] = True
     out["small_stripe_stats"] = {"batches": q.batches, "jobs": q.jobs}
 
 
 def clay_repair(jax, out):
     """Clay repair-decode GB/s (BASELINE metric 3): single-node repair
-    should read ~(d/(d-k+1))/k of the RS repair bytes."""
+    should read ~(d/(d-k+1))/k of the RS repair bytes.  Host-path
+    (python codec objects)."""
     from ceph_tpu.ec.clay import ClayCodec
 
     codec = ClayCodec(k=K, m=M, d=K + M - 1)
@@ -203,7 +486,7 @@ def clay_repair(jax, out):
 
 def baseline_configs(jax, out):
     """The remaining BASELINE.md table rows: #1 jerasure reed_sol_van
-    k=4,m=2 at 4 KiB, #4 lrc k=8,m=4,l=4 local-repair decode."""
+    k=4,m=2 at 4 KiB, #4 lrc k=8,m=4 local-repair decode (host-path)."""
     from ceph_tpu.ec import instance
 
     rng = np.random.default_rng(3)
@@ -211,16 +494,15 @@ def baseline_configs(jax, out):
     jer = instance().factory("jerasure", {"technique": "reed_sol_van",
                                           "k": "4", "m": "2"})
     payload = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
-    chunks = jer.encode(range(6), payload)  # warm + correctness
+    chunks = jer.encode(range(6), payload)
     got = jer.decode_concat({i: chunks[i] for i in (0, 1, 4, 5)})
     assert bytes(got[:4096]) == payload, "jerasure decode mismatch"
     dt = _bench(lambda: jer.encode(range(6), payload), warmup=2, iters=20)
     out["jerasure_k4m2_4k_encode_gbps"] = round(4096 / dt / 1e9, 3)
 
     # BASELINE row 4 asks k=8,m=4,l=4 — which the REFERENCE's own
-    # parse_kml rejects (ErasureCodeLrc.cc parse_kml: k and m must be
-    # multiples of (k+m)/l; 8 % 3 != 0).  l=6 is the closest profile
-    # both implementations accept (2 local groups, one parity each).
+    # parse_kml rejects (k and m must be multiples of (k+m)/l).  l=6 is
+    # the closest profile both implementations accept.
     lrc = instance().factory("lrc", {"k": "8", "m": "4", "l": "6"})
     out["lrc_profile"] = "k=8 m=4 l=6 (l=4 invalid per reference parse_kml)"
     n = lrc.get_chunk_count()
@@ -239,40 +521,91 @@ def baseline_configs(jax, out):
                           np.asarray(lchunks[lost])), "lrc repair mismatch"
     dt = _bench(rep, warmup=1, iters=5)
     chunk_bytes = np.asarray(lchunks[lost]).size
-    # object-equivalent GB/s (same convention as clay_repair_gbps and
-    # BASELINE.md: bytes = chunk * k), so rows compare 1:1
-    out["lrc_local_repair_gbps"] = round(
-        chunk_bytes * 8 / dt / 1e9, 3)
+    out["lrc_local_repair_gbps"] = round(chunk_bytes * 8 / dt / 1e9, 3)
 
 
-def crush_sweep(jax, out):
-    from ceph_tpu import _crush_ref
+# ---------------------------------------------------------------------------
+# CRUSH
+# ---------------------------------------------------------------------------
+
+def _crush_common():
     from ceph_tpu.crush import map as cmap
-    from ceph_tpu.crush import mapper
 
     n_osds, n_hosts, nrep = 1024, 64, 3
     m, root = cmap.build_flat_cluster(n_osds, hosts=n_hosts)
     steps = [(cmap.OP_TAKE, root, 0),
              (cmap.OP_CHOOSELEAF_FIRSTN, nrep, 1),
              (cmap.OP_EMIT, 0, 0)]
-    flat = m.flatten()
     dev_w = np.full(n_osds, 0x10000, dtype=np.uint32)
+    return m, m.flatten(), steps, nrep, dev_w
 
-    # BASELINE metric 6: the FULL 10M-id, 1024-OSD sweep through the
-    # two-stage program (one-shot fast pass + full-retry re-run of the
-    # ~5% unclean lanes — mapper.sweep), chunked so live HBM temps
-    # stay bounded (the round-2 one-shot OOM'd)
-    n_x = CRUSH_IDS
+
+def _crush_ref_pin(out, m, steps, nrep, dev_w, got_head):
+    """Reference C rate + bit-exact conformance on the first 100k ids."""
+    from ceph_tpu import _crush_ref
+    from ceph_tpu.crush import map as cmap
+
+    if not _crush_ref.available():
+        return
+    m.add_rule(cmap.Rule("bench", steps))
+    ref = _crush_ref.RefCrushMap(m)
+    sub = np.arange(100_000, dtype=np.int32)
+    ref_dt = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter()
+        ref_out = ref.do_rule(ref.rulenos[-1], sub, nrep, dev_w)
+        ref_dt = min(ref_dt, time.perf_counter() - t0)
+    out["crush_ref_c_mplacements_per_s"] = round(len(sub) / ref_dt / 1e6, 2)
+    out["crush_vs_ref_c"] = round(
+        out["crush_mplacements_per_s"]
+        / out["crush_ref_c_mplacements_per_s"], 2)
+    assert np.array_equal(got_head, ref_out), "sweep != reference C"
+
+
+def _crush_device(jax, out):
+    """BASELINE metric 6 on-device: ~10M ids through sweep_device — the
+    ENTIRE two-stage sweep is one jit dispatch, placements stay in HBM,
+    only the overflow flag and the 100k-id conformance head are
+    fetched."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush import mapper
+
+    m, flat, steps, nrep, dev_w = _crush_common()
+    n_chunks = 20
+    n_x = n_chunks * CRUSH_CHUNK  # 10,485,760
+    xs = jnp.arange(n_x, dtype=jnp.int32)
+
+    res, overflow = mapper.sweep_device(flat, steps, nrep, xs, dev_w,
+                                        chunk=CRUSH_CHUNK)  # compile+warm
+    assert not bool(overflow), "fixup capacity overflow on healthy map"
+    best = 1e18
+    for _ in range(2):
+        t0 = time.perf_counter()
+        res, overflow = mapper.sweep_device(flat, steps, nrep, xs, dev_w,
+                                            chunk=CRUSH_CHUNK)
+        bool(overflow)  # sync: waits for the whole dispatch
+        best = min(best, time.perf_counter() - t0)
+    out["crush_mplacements_per_s"] = round(n_x / best / 1e6, 2)
+    out["crush_ids"] = n_x
+    out["crush_ids_measured"] = n_x
+    out["crush_device_resident"] = True
+    out["crush_chunk"] = CRUSH_CHUNK
+
+    got_head = np.asarray(res[:100_000])  # one fetch, conformance only
+    _crush_ref_pin(out, m, steps, nrep, dev_w, got_head)
+
+
+def _crush_cpu(jax, out):
+    from ceph_tpu.crush import mapper
+
+    m, flat, steps, nrep, dev_w = _crush_common()
+    n_x = 10_000_000
     xs = np.arange(n_x, dtype=np.int32)
-    # warm both traces at the chunk shape — two different chunks so the
-    # slow pass's pow2(bad-count) shape is cached too (~5% unclean of a
-    # fixed chunk rounds to the same power of two on essentially every
-    # chunk)
     mapper.sweep(flat, steps, nrep, xs[:CRUSH_CHUNK], dev_w,
                  chunk=CRUSH_CHUNK)
     mapper.sweep(flat, steps, nrep, xs[CRUSH_CHUNK:2 * CRUSH_CHUNK],
                  dev_w, chunk=CRUSH_CHUNK)
-    # time-budgeted: measure one chunk, run as many as fit, extrapolate
     t0 = time.perf_counter()
     mapper.sweep(flat, steps, nrep, xs[:CRUSH_CHUNK], dev_w,
                  chunk=CRUSH_CHUNK)
@@ -291,34 +624,23 @@ def crush_sweep(jax, out):
     out["crush_ids_measured"] = measured
     out["crush_extrapolated"] = measured < n_x
     out["crush_chunk"] = CRUSH_CHUNK
+    _crush_ref_pin(out, m, steps, nrep, dev_w, res[:100_000])
 
-    # reference C rate (the scalar crush_do_rule loop, single-core —
-    # the same work ParallelPGMapper shards over threads)
-    if _crush_ref.available():
-        m.add_rule(cmap.Rule("bench", steps))
-        ref = _crush_ref.RefCrushMap(m)
-        sub = np.arange(100_000, dtype=np.int32)
-        ref_dt = 1e9
-        for _ in range(2):
-            t0 = time.perf_counter()
-            ref_out = ref.do_rule(ref.rulenos[-1], sub, nrep, dev_w)
-            ref_dt = min(ref_dt, time.perf_counter() - t0)
-        out["crush_ref_c_mplacements_per_s"] = round(
-            len(sub) / ref_dt / 1e6, 2)
-        out["crush_vs_ref_c"] = round(
-            out["crush_mplacements_per_s"]
-            / out["crush_ref_c_mplacements_per_s"], 2)
-        # conformance: the sweep must be bit-exact vs the reference C
-        assert np.array_equal(res[:100_000], ref_out), \
-            "sweep != reference C"
+
+def crush_section(jax, out):
+    if jax.default_backend() == "cpu":
+        _crush_cpu(jax, out)
+    else:
+        _crush_device(jax, out)
 
 
 SECTIONS = [
-    ("ec", ec_sweep),
+    ("envelope", envelope),
+    ("ec", ec_section),
     ("small_stripe", small_stripe_batched),
     ("clay", clay_repair),
     ("baseline_configs", baseline_configs),
-    ("crush", crush_sweep),
+    ("crush", crush_section),
 ]
 
 
@@ -349,25 +671,28 @@ def _probe_accelerator(timeout_s: float = 240.0) -> bool:
 def main():
     import os
 
-    if (os.environ.get("CEPH_TPU_BENCH_FALLBACK") != "1"
-            and os.environ.get("JAX_PLATFORMS", "") != "cpu"
-            # an explicit CPU run is honored as-is (no probe, no
-            # re-exec, user env untouched); only accelerator-targeted
-            # runs pay the probe (one extra backend bring-up) because a
-            # wedged tunnel would otherwise hang the round's artifact
-            and not _probe_accelerator()):
-        # the axon sitecustomize imports jax at interpreter START, so
-        # env mutation in-process is too late — re-exec scrubbed (the
-        # same discipline as conftest.py / dryrun_multichip)
-        print("bench: accelerator probe failed/timed out -> re-exec "
-              "on CPU", file=sys.stderr, flush=True)
-        env = {k: v for k, v in os.environ.items()
-               if not (k.startswith(("JAX_", "TPU_", "LIBTPU", "XLA_",
-                                     "PJRT_", "PALLAS_")))}
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
-        env["CEPH_TPU_BENCH_FALLBACK"] = "1"
-        os.execve(sys.executable, [sys.executable, __file__], env)
+    if os.environ.get("CEPH_TPU_BENCH_FALLBACK") not in ("1", "explicit"):
+        # an explicit JAX_PLATFORMS=cpu run skips the probe but still
+        # re-execs scrubbed below: the axon sitecustomize touches the
+        # tunnel at interpreter start even under JAX_PLATFORMS=cpu,
+        # and a wedged tunnel hangs the import (observed this round)
+        explicit_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        if explicit_cpu or not _probe_accelerator():
+            # the axon sitecustomize imports jax at interpreter START,
+            # so env mutation in-process is too late — re-exec scrubbed
+            # (the same discipline as conftest.py / dryrun_multichip)
+            print("bench: explicit CPU run -> re-exec scrubbed"
+                  if explicit_cpu else
+                  "bench: accelerator probe failed/timed out -> re-exec "
+                  "on CPU", file=sys.stderr, flush=True)
+            env = {k: v for k, v in os.environ.items()
+                   if not (k.startswith(("JAX_", "TPU_", "LIBTPU", "XLA_",
+                                         "PJRT_", "PALLAS_")))}
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+            env["CEPH_TPU_BENCH_FALLBACK"] = \
+                "explicit" if explicit_cpu else "1"
+            os.execve(sys.executable, [sys.executable, __file__], env)
 
     print("bench: importing jax...", file=sys.stderr, flush=True)
     import jax
@@ -375,19 +700,21 @@ def main():
     print(f"bench: backend={jax.default_backend()} "
           f"devices={jax.devices()}", file=sys.stderr, flush=True)
     out = {"backend": jax.default_backend(), "errors": {}}
-    if os.environ.get("CEPH_TPU_BENCH_FALLBACK") == "1":
-        # make the artifact self-explanatory: these are CPU numbers
-        # because the attached accelerator never answered the probe
+    fb = os.environ.get("CEPH_TPU_BENCH_FALLBACK")
+    if fb == "1":
         out["accelerator_fallback"] = (
             "attached accelerator unreachable (probe timeout); "
             "numbers are CPU")
+    elif fb == "explicit":
+        out["accelerator_fallback"] = (
+            "explicit JAX_PLATFORMS=cpu run; numbers are CPU")
     partial_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "BENCH_PARTIAL.json")
 
     def _flush_partial():
-        # wedge-proofing (VERDICT r3 #1): the artifact-so-far hits disk
-        # after EVERY section, so a tunnel wedge mid-run keeps every
-        # completed section's numbers instead of erasing the round
+        # wedge-proofing: the artifact-so-far hits disk after EVERY
+        # section, so a tunnel wedge mid-run keeps every completed
+        # section's numbers instead of erasing the round
         try:
             with open(partial_path, "w") as f:
                 f.write(json.dumps(out) + "\n")
@@ -397,7 +724,6 @@ def main():
     # watchdog: a tunnel that wedges MID-SECTION hangs that dispatch
     # forever — after section_timeout with no progress, emit the
     # one-line JSON with everything recorded so far and hard-exit.
-    # A partial artifact always beats a hung driver (round-3 failure).
     import threading
 
     section_timeout = float(os.environ.get("CEPH_TPU_SECTION_TIMEOUT",
@@ -420,8 +746,6 @@ def main():
     threading.Thread(target=_watchdog, daemon=True).start()
 
     for name, fn in SECTIONS:
-        # progress to stderr: if the tunnel wedges mid-run, the log
-        # shows WHICH section hung (round-3 outage forensics)
         t0 = time.perf_counter()
         progress.update(t=time.monotonic(), name=name)
         print(f"bench: section {name} start", file=sys.stderr, flush=True)
@@ -450,8 +774,6 @@ def _emit(out) -> float:
     enc = out.get("encode_gbps")
     dec = out.get("decode_gbps")
     # vs_baseline is judged against the BEST cpu number we recorded
-    # (vectorized numpy beats the scalar oracle ~10x; using the scalar
-    # number would overstate progress — VERDICT r3 weak #3)
     base = max(out.get("baseline_cpu_native_gbps") or 0,
                out.get("baseline_cpu_vectorized_gbps") or 0) or None
     if enc and dec:
@@ -464,7 +786,6 @@ def _emit(out) -> float:
                    "sweep"),
         "value": value,
         "unit": "GB/s",
-        # no silent fake ratio: 0 when the baseline didn't record
         "vs_baseline": round(value / base, 2) if (value and base) else 0,
     })
     if not out.get("errors"):

@@ -1,0 +1,52 @@
+"""Shared in-jit loop measurement harness for engine benchmarks.
+
+The axon tunnel (~94 ms RTT; block_until_ready not a true sync) makes
+per-dispatch timing meaningless, so every EC engine benchmark measures
+the same way: iterations loop INSIDE one jit, each iteration XORs an
+anti-hoisting seed into the input (so XLA cannot hoist the encode as
+loop-invariant), outputs fold into an xor accumulator, and only a u32
+digest is fetched.  bench.py, tools/tpu_minibench.py and
+tools/tpu_tune.py all use THIS helper — the measurement protocol lives
+in one place (review finding: four hand copies drift).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def seeded_loop_runner(enc, out_shape, iters: int):
+    """jit'd runner: enc(words, seed_u32[1]) -> u32[out_shape] folded
+    over `iters` seeded iterations; returns a scalar digest."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def run(w3):
+        def body(i, acc):
+            s = jnp.full((1,), i, jnp.uint32)
+            return acc ^ enc(w3, s)
+        o = lax.fori_loop(0, iters, body, jnp.zeros(out_shape, jnp.uint32))
+        return jnp.sum(o & 0xFF)
+
+    return run
+
+
+def timed_best(run, w3, reps: int = 2) -> float:
+    """Compile+warm once (digest fetch = the only true sync on this
+    rig), then best-of-`reps` wall seconds."""
+    int(run(w3))
+    best = 1e18
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        int(run(w3))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def loop_rate_gbps(enc, w3, out_shape, iters: int, object_bytes: int,
+                   reps: int = 2) -> float:
+    """GB/s of `enc` over `iters` in-jit iterations on batch `w3`."""
+    dt = timed_best(seeded_loop_runner(enc, out_shape, iters), w3, reps)
+    return iters * object_bytes / dt / 1e9

@@ -31,6 +31,7 @@ isa vandermonde/cauchy, lrc, shec, clay).  Bit-matrix techniques
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable, Dict, Tuple
 
 import jax
@@ -152,6 +153,31 @@ def gf_matmul_bytes(matrix: np.ndarray, x, donate: bool = False):
         return out[:, :n] if pad else out
     x = jnp.asarray(x, dtype=jnp.uint8)
     k, n = x.shape
+    if ((jax.default_backend() == "tpu"
+         or os.environ.get("CEPH_TPU_FORCE_PALLAS") == "1")
+            and n % 512 == 0
+            and os.environ.get("CEPH_TPU_NO_PALLAS") != "1"):
+        # TPU fast path: the Pallas VMEM-tiled kernel (the XLA graph
+        # lowering materializes the network's intermediates to HBM —
+        # measured ~2-3x slower on hardware).  Same bytes, pinned
+        # equal by tests/test_gf256_pallas.py (incl. this wrapper's
+        # bitcast round-trip).  donate passes through: the kernel
+        # aliases the input buffer when shapes allow (square decode).
+        from ceph_tpu.ops import gf256_pallas
+
+        R = matrix.shape[0]
+        words3 = jax.lax.bitcast_convert_type(
+            x.reshape(k, n // 4, 4), jnp.uint32
+        ).reshape(k, -1, gf256_pallas.LANES)
+        T = words3.shape[1]
+        tile = max(t for t in (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
+                   if T % t == 0)
+        # interpret=None: real lowering on TPU, interpreter elsewhere
+        # (lets tests exercise THIS wrapper via CEPH_TPU_FORCE_PALLAS)
+        out3 = gf256_pallas.encode_planes(matrix, words3, tile=tile,
+                                          interpret=None, donate=donate)
+        # u32 (R, T, 128) -> u8 (R, T, 128, 4) -> (R, n)
+        return jax.lax.bitcast_convert_type(out3, jnp.uint8).reshape(R, n)
     pad = (-n) % 4
     if pad:
         x = jnp.pad(x, ((0, 0), (0, pad)))

@@ -1,0 +1,112 @@
+"""Pallas GF(2^8) engine pinned against the SWAR network + native oracle.
+
+Runs in Pallas interpret mode on the CPU backend (the kernel body is the
+same python; only the TPU lowering differs), mirroring how the reference
+pins its SIMD encode regions against the scalar gf-complete path
+(src/test/erasure-code/TestErasureCodeIsa.cc)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu import _native
+from ceph_tpu.ec import matrices
+from ceph_tpu.ops import gf256_pallas, gf256_swar
+
+
+@pytest.mark.parametrize("k,m", [(8, 4), (4, 2), (3, 3)])
+def test_pallas_matches_network_and_oracle(k, m):
+    coding = matrices.isa_cauchy(k, m)
+    rng = np.random.default_rng(7)
+    n = 4 * gf256_pallas.LANES * 8  # T = 8 sublane rows
+    x = rng.integers(0, 256, size=(k, n), dtype=np.uint8)
+
+    words = gf256_pallas.pack_planes(x)
+    out = gf256_pallas.encode_planes(coding, words, tile=4)
+    got = gf256_pallas.unpack_planes(out)
+
+    want = np.asarray(gf256_swar.gf_matmul_bytes(coding, x))
+    assert np.array_equal(got, want)
+
+    oracle = _native.rs_encode(coding.astype(np.uint8), x)
+    assert np.array_equal(got, oracle)
+
+
+def test_pallas_seed_xor_is_encode_of_xored_input():
+    """The bench's anti-hoisting seed must equal encoding (x ^ seed)."""
+    coding = matrices.isa_cauchy(4, 2)
+    rng = np.random.default_rng(8)
+    x = rng.integers(0, 256, size=(4, 4 * gf256_pallas.LANES * 4),
+                     dtype=np.uint8)
+    words = gf256_pallas.pack_planes(x)
+    import jax.numpy as jnp
+    seed = jnp.full((1,), 0xA5A5A5A5, jnp.uint32)
+    out = gf256_pallas.encode_planes(coding, words, seed, tile=4)
+
+    x2 = (gf256_pallas.pack_planes(x) ^ np.uint32(0xA5A5A5A5))
+    want = gf256_pallas.encode_planes(coding, x2, tile=4)
+    assert np.array_equal(np.asarray(out), np.asarray(want))
+
+
+def test_pallas_recovery_matrix_decode():
+    """Decode via recovery matrix through the same kernel."""
+    from ceph_tpu.ec.codec import RSMatrixCodec
+
+    k, m = 8, 4
+    coding = matrices.isa_cauchy(k, m)
+    codec = RSMatrixCodec(k, m, coding)
+    rng = np.random.default_rng(9)
+    n = 4 * gf256_pallas.LANES * 8
+    x = rng.integers(0, 256, size=(k, n), dtype=np.uint8)
+    words = gf256_pallas.pack_planes(x)
+    coded = gf256_pallas.unpack_planes(
+        gf256_pallas.encode_planes(coding, words, tile=4))
+
+    survivors = [0, 2, 3, 5, 6, 7, 8, 11]  # lose 1, 4 + coding 9, 10
+    rec, _ = codec.recovery_matrix(survivors)
+    surv = np.stack([x[s] if s < k else coded[s - k] for s in survivors])
+    out = gf256_pallas.encode_planes(
+        rec, gf256_pallas.pack_planes(surv), tile=4)
+    assert np.array_equal(gf256_pallas.unpack_planes(out), x)
+
+
+def test_pallas_interleaved_matches_planar():
+    coding = matrices.isa_cauchy(8, 4)
+    rng = np.random.default_rng(11)
+    x = rng.integers(0, 256, size=(8, 4 * gf256_pallas.LANES * 8),
+                     dtype=np.uint8)
+    words = gf256_pallas.pack_planes(x)
+    want = np.asarray(gf256_pallas.encode_planes(coding, words, tile=4))
+    got = np.asarray(gf256_pallas.encode_planes_interleaved(
+        coding, np.transpose(words, (1, 0, 2)), tile=4))
+    assert np.array_equal(np.transpose(got, (1, 0, 2)), want)
+
+
+def test_product_routing_wrapper_roundtrip(monkeypatch):
+    """The gf_matmul_bytes TPU routing branch (bitcast u8->u32 planes,
+    pallas encode, bitcast back) — forced on via env so the CPU suite
+    exercises the exact wrapper a real TPU runs (a reshape bug here
+    shipped blind once; never again)."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops import gf256_swar
+
+    monkeypatch.setenv("CEPH_TPU_FORCE_PALLAS", "1")
+    coding = matrices.isa_cauchy(8, 4)
+    rng = np.random.default_rng(12)
+    for n in (512, 4096):
+        x = rng.integers(0, 256, size=(8, n), dtype=np.uint8)
+        got = np.asarray(gf256_swar.gf_matmul_bytes(coding, jnp.asarray(x)))
+        want = _native.rs_encode(coding.astype(np.uint8), x)
+        assert np.array_equal(got, want), n
+    # square decode with donate=True (the queue path) aliases buffers
+    from ceph_tpu.ec.codec import RSMatrixCodec
+
+    codec = RSMatrixCodec(8, 4, coding)
+    survivors = [0, 1, 2, 3, 4, 5, 8, 9]
+    rec, _ = codec.recovery_matrix(survivors)
+    x = rng.integers(0, 256, size=(8, 512), dtype=np.uint8)
+    coded = _native.rs_encode(coding.astype(np.uint8), x)
+    surv = np.stack([x[s] if s < 8 else coded[s - 8] for s in survivors])
+    got = np.asarray(gf256_swar.gf_matmul_bytes(
+        rec, jnp.asarray(surv), donate=True))
+    assert np.array_equal(got, x)

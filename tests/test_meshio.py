@@ -127,3 +127,25 @@ def test_decode_batching_rides_mesh(mesh, codec):
         assert np.array_equal(np.asarray(f.result()), x)
     q.stop()
     assert q.mesh_batches >= 1
+
+
+def test_device_resident_chain_no_host_hop(mesh, codec):
+    """encode_scatter(keep_device=True) -> recovery_gather(jax input):
+    the pipeline chains on device; only the final fetch leaves."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    x = rng.integers(0, 256, size=(K, 4096), dtype=np.uint8)
+    xd = jnp.asarray(x)
+    cm = np.asarray(codec.coding, np.uint8)
+    coding_dev = mesh.encode_scatter(cm, xd, keep_device=True)
+    assert not isinstance(coding_dev, np.ndarray)
+
+    survivors = [0, 1, 2, 3, 4, 5, 8, 9]
+    rec, _ = codec.recovery_matrix(survivors)
+    # survivors 8,9 are coding rows 0,1
+    surv_dev = jnp.concatenate([xd[:6], coding_dev[:2]], axis=0)
+    rebuilt = mesh.recovery_gather(np.asarray(rec, np.uint8), surv_dev,
+                                   keep_device=True)
+    assert not isinstance(rebuilt, np.ndarray)
+    assert np.array_equal(np.asarray(rebuilt), x)

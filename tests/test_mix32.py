@@ -1,0 +1,14 @@
+"""mix32 twins must be bit-identical (the oracle-pin invariant)."""
+import numpy as np
+
+from ceph_tpu.ops import mix32
+
+
+def test_mix_twins_identical():
+    i = np.arange(1 << 16, dtype=np.uint32)
+    a = mix32.mix_np(i)
+    import jax.numpy as jnp
+    b = np.asarray(mix32.mix_jnp(jnp.asarray(i)))
+    assert np.array_equal(a, b)
+    # and actually mixes (not identity, not constant)
+    assert len(np.unique(a[:1000])) == 1000

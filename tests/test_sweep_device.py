@@ -1,0 +1,59 @@
+"""sweep_device (all-on-device two-stage sweep) vs the host sweep().
+
+Bit-exactness matters: sweep() itself is pinned against the reference C
+crush_do_rule (tests/test_crush_vs_reference.py), so equality here
+transitively pins the device-resident path too."""
+
+import numpy as np
+
+from ceph_tpu.crush import map as cmap
+from ceph_tpu.crush import mapper
+
+
+def _cluster(n_osds=64, hosts=8, nrep=3):
+    m, root = cmap.build_flat_cluster(n_osds, hosts=hosts)
+    steps = [(cmap.OP_TAKE, root, 0),
+             (cmap.OP_CHOOSELEAF_FIRSTN, nrep, 1),
+             (cmap.OP_EMIT, 0, 0)]
+    return m.flatten(), steps, nrep
+
+
+def test_sweep_device_matches_host_sweep():
+    flat, steps, nrep = _cluster()
+    dev_w = np.full(64, 0x10000, dtype=np.uint32)
+    # knock a few devices out/down-weight to force unclean lanes
+    dev_w[5] = 0
+    dev_w[17] = 0x4000
+    dev_w[40] = 0
+    xs = np.arange(4096, dtype=np.int32)
+    want = mapper.sweep(flat, steps, nrep, xs, dev_w, chunk=1024)
+    # small clusters collide on first try far more than the big bench
+    # map (~1/3 of lanes with 8 hosts vs ~5% with 64) -> 50% capacity
+    got, overflow = mapper.sweep_device(flat, steps, nrep, xs, dev_w,
+                                        chunk=1024, bad_div=2)
+    assert not bool(overflow)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_sweep_device_overflow_flag():
+    """With a tiny fixup capacity and most devices out, the unclean
+    count exceeds capacity and the flag must raise."""
+    flat, steps, nrep = _cluster()
+    dev_w = np.zeros(64, dtype=np.uint32)
+    dev_w[:4] = 0x10000  # nearly everything rejected -> heavy retries
+    xs = np.arange(1024, dtype=np.int32)
+    got, overflow = mapper.sweep_device(flat, steps, nrep, xs, dev_w,
+                                        chunk=1024, bad_div=256)
+    assert bool(overflow)
+
+
+def test_sweep_device_single_chunk_whole_batch():
+    flat, steps, nrep = _cluster(n_osds=32, hosts=4)
+    dev_w = np.full(32, 0x10000, dtype=np.uint32)
+    xs = np.arange(2048, dtype=np.int32)
+    want = mapper.sweep(flat, steps, nrep, xs, dev_w)
+    # 4 hosts / 3 reps: the majority of lanes retry -> full capacity
+    got, overflow = mapper.sweep_device(flat, steps, nrep, xs, dev_w,
+                                        bad_div=1)
+    assert not bool(overflow)
+    np.testing.assert_array_equal(np.asarray(got), want)

@@ -1,13 +1,17 @@
 """Minimal TPU bench: the two north-star engines, nothing else.
 
-Designed to finish in well under a minute of chip time so that even a
-brief tunnel-alive window yields a hardware number (the round-3 failure
-mode was a wedge window erasing the whole round's perf story).  Runs:
+Designed to finish in a few minutes of chip time so that even a brief
+tunnel-alive window yields a hardware number.  Measurement model per
+the round-4 envelope finding (tunnel RTT ~94 ms, h2d ~5 MB/s): data is
+generated ON DEVICE, iterations loop INSIDE one jit, and only digests
+are fetched — per-dispatch timing would measure the tunnel, not the
+chip.  Runs:
 
-- SWAR GF(2^8) RS k=8,m=4 encode+decode at 1 MiB (BASELINE metric 2,
-  reference harness src/test/erasure-code/ceph_erasure_code_benchmark.cc)
-- u32-limb vmapped straw2 CRUSH sweep, 1M ids over a 1024-OSD map
-  (BASELINE metric 6, reference src/crush/mapper.c:900)
+- SWAR GF(2^8) RS k=8,m=4 encode, XLA graph vs Pallas kernel, 16 MiB
+  (BASELINE metric 2; reference harness
+  src/test/erasure-code/ceph_erasure_code_benchmark.cc:181-186)
+- u32-limb vmapped straw2 CRUSH sweep_device, ~1M ids over a 1024-OSD
+  map (BASELINE metric 6, reference src/crush/mapper.c:900)
 
 Prints ONE JSON line; also writes it to the path in argv[1] if given.
 """
@@ -18,52 +22,66 @@ import time
 
 import numpy as np
 
-
-def bench(fn, warmup=2, iters=10):
-    out = None
-    for _ in range(warmup):
-        out = fn()
-    if hasattr(out, "block_until_ready"):
-        out.block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn()
-    if hasattr(out, "block_until_ready"):
-        out.block_until_ready()
-    return (time.perf_counter() - t0) / iters
+K, M = 8, 4
+LANES = 128
 
 
 def main():
     import jax
+    import jax.numpy as jnp
+    from jax import lax
 
     out = {"backend": jax.default_backend(),
            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
 
     from ceph_tpu import _native
     from ceph_tpu.ec import matrices
-    from ceph_tpu.ec.codec import RSMatrixCodec
-    from ceph_tpu.ops import gf256_swar
+    from ceph_tpu.ops import gf256_pallas
+    from ceph_tpu.ops.gf256_swar import _build_network
 
-    K, M = 8, 4
     coding = matrices.isa_cauchy(K, M)
-    codec = RSMatrixCodec(K, M, coding)
-    rng = np.random.default_rng(0)
-    size = 1 << 20
-    x = rng.integers(0, 256, size=(K, size // K), dtype=np.uint8)
-    xd = jax.device_put(x)
-    enc = lambda: gf256_swar.gf_matmul_bytes(coding, xd)  # noqa: E731
-    coded = np.asarray(enc())
-    want = _native.rs_encode(coding.astype(np.uint8), x[:, :4096])
-    assert np.array_equal(coded[:, :4096], want), "encode != oracle"
-    out["encode_1mib_gbps"] = round(size / bench(enc) / 1e9, 3)
+    net = _build_network(coding)
 
-    survivors = [0, 1, 2, 3, 4, 5, 8, 9]
-    rec, _ = codec.recovery_matrix(survivors)
-    surv = np.stack([x[s] if s < K else coded[s - K] for s in survivors])
-    sd = jax.device_put(surv)
-    dec = lambda: gf256_swar.gf_matmul_bytes(rec, sd)  # noqa: E731
-    assert np.array_equal(np.asarray(dec()), x), "decode != data"
-    out["decode_1mib_gbps"] = round(size / bench(dec) / 1e9, 3)
+    from ceph_tpu.ops.mix32 import mix_jnp as mix
+    from ceph_tpu.ops.mix32 import mix_np
+
+    T = 4096  # 16 MiB object at k=8
+    size = T * LANES * 4 * K
+
+    @jax.jit
+    def gen():
+        i = lax.iota(jnp.uint32, K * T * LANES).reshape(K, T, LANES)
+        return mix(i)
+
+    w3 = gen()
+
+    # correctness pin on the head of the batch (small fetch)
+    got3 = np.asarray(gf256_pallas.encode_planes(
+        coding, w3[:, :8, :], tile=8, interpret=None))
+    i_host = np.arange(K * T * LANES, dtype=np.uint32).reshape(K, T, LANES)
+    x_host = mix_np(i_host)[:, :8, :]
+    xb = np.ascontiguousarray(x_host).view(np.uint8).reshape(K, -1)
+    want = _native.rs_encode(coding.astype(np.uint8), xb)
+    assert np.array_equal(gf256_pallas.unpack_planes(got3), want), \
+        "encode != oracle"
+
+    from ceph_tpu.ops.benchloop import loop_rate_gbps
+
+    def engine_rate(enc, iters=30):
+        return round(loop_rate_gbps(enc, w3, (M, T, LANES), iters, size), 2)
+
+    out["encode_16mib_xla_gbps"] = engine_rate(
+        lambda w, s: net((w ^ s[0]).reshape(K, -1)).reshape(M, T, LANES))
+    out["encode_16mib_pallas_gbps"] = engine_rate(
+        lambda w, s: gf256_pallas.encode_planes(coding, w, s, tile=512,
+                                                interpret=False))
+
+    # interleaved layout (contiguous per-step DMA)
+    w3i = jnp.transpose(w3, (1, 0, 2))
+    out["encode_16mib_pallas_inter_gbps"] = round(loop_rate_gbps(
+        lambda w, s: gf256_pallas.encode_planes_interleaved(
+            coding, w, s, tile=512, interpret=False),
+        w3i, (T, M, LANES), 30, size), 2)
 
     from ceph_tpu.crush import map as cmap
     from ceph_tpu.crush import mapper
@@ -75,12 +93,19 @@ def main():
              (cmap.OP_EMIT, 0, 0)]
     flat = m.flatten()
     w = np.full(n_osds, 0x10000, dtype=np.uint32)
-    n_x = 1_000_000
-    xs = np.arange(n_x, dtype=np.int32)
-    mapper.sweep(flat, steps, nrep, xs, w)  # warm both traces
-    dt = bench(lambda: mapper.sweep(flat, steps, nrep, xs, w),
-               warmup=0, iters=2)
-    out["crush_1m_mplacements_per_s"] = round(n_x / dt / 1e6, 2)
+    chunk = 1 << 18
+    n_x = 4 * chunk  # ~1M ids
+    xs = jnp.arange(n_x, dtype=jnp.int32)
+    res, ovf = mapper.sweep_device(flat, steps, nrep, xs, w, chunk=chunk)
+    assert not bool(ovf)
+    best = 1e18
+    for _ in range(2):
+        t0 = time.perf_counter()
+        res, ovf = mapper.sweep_device(flat, steps, nrep, xs, w,
+                                       chunk=chunk)
+        bool(ovf)
+        best = min(best, time.perf_counter() - t0)
+    out["crush_1m_mplacements_per_s"] = round(n_x / best / 1e6, 2)
 
     line = json.dumps(out)
     print(line)

@@ -1,0 +1,105 @@
+"""EC-kernel variant sweep for a live TPU window.
+
+When the axon tunnel answers, one run of this script measures EVERY
+engine variant (XLA SWAR graph; Pallas planar/interleaved layouts x
+tile sizes x imul/shift doubling) at 16 and 64 MiB with the in-jit loop
+measurement model, so a single alive window yields the full tuning
+surface instead of one number.  Results append to TUNE_TPU.jsonl (one
+JSON line per run) — the bench's static autotune list can then be
+pruned to the winners.
+
+Usage: PYTHONPATH=/root/.axon_site:/root/repo python tools/tpu_tune.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+K, M = 8, 4
+LANES = 128
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ceph_tpu.ec import matrices
+    from ceph_tpu.ops import gf256_pallas
+    from ceph_tpu.ops.gf256_swar import _build_network
+    from ceph_tpu.ops.mix32 import mix_jnp
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"error": "not on tpu",
+                          "backend": jax.default_backend()}))
+        return 1
+
+    coding = matrices.isa_cauchy(K, M)
+    net = _build_network(coding)
+    out = {"backend": "tpu",
+           "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "results": {}}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "TUNE_TPU.jsonl")
+    partial = os.path.join(repo, "TUNE_TPU_PARTIAL.json")
+
+    def flush():
+        # overwrite the partial (wedge-proof progress); the jsonl gets
+        # exactly ONE line per run, appended at the end
+        with open(partial, "w") as f:
+            f.write(json.dumps(out) + "\n")
+
+    def gen(T, interleaved):
+        shape = (T, K, LANES) if interleaved else (K, T, LANES)
+
+        @jax.jit
+        def g():
+            return mix_jnp(
+                lax.iota(jnp.uint32, K * T * LANES).reshape(shape))
+        return g()
+
+    from ceph_tpu.ops.benchloop import loop_rate_gbps
+
+    def rate(enc, T, interleaved, iters):
+        w3 = gen(T, interleaved)
+        oshape = (T, M, LANES) if interleaved else (M, T, LANES)
+        return round(loop_rate_gbps(enc, w3, oshape, iters,
+                                    T * LANES * 4 * K), 2)
+
+    variants = {"xla": (
+        lambda w, s: net((w ^ s[0]).reshape(K, -1)).reshape(M, -1, LANES),
+        False)}
+    for tile in (128, 256, 512, 1024):
+        for ms in (False, True):
+            tag = f"t{tile}" + ("_shift" if ms else "")
+            variants[f"planar_{tag}"] = (
+                (lambda t, m: lambda w, s: gf256_pallas.encode_planes(
+                    coding, w, s, tile=t, interpret=False, mul_shift=m)
+                 )(tile, ms), False)
+            variants[f"inter_{tag}"] = (
+                (lambda t, m: lambda w, s:
+                 gf256_pallas.encode_planes_interleaved(
+                     coding, w, s, tile=t, interpret=False, mul_shift=m)
+                 )(tile, ms), True)
+
+    for T, iters in ((4096, 30), (16384, 10)):
+        size_mib = T * LANES * 4 * K >> 20
+        for name, (enc, inter) in variants.items():
+            key = f"{name}_{size_mib}mib"
+            try:
+                out["results"][key] = rate(enc, T, inter, iters)
+            except Exception as e:
+                out["results"][key] = f"error: {e!r}"[:100]
+            print(f"{key}: {out['results'][key]}", flush=True)
+            flush()
+    with open(path, "a") as f:
+        f.write(json.dumps(out) + "\n")
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
