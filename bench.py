@@ -154,37 +154,26 @@ def envelope(jax, out):
 
 def _ec_device(jax, out):
     import jax.numpy as jnp
-    from jax import lax
 
     from ceph_tpu import _native
     from ceph_tpu.ec import matrices
     from ceph_tpu.ec.codec import RSMatrixCodec
     from ceph_tpu.ops import gf256_pallas
+    from ceph_tpu.ops.benchloop import gen_planes, xla_swar_engine
     from ceph_tpu.ops.gf256_swar import _build_network
 
-    from ceph_tpu.ops.mix32 import mix_jnp, mix_np
+    from ceph_tpu.ops.mix32 import mix_np
 
     coding = matrices.isa_cauchy(K, M)
     codec = RSMatrixCodec(K, M, coding)
     net = _build_network(coding)
 
     def gen(T, k=K, interleaved=False):
-        shape = (T, k, LANES) if interleaved else (k, T, LANES)
-
-        @jax.jit
-        def g():
-            i = lax.iota(jnp.uint32, k * T * LANES).reshape(shape)
-            return mix_jnp(i)
-        return g()
+        return gen_planes(k, T, interleaved)
 
     def xla_engine(matrix):
         n2 = _build_network(matrix) if matrix is not coding else net
-        R = matrix.shape[0]
-
-        def enc(w3, seed):
-            k, T, L = w3.shape
-            return n2((w3 ^ seed[0]).reshape(k, -1)).reshape(R, T, L)
-        return enc
+        return xla_swar_engine(n2, matrix.shape[0])
 
     def pallas_engine(matrix, tile):
         def enc(w3, seed):
@@ -209,28 +198,46 @@ def _ec_device(jax, out):
     x_host = mix_np(i_host).view(np.uint8).reshape(K, -1)
     want = _native.rs_encode(coding.astype(np.uint8), x_host)
     zseed = jnp.zeros((1,), jnp.uint32)
-    for name, enc in (("xla", xla_engine(coding)),
-                      ("pallas", pallas_engine(coding, 256))):
-        got3 = jax.jit(enc)(w_pin, zseed)
-        got = gf256_pallas.unpack_planes(np.asarray(got3))
-        assert np.array_equal(got, want), f"{name} encode != oracle"
-    # interleaved layout: same bytes, (T, k, 128) order
+    # per-family pin, individually guarded: a family whose kernel the
+    # rig's compiler rejects (round-4: the interleaved layout crashes
+    # the remote compile helper on one libtpu build) is EXCLUDED from
+    # the autotune instead of aborting the section
+    pins = {}
     w_pin_i = jnp.transpose(w_pin, (1, 0, 2))
-    got3 = jax.jit(pallas_inter_engine(coding, 256))(w_pin_i, zseed)
-    got = gf256_pallas.unpack_planes(
-        np.transpose(np.asarray(got3), (1, 0, 2)))
-    assert np.array_equal(got, want), "pallas_interleaved != oracle"
-    out["ec_device_pinned"] = True
+
+    def _pin(name, enc, inter):
+        try:
+            got3 = np.asarray(jax.jit(enc)(w_pin_i if inter else w_pin,
+                                           zseed))
+            if inter:
+                got3 = np.transpose(got3, (1, 0, 2))
+            got = gf256_pallas.unpack_planes(got3)
+            assert np.array_equal(got, want), f"{name} encode != oracle"
+            pins[name] = True
+        except Exception as e:
+            pins[name] = f"error: {e!r}"[:160]
+
+    _pin("xla", xla_engine(coding), False)
+    _pin("pallas", pallas_engine(coding, 256), False)
+    _pin("pallas_inter", pallas_inter_engine(coding, 256), True)
+    out["ec_device_pinned"] = pins
+    if pins["xla"] is not True and pins["pallas"] is not True:
+        raise RuntimeError(f"no EC engine family passed its pin: {pins}")
 
     # ---- autotune at 16 MiB ----
     # candidate -> (engine factory(matrix, tile), interleaved?)
     T_tune = 4096
     iters_tune = 20
     size_tune = T_tune * LANES * 4 * K
-    cands = {"xla_swar": (xla_engine, None, False)}
+    cands = {}
+    if pins["xla"] is True:
+        cands["xla_swar"] = (xla_engine, None, False)
     for tile in (256, 512, 1024):
-        cands[f"pallas_t{tile}"] = (pallas_engine, tile, False)
-        cands[f"pallas_inter_t{tile}"] = (pallas_inter_engine, tile, True)
+        if pins["pallas"] is True:
+            cands[f"pallas_t{tile}"] = (pallas_engine, tile, False)
+        if pins["pallas_inter"] is True:
+            cands[f"pallas_inter_t{tile}"] = (pallas_inter_engine, tile,
+                                              True)
     w_tune_p = gen(T_tune)
     w_tune_i = gen(T_tune, interleaved=True)
     tune = {}
@@ -634,13 +641,72 @@ def crush_section(jax, out):
         _crush_device(jax, out)
 
 
+def aux_section(jax, out):
+    """Clay + jerasure/lrc BASELINE rows: host-path python-codec
+    measurements.  On the axon rig an in-process run would time the
+    tunnel (~80-94 ms RTT per dispatch), not the codec, so on the TPU
+    backend they run in a scrubbed CPU subprocess and merge in,
+    labeled; on the CPU fallback they run in-process (the host path IS
+    the product path there)."""
+    import os
+    import subprocess
+    import tempfile
+
+    if jax.default_backend() == "cpu":
+        clay_repair(jax, out)
+        baseline_configs(jax, out)
+        return
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    fd, path = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "TPU_", "LIBTPU", "XLA_",
+                                "PJRT_", "PALLAS_"))}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": here,
+        "CEPH_TPU_BENCH_FALLBACK": "explicit",
+        "CEPH_TPU_BENCH_SECTIONS": "aux",
+        "CEPH_TPU_BENCH_PARTIAL_PATH": path,
+    })
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=1200)
+        try:
+            with open(path) as f:
+                sub = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            # subprocess died before its first section flush: surface
+            # ITS stderr, not a bare JSONDecodeError
+            raise RuntimeError(
+                f"aux subprocess rc={proc.returncode}: {e!r}; "
+                f"stderr tail: {proc.stderr[-400:]}") from e
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    for k in ("clay_repair_gbps", "clay_repair_read_frac_vs_rs",
+              "jerasure_k4m2_4k_encode_gbps", "lrc_profile",
+              "lrc_local_repair_reads", "lrc_local_repair_gbps"):
+        if k in sub:
+            out[k] = sub[k]
+    out["aux_measured_on"] = "host cpu subprocess (host-path codecs)"
+
+
+# north stars FIRST: a tunnel wedge mid-run must cost the aux rows,
+# never the EC sweep or the CRUSH sweep (VERDICT r3 weak #1).  crush
+# runs AFTER small_stripe: a TPU-worker crash mid-crush poisons the
+# in-process jax client, and aux (subprocess) is the only section
+# immune to that.
 SECTIONS = [
     ("envelope", envelope),
     ("ec", ec_section),
     ("small_stripe", small_stripe_batched),
-    ("clay", clay_repair),
-    ("baseline_configs", baseline_configs),
     ("crush", crush_section),
+    ("aux", aux_section),
 ]
 
 
@@ -708,8 +774,9 @@ def main():
     elif fb == "explicit":
         out["accelerator_fallback"] = (
             "explicit JAX_PLATFORMS=cpu run; numbers are CPU")
-    partial_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "BENCH_PARTIAL.json")
+    partial_path = os.environ.get("CEPH_TPU_BENCH_PARTIAL_PATH") or \
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_PARTIAL.json")
 
     def _flush_partial():
         # wedge-proofing: the artifact-so-far hits disk after EVERY
@@ -745,7 +812,9 @@ def main():
 
     threading.Thread(target=_watchdog, daemon=True).start()
 
-    for name, fn in SECTIONS:
+    only = os.environ.get("CEPH_TPU_BENCH_SECTIONS")
+    sections = [s for s in SECTIONS if not only or s[0] in only.split(",")]
+    for name, fn in sections:
         t0 = time.perf_counter()
         progress.update(t=time.monotonic(), name=name)
         print(f"bench: section {name} start", file=sys.stderr, flush=True)

@@ -15,6 +15,40 @@ from __future__ import annotations
 import time
 
 
+LANES = 128
+
+
+def gen_planes(k: int, T: int, interleaved: bool = False):
+    """Device-resident deterministic batch: u32 planes (k,T,128) (or
+    (T,k,128) interleaved) from iota -> splitmix mix32.  The numpy twin
+    for oracle pins is mix32.mix_np over the same iota — keeping the
+    generator HERE (one copy) is what makes bench/minibench/tune
+    numbers and their pins comparable."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ceph_tpu.ops.mix32 import mix_jnp
+
+    shape = (T, k, LANES) if interleaved else (k, T, LANES)
+
+    @jax.jit
+    def g():
+        return mix_jnp(lax.iota(jnp.uint32, k * T * LANES).reshape(shape))
+
+    return g()
+
+
+def xla_swar_engine(net, R: int):
+    """enc(words3, seed) for the XLA-graph SWAR network `net` over
+    planar (k, T, 128) batches -> (R, T, 128)."""
+    def enc(w3, seed):
+        k, T, _ = w3.shape
+        return net((w3 ^ seed[0]).reshape(k, -1)).reshape(R, T, LANES)
+
+    return enc
+
+
 def seeded_loop_runner(enc, out_shape, iters: int):
     """jit'd runner: enc(words, seed_u32[1]) -> u32[out_shape] folded
     over `iters` seeded iterations; returns a scalar digest."""

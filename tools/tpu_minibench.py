@@ -42,18 +42,13 @@ def main():
     coding = matrices.isa_cauchy(K, M)
     net = _build_network(coding)
 
-    from ceph_tpu.ops.mix32 import mix_jnp as mix
+    from ceph_tpu.ops.benchloop import gen_planes, xla_swar_engine
     from ceph_tpu.ops.mix32 import mix_np
 
     T = 4096  # 16 MiB object at k=8
     size = T * LANES * 4 * K
 
-    @jax.jit
-    def gen():
-        i = lax.iota(jnp.uint32, K * T * LANES).reshape(K, T, LANES)
-        return mix(i)
-
-    w3 = gen()
+    w3 = gen_planes(K, T)
 
     # correctness pin on the head of the batch (small fetch)
     got3 = np.asarray(gf256_pallas.encode_planes(
@@ -67,51 +62,68 @@ def main():
 
     from ceph_tpu.ops.benchloop import loop_rate_gbps
 
+    def flush():
+        line = json.dumps(out)
+        if len(sys.argv) > 1:
+            with open(sys.argv[1], "w") as f:
+                f.write(line + "\n")
+        return line
+
+    def guarded(key, fn):
+        # one engine failing on this rig's compiler (e.g. the round-4
+        # server-side VMEM-OOM on the interleaved kernel) must not
+        # erase the other engines' hardware numbers
+        try:
+            out[key] = fn()
+        except Exception as e:
+            out[key] = f"error: {e!r}"[:160]
+        flush()
+
     def engine_rate(enc, iters=30):
         return round(loop_rate_gbps(enc, w3, (M, T, LANES), iters, size), 2)
 
-    out["encode_16mib_xla_gbps"] = engine_rate(
-        lambda w, s: net((w ^ s[0]).reshape(K, -1)).reshape(M, T, LANES))
-    out["encode_16mib_pallas_gbps"] = engine_rate(
+    guarded("encode_16mib_xla_gbps", lambda: engine_rate(
+        xla_swar_engine(net, M)))
+    guarded("encode_16mib_pallas_gbps", lambda: engine_rate(
         lambda w, s: gf256_pallas.encode_planes(coding, w, s, tile=512,
-                                                interpret=False))
+                                                interpret=False)))
 
     # interleaved layout (contiguous per-step DMA)
     w3i = jnp.transpose(w3, (1, 0, 2))
-    out["encode_16mib_pallas_inter_gbps"] = round(loop_rate_gbps(
+    guarded("encode_16mib_pallas_inter_gbps", lambda: round(loop_rate_gbps(
         lambda w, s: gf256_pallas.encode_planes_interleaved(
             coding, w, s, tile=512, interpret=False),
-        w3i, (T, M, LANES), 30, size), 2)
+        w3i, (T, M, LANES), 30, size), 2))
 
-    from ceph_tpu.crush import map as cmap
-    from ceph_tpu.crush import mapper
+    def crush_rate():
+        from ceph_tpu.crush import map as cmap
+        from ceph_tpu.crush import mapper
 
-    n_osds, nrep = 1024, 3
-    m, root = cmap.build_flat_cluster(n_osds, hosts=64)
-    steps = [(cmap.OP_TAKE, root, 0),
-             (cmap.OP_CHOOSELEAF_FIRSTN, nrep, 1),
-             (cmap.OP_EMIT, 0, 0)]
-    flat = m.flatten()
-    w = np.full(n_osds, 0x10000, dtype=np.uint32)
-    chunk = 1 << 18
-    n_x = 4 * chunk  # ~1M ids
-    xs = jnp.arange(n_x, dtype=jnp.int32)
-    res, ovf = mapper.sweep_device(flat, steps, nrep, xs, w, chunk=chunk)
-    assert not bool(ovf)
-    best = 1e18
-    for _ in range(2):
-        t0 = time.perf_counter()
+        n_osds, nrep = 1024, 3
+        m, root = cmap.build_flat_cluster(n_osds, hosts=64)
+        steps = [(cmap.OP_TAKE, root, 0),
+                 (cmap.OP_CHOOSELEAF_FIRSTN, nrep, 1),
+                 (cmap.OP_EMIT, 0, 0)]
+        flat = m.flatten()
+        w = np.full(n_osds, 0x10000, dtype=np.uint32)
+        chunk = 1 << 18
+        n_x = 4 * chunk  # ~1M ids
+        xs = jnp.arange(n_x, dtype=jnp.int32)
         res, ovf = mapper.sweep_device(flat, steps, nrep, xs, w,
                                        chunk=chunk)
-        bool(ovf)
-        best = min(best, time.perf_counter() - t0)
-    out["crush_1m_mplacements_per_s"] = round(n_x / best / 1e6, 2)
+        assert not bool(ovf)
+        best = 1e18
+        for _ in range(2):
+            t0 = time.perf_counter()
+            res, ovf = mapper.sweep_device(flat, steps, nrep, xs, w,
+                                           chunk=chunk)
+            bool(ovf)
+            best = min(best, time.perf_counter() - t0)
+        return round(n_x / best / 1e6, 2)
 
-    line = json.dumps(out)
-    print(line)
-    if len(sys.argv) > 1:
-        with open(sys.argv[1], "w") as f:
-            f.write(line + "\n")
+    guarded("crush_1m_mplacements_per_s", crush_rate)
+
+    print(flush())
     return 0
 
 
