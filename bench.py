@@ -653,8 +653,15 @@ def aux_section(jax, out):
     import tempfile
 
     if jax.default_backend() == "cpu":
-        clay_repair(jax, out)
-        baseline_configs(jax, out)
+        # preserve per-row fault isolation: a clay bug must not erase
+        # the jerasure/lrc rows (each records its own error)
+        for name, fn in (("clay", clay_repair),
+                         ("baseline_configs", baseline_configs)):
+            try:
+                fn(jax, out)
+            except Exception:
+                out.setdefault("errors", {})[name] = \
+                    traceback.format_exc(limit=4)
         return
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -693,6 +700,10 @@ def aux_section(jax, out):
               "lrc_local_repair_reads", "lrc_local_repair_gbps"):
         if k in sub:
             out[k] = sub[k]
+    # surface the subprocess's own failures in THIS artifact: missing
+    # rows must be explained, not silent
+    for name, err in (sub.get("errors") or {}).items():
+        out.setdefault("errors", {})[f"aux/{name}"] = err
     out["aux_measured_on"] = "host cpu subprocess (host-path codecs)"
 
 

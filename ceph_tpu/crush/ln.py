@@ -66,6 +66,33 @@ def ln16_table() -> np.ndarray:
     return (crush_ln(u) - np.int64(0x1000000000000)).astype(np.int64)
 
 
+@functools.lru_cache(maxsize=None)
+def fastcmp_bounds() -> dict:
+    """{delta: bound}: for every pair of 16-bit hash values u_i < u_j
+    with u_j - u_i >= delta, the straw2 magnitudes satisfy
+    n(u_i) - n(u_j) >= bound, where n(u) = 2^48 - crush_ln(u).
+
+    crush_ln's fixed-point interpolation is NOT monotone (adjacent
+    values can invert by up to ~2^27.7), but the inversion is local:
+    at distance >= 2 the magnitudes separate by > 2^25.  Consequence:
+    in a bucket whose (positive) item weights all equal w <= bound[d],
+    the straw2 winner argmin(floor(n/w)) is EXACTLY the item with the
+    maximum hash (first index on hash ties) whenever the runner-up
+    hash is more than d below the maximum — floor(a/w) > floor(b/w)
+    for a - b >= w.  The vmapped one-shot sweep uses this to replace
+    the draw-table gathers with a pure hash+argmax, flagging lanes
+    whose top-2 hashes are within d as unclean for the exact re-run
+    (mapper._straw2_choose fastcmp path).
+
+    Computed exactly from the table via suffix-max (not hardcoded so
+    the derivation is checkable): bound[d] = min_u [n(u) -
+    max_{v >= u+d} n(v)].
+    """
+    n = (-ln16_table()).astype(np.int64)
+    sm = np.maximum.accumulate(n[::-1])[::-1]
+    return {d: int((n[:-d] - sm[d:]).min()) for d in (2, 3, 4)}
+
+
 def div64_trunc(num, den, xp=np):
     """C-style truncating signed 64-bit division (div64_s64 semantics).
 

@@ -91,6 +91,11 @@ _SKIP = 2  # bad item / bad type: give up on this replica slot
 # (real maps quantize weights to a handful of device sizes)
 _MAX_DRAW_TABS = 64
 
+# mid-stage retry budget for the staged sweeps: real retry semantics
+# statically unrolled this many attempts (resolves ~97% of stage-1
+# unclean lanes; the rest hit the exact full program)
+MID_BUDGET = 3
+
 
 class _DeviceMap:
     """FlatMap lowered to device arrays (captured by the compiled rule).
@@ -173,6 +178,8 @@ class _DeviceMap:
         self._np_items = np.asarray(flat.items)
         self._np_sizes = np.asarray(flat.sizes)
         self._np_types = np.asarray(flat.types)
+        self._np_algs = np.asarray(flat.algs)
+        self._np_weights = np.asarray(flat.weights)  # post-choose_args
         # legacy bucket algorithm support: aux planes are materialized
         # only for algs the map actually uses (straw2-only maps — the
         # modern default — pay nothing)
@@ -196,25 +203,70 @@ class _DeviceMap:
                        ).bit_length() - 1)
 
 
-def _descent_plan(dm: "_DeviceMap", frontier, want_type: int):
+def _level_fast_delta(dm: "_DeviceMap", frontier) -> int:
+    """Hash-ambiguity window for the fastcmp straw2 draw at one descent
+    level, or 0 when the level is ineligible.
+
+    Eligible when every frontier bucket is straw2 with uniform positive
+    item weights, all under ln.fastcmp_bounds()[delta]: then the draw
+    winner is exactly the max-hash item unless the runner-up hash is
+    within delta (those lanes are flagged unclean and re-run through
+    the exact table path — see ln.fastcmp_bounds).
+    CEPH_TPU_CRUSH_NO_FASTCMP=1 disables (A/B + safety)."""
+    import os
+
+    from ceph_tpu.crush import ln as _ln
+
+    if os.environ.get("CEPH_TPU_CRUSH_NO_FASTCMP") == "1":
+        return 0
+
+    wmax = 0
+    for b in frontier:
+        if int(dm._np_algs[b]) != ALG_STRAW2:
+            return 0
+        sz = int(dm._np_sizes[b])
+        if sz == 0:
+            continue
+        ws = dm._np_weights[b, :sz]
+        pos = ws[ws > 0]
+        if pos.size == 0:
+            continue
+        if (pos != pos[0]).any():
+            return 0
+        wmax = max(wmax, int(pos[0]))
+    if wmax == 0:
+        return 0
+    for d, bound in _ln.fastcmp_bounds().items():
+        if wmax <= bound:
+            return d
+    return 0
+
+
+def _descent_plan(dm: "_DeviceMap", frontier, want_type: int,
+                  fastcmp: bool = False):
     """Static unroll plan for a descent whose possible start buckets
-    are known at trace time: per level, the max bucket width actually
-    reachable.  A take->chooseleaf walk on a root(64 hosts) ->
-    host(16 osds) map plans [64, 16] instead of paying the global
-    max_size at every level AND the global tree depth — for typical
-    2-level maps this halves the straw2 work per choose.
+    are known at trace time: per level, (max bucket width actually
+    reachable, fastcmp delta).  A take->chooseleaf walk on a
+    root(64 hosts) -> host(16 osds) map plans [64, 16] instead of
+    paying the global max_size at every level AND the global tree
+    depth — for typical 2-level maps this halves the straw2 work per
+    choose.  fastcmp=True (one-shot traces only) additionally marks
+    levels whose frontier buckets have uniform weights: those levels
+    draw by pure hash+argmax with an unclean flag instead of table
+    gathers (_level_fast_delta).
 
     frontier: iterable of bucket indices possibly holding the walk at
-    level 0.  Returns a list of per-level widths (len == levels the
-    unroll needs); falls back to the conservative global plan when the
-    frontier is unknown."""
+    level 0.  Returns a list of per-level (width, delta) tuples;
+    falls back to the conservative global plan when the frontier is
+    unknown."""
     frontier = {b for b in frontier if 0 <= b < dm.n_buckets}
     if not frontier:
-        return [dm.max_size] * dm.depth
+        return [(dm.max_size, 0)] * dm.depth
     plan = []
     for _ in range(dm.depth):
         width = max(int(dm._np_sizes[b]) for b in frontier)
-        plan.append(max(width, 1))
+        delta = _level_fast_delta(dm, frontier) if fastcmp else 0
+        plan.append((max(width, 1), delta))
         nxt = set()
         for b in frontier:
             for j in range(int(dm._np_sizes[b])):
@@ -265,16 +317,24 @@ _U16 = jnp.uint32(0xFFFF)
 _UMAX = jnp.uint32(0xFFFFFFFF)
 
 
-def _straw2_choose(dm: _DeviceMap, bno, x, r, width=None):
+def _straw2_choose(dm: _DeviceMap, bno, x, r, width=None, delta: int = 0):
     """Vectorized bucket_straw2_choose (reference: mapper.c:361-384),
-    exact and 64-bit-free.
+    exact and 64-bit-free.  Returns (item, ambig).
 
     The C computes draw = div64_s64(ln, w) per item and keeps the
     strictly-greatest draw (first index on ties).  ln is negative with
     |ln| = n < 2^48, so argmax(draw) == lexicographic argmin of the
     positive quotient q = floor(n / w).
 
-    Fast path (table_mode): weights are map constants, so q is
+    fastcmp path (delta > 0, one-shot traces on uniform-weight
+    buckets): the winner is the max-hash item directly — NO table
+    access at all (TPU gathers measured ~8x slower than the hash
+    itself).  Exact except when the runner-up hash is within `delta`
+    of the winner (ln.fastcmp_bounds derivation); those lanes return
+    ambig=True and the two-stage sweep re-runs them through the exact
+    program, so end-to-end results stay bit-identical.
+
+    Table path (table_mode): weights are map constants, so q is
     precomputed per distinct weight as (hi, lo) u32 planes over all
     2^16 hash values — the choose is one hash + two gathers + a
     lexicographic argmin.  Fallback: q computed exactly in uint32 limb
@@ -290,6 +350,42 @@ def _straw2_choose(dm: _DeviceMap, bno, x, r, width=None):
         x.astype(jnp.uint32), items.astype(jnp.uint32), r.astype(jnp.uint32),
         xp=jnp,
     ) & _U16
+    if delta:
+        valid = (jnp.arange(width) < size) & (wts > 0)
+        uv = jnp.where(valid, u.astype(jnp.int32), jnp.int32(-1))
+        u1 = jnp.max(uv)
+        sel1 = uv == u1  # valid implied: invalid slots are -1 < u1
+        i1 = jnp.argmax(sel1).astype(jnp.int32)
+        # nearest DISTINCT runner-up; hash ties (same u -> same draw)
+        # resolve first-index exactly like the table path
+        sel2 = (~sel1) & (uv >= 0)
+        u2 = jnp.max(jnp.where(sel2, uv, jnp.int32(-1)))
+        close2 = (u2 >= 0) & (u1 - u2 <= delta)
+        if dm.table_mode:
+            # EXACT runner-up resolution: the only contested case is
+            # u1 - u2 <= delta (ln.fastcmp_bounds), so compare the two
+            # candidates' true draws via two precomputed q-table
+            # lookups — 4 scattered gathers instead of 2*width.  Only
+            # a THIRD distinct hash inside the window (P ~ 1e-5 per
+            # draw) stays ambiguous.
+            i2 = jnp.argmax(sel2 & (uv == u2)).astype(jnp.int32)
+            wi = dm.w_idx[bno, jnp.minimum(i1, width - 1)]
+            u2c = jnp.clip(u2, 0, 0xFFFF)
+            q1h, q1l = dm.draw_hi[wi, u1], dm.draw_lo[wi, u1]
+            q2h, q2l = dm.draw_hi[wi, u2c], dm.draw_lo[wi, u2c]
+            two_wins = (q2h < q1h) | ((q2h == q1h) & (q2l < q1l))
+            q_tie = (q2h == q1h) & (q2l == q1l)
+            resolved = jnp.where(
+                q_tie, jnp.minimum(i1, i2), jnp.where(two_wins, i2, i1))
+            idx = jnp.where(close2, resolved, i1)
+            u3 = jnp.max(jnp.where(sel2 & (uv != u2), uv, jnp.int32(-1)))
+            ambig = (u3 >= 0) & (u1 - u3 <= delta)
+            return items[idx], ambig
+        # no q tables on this map: flag the contested case instead
+        # all-invalid: u1 == -1, argmax(all False) == 0 -> items[0],
+        # identical to the table path's all-masked argmin
+        return items[i1], close2
+    no_ambig = jnp.asarray(False)
     if dm.table_mode:
         ui = u.astype(jnp.int32)
         wi = dm.w_idx[:, :width][bno]
@@ -302,7 +398,7 @@ def _straw2_choose(dm: _DeviceMap, bno, x, r, width=None):
         cand = q_hi == min_hi
         min_lo = jnp.min(jnp.where(cand, q_lo, _UMAX))
         sel = cand & (q_lo == min_lo)
-        return items[jnp.argmax(sel)]
+        return items[jnp.argmax(sel)], no_ambig
     ui = u.astype(jnp.int32)
     nl = [dm.ln_l[i][ui] for i in range(4)]  # n in 4x16-bit limbs
     ml = [mlj[:, :width][bno] for mlj in dm.magic_l]  # magic, 16-bit limbs
@@ -364,7 +460,7 @@ def _straw2_choose(dm: _DeviceMap, bno, x, r, width=None):
     cand = q_hi == min_hi
     min_lo = jnp.min(jnp.where(cand, q_lo, _UMAX))
     sel = cand & (q_lo == min_lo)
-    return items[jnp.argmax(sel)]
+    return items[jnp.argmax(sel)], no_ambig
 
 
 def _umulhi32(a, b):
@@ -472,14 +568,17 @@ def _uniform_choose(dm: _DeviceMap, bno, x, r):
     return dm.items[bno][perm[pr]]
 
 
-def _bucket_choose(dm: _DeviceMap, bno, x, r, width=None):
+def _bucket_choose(dm: _DeviceMap, bno, x, r, width=None, delta: int = 0):
     """Per-alg dispatch; straw2-only maps trace straight through the
-    straw2 path with zero overhead.  `width` is the static per-level
-    bucket-width bound from the descent plan (straw2 only; the legacy
-    algs are rare enough to always run at full width)."""
+    straw2 path with zero overhead.  `width` / `delta` are the static
+    per-level bounds from the descent plan (straw2 only; the legacy
+    algs are rare enough to always run at full width).  Returns
+    (item, ambig); delta > 0 implies the plan proved every reachable
+    bucket at this level is straw2, so the legacy overrides below are
+    per-lane no-ops then."""
     if dm.only_straw2:
-        return _straw2_choose(dm, bno, x, r, width)
-    out = _straw2_choose(dm, bno, x, r, width)
+        return _straw2_choose(dm, bno, x, r, width, delta)
+    out, ambig = _straw2_choose(dm, bno, x, r, width, delta)
     alg = dm.algs[bno]
     if ALG_STRAW in dm.algs_present:
         out = jnp.where(alg == ALG_STRAW, _straw_choose(dm, bno, x, r),
@@ -493,7 +592,7 @@ def _bucket_choose(dm: _DeviceMap, bno, x, r, width=None):
     if ALG_UNIFORM in dm.algs_present:
         out = jnp.where(alg == ALG_UNIFORM,
                         _uniform_choose(dm, bno, x, r), out)
-    return out
+    return out, ambig
 
 
 def _is_out(dev_weights, max_devices, item, x):
@@ -544,11 +643,12 @@ def _descend(
     item = jnp.int32(0)
     done = jnp.asarray(False)
     status = jnp.int32(_OK)
+    ambig = jnp.asarray(False)
 
-    levels = plan if plan is not None else [dm.max_size] * dm.depth
-    for width in levels:
+    levels = plan if plan is not None else [(dm.max_size, 0)] * dm.depth
+    for width, fast_delta in levels:
         empty = dm.sizes[bno] == 0
-        it = _bucket_choose(dm, bno, x, r_for(bno), width)
+        it, amb = _bucket_choose(dm, bno, x, r_for(bno), width, fast_delta)
         bad_item = it >= dm.max_devices
         sub_bno = -1 - it
         valid_sub = (it < 0) & (sub_bno < dm.n_buckets)
@@ -575,24 +675,25 @@ def _descend(
         # masked carry: lanes already done pass through unchanged
         status = jnp.where(done, status, new_status)
         item = jnp.where(done, item, new_item)
+        ambig = ambig | ((~done) & amb)
         bno = jnp.where((~done) & keep_going, sub_bno, bno)
         done = done | ~keep_going
 
     status = jnp.where(done, status, jnp.int32(_SKIP))  # depth exhausted
-    return item, status
+    return item, status, ambig
 
 
 def _leaf_attempt(dm, dev_weights, bno, x, r, outpos, out2, plan=None):
     """One recursive chooseleaf descent attempt (type-0 target)."""
     nslots = out2.shape[0]
-    item, status = _descend(dm, bno, x, r, 0, plan=plan)
+    item, status, ambig = _descend(dm, bno, x, r, 0, plan=plan)
     collide = jnp.any((jnp.arange(nslots) < outpos) & (out2 == item))
     reject = (status == _REJECT) | _is_out(
         dev_weights, dm.max_devices, item, x
     )
     skip = status == _SKIP
     fail = reject | collide
-    return item, (~fail) & (~skip), skip, fail
+    return item, (~fail) & (~skip), skip, fail, ambig
 
 
 def _leaf_firstn(
@@ -606,6 +707,7 @@ def _leaf_firstn(
     recurse_tries: int,
     stable: int,
     plan=None,
+    unroll: int = 0,
 ):
     """The chooseleaf recursion: pick ONE device under bucket_item.
 
@@ -621,27 +723,42 @@ def _leaf_firstn(
     rep = jnp.where(jnp.bool_(stable), 0, outpos)
 
     if recurse_tries == 1:
-        item, placed, _, _ = _leaf_attempt(
+        item, placed, _, _, ambig = _leaf_attempt(
             dm, dev_weights, bno, x, rep + sub_r, outpos, out2, plan
         )
-        return item, placed
+        return item, placed, ambig
 
     def cond(c):
-        ftotal, _, placed, give_up = c
+        ftotal, _, placed, give_up, _ = c
         return (~placed) & (~give_up)
 
     def body(c):
-        ftotal, _, placed, give_up = c
-        item, ok, skip, fail = _leaf_attempt(
+        ftotal, _, placed, give_up, amb0 = c
+        item, ok, skip, fail, amb = _leaf_attempt(
             dm, dev_weights, bno, x, rep + sub_r + ftotal, outpos, out2,
             plan,
         )
         nf = ftotal + 1
-        return (nf, item, ok, skip | (fail & (nf >= recurse_tries)))
+        return (nf, item, ok, skip | (fail & (nf >= recurse_tries)),
+                amb0 | amb)
 
-    init = (jnp.int32(0), jnp.int32(0), jnp.asarray(False), jnp.asarray(False))
-    _, item, placed, _ = jax.lax.while_loop(cond, body, init)
-    return item, placed
+    init = (jnp.int32(0), jnp.int32(0), jnp.asarray(False),
+            jnp.asarray(False), jnp.asarray(False))
+    if unroll:
+        c = init
+        for _ in range(min(unroll, recurse_tries)):
+            active = cond(c)
+            cn = body(c)
+            c = jax.tree.map(
+                lambda new, old: jnp.where(active, new, old), cn, c)
+        _, item, placed, _, ambig = c
+        # ran out of unroll budget while the exact program would keep
+        # trying: reporting failure here would let the OUTER retry
+        # diverge from the exact walk — poison the lane instead
+        ambig = ambig | cond(c)
+        return item, placed, ambig
+    _, item, placed, _, ambig = jax.lax.while_loop(cond, body, init)
+    return item, placed, ambig
 
 
 def _choose_firstn_oneshot(
@@ -664,16 +781,19 @@ def _choose_firstn_oneshot(
     tries=1 sequential body: retries only change results on failure,
     and failures here mean the lane is re-run by the full program."""
     reps = jnp.arange(numrep, dtype=jnp.int32)
-    items, statuses = jax.vmap(
+    items, statuses, ambigs = jax.vmap(
         lambda r: _descend(dm, bucket_bno, x, r, want_type, plan=plan)
     )(reps)
+    ambig_any = jnp.any(ambigs)
     if recurse_to_leaf:
         sub_rs = (reps >> (vary_r - 1)) if vary_r else jnp.zeros_like(reps)
         # stable profile: leaf rep is 0 for every slot
-        leaf_items, leaf_statuses = jax.vmap(
+        leaf_items, leaf_statuses, leaf_ambigs = jax.vmap(
             lambda it, sr: _descend(
                 dm, -1 - jnp.minimum(it, -1), x, sr, 0, plan=leaf_plan)
         )(items, sub_rs)
+        # dummy descents (item not a bucket) carry no real ambiguity
+        ambig_any = ambig_any | jnp.any(leaf_ambigs & (items < 0))
 
     out = jnp.full((numrep,), ITEM_NONE, dtype=jnp.int32)
     out2 = jnp.full((numrep,), ITEM_NONE, dtype=jnp.int32)
@@ -703,7 +823,7 @@ def _choose_firstn_oneshot(
         out2 = jnp.where(placed, out2.at[outpos].set(leaf), out2)
         outpos = outpos + placed.astype(jnp.int32)
     values = out2 if recurse_to_leaf else out
-    return values, outpos
+    return values, outpos, ambig_any
 
 
 def _choose_firstn(
@@ -720,26 +840,36 @@ def _choose_firstn(
     stable: int,
     plan=None,
     leaf_plan=None,
+    unroll: int = 0,
 ):
     """crush_choose_firstn for one source bucket (outpos starts at 0).
 
-    Returns (values[numrep], count): values are leaves when
+    Returns (values[numrep], count, ambig): values are leaves when
     recurse_to_leaf else items; only the first `count` are valid.
+
+    unroll > 0 (bounded-budget traces, the sweep's mid stage): the
+    retry while_loops are statically unrolled to `unroll` attempts.  A
+    lane whose every rep places within the budget follows the exact
+    program's attempt sequence verbatim (retries are deterministic), so
+    its result is bit-identical; a rep that exhausts the budget leaves
+    count < numrep (or sets ambig via the bounded leaf recursion) and
+    the caller re-runs the lane through the full program.
     """
     out = jnp.full((numrep,), ITEM_NONE, dtype=jnp.int32)
     out2 = jnp.full((numrep,), ITEM_NONE, dtype=jnp.int32)
     outpos = jnp.int32(0)
+    ambig_all = jnp.asarray(False)
 
     for rep in range(numrep):
         def cond(c):
-            ftotal, _, _, placed, give_up = c
+            ftotal, _, _, placed, give_up, _ = c
             return (~placed) & (~give_up)
 
         def body(c, rep=rep):
-            ftotal, item_prev, leaf_prev, placed, give_up = c
+            ftotal, item_prev, leaf_prev, placed, give_up, amb0 = c
             r = rep + ftotal
-            item, status = _descend(dm, bucket_bno, x, r, want_type,
-                                    plan=plan)
+            item, status, amb = _descend(dm, bucket_bno, x, r, want_type,
+                                         plan=plan)
             collide = jnp.any((jnp.arange(numrep) < outpos) & (out == item))
             reject = status == _REJECT
             skip = status == _SKIP
@@ -747,13 +877,15 @@ def _choose_firstn(
             if recurse_to_leaf:
                 sub_r = (r >> (vary_r - 1)) if vary_r else jnp.int32(0)
                 is_bucket = item < 0
-                leaf_item, leaf_ok = _leaf_firstn(
+                leaf_item, leaf_ok, leaf_amb = _leaf_firstn(
                     dm, dev_weights, jnp.minimum(item, -1), x, outpos,
                     out2, sub_r, recurse_tries, stable, leaf_plan,
+                    unroll,
                 )
                 leaf = jnp.where(is_bucket, leaf_item, item)
                 leaf_fail = is_bucket & (~leaf_ok) & (~collide) & (status == _OK)
                 reject = reject | leaf_fail
+                amb = amb | (leaf_amb & is_bucket)
             if want_type == 0:
                 reject = reject | (
                     (status == _OK)
@@ -768,6 +900,7 @@ def _choose_firstn(
                 leaf,
                 (status == _OK) & (~fail) & (~skip),
                 skip | (fail & (nf >= tries)),
+                amb0 | amb,
             )
 
         init = (
@@ -776,47 +909,68 @@ def _choose_firstn(
             jnp.int32(0),
             jnp.asarray(False),
             jnp.asarray(False),
+            jnp.asarray(False),
         )
         if tries == 1:
             # one-shot trace (the two-stage sweep's fast pass): a single
             # inline attempt, no while_loop round-trips
-            _, item, leaf, placed, _ = body(init)
+            _, item, leaf, placed, _, amb = body(init)
+        elif unroll:
+            c = init
+            for _ in range(min(unroll, tries)):
+                active = cond(c)
+                cn = body(c)
+                c = jax.tree.map(
+                    lambda new, old: jnp.where(active, new, old), cn, c)
+            _, item, leaf, placed, _, amb = c
+            # budget exhausted mid-retry: not placed -> count stays
+            # short -> the lane is re-run by the full program
         else:
-            _, item, leaf, placed, _ = jax.lax.while_loop(cond, body, init)
+            _, item, leaf, placed, _, amb = jax.lax.while_loop(
+                cond, body, init)
         out = jnp.where(placed, out.at[outpos].set(item), out)
         out2 = jnp.where(placed, out2.at[outpos].set(leaf), out2)
         outpos = outpos + placed.astype(jnp.int32)
+        ambig_all = ambig_all | amb
 
     values = out2 if recurse_to_leaf else out
-    return values, outpos
+    return values, outpos, ambig_all
 
 
 def _leaf_indep(dm, dev_weights, bucket_item, x, numrep, parent_r,
-                recurse_tries: int, plan=None):
+                recurse_tries: int, plan=None, unroll: int = 0):
     """Recursive indep leaf choice: one slot, r' = parent_r + n*ftotal."""
     bno = -1 - bucket_item
 
     def attempt(ftotal):
-        item, status = _descend(
+        item, status, amb = _descend(
             dm, bno, x, parent_r, 0,
             indep_numrep=jnp.int32(numrep), ftotal=ftotal, plan=plan,
         )
         bad = status != _OK
         outed = _is_out(dev_weights, dm.max_devices, item, x)
-        return jnp.where(bad | outed, ITEM_UNDEF, item)
+        return jnp.where(bad | outed, ITEM_UNDEF, item), amb
 
+    def body(ftotal, c):
+        got, amb0 = c
+        nxt, amb = attempt(jnp.int32(ftotal))
+        return (jnp.where(got == ITEM_UNDEF, nxt, got),
+                amb0 | (amb & (got == ITEM_UNDEF)))
+
+    init = (jnp.int32(ITEM_UNDEF), jnp.asarray(False))
     if recurse_tries == 1:
-        got = attempt(jnp.int32(0))
+        got, ambig = attempt(jnp.int32(0))
+    elif unroll:
+        c = init
+        for f in range(min(unroll, recurse_tries)):
+            c = body(f, c)
+        got, ambig = c
+        # budget < the exact program's tries and still unresolved:
+        # the exact result could differ — poison the lane
+        ambig = ambig | ((got == ITEM_UNDEF) & (unroll < recurse_tries))
     else:
-        def body(ftotal, got):
-            return jnp.where(
-                got == ITEM_UNDEF, attempt(jnp.int32(ftotal)), got
-            )
-
-        got = jax.lax.fori_loop(
-            0, recurse_tries, body, jnp.int32(ITEM_UNDEF)
-        )
-    return jnp.where(got == ITEM_UNDEF, ITEM_NONE, got)
+        got, ambig = jax.lax.fori_loop(0, recurse_tries, body, init)
+    return jnp.where(got == ITEM_UNDEF, ITEM_NONE, got), ambig
 
 
 def _choose_indep(
@@ -832,20 +986,24 @@ def _choose_indep(
     recurse_to_leaf: bool,
     plan=None,
     leaf_plan=None,
+    unroll: int = 0,
 ):
     """crush_choose_indep for one source bucket (positional, out_size
-    slots).  Returns values[left0] with CRUSH_ITEM_NONE holes."""
+    slots).  Returns (values[left0], nslots, ambig) with
+    CRUSH_ITEM_NONE holes.  unroll bounds the retry rounds statically
+    (see _choose_firstn): unfilled slots after the budget leave NONE
+    holes, which the bounded-budget caller treats as unclean."""
     nslots = left0
     out = jnp.full((nslots,), ITEM_UNDEF, dtype=jnp.int32)
     out2 = jnp.full((nslots,), ITEM_UNDEF, dtype=jnp.int32)
 
     def round_body(c):
-        ftotal, out, out2, left = c
+        ftotal, out, out2, left, ambig = c
         for rep in range(nslots):
             # compute the slot unconditionally (under vmap a cond is a
             # select anyway) and mask the update on slot-vacancy
             vacant = out[rep] == ITEM_UNDEF
-            item, status = _descend(
+            item, status, amb = _descend(
                 dm, bucket_bno, x, jnp.int32(rep), want_type,
                 indep_numrep=jnp.int32(numrep), ftotal=ftotal, plan=plan,
             )
@@ -860,12 +1018,13 @@ def _choose_indep(
                 # (straw2-only => the per-level multiplier is always
                 # numrep, so r_parent is the top-level r')
                 r_parent = jnp.int32(rep) + jnp.int32(numrep) * ftotal
-                leaf_val = _leaf_indep(
+                leaf_val, leaf_amb = _leaf_indep(
                     dm, dev_weights, jnp.minimum(item, -1), x,
                     numrep, jnp.int32(rep) + r_parent, recurse_tries,
-                    leaf_plan,
+                    leaf_plan, unroll,
                 )
                 leaf = jnp.where(is_bucket, leaf_val, item)
+                amb = amb | (leaf_amb & is_bucket)
                 soft_fail = soft_fail | (
                     is_bucket & (leaf == ITEM_NONE) & (status == _OK)
                 )
@@ -887,18 +1046,28 @@ def _choose_indep(
             out = jnp.where(placed, out.at[rep].set(new_item), out)
             out2 = jnp.where(placed, out2.at[rep].set(new_leaf), out2)
             left = left - placed.astype(jnp.int32)
-        return ftotal + 1, out, out2, left
+            ambig = ambig | (amb & vacant)
+        return ftotal + 1, out, out2, left, ambig
 
     def round_cond(c):
-        ftotal, _, _, left = c
+        ftotal, _, _, left, _ = c
         return (left > 0) & (ftotal < tries)
 
-    _, out, out2, _ = jax.lax.while_loop(
-        round_cond, round_body, (jnp.int32(0), out, out2, jnp.int32(nslots))
-    )
+    init = (jnp.int32(0), out, out2, jnp.int32(nslots), jnp.asarray(False))
+    if unroll:
+        c = init
+        for _ in range(min(unroll, tries)):
+            active = round_cond(c)
+            cn = round_body(c)
+            c = jax.tree.map(
+                lambda new, old: jnp.where(active, new, old), cn, c)
+        _, out, out2, _, ambig = c
+    else:
+        _, out, out2, _, ambig = jax.lax.while_loop(
+            round_cond, round_body, init)
     out = jnp.where(out == ITEM_UNDEF, ITEM_NONE, out)
     out2 = jnp.where(out2 == ITEM_UNDEF, ITEM_NONE, out2)
-    return (out2 if recurse_to_leaf else out), jnp.int32(nslots)
+    return (out2 if recurse_to_leaf else out), jnp.int32(nslots), ambig
 
 
 def _rule_digest(flat: FlatMap, steps, result_max: int,
@@ -934,6 +1103,7 @@ def compile_rule(
     result_max: int,
     choose_args=None,
     one_shot: bool = False,
+    budget: Optional[int] = None,
 ):
     """Build fn(xs[int32 N], device_weights[uint32 D]) -> int32 [N, result_max].
 
@@ -944,22 +1114,36 @@ def compile_rule(
     ({bucket_id: [weights]}) bakes straw2 weight-set overrides into the
     compiled rule (reference crush_do_rule's choose_args parameter).
 
-    one_shot=True builds the two-stage sweep's FAST pass: every choose
+    one_shot=True builds the staged sweep's FAST pass: every choose
     gets exactly one attempt (tries=1, no retry while_loops) and the
     function returns (result, clean[bool N]).  clean lanes are exactly
-    the lanes whose every placement succeeded at first attempt — for
-    those the full algorithm provably produces the identical result
-    (retries only trigger on failure).  Unclean lanes must be re-run
-    through the full-semantics program (see sweep()); under vmap this
-    removes the dominant cost of the full program, where every lane
-    pays the batch's WORST-CASE retry rounds.
+    the lanes whose every placement succeeded at first attempt with no
+    fastcmp draw ambiguity (_straw2_choose) — for those the full
+    algorithm provably produces the identical result (retries only
+    trigger on failure).  Unclean lanes must be re-run through a
+    higher-budget program (see sweep()); under vmap this removes the
+    dominant cost of the full program, where every lane pays the
+    batch's WORST-CASE retry rounds.
+
+    budget=N (with one_shot=True) builds the MID stage: real retry
+    semantics statically unrolled to N attempts per choose; lanes fully
+    placed within the budget are bit-identical to the full program
+    (deterministic attempt sequences), the rest stay unclean for the
+    exact full program.
 
     Compiled programs are cached process-wide by map content: rebuilding
     an identical map (common in tests and in OSDMap churn that leaves
     the crush tree untouched) costs a digest, not a ~10s XLA compile.
     """
+    import os
+
+    budget_val = (1 if one_shot else 0) if budget is None else int(budget)
+    # the kill-switch is read at TRACE time (_level_fast_delta), so it
+    # must key the compile cache or toggling it mid-process is inert
+    no_fc = os.environ.get("CEPH_TPU_CRUSH_NO_FASTCMP") == "1"
     digest = _rule_digest(flat, steps, result_max, choose_args) + (
-        ":one_shot" if one_shot else "")
+        f":budget{budget_val}{':nofc' if no_fc else ''}"
+        if budget_val else "")
     cached = _compiled_rules.get(digest)
     if cached is not None:
         return cached
@@ -1017,9 +1201,26 @@ def compile_rule(
                     )
                 else:
                     recurse_tries = choose_leaf_tries or 1
-                use_tries = 1 if one_shot else choose_tries
-                use_recurse = 1 if one_shot else recurse_tries
-                plan = (_descent_plan(dm, static_frontier, arg2)
+                if budget_val == 1:
+                    # legacy one-shot shape: single inline attempt
+                    use_tries, use_recurse, use_unroll = 1, 1, 0
+                elif budget_val > 1:
+                    # bounded-budget mid stage: real retry semantics,
+                    # statically unrolled to budget attempts
+                    use_tries, use_recurse, use_unroll = (
+                        choose_tries, recurse_tries, budget_val)
+                else:
+                    use_tries, use_recurse, use_unroll = (
+                        choose_tries, recurse_tries, 0)
+                # fastcmp deltas only in budgeted traces; the full
+                # program must stay exact standalone (it is the final
+                # stage unclean lanes re-run through).  With the
+                # table_mode top-2 exact resolution the fastcmp draw is
+                # exact except for 3-candidates-in-window (~1e-5), so
+                # the mid stage keeps it too.
+                fc = budget_val > 0
+                plan = (_descent_plan(dm, static_frontier, arg2,
+                                      fastcmp=fc)
                         if static_frontier is not None else None)
                 leaf_plan = None
                 if recurse and arg2 > 0:
@@ -1027,7 +1228,8 @@ def compile_rule(
                     # arg2 (whichever one the outer choose picked)
                     leaf_starts = [b for b in range(dm.n_buckets)
                                    if int(dm._np_types[b]) == arg2]
-                    leaf_plan = _descent_plan(dm, leaf_starts, 0)
+                    leaf_plan = _descent_plan(dm, leaf_starts, 0,
+                                              fastcmp=fc)
                 # after this choose the walk holds items of type arg2
                 static_frontier = (
                     [b for b in range(dm.n_buckets)
@@ -1045,26 +1247,27 @@ def compile_rule(
                     active = src_active & bno_ok
                     bno_safe = jnp.clip(bno, 0, dm.n_buckets - 1)
                     if firstn:
-                        if one_shot and (stable or not recurse):
+                        if budget_val == 1 and (stable or not recurse):
                             # rep-vectorized fast pass (see helper)
-                            vals, cnt = _choose_firstn_oneshot(
+                            vals, cnt, amb = _choose_firstn_oneshot(
                                 dm, dev_weights, bno_safe, x, numrep,
                                 arg2, recurse, vary_r, plan, leaf_plan,
                             )
                         else:
-                            vals, cnt = _choose_firstn(
+                            vals, cnt, amb = _choose_firstn(
                                 dm, dev_weights, bno_safe, x, numrep,
                                 arg2, use_tries, use_recurse, recurse,
                                 vary_r, stable, plan, leaf_plan,
+                                use_unroll,
                             )
-                        step_clean = cnt == numrep
+                        step_clean = (cnt == numrep) & (~amb)
                     else:
-                        vals, cnt = _choose_indep(
+                        vals, cnt, amb = _choose_indep(
                             dm, dev_weights, bno_safe, x, numrep, numrep,
                             arg2, use_tries, use_recurse, recurse,
-                            plan, leaf_plan,
+                            plan, leaf_plan, use_unroll,
                         )
-                        step_clean = jnp.all(vals != ITEM_NONE)
+                        step_clean = jnp.all(vals != ITEM_NONE) & (~amb)
                     clean = clean & ((~active) | step_clean)
                     cnt = jnp.where(active, cnt, 0)
                     # append vals[:cnt] at o_buf[osize:]
@@ -1093,7 +1296,7 @@ def compile_rule(
                     )
                     result_len = result_len + valid.astype(jnp.int32)
                 wsize = jnp.int32(0)
-        if one_shot:
+        if budget_val:
             return result, clean
         return result
 
@@ -1121,19 +1324,24 @@ def sweep(
     chunk: int = 1 << 19,
 ) -> np.ndarray:
     """Full-cluster placement sweep (the ParallelPGMapper workload,
-    reference src/osd/OSDMapMapping.h:17) as a TWO-STAGE program:
+    reference src/osd/OSDMapMapping.h:17) as a THREE-STAGE program:
 
     1. the one-shot trace maps every id with exactly one attempt per
-       choose — the overwhelmingly common case on healthy maps — and
-       reports which lanes were clean;
-    2. only the unclean lanes (collisions/rejections, typically <5%)
-       re-run through the full-retry-semantics trace, padded to a
-       power-of-two batch so the slow program compiles for O(log)
-       distinct shapes.
+       choose (fastcmp draws) — the overwhelmingly common case on
+       healthy maps — and reports which lanes were clean;
+    2. the unclean lanes (collisions/rejections/draw ambiguity,
+       typically <6%) re-run through the bounded-budget trace (real
+       retry semantics unrolled to a few attempts — resolves nearly
+       all collisions at a fraction of the full program's cost);
+    3. the residue (typically <0.2%) re-runs through the exact
+       full-retry program, padded to a power-of-two batch so the slow
+       program compiles for O(log) distinct shapes.
 
     Chunked so live device temps stay bounded at 10M+ ids.  Bit-exact
     with running the full program on everything: a clean lane's result
-    is identical by construction (retries only fire on failure).
+    is identical by construction (retries only fire on failure, and
+    budgeted lanes follow the exact attempt sequence — see
+    compile_rule).
     """
     xs = np.asarray(xs, dtype=np.int32)
     n = len(xs)
@@ -1141,6 +1349,8 @@ def sweep(
         return np.empty((0, result_max), dtype=np.int32)
     fast = compile_rule(flat, steps, result_max, choose_args,
                         one_shot=True)
+    mid = compile_rule(flat, steps, result_max, choose_args,
+                       one_shot=True, budget=MID_BUDGET)
     slow = compile_rule(flat, steps, result_max, choose_args)
     chunk = min(chunk, n)
     outs = []
@@ -1153,12 +1363,19 @@ def sweep(
         res = np.array(res)  # writable host copy
         bad = np.nonzero(~np.asarray(clean))[0]
         if bad.size:
-            # power-of-two padding: O(log chunk) slow-program shapes
+            # power-of-two padding: O(log chunk) program shapes
             n_pad = 1 << max(0, int(bad.size - 1).bit_length())
             padded = np.full(n_pad, sub[bad[0]], dtype=np.int32)
             padded[: bad.size] = sub[bad]
-            fixed = np.asarray(slow(padded, dev_weights))
-            res[bad] = fixed[: bad.size]
+            res2, clean2 = mid(padded, dev_weights)
+            res[bad] = np.asarray(res2)[: bad.size]
+            bad2 = np.nonzero(~np.asarray(clean2)[: bad.size])[0]
+            if bad2.size:
+                n_pad2 = 1 << max(0, int(bad2.size - 1).bit_length())
+                padded2 = np.full(n_pad2, padded[bad2[0]], dtype=np.int32)
+                padded2[: bad2.size] = padded[bad2]
+                fixed = np.asarray(slow(padded2, dev_weights))
+                res[bad[bad2]] = fixed[: bad2.size]
         outs.append(res[: len(xs) - off])
     return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
@@ -1172,23 +1389,34 @@ def sweep_device(
     choose_args=None,
     chunk: int = 1 << 19,
     bad_div: int = 8,
+    bad2_div: int = 2048,
 ):
-    """Device-resident two-stage sweep: the whole 10M-id program is ONE
-    jit dispatch, placements stay in HBM, and nothing round-trips to
-    the host (the axon tunnel's 94 ms RTT + ~5 MB/s h2d makes sweep()'s
-    per-chunk host fixup tunnel-bound, not compute-bound).
+    """Device-resident staged sweep: the whole multi-million-id program
+    is ONE jit dispatch, placements stay in HBM, and nothing
+    round-trips to the host (the axon tunnel's 94 ms RTT + ~5 MB/s h2d
+    makes sweep()'s per-chunk host fixup tunnel-bound, not
+    compute-bound).
 
-    Same two-stage semantics as sweep() but with static shapes:
+    Same three-stage semantics as sweep() but with static shapes:
 
-    1. fast one-shot pass over each chunk;
+    1. fast one-shot pass (fastcmp draws) over each chunk;
     2. the unclean lane INDICES are extracted with a fixed capacity of
        chunk/bad_div (jnp.nonzero(size=...)), re-run through the
-       full-retry program, and scattered back (out-of-capacity padding
-       indices are dropped).  Healthy maps run ~5% unclean, far under
-       the 12.5% default capacity; if a chunk ever overflows, the
-       returned flag is True and the caller must fall back to sweep()
-       (results would be incomplete, not wrong: overflowed lanes keep
-       their one-shot placement, which may differ from full retry).
+       bounded-budget program, and scattered back (out-of-capacity
+       padding indices are dropped);
+    3. lanes still unclean after the budget re-run through the exact
+       full-retry program in ONE global batch after the scan (capacity
+       max(n/bad2_div, 2048)) — the full program's while_loop overhead
+       is paid once per sweep, not once per chunk.
+
+    Healthy maps run ~6% unclean after stage 1 and ~0.006% after stage
+    2, far under the 12.5% / 0.05%+floor default capacities; if the
+    sweep overflows either capacity, the returned flag is True and the
+    caller must fall back to sweep() (results would be incomplete, not
+    wrong: overflowed lanes keep their earlier-stage placement, which
+    may differ from full retry).  bad_div=1, bad2_div=1 gives full
+    capacity at every stage (exact on any map, at full-program cost
+    for the fixup batches).
 
     xs length must be a multiple of `chunk` (callers pad; the bench
     repeats ids).  Returns (placements i32 [N, result_max] ON DEVICE,
@@ -1199,16 +1427,24 @@ def sweep_device(
     chunk = min(chunk, n)
     assert n % chunk == 0, (n, chunk)
     cap = max(1, chunk // bad_div)
+    # global stage-3 capacity: residue is ~0.006% on healthy maps; the
+    # floor keeps small sweeps from starving the exact stage
+    cap2 = min(n, max(n // bad2_div, 2048))
 
     # the jitted runner is cached process-wide (like compile_rule):
     # a fresh jax.jit wrapper per call would re-trace + re-compile on
     # EVERY call, so repeated sweeps would time XLA, not the sweep
+    import os
+
     key = (_rule_digest(flat, steps, result_max, choose_args),
-           "sweep_device", n, chunk, cap)
+           "sweep_device", n, chunk, cap, cap2,
+           os.environ.get("CEPH_TPU_CRUSH_NO_FASTCMP") == "1")
     run = _compiled_rules.get(key)
     if run is None:
         fast = compile_rule(flat, steps, result_max, choose_args,
                             one_shot=True)
+        mid = compile_rule(flat, steps, result_max, choose_args,
+                           one_shot=True, budget=MID_BUDGET)
         slow = compile_rule(flat, steps, result_max, choose_args)
 
         @jax.jit
@@ -1220,13 +1456,26 @@ def sweep_device(
                 # padding lanes (index==chunk) clamp to chunk-1 and
                 # recompute sub[chunk-1]; their scatter is dropped
                 bad_xs = sub[jnp.minimum(bad, chunk - 1)]
-                fixed = slow(bad_xs, w)
-                res = res.at[bad].set(fixed, mode="drop")
-                return overflow | (n_bad > cap), res
+                res2, clean2 = mid(bad_xs, w)
+                res = res.at[bad].set(res2, mode="drop")
+                # residual mask back in chunk shape (padding dropped);
+                # the exact full-program fixup runs ONCE over the whole
+                # sweep after the scan — its while_loop overhead is per
+                # batch, not per chunk
+                resid = jnp.zeros((chunk,), jnp.bool_).at[bad].set(
+                    ~clean2, mode="drop")
+                return overflow | (n_bad > cap), (res, resid)
 
-            overflow, out = jax.lax.scan(
+            overflow, (out, resids) = jax.lax.scan(
                 body, jnp.asarray(False), xs2.reshape(-1, chunk))
-            return out.reshape(n, result_max), overflow
+            out = out.reshape(n, result_max)
+            resid_all = resids.reshape(n)
+            n3 = jnp.sum(resid_all)
+            b3 = jnp.nonzero(resid_all, size=cap2, fill_value=n)[0]
+            xs3 = xs2[jnp.minimum(b3, n - 1)]
+            fixed = slow(xs3, w)
+            out = out.at[b3].set(fixed, mode="drop")
+            return out, overflow | (n3 > cap2)
 
         _compiled_rules[key] = run
         if len(_compiled_rules) > 256:

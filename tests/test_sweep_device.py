@@ -53,7 +53,8 @@ def test_sweep_device_single_chunk_whole_batch():
     xs = np.arange(2048, dtype=np.int32)
     want = mapper.sweep(flat, steps, nrep, xs, dev_w)
     # 4 hosts / 3 reps: the majority of lanes retry -> full capacity
+    # at BOTH fixup stages
     got, overflow = mapper.sweep_device(flat, steps, nrep, xs, dev_w,
-                                        bad_div=1)
+                                        bad_div=1, bad2_div=1)
     assert not bool(overflow)
     np.testing.assert_array_equal(np.asarray(got), want)

@@ -50,15 +50,22 @@ def main():
 
     w3 = gen_planes(K, T)
 
-    # correctness pin on the head of the batch (small fetch)
-    got3 = np.asarray(gf256_pallas.encode_planes(
-        coding, w3[:, :8, :], tile=8, interpret=None))
+    # correctness pin on the head of the batch (small fetch); guarded:
+    # a rig that rejects the Pallas kernel must still produce the XLA
+    # engine's numbers (only the Pallas rows are skipped then)
     i_host = np.arange(K * T * LANES, dtype=np.uint32).reshape(K, T, LANES)
     x_host = mix_np(i_host)[:, :8, :]
     xb = np.ascontiguousarray(x_host).view(np.uint8).reshape(K, -1)
     want = _native.rs_encode(coding.astype(np.uint8), xb)
-    assert np.array_equal(gf256_pallas.unpack_planes(got3), want), \
-        "encode != oracle"
+    try:
+        got3 = np.asarray(gf256_pallas.encode_planes(
+            coding, w3[:, :8, :], tile=8, interpret=None))
+        assert np.array_equal(gf256_pallas.unpack_planes(got3), want), \
+            "encode != oracle"
+        pallas_ok = True
+    except Exception as e:
+        out["pallas_pin"] = f"error: {e!r}"[:160]
+        pallas_ok = False
 
     from ceph_tpu.ops.benchloop import loop_rate_gbps
 
@@ -84,16 +91,18 @@ def main():
 
     guarded("encode_16mib_xla_gbps", lambda: engine_rate(
         xla_swar_engine(net, M)))
-    guarded("encode_16mib_pallas_gbps", lambda: engine_rate(
-        lambda w, s: gf256_pallas.encode_planes(coding, w, s, tile=512,
-                                                interpret=False)))
+    if pallas_ok:
+        guarded("encode_16mib_pallas_gbps", lambda: engine_rate(
+            lambda w, s: gf256_pallas.encode_planes(
+                coding, w, s, tile=512, interpret=False)))
 
-    # interleaved layout (contiguous per-step DMA)
-    w3i = jnp.transpose(w3, (1, 0, 2))
-    guarded("encode_16mib_pallas_inter_gbps", lambda: round(loop_rate_gbps(
-        lambda w, s: gf256_pallas.encode_planes_interleaved(
-            coding, w, s, tile=512, interpret=False),
-        w3i, (T, M, LANES), 30, size), 2))
+        # interleaved layout (contiguous per-step DMA)
+        w3i = jnp.transpose(w3, (1, 0, 2))
+        guarded("encode_16mib_pallas_inter_gbps",
+                lambda: round(loop_rate_gbps(
+                    lambda w, s: gf256_pallas.encode_planes_interleaved(
+                        coding, w, s, tile=512, interpret=False),
+                    w3i, (T, M, LANES), 30, size), 2))
 
     def crush_rate():
         from ceph_tpu.crush import map as cmap
