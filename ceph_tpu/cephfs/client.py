@@ -164,7 +164,25 @@ class FSClient(Dispatcher):
         self._request("rmdir", path)
 
     def stat(self, path: str) -> dict:
-        return self._request("stat", path).data["inode"]
+        rep = self._request("stat", path)
+        snapc = rep.data.get("snapc")
+        if snapc is not None:
+            # realm SnapContext piggybacked on the reply: the next data
+            # write on this ioctx clones what live snapshots cover
+            self.io.set_snap_context(int(snapc[0]),
+                                     [int(s) for s in snapc[1]])
+        return rep.data["inode"]
+
+    # -- snapshots (.snap semantics via the MDS; journaled there) ---------
+    def mksnap(self, path: str, name: str) -> int:
+        return int(self._request("mksnap", path,
+                                 {"name": name}).data["snapid"])
+
+    def rmsnap(self, path: str, name: str) -> None:
+        self._request("rmsnap", path, {"name": name})
+
+    def lssnap(self, path: str) -> List[str]:
+        return self._request("lssnap", path).data["names"]
 
     def unlink(self, path: str) -> None:
         self._request("unlink", path)
@@ -220,6 +238,8 @@ class FSClient(Dispatcher):
             inode = self.create(path, wants=CAP_RD | CAP_WR)
         if inode["type"] != "file":
             raise MDSError(-21, "is a directory")  # EISDIR
+        if inode.get("snapid"):
+            raise MDSError(-30, "snapshots are read-only")  # EROFS
         self.striper.write(CephFS._data_oid(inode["ino"]), data, off=off)
         new_size = max(inode.get("size", 0), off + len(data))
         self._request("setattr", path,
@@ -236,7 +256,9 @@ class FSClient(Dispatcher):
             return b""
         try:
             got = self.striper.read(CephFS._data_oid(inode["ino"]),
-                                    length, off)
+                                    length, off,
+                                    snapid=inode.get("snapid", 0),
+                                    size=size)
         except RadosError as e:
             if e.rc != -2:
                 raise

@@ -86,6 +86,10 @@ def _register_fs_cls() -> None:
 _register_fs_cls()
 
 
+class ReadOnlyFS(FSError):
+    pass
+
+
 class CephFS:
     def __init__(self, ioctx: IoCtx, stripe_unit: int = 65536,
                  object_size: int = 4 << 20) -> None:
@@ -93,6 +97,12 @@ class CephFS:
         self.striper = RadosStriper(ioctx, stripe_unit=stripe_unit,
                                     stripe_count=4,
                                     object_size=object_size)
+        # snapshot registry cache (path -> {name: snapid}); small TTL —
+        # the realm snapc consulted on writes tolerates the same
+        # bounded staleness the reference's client cap cache does
+        self._snap_cache: Tuple[float, Dict[str, Dict[str, int]]] = \
+            (0.0, {})
+        self.snap_ttl = 0.5
         self._mkroot()
 
     # -- layout ------------------------------------------------------------
@@ -128,8 +138,206 @@ class CephFS:
         # as ONE in-OSD cls op, so concurrent clients never collide
         return int(self.io.call("fs.meta", "fsdir", "alloc_ino"))
 
+    # -- snapshots (reference SnapRealm / .snap semantics,
+    # src/mds/SnapRealm.h + snap.cc re-derived): a snapshot of a
+    # directory freezes that subtree.  Metadata is frozen eagerly
+    # (dentry tables are small: copied to fs.snap.<id>.dir.* objects);
+    # file DATA is copy-on-write via the OSD's self-managed snapshots —
+    # writes under a snapped subtree carry the subtree's realm
+    # SnapContext, so the OSD clones old data on first overwrite, and
+    # `.snap/<name>/...` reads fetch the clone (striper snapid reads,
+    # the same machinery RBD snapshots ride) ---------------------------
+    SNAP_DIR = ".snap"
+
+    @staticmethod
+    def _snap_key(path: str, name: str) -> str:
+        return f"fssnap.{CephFS._norm(path)}//{name}"
+
+    @staticmethod
+    def _snap_dir_oid(snapid: int, path: str) -> str:
+        return f"fs.snap.{snapid}.dir.{CephFS._norm(path)}"
+
+    def _snap_registry(self) -> Dict[str, Dict[str, int]]:
+        """{dir_path: {snap_name: snapid}} from fs.meta (TTL-cached)."""
+        stamp, table = self._snap_cache
+        now = time.time()
+        if now - stamp <= self.snap_ttl:
+            return table
+        try:
+            om = self.io.omap_get("fs.meta")
+        except RadosError:
+            om = {}
+        table = {}
+        for k, v in om.items():
+            if not k.startswith("fssnap."):
+                continue
+            p, _, name = k[len("fssnap."):].rpartition("//")
+            table.setdefault(p, {})[name] = int(json.loads(
+                v.decode())["snapid"])
+        self._snap_cache = (now, table)
+        return table
+
+    def _invalidate_snaps(self) -> None:
+        self._snap_cache = (0.0, {})
+
+    def _realm_snapc(self, path: str) -> Tuple[int, List[int]]:
+        """SnapContext covering `path`: snapids of every snapshot taken
+        on it or any ancestor (the reference's realm resolution,
+        SnapRealm::get_snap_context)."""
+        p = self._norm(path)
+        reg = self._snap_registry()
+        ids: List[int] = []
+        for dirp, snaps in reg.items():
+            if p == dirp or p.startswith(dirp.rstrip("/") + "/"):
+                ids.extend(snaps.values())
+        ids.sort(reverse=True)
+        return (ids[0] if ids else 0, ids)
+
+    def _with_realm(self, path: str):
+        """Context manager: point the ioctx snap context at the path's
+        realm for the duration of a data mutation, so the OSD clones
+        exactly the objects a live snapshot covers (no pool-wide
+        cloning, no leaked clones)."""
+        import contextlib
+
+        fs = self
+
+        @contextlib.contextmanager
+        def cm():
+            saved = (fs.io.snap_seq, list(fs.io.snaps))
+            seq, ids = fs._realm_snapc(path)
+            fs.io.set_snap_context(seq, ids)
+            try:
+                yield
+            finally:
+                fs.io.set_snap_context(*saved)
+        return cm()
+
+    def _split_snap(self, path: str
+                    ) -> Optional[Tuple[str, str, str]]:
+        """`/a/b/.snap/name/rest` -> (/a/b, name, rest); None when the
+        path has no .snap component."""
+        p = self._norm(path)
+        parts = [q for q in p.split("/") if q]
+        if self.SNAP_DIR not in parts:
+            return None
+        i = parts.index(self.SNAP_DIR)
+        base = "/" + "/".join(parts[:i])
+        name = parts[i + 1] if len(parts) > i + 1 else ""
+        rest = "/".join(parts[i + 2:])
+        return self._norm(base), name, rest
+
+    def _snap_id(self, base: str, name: str) -> int:
+        reg = self._snap_registry()
+        snaps = reg.get(self._norm(base), {})
+        if name not in snaps:
+            raise NoSuchEntry(f"{base}/.snap/{name}")
+        return snaps[name]
+
+    def _snap_lookup(self, base: str, name: str, rest: str) -> Dict:
+        sid = self._snap_id(base, name)
+        if not rest:
+            return {"type": "dir", "ino": 0, "snapid": sid}
+        full = self._norm(base + "/" + rest)
+        parent = posixpath.dirname(full)
+        leaf = posixpath.basename(full)
+        try:
+            got = self.io.omap_get(self._snap_dir_oid(sid, parent),
+                                   [leaf])
+        except RadosError:
+            raise NoSuchEntry(f"{base}/.snap/{name}/{rest}")
+        if leaf not in got:
+            raise NoSuchEntry(f"{base}/.snap/{name}/{rest}")
+        ent = json.loads(got[leaf].decode())
+        ent["snapid"] = sid
+        return ent
+
+    def _freeze_tree(self, snapid: int, path: str) -> None:
+        """Copy the subtree's dentry tables into the snapshot
+        namespace (idempotent: plain overwrites)."""
+        p = self._norm(path)
+        try:
+            kv = self.io.omap_get(self._dir_oid(p))
+        except RadosError:
+            kv = {}
+        self.io.write_full(self._snap_dir_oid(snapid, p), b"")
+        if kv:
+            self.io.omap_set(self._snap_dir_oid(snapid, p), kv)
+        for nm, blob in kv.items():
+            child = json.loads(blob.decode())
+            if child.get("type") == "dir":
+                self._freeze_tree(snapid, f"{p}/{nm}")
+
+    def mksnap(self, path: str, name: str,
+               snapid: Optional[int] = None) -> int:
+        """Snapshot the subtree at `path` as `.snap/<name>`.  Returns
+        the snapid.  `snapid` is passed on journal replay so the apply
+        is idempotent (a fresh call allocates)."""
+        p = self._norm(path)
+        if not name or "/" in name or name == self.SNAP_DIR:
+            raise FSError(-22, f"bad snapshot name {name!r}")
+        if self._lookup(p)["type"] != "dir":
+            raise NotADirectory(p)
+        key = self._snap_key(p, name)
+        existing = self.io.omap_get("fs.meta", [key])
+        if key in existing:
+            if snapid is not None:  # replay of an applied event
+                return int(json.loads(existing[key].decode())["snapid"])
+            raise FSError(-17, f"snapshot {name!r} exists")  # EEXIST
+        if snapid is None:
+            snapid = self.io.selfmanaged_snap_create()
+        self._freeze_tree(snapid, p)
+        self.io.omap_set("fs.meta", {key: json.dumps(
+            {"snapid": snapid, "created": time.time()}).encode()})
+        self._invalidate_snaps()
+        return snapid
+
+    def rmsnap(self, path: str, name: str) -> None:
+        """Delete a snapshot: trim every covered file's data clones,
+        drop the frozen dentry tables, unregister."""
+        p = self._norm(path)
+        sid = self._snap_id(p, name)
+        self._trim_tree(sid, p)
+        self.io.omap_rm("fs.meta", [self._snap_key(p, name)])
+        self._invalidate_snaps()
+
+    def _trim_tree(self, snapid: int, path: str) -> None:
+        p = self._norm(path)
+        oid = self._snap_dir_oid(snapid, p)
+        try:
+            kv = self.io.omap_get(oid)
+        except RadosError:
+            kv = {}
+        for nm, blob in kv.items():
+            ent = json.loads(blob.decode())
+            if ent.get("type") == "dir":
+                self._trim_tree(snapid, f"{p}/{nm}")
+            elif ent.get("type") == "file":
+                self._trim_file(snapid, ent)
+        try:
+            self.io.remove(oid)
+        except RadosError:
+            pass
+
+    def _trim_file(self, snapid: int, ent: Dict) -> None:
+        soid = self._data_oid(ent["ino"])
+        size = max(ent.get("size", 0), 1)
+        for comp in self.striper.component_oids(soid, size):
+            try:
+                self.io.snap_trim(comp, snapid)
+            except RadosError:
+                pass
+
+    def snaps(self, path: str) -> List[str]:
+        """Snapshot names on `path` (the .snap dir listing)."""
+        self._lookup(path)
+        return sorted(self._snap_registry().get(self._norm(path), {}))
+
     def _lookup(self, path: str) -> Dict:
         p = self._norm(path)
+        sp = self._split_snap(p)
+        if sp is not None:
+            return self._snap_lookup(*sp)
         if p == "/":
             return {"type": "dir", "ino": 0}
         parent, name = self._split(p)
@@ -157,9 +365,17 @@ class CephFS:
             raise
         return json.loads(got.decode())
 
+    def _deny_snap_write(self, *paths: str) -> None:
+        for p in paths:
+            if self._split_snap(p) is not None:
+                raise ReadOnlyFS(-30, f"{p}: snapshots are read-only")
+
     # -- directories -------------------------------------------------------
     def mkdir(self, path: str) -> None:
+        self._deny_snap_write(path)
         parent, name = self._split(path)
+        if name == self.SNAP_DIR:
+            raise FSError(-22, ".snap is reserved")
         if self._lookup(parent)["type"] != "dir":
             raise NotADirectory(parent)
         self.io.write_full(self._dir_oid(path), b"")
@@ -167,6 +383,21 @@ class CephFS:
                                   "mtime": time.time()})
 
     def listdir(self, path: str) -> List[str]:
+        sp = self._split_snap(path)
+        if sp is not None:
+            base, name, rest = sp
+            if not name:  # "/a/.snap" lists the snapshots themselves
+                return self.snaps(base)
+            sid = self._snap_id(base, name)
+            full = self._norm(base + ("/" + rest if rest else ""))
+            ent = self._snap_lookup(base, name, rest)
+            if ent["type"] != "dir":
+                raise NotADirectory(path)
+            try:
+                return sorted(self.io.omap_get(
+                    self._snap_dir_oid(sid, full)))
+            except RadosError:
+                raise NoSuchEntry(path)
         ent = self._lookup(path)
         if ent["type"] != "dir":
             raise NotADirectory(path)
@@ -176,8 +407,11 @@ class CephFS:
             raise NoSuchEntry(path)
 
     def rmdir(self, path: str) -> None:
+        self._deny_snap_write(path)
         if self.listdir(path):
             raise NotEmpty(path)
+        if self.snaps(path):
+            raise NotEmpty(f"{path} has snapshots")
         parent, name = self._split(path)
         self._unlink(parent, name)
         try:
@@ -187,14 +421,18 @@ class CephFS:
 
     # -- files -------------------------------------------------------------
     def write(self, path: str, data: bytes, off: int = 0) -> int:
+        self._deny_snap_write(path)
         parent, name = self._split(path)
+        if name == self.SNAP_DIR:
+            raise FSError(-22, ".snap is reserved")
         try:
             ent = self._lookup(path)
             if ent["type"] == "dir":
                 raise IsADirectory(path)
         except NoSuchEntry:
             ent = {"type": "file", "ino": self._next_ino(), "size": 0}
-        self.striper.write(self._data_oid(ent["ino"]), data, off=off)
+        with self._with_realm(path):
+            self.striper.write(self._data_oid(ent["ino"]), data, off=off)
         ent["size"] = max(ent.get("size", 0), off + len(data))
         ent["mtime"] = time.time()
         self._link(parent, name, ent, replace=True)
@@ -211,7 +449,9 @@ class CephFS:
             length = size - off
         try:
             got = self.striper.read(self._data_oid(ent["ino"]),
-                                    length, off)
+                                    length, off,
+                                    snapid=ent.get("snapid", 0),
+                                    size=size)
         except RadosError:
             got = b""
         if len(got) < length:
@@ -224,6 +464,7 @@ class CephFS:
     # -- symlinks (reference Client::symlink/readlink; the target lives
     # in the dentry inode like the MDS's inline symlink target) -----------
     def symlink(self, target: str, linkpath: str) -> None:
+        self._deny_snap_write(linkpath)
         parent, name = self._split(linkpath)
         if self._lookup(parent)["type"] != "dir":
             raise NotADirectory(parent)
@@ -284,23 +525,29 @@ class CephFS:
         return self.resolve(target, _depth + 1)
 
     def unlink(self, path: str) -> None:
+        self._deny_snap_write(path)
         ent = self._lookup(path)
         if ent["type"] == "dir":
             raise IsADirectory(path)
         parent, name = self._split(path)
         self._unlink(parent, name)
         try:
-            self.striper.remove(self._data_oid(ent["ino"]))
+            # under a live realm the OSD whiteouts the head and keeps
+            # the clones, so .snap reads survive the unlink
+            with self._with_realm(path):
+                self.striper.remove(self._data_oid(ent["ino"]))
         except RadosError:
             pass
 
     def truncate(self, path: str, size: int) -> None:
+        self._deny_snap_write(path)
         parent, name = self._split(path)
         ent = self._lookup(path)
         if ent["type"] == "dir":
             raise IsADirectory(path)
         try:
-            self.striper.truncate(self._data_oid(ent["ino"]), size)
+            with self._with_realm(path):
+                self.striper.truncate(self._data_oid(ent["ino"]), size)
         except RadosError:
             pass
         ent["size"] = size
@@ -312,6 +559,7 @@ class CephFS:
         Directory renames move the WHOLE subtree's dentry-table
         objects — tables are keyed by absolute path, so every
         descendant directory relocates too."""
+        self._deny_snap_write(src, dst)
         sp, sn = self._split(src)
         dp, dn = self._split(dst)
         ent = self._lookup(src)

@@ -31,7 +31,7 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.cephfs import messages as cm
-from ceph_tpu.cephfs.fs import CephFS, FSError, NoSuchEntry
+from ceph_tpu.cephfs.fs import CephFS, FSError, NoSuchEntry, ReadOnlyFS
 from ceph_tpu.client.rados import IoCtx, RadosError
 from ceph_tpu.msg.message import EntityName, Message
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
@@ -213,6 +213,20 @@ class MDSDaemon(Dispatcher):
             except NoSuchEntry:
                 self._step()
                 fs.symlink(ev["target"], ev["path"])
+        elif op == "mksnap":
+            # snapid journaled at submit time -> replay re-freezes with
+            # the SAME id (freeze-copy is plain overwrites, idempotent)
+            self._step()
+            try:
+                fs.mksnap(ev["path"], ev["name"], snapid=ev["snapid"])
+            except (FSError, RadosError):
+                pass
+        elif op == "rmsnap":
+            self._step()
+            try:
+                fs.rmsnap(ev["path"], ev["name"])
+            except (FSError, RadosError):
+                pass  # already removed: replayed event
         else:
             self._log(1, f"mds: unknown journal op {op!r}")
 
@@ -325,6 +339,8 @@ class MDSDaemon(Dispatcher):
             return  # injected crash: no reply, daemon is "dead"
         except NoSuchEntry:
             rep = cm.MClientReply(ENOENT)
+        except ReadOnlyFS as e:
+            rep = cm.MClientReply(-30, {"error": str(e)})  # EROFS
         except FSError as e:
             rep = cm.MClientReply(EINVAL, {"error": str(e)})
         except RadosError as e:
@@ -384,7 +400,35 @@ class MDSDaemon(Dispatcher):
                 self.caps.get(path, {}).pop(args["client"], None)
             return cm.MClientReply(0)
         if op == "stat":
-            return cm.MClientReply(0, {"inode": self.fs._lookup(path)})
+            # the reply carries the path's realm SnapContext so the
+            # client's next data write clones exactly what live
+            # snapshots cover (client.write stats first, so every
+            # write sees a fresh realm — the SnapRealm propagation
+            # the reference pushes through cap messages)
+            seq, ids = self.fs._realm_snapc(path)
+            return cm.MClientReply(0, {"inode": self.fs._lookup(path),
+                                       "snapc": [seq, ids]})
+        if op == "mksnap":
+            name = args["name"]
+            self.fs._lookup(path)
+            # allocate OUTSIDE the journal append (ids are cheap; a
+            # crash between alloc and append just wastes one)
+            snapid = self.io.selfmanaged_snap_create()
+            key = self.fs._snap_key(path, name)
+            if key in self.io.omap_get("fs.meta", [key]):
+                return cm.MClientReply(EEXIST)
+            self._submit({"op": "mksnap", "path": path, "name": name,
+                          "snapid": snapid})
+            return cm.MClientReply(0, {"snapid": snapid})
+        if op == "rmsnap":
+            name = args["name"]
+            key = self.fs._snap_key(path, name)
+            if key not in self.io.omap_get("fs.meta", [key]):
+                return cm.MClientReply(ENOENT)
+            self._submit({"op": "rmsnap", "path": path, "name": name})
+            return cm.MClientReply(0)
+        if op == "lssnap":
+            return cm.MClientReply(0, {"names": self.fs.snaps(path)})
         if op == "listdir":
             return cm.MClientReply(0, {"names": self.fs.listdir(path)})
         if op == "unlink":

@@ -304,3 +304,6 @@ class IoCtx:
             oid, [OSDOp(t_.OP_OMAP_GET, keys=keys or [])])
         self._check(rep)
         return rep.ops[0].out_kv
+
+    def omap_rm(self, oid: str, keys: List[str]) -> None:
+        self._check(self.operate(oid, [OSDOp(t_.OP_OMAP_RM, keys=keys)]))

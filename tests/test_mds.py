@@ -269,3 +269,44 @@ def test_fsmap_through_mon():
         c.mds[1].boot(c.monmap)
         c.wait_for(lambda: c.fs_status()["ranks"]["1"]["up"],
                    what="rank 1 back up")
+
+
+def test_snapshots_through_mds_with_crash_replay(cluster, rc):
+    """mksnap is journaled: an MDS that dies right after appending the
+    mksnap event (before commit) replays it to the identical snapshot;
+    a SECOND client's post-snap write still clones (the realm snapc
+    rides the stat reply it makes before writing)."""
+    io = rc.rc.ioctx(REP_POOL)
+    mds = MDSDaemon(cluster.ctx, io, commit_every=1000)
+    c1 = _mount(cluster, rc, mds, "snap-c1")
+    c2 = _mount(cluster, rc, mds, "snap-c2")
+    try:
+        c1.mkdir("/sv")
+        c1.write("/sv/f", b"original")
+        sid = c1.mksnap("/sv", "s1")
+        assert sid > 0
+        assert c1.lssnap("/sv") == ["s1"]
+        # client 2 overwrites AFTER the snap: its write must clone
+        c2.write("/sv/f", b"CLOBBERED")
+        assert c1.read("/sv/.snap/s1/f") == b"original"
+        assert c2.read("/sv/f") == b"CLOBBERED"
+        # snapshots are read-only through the MDS too
+        with pytest.raises(MDSError) as ei:
+            c2.write("/sv/.snap/s1/f", b"nope")
+        assert ei.value.rc == -30  # EROFS
+        # crash (no journal commit) -> replay must keep the snapshot
+        mds.kill()
+        mds2 = MDSDaemon(cluster.ctx, io, commit_every=1000)
+        c3 = _mount(cluster, rc, mds2, "snap-c3")
+        try:
+            assert c3.lssnap("/sv") == ["s1"]
+            assert c3.read("/sv/.snap/s1/f") == b"original"
+            c3.rmsnap("/sv", "s1")
+            assert c3.lssnap("/sv") == []
+            assert c3.read("/sv/f") == b"CLOBBERED"
+        finally:
+            c3.shutdown()
+            mds2.shutdown()
+    finally:
+        c1.shutdown()
+        c2.shutdown()

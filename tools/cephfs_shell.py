@@ -113,6 +113,15 @@ def _run_lines(fs, scripts, tree) -> int:
                     out = []
                     tree(fs, rest[0] if rest else "/", 0, out)
                     print("\n".join(out))
+                elif cmd == "mksnap":
+                    fs.mksnap(rest[0], rest[1])
+                elif cmd == "rmsnap":
+                    fs.rmsnap(rest[0], rest[1])
+                elif cmd == "lssnap":
+                    names = (fs.lssnap(rest[0]) if hasattr(fs, "lssnap")
+                             else fs.snaps(rest[0]))
+                    for n in names:
+                        print(n)
                 else:
                     print(f"unknown command {cmd!r}", file=sys.stderr)
                     return 22
