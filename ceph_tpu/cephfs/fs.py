@@ -252,21 +252,28 @@ class CephFS:
         ent["snapid"] = sid
         return ent
 
-    def _freeze_tree(self, snapid: int, path: str) -> None:
-        """Copy the subtree's dentry tables into the snapshot
-        namespace (idempotent: plain overwrites)."""
+    def _tree_tables(self, path: str, oid_fn):
+        """Depth-first (dir_path, dentry_kv) walk over the dentry
+        tables rooted at `path`, read via oid_fn(path) — the ONE
+        subtree traversal freeze/trim/move all share."""
         p = self._norm(path)
         try:
-            kv = self.io.omap_get(self._dir_oid(p))
+            kv = self.io.omap_get(oid_fn(p))
         except RadosError:
             kv = {}
-        self.io.write_full(self._snap_dir_oid(snapid, p), b"")
-        if kv:
-            self.io.omap_set(self._snap_dir_oid(snapid, p), kv)
+        yield p, kv
         for nm, blob in kv.items():
             child = json.loads(blob.decode())
             if child.get("type") == "dir":
-                self._freeze_tree(snapid, f"{p}/{nm}")
+                yield from self._tree_tables(f"{p}/{nm}", oid_fn)
+
+    def _freeze_tree(self, snapid: int, path: str) -> None:
+        """Copy the subtree's dentry tables into the snapshot
+        namespace (idempotent: plain overwrites)."""
+        for p, kv in self._tree_tables(path, self._dir_oid):
+            self.io.write_full(self._snap_dir_oid(snapid, p), b"")
+            if kv:
+                self.io.omap_set(self._snap_dir_oid(snapid, p), kv)
 
     def mksnap(self, path: str, name: str,
                snapid: Optional[int] = None) -> int:
@@ -285,7 +292,14 @@ class CephFS:
                 return int(json.loads(existing[key].decode())["snapid"])
             raise FSError(-17, f"snapshot {name!r} exists")  # EEXIST
         if snapid is None:
+            # allocation must NOT leak into the ioctx's write context:
+            # selfmanaged_snap_create folds the new id into the global
+            # snapc, but realm scoping (_with_realm) is the ONLY place
+            # snap contexts belong — otherwise every later metadata/cls
+            # write clones pool-wide and rmsnap can't reclaim it
+            saved = (self.io.snap_seq, list(self.io.snaps))
             snapid = self.io.selfmanaged_snap_create()
+            self.io.set_snap_context(*saved)
         self._freeze_tree(snapid, p)
         self.io.omap_set("fs.meta", {key: json.dumps(
             {"snapid": snapid, "created": time.time()}).encode()})
@@ -302,22 +316,16 @@ class CephFS:
         self._invalidate_snaps()
 
     def _trim_tree(self, snapid: int, path: str) -> None:
-        p = self._norm(path)
-        oid = self._snap_dir_oid(snapid, p)
-        try:
-            kv = self.io.omap_get(oid)
-        except RadosError:
-            kv = {}
-        for nm, blob in kv.items():
-            ent = json.loads(blob.decode())
-            if ent.get("type") == "dir":
-                self._trim_tree(snapid, f"{p}/{nm}")
-            elif ent.get("type") == "file":
-                self._trim_file(snapid, ent)
-        try:
-            self.io.remove(oid)
-        except RadosError:
-            pass
+        oid_fn = lambda q: self._snap_dir_oid(snapid, q)  # noqa: E731
+        for p, kv in self._tree_tables(path, oid_fn):
+            for nm, blob in kv.items():
+                ent = json.loads(blob.decode())
+                if ent.get("type") == "file":
+                    self._trim_file(snapid, ent)
+            try:
+                self.io.remove(oid_fn(p))
+            except RadosError:
+                pass
 
     def _trim_file(self, snapid: int, ent: Dict) -> None:
         soid = self._data_oid(ent["ino"])
@@ -332,6 +340,16 @@ class CephFS:
         """Snapshot names on `path` (the .snap dir listing)."""
         self._lookup(path)
         return sorted(self._snap_registry().get(self._norm(path), {}))
+
+    def _subtree_has_snaps(self, path: str) -> bool:
+        """True when any directory at/under `path` has a snapshot —
+        registry keys are absolute paths, so such a subtree cannot be
+        renamed without detaching its snapshots."""
+        p = self._norm(path)
+        for dirp in self._snap_registry():
+            if dirp == p or dirp.startswith(p.rstrip("/") + "/"):
+                return True
+        return False
 
     def _lookup(self, path: str) -> Dict:
         p = self._norm(path)
@@ -560,6 +578,14 @@ class CephFS:
         objects — tables are keyed by absolute path, so every
         descendant directory relocates too."""
         self._deny_snap_write(src, dst)
+        # registry + frozen tables are keyed by absolute path: moving
+        # the tree would detach its snapshots (and a future dir at the
+        # old path would inherit them) — refuse, like rmdir of a
+        # snapped dir (reference: ENOTEMPTY).  Fresh registry read: a
+        # false allow from the TTL cache would lose snapshot COW.
+        self._invalidate_snaps()
+        if self._subtree_has_snaps(src):
+            raise NotEmpty(f"{src}: subtree has snapshots")
         sp, sn = self._split(src)
         dp, dn = self._split(dst)
         ent = self._lookup(src)
@@ -574,18 +600,14 @@ class CephFS:
     def _move_dir_tree(self, src: str, dst: str) -> None:
         """Depth-first copy of dentry tables src/* -> dst/*, then drop
         the old tables."""
-        try:
-            kv = self.io.omap_get(self._dir_oid(src))
-        except RadosError:
-            kv = {}
-        self.io.write_full(self._dir_oid(dst), b"")
-        if kv:
-            self.io.omap_set(self._dir_oid(dst), kv)
-        for name, blob in kv.items():
-            child = json.loads(blob.decode())
-            if child.get("type") == "dir":
-                self._move_dir_tree(f"{src}/{name}", f"{dst}/{name}")
-        try:
-            self.io.remove(self._dir_oid(src))
-        except RadosError:
-            pass
+        src = self._norm(src)
+        dst = self._norm(dst)
+        for p, kv in self._tree_tables(src, self._dir_oid):
+            dstp = dst + p[len(src):]
+            self.io.write_full(self._dir_oid(dstp), b"")
+            if kv:
+                self.io.omap_set(self._dir_oid(dstp), kv)
+            try:
+                self.io.remove(self._dir_oid(p))
+            except RadosError:
+                pass

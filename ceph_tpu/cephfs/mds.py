@@ -410,13 +410,25 @@ class MDSDaemon(Dispatcher):
                                        "snapc": [seq, ids]})
         if op == "mksnap":
             name = args["name"]
-            self.fs._lookup(path)
-            # allocate OUTSIDE the journal append (ids are cheap; a
-            # crash between alloc and append just wastes one)
-            snapid = self.io.selfmanaged_snap_create()
+            # full validation BEFORE journaling: _apply swallows
+            # FSErrors (idempotent-replay discipline), so a bogus event
+            # journaled here would ack a snapshot that never exists
+            if self.fs._lookup(path)["type"] != "dir":
+                return cm.MClientReply(-20)  # ENOTDIR
+            if (not name or "/" in name
+                    or name == self.fs.SNAP_DIR):
+                return cm.MClientReply(
+                    EINVAL, {"error": f"bad snapshot name {name!r}"})
             key = self.fs._snap_key(path, name)
             if key in self.io.omap_get("fs.meta", [key]):
                 return cm.MClientReply(EEXIST)
+            # allocate OUTSIDE the journal append (ids are cheap; a
+            # crash between alloc and append just wastes one) and
+            # restore the ioctx write context — realm scoping is the
+            # only place snapcs belong (see fs.mksnap)
+            saved = (self.io.snap_seq, list(self.io.snaps))
+            snapid = self.io.selfmanaged_snap_create()
+            self.io.set_snap_context(*saved)
             self._submit({"op": "mksnap", "path": path, "name": name,
                           "snapid": snapid})
             return cm.MClientReply(0, {"snapid": snapid})

@@ -161,8 +161,11 @@ class MgrDaemon:
         self.osdmap = None  # fed by whoever owns the map (mon/tests)
         self.last_collect = 0.0
         self._lock = threading.Lock()
+        from ceph_tpu.mgr.dashboard import DashboardModule
+
         for m in (StatusModule(self), PrometheusModule(self),
-                  CrashModule(self), BalancerModule(self)):
+                  CrashModule(self), BalancerModule(self),
+                  DashboardModule(self)):
             self.modules[m.name] = m
 
     def register_daemon(self, name: str, ctx) -> None:

@@ -298,8 +298,8 @@ def _guard_input(fn):
 
 def _register_extended_families(h: ClassHandler) -> None:
     """The remaining reference cls families this framework models
-    (reference /root/reference/src/cls/: journal, numops, timeindex —
-    user/otp/lua have no meaningful analog here)."""
+    (reference /root/reference/src/cls/: journal, numops, timeindex,
+    otp — user/lua have no meaningful analog here)."""
     import json as _json
     import time as _time
 
@@ -445,4 +445,106 @@ def _register_extended_families(h: ClassHandler) -> None:
     h.register("timeindex", "add", CLS_RD | CLS_WR, timeindex_add)
     h.register("timeindex", "list", CLS_RD, timeindex_list)
     h.register("timeindex", "trim", CLS_RD | CLS_WR, timeindex_trim)
+
+    # cls_otp (reference src/cls/otp/cls_otp.cc): RFC-6238 TOTP tokens
+    # verified INSIDE the OSD so the seed never leaves the object and
+    # replay checks are atomic in the PG write pipeline.  A token is
+    # {id, seed(hex), step, window, digits}; check() accepts a code if
+    # it matches any step within +/-window and that step is NEWER than
+    # the last accepted one (replay protection, the reference's
+    # last_success bookkeeping).
+    import hashlib as _hashlib
+    import hmac as _hmac
+    import struct as _struct
+
+    def _totp(seed: bytes, counter: int, digits: int) -> str:
+        mac = _hmac.new(seed, _struct.pack(">Q", counter),
+                        _hashlib.sha1).digest()
+        off = mac[-1] & 0xF
+        code = (_struct.unpack(">I", mac[off:off + 4])[0]
+                & 0x7FFFFFFF) % (10 ** digits)
+        return f"{code:0{digits}d}"
+
+    def _otp_key(tid: str) -> str:
+        return f"otp.{tid}"
+
+    @_guard_input
+    def otp_set(ctx: MethodContext, indata: bytes) -> bytes:
+        req = _json.loads(indata.decode())
+        tid, seed = req["id"], req["seed"]
+        try:
+            bytes.fromhex(seed)
+        except ValueError:
+            raise ClsError(-22, "seed must be hex")
+        tok = {"id": tid, "seed": seed,
+               "step": int(req.get("step", 30)),
+               "window": int(req.get("window", 1)),
+               "digits": int(req.get("digits", 6)),
+               "last_counter": -1}
+        if tok["step"] <= 0 or not 6 <= tok["digits"] <= 10:
+            raise ClsError(-22, "bad step/digits")
+        ctx.omap_set({_otp_key(tid): _json.dumps(tok).encode()})
+        return b""
+
+    @_guard_input
+    def otp_remove(ctx: MethodContext, indata: bytes) -> bytes:
+        key = _otp_key(indata.decode())
+        if key not in ctx.omap_get([key]):
+            raise ClsError(-2, "no such token")
+        ctx.omap_rm([key])
+        return b""
+
+    @_guard_input
+    def otp_list(ctx: MethodContext, indata: bytes) -> bytes:
+        if not ctx.exists:
+            return b"[]"
+        ids = [k[len("otp."):] for k in sorted(ctx.omap_get())
+               if k.startswith("otp.")]
+        return _json.dumps(ids).encode()
+
+    @_guard_input
+    def otp_check(ctx: MethodContext, indata: bytes) -> bytes:
+        req = _json.loads(indata.decode())
+        key = _otp_key(req["id"])
+        got = ctx.omap_get([key])
+        if key not in got:
+            raise ClsError(-2, "no such token")
+        tok = _json.loads(got[key].decode())
+        now = float(req.get("now", _time.time()))
+        counter = int(now // tok["step"])
+        seed = bytes.fromhex(tok["seed"])
+        code = str(req["code"])
+        result = "fail"
+        for c in range(counter - tok["window"],
+                       counter + tok["window"] + 1):
+            if c < 0 or not _hmac.compare_digest(
+                    _totp(seed, c, tok["digits"]), code):
+                continue
+            if c <= tok["last_counter"]:
+                result = "replay"  # code already consumed
+                break
+            tok["last_counter"] = c
+            result = "ok"
+            break
+        tok["last_check"] = now
+        tok["last_result"] = result
+        ctx.omap_set({key: _json.dumps(tok).encode()})
+        return result.encode()
+
+    @_guard_input
+    def otp_get_result(ctx: MethodContext, indata: bytes) -> bytes:
+        key = _otp_key(indata.decode())
+        got = ctx.omap_get([key])
+        if key not in got:
+            raise ClsError(-2, "no such token")
+        tok = _json.loads(got[key].decode())
+        return _json.dumps({
+            "last_check": tok.get("last_check"),
+            "last_result": tok.get("last_result", "none")}).encode()
+
+    h.register("otp", "set", CLS_RD | CLS_WR, otp_set)
+    h.register("otp", "remove", CLS_RD | CLS_WR, otp_remove)
+    h.register("otp", "list", CLS_RD, otp_list)
+    h.register("otp", "check", CLS_RD | CLS_WR, otp_check)
+    h.register("otp", "get_result", CLS_RD, otp_get_result)
 

@@ -101,6 +101,35 @@ class VStartCluster:
         if wait:
             self.wait_for_up()
 
+    # -- mgr (reference vstart.sh always starts one) ----------------------
+    def start_mgr(self, dashboard: bool = False,
+                  dashboard_port: int = 0):
+        """Start the in-process mgr: every daemon's perf counters are
+        registered, and `dashboard=True` serves the HTTP status UI /
+        JSON API / prometheus endpoint (returns the MgrDaemon; its
+        dashboard port is in mgr.modules['dashboard'].port)."""
+        from ceph_tpu.mgr.manager import MgrDaemon
+
+        mgr = MgrDaemon(self.ctx)
+        # vstart daemons often share one Context (one perf collection):
+        # register each DISTINCT context once so counters aren't
+        # duplicated under every daemon label
+        pairs = [(f"mon.{r}", self.ctx) for r in range(len(self.mons))]
+        pairs += [(f"osd.{i}", svc.ctx) for i, svc in self.osds.items()]
+        seen: Dict[int, str] = {}
+        for name, dctx in pairs:
+            if id(dctx) in seen:
+                continue
+            label = "cluster" if dctx is self.ctx else name
+            seen[id(dctx)] = label
+            mgr.register_daemon(label, dctx)
+        mgr.osdmap = self.leader().osdmap
+        if dashboard:
+            mgr.modules["dashboard"].serve(
+                port=dashboard_port, mon_command=self.command)
+        self.mgr = mgr
+        return mgr
+
     # -- MDS (the cephfs metadata tier; reference vstart.sh -m) -----------
     def start_mds(self, pool_name: str = "cephfs_meta", ranks: int = 1,
                   size: int = 2):
@@ -258,6 +287,12 @@ class VStartCluster:
         self.osds[i] = svc
 
     def shutdown(self) -> None:
+        mgr = getattr(self, "mgr", None)
+        if mgr is not None:
+            try:
+                mgr.modules["dashboard"].stop()
+            except Exception:
+                pass
         for d in self.mds.values():
             try:
                 d.shutdown()

@@ -178,3 +178,35 @@ def test_large_striped_file_snapshot(fs):
     fs.write("/big/blob", v2)
     assert fs.read("/big/.snap/s/blob") == v1
     assert fs.read("/big/blob") == v2
+
+
+def test_rename_denied_with_live_snapshots(fs):
+    """Registry/frozen tables are path-keyed: renaming a snapped
+    subtree would detach the snapshots (and a later dir at the old
+    path would inherit them) — refused like rmdir (review find)."""
+    _wipe(fs)
+    fs.mkdir("/mv")
+    fs.mkdir("/mv/sub")
+    fs.write("/mv/sub/f", b"keep")
+    fs.mksnap("/mv/sub", "s")  # snap on a DESCENDANT
+    with pytest.raises(FSError):
+        fs.rename("/mv", "/mv2")
+    with pytest.raises(FSError):
+        fs.rename("/mv/sub", "/mv/sub2")
+    # files inside still rename-able once the snapshot is gone
+    fs.rmsnap("/mv/sub", "s")
+    fs.rename("/mv", "/mv2")
+    assert fs.read("/mv2/sub/f") == b"keep"
+
+
+def test_mksnap_does_not_leak_snapc_into_ioctx(fs):
+    """selfmanaged_snap_create folds the id into the ioctx write
+    context; mksnap must restore it — otherwise EVERY later write
+    (metadata included) clones pool-wide (review find)."""
+    _wipe(fs)
+    fs.mkdir("/leak")
+    before = (fs.io.snap_seq, list(fs.io.snaps))
+    fs.mksnap("/leak", "s")
+    assert (fs.io.snap_seq, list(fs.io.snaps)) == before
+    fs.rmsnap("/leak", "s")
+    assert (fs.io.snap_seq, list(fs.io.snaps)) == before

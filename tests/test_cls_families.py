@@ -176,3 +176,61 @@ def test_ec_plugin_successful_third_party_load():
     finally:
         del sys.modules["fake_ec_good"]
         reg._factories.pop("thirdparty", None)
+
+
+# -- cls_otp ----------------------------------------------------------------
+
+def _totp_ref(seed_hex: str, t: float, step: int = 30,
+              digits: int = 6) -> str:
+    """Independent RFC-6238 computation for the test side."""
+    import hashlib
+    import hmac
+    import struct
+
+    counter = int(t // step)
+    mac = hmac.new(bytes.fromhex(seed_hex), struct.pack(">Q", counter),
+                   hashlib.sha1).digest()
+    off = mac[-1] & 0xF
+    code = (struct.unpack(">I", mac[off:off + 4])[0]
+            & 0x7FFFFFFF) % (10 ** digits)
+    return f"{code:0{digits}d}"
+
+
+def test_cls_otp(io):
+    oid = "otp_store"
+    seed = "3132333435363738393031323334353637383930"  # RFC 6238 vector
+    io.call(oid, "otp", "set",
+            json.dumps({"id": "tok1", "seed": seed}).encode())
+    assert json.loads(io.call(oid, "otp", "list").decode()) == ["tok1"]
+
+    now = 1_700_000_000.0
+    good = _totp_ref(seed, now)
+    assert io.call(oid, "otp", "check", json.dumps(
+        {"id": "tok1", "code": good, "now": now}).encode()) == b"ok"
+    # replay: the same code is consumed
+    assert io.call(oid, "otp", "check", json.dumps(
+        {"id": "tok1", "code": good, "now": now}).encode()) == b"replay"
+    # wrong code fails
+    bad = f"{(int(good) + 1) % 1_000_000:06d}"
+    assert io.call(oid, "otp", "check", json.dumps(
+        {"id": "tok1", "code": bad, "now": now}).encode()) == b"fail"
+    res = json.loads(io.call(oid, "otp", "get_result", b"tok1").decode())
+    assert res["last_result"] == "fail"
+    # next step's code works (monotonic counter)
+    nxt = _totp_ref(seed, now + 30)
+    assert io.call(oid, "otp", "check", json.dumps(
+        {"id": "tok1", "code": nxt, "now": now + 30}).encode()) == b"ok"
+    # window: a code one step old is accepted once
+    now2 = now + 300
+    prev = _totp_ref(seed, now2 - 30)
+    assert io.call(oid, "otp", "check", json.dumps(
+        {"id": "tok1", "code": prev, "now": now2}).encode()) == b"ok"
+    io.call(oid, "otp", "remove", b"tok1")
+    assert json.loads(io.call(oid, "otp", "list").decode()) == []
+    from ceph_tpu.client.rados import RadosError
+    with pytest.raises(RadosError):
+        io.call(oid, "otp", "check", json.dumps(
+            {"id": "tok1", "code": "000000"}).encode())
+    with pytest.raises(RadosError):
+        io.call(oid, "otp", "set", json.dumps(
+            {"id": "t2", "seed": "zz"}).encode())  # non-hex seed

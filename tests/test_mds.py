@@ -310,3 +310,30 @@ def test_snapshots_through_mds_with_crash_replay(cluster, rc):
     finally:
         c1.shutdown()
         c2.shutdown()
+
+
+def test_mksnap_validation_before_journal(cluster, rc):
+    """mksnap on a file / with a bad name must FAIL the request (not
+    ack a snapshot that never applies — review find)."""
+    io = rc.rc.ioctx(REP_POOL)
+    mds = MDSDaemon(cluster.ctx, io, commit_every=1000)
+    c = _mount(cluster, rc, mds, "snap-val")
+    try:
+        c.mkdir("/sd")
+        c.write("/sd/file", b"x")
+        with pytest.raises(MDSError) as ei:
+            c.mksnap("/sd/file", "s")  # not a directory
+        assert ei.value.rc == -20  # ENOTDIR
+        with pytest.raises(MDSError) as ei:
+            c.mksnap("/sd", "a/b")  # bad name
+        assert ei.value.rc == -22
+        with pytest.raises(MDSError):
+            c.mksnap("/sd", ".snap")
+        assert c.lssnap("/sd") == []
+        # ioctx snapc stays clean on the MDS side too
+        assert (io.snap_seq, io.snaps) == (0, [])
+        c.mksnap("/sd", "ok")
+        assert (io.snap_seq, io.snaps) == (0, [])
+    finally:
+        c.shutdown()
+        mds.shutdown()

@@ -1,0 +1,75 @@
+"""mgr dashboard: HTTP status UI + JSON API + prometheus endpoint.
+
+Reference role: src/pybind/mgr/dashboard/ (CherryPy UI + REST API).
+Driven over real HTTP against a vstart cluster.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from ceph_tpu.vstart import VStartCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        pool_id = c.create_pool("data", size=2)
+        rc = c.client()
+        io = rc.ioctx(pool_id)
+        io.write_full("obj1", b"dashboard test payload")
+        mgr = c.start_mgr(dashboard=True)
+        c._dash_port = mgr.modules["dashboard"].port
+        # pg stats arrive on the OSDs' report timer
+        c.wait_for(lambda: c.command({"prefix": "pg dump"})[1].get(
+            "num_pg_stats", 0) > 0, timeout=30)
+        yield c
+
+
+def _get(cluster, path):
+    url = f"http://127.0.0.1:{cluster._dash_port}{path}"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def test_html_status_page(cluster):
+    status, ctype, body = _get(cluster, "/")
+    assert status == 200 and ctype.startswith("text/html")
+    text = body.decode()
+    assert "ceph_tpu cluster" in text
+    assert "HEALTH" in text      # health pill rendered
+    assert "osd.0" in text or "osd0" in text.replace(".", "")
+    assert "data" in text        # the pool table
+
+def test_json_api(cluster):
+    for ep, key in (("/api/status", None), ("/api/health", "status"),
+                    ("/api/osds", "osds"), ("/api/df", "nodes"),
+                    ("/api/pgs", "num_pgs")):
+        status, ctype, body = _get(cluster, ep)
+        assert status == 200 and ctype.startswith("application/json"), ep
+        obj = json.loads(body)
+        if key:
+            assert key in obj, (ep, obj)
+    status, _, body = _get(cluster, "/api/pgs")
+    pgs = json.loads(body)
+    assert pgs["num_pgs"] > 0
+    assert any("active" in s for s in pgs["by_state"])
+
+
+def test_prometheus_and_perf(cluster):
+    status, ctype, body = _get(cluster, "/metrics")
+    assert status == 200 and "ceph_" in body.decode()
+    status, _, body = _get(cluster, "/api/perf")
+    perf = json.loads(body)
+    assert perf  # at least one registered perf source
+
+
+def test_404_and_command(cluster):
+    try:
+        _get(cluster, "/nope")
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    rc, out = cluster.mgr.handle_command({"prefix": "dashboard status"})
+    assert rc == 0 and out["running"] and str(cluster._dash_port) in out["url"]
