@@ -531,6 +531,48 @@ def baseline_configs(jax, out):
     out["lrc_local_repair_gbps"] = round(chunk_bytes * 8 / dt / 1e9, 3)
 
 
+def cluster_io(jax, out):
+    """BASELINE row 8 (secondary): end-to-end cluster IO through the
+    full stack — client -> messenger -> PG pipeline -> store — the
+    `rados bench` role (reference src/common/obj_bencher.h:64).
+    Host-path by construction (daemons + sockets), labeled as such."""
+    from ceph_tpu.vstart import VStartCluster
+
+    from ceph_tpu.osd import types as t_
+    from ceph_tpu.client.rados import OSDOp
+
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        rep_pool = c.create_pool("bench_rep", size=2)
+        io = c.client().ioctx(rep_pool)
+        payload = b"b" * 65536
+        n_objs, depth = 128, 16  # rados bench default concurrency
+
+        def run(mk_ops):
+            t0 = time.perf_counter()
+            pend = []
+            for i in range(n_objs):
+                pend.append(io.aio_operate(f"bench_{i}", mk_ops()))
+                if len(pend) >= depth:
+                    pend.pop(0).result(30.0)
+            for p in pend:
+                p.result(30.0)
+            return time.perf_counter() - t0
+
+        wdt = run(lambda: [OSDOp(t_.OP_WRITEFULL, data=payload)])
+        rdt = run(lambda: [OSDOp(t_.OP_READ, off=0,
+                                 length=len(payload))])
+        assert io.read("bench_0") == payload
+        out["cluster_io"] = {
+            "object_kib": 64, "objects": n_objs, "depth": depth,
+            "write_iops": round(n_objs / wdt, 1),
+            "write_mbps": round(n_objs * 65536 / wdt / 1e6, 1),
+            "read_iops": round(n_objs / rdt, 1),
+            "read_mbps": round(n_objs * 65536 / rdt / 1e6, 1),
+            "note": "full stack over loopback sockets (rados bench "
+                    "role, 16-deep like ObjBencher); host-path",
+        }
+
+
 # ---------------------------------------------------------------------------
 # CRUSH
 # ---------------------------------------------------------------------------
@@ -656,7 +698,8 @@ def aux_section(jax, out):
         # preserve per-row fault isolation: a clay bug must not erase
         # the jerasure/lrc rows (each records its own error)
         for name, fn in (("clay", clay_repair),
-                         ("baseline_configs", baseline_configs)):
+                         ("baseline_configs", baseline_configs),
+                         ("cluster_io", cluster_io)):
             try:
                 fn(jax, out)
             except Exception:
@@ -697,7 +740,8 @@ def aux_section(jax, out):
             pass
     for k in ("clay_repair_gbps", "clay_repair_read_frac_vs_rs",
               "jerasure_k4m2_4k_encode_gbps", "lrc_profile",
-              "lrc_local_repair_reads", "lrc_local_repair_gbps"):
+              "lrc_local_repair_reads", "lrc_local_repair_gbps",
+              "cluster_io"):
         if k in sub:
             out[k] = sub[k]
     # surface the subprocess's own failures in THIS artifact: missing
