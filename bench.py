@@ -175,16 +175,18 @@ def _ec_device(jax, out):
         n2 = _build_network(matrix) if matrix is not coding else net
         return xla_swar_engine(n2, matrix.shape[0])
 
-    def pallas_engine(matrix, tile):
+    def pallas_engine(matrix, tile, ms=False):
         def enc(w3, seed):
             return gf256_pallas.encode_planes(matrix, w3, seed, tile=tile,
-                                              interpret=False)
+                                              interpret=False,
+                                              mul_shift=ms)
         return enc
 
-    def pallas_inter_engine(matrix, tile):
+    def pallas_inter_engine(matrix, tile, ms=False):
         def enc(w3, seed):
             return gf256_pallas.encode_planes_interleaved(
-                matrix, w3, seed, tile=tile, interpret=False)
+                matrix, w3, seed, tile=tile, interpret=False,
+                mul_shift=ms)
         return enc
 
     # shared measurement protocol (ceph_tpu/ops/benchloop.py)
@@ -217,9 +219,12 @@ def _ec_device(jax, out):
         except Exception as e:
             pins[name] = f"error: {e!r}"[:160]
 
+    # pin at tile 128: the smallest tile compiles on every rig seen so
+    # far (one rig's remote compiler rejects inter>=256 and t1024), and
+    # the pin only establishes family correctness
     _pin("xla", xla_engine(coding), False)
-    _pin("pallas", pallas_engine(coding, 256), False)
-    _pin("pallas_inter", pallas_inter_engine(coding, 256), True)
+    _pin("pallas", pallas_engine(coding, 128), False)
+    _pin("pallas_inter", pallas_inter_engine(coding, 128), True)
     out["ec_device_pinned"] = pins
     if pins["xla"] is not True and pins["pallas"] is not True:
         raise RuntimeError(f"no EC engine family passed its pin: {pins}")
@@ -232,12 +237,21 @@ def _ec_device(jax, out):
     cands = {}
     if pins["xla"] is True:
         cands["xla_swar"] = (xla_engine, None, False)
-    for tile in (256, 512, 1024):
+    # tile/doubling grid from the TUNE_TPU surface: t128 is the only
+    # interleaved tile one rig's compiler accepts (and its shift
+    # variant won there); t1024 fails on the same rig and never beat
+    # t512 elsewhere
+    for tile, ms in ((128, False), (128, True), (256, False),
+                     (256, True), (512, False)):
+        tag = f"t{tile}" + ("_shift" if ms else "")
         if pins["pallas"] is True:
-            cands[f"pallas_t{tile}"] = (pallas_engine, tile, False)
+            cands[f"pallas_{tag}"] = (
+                (lambda m, t, _ms=ms: pallas_engine(m, t, _ms)),
+                tile, False)
         if pins["pallas_inter"] is True:
-            cands[f"pallas_inter_t{tile}"] = (pallas_inter_engine, tile,
-                                              True)
+            cands[f"pallas_inter_{tag}"] = (
+                (lambda m, t, _ms=ms: pallas_inter_engine(m, t, _ms)),
+                tile, True)
     w_tune_p = gen(T_tune)
     w_tune_i = gen(T_tune, interleaved=True)
     tune = {}
@@ -262,7 +276,7 @@ def _ec_device(jax, out):
     def winner_enc(matrix, T):
         factory, tile, _ = cands[winner]
         if tile and T % tile:
-            tile = max(t for t in (256, 512, 1024) if T % t == 0)
+            tile = max(t for t in (128, 256, 512) if T % t == 0)
         return factory(matrix, tile) if tile else factory(matrix)
 
     def rate_at(matrix, T, iters, R):

@@ -34,8 +34,27 @@ _SEP = "\x00"
 _TOMBSTONE = 0xFFFFFFFF
 _FOOTER = struct.Struct("<QIIQ")  # index_off, n_index, index_crc, magic
 _MAGIC = 0x53535442_4C534D31  # "SSTB"/"LSM1"
+# v2 footer adds a per-table bloom filter (the RocksDB
+# BloomFilterPolicy role): index_off, n_index, bloom_off, bloom_bits,
+# crc(index+bloom), magic2.  v1 tables (no bloom) still load.
+_FOOTER2 = struct.Struct("<QIQIIQ")
+_MAGIC2 = 0x53535442_4C534D32  # "SSTB"/"LSM2"
+_BLOOM_K = 7           # hash probes (~1% FP at 10 bits/key)
+_BLOOM_BITS_PER_KEY = 10
 _REC = struct.Struct("<II")  # klen, vlen (or _TOMBSTONE)
 _WAL_HDR = struct.Struct("<II")  # body_len, crc
+
+
+def _bloom_probes(key: str, nbits: int) -> Iterator[int]:
+    """k deterministic bit positions for `key` (double hashing over a
+    blake2b digest — stable across processes/restarts)."""
+    import hashlib
+
+    h = hashlib.blake2b(key.encode("utf-8"), digest_size=16).digest()
+    a = int.from_bytes(h[:8], "little")
+    b = int.from_bytes(h[8:], "little") | 1
+    for i in range(_BLOOM_K):
+        yield (a + i * b) % nbits
 
 
 class SSTable:
@@ -48,6 +67,9 @@ class SSTable:
         self.path = path
         self._index: List[Tuple[str, int]] = []
         self._data_end = 0
+        self._bloom: Optional[bytes] = None
+        self._bloom_bits = 0
+        self.data_scans = 0  # observability: file scans get() performed
         self._load_index()
 
     def _load_index(self) -> None:
@@ -56,14 +78,30 @@ class SSTable:
             size = f.tell()
             if size < _FOOTER.size:
                 raise IOError(f"truncated sstable {self.path}")
-            f.seek(size - _FOOTER.size)
-            idx_off, n, want, magic = _FOOTER.unpack(f.read(_FOOTER.size))
-            if magic != _MAGIC:
+            f.seek(size - 8)
+            (magic,) = struct.unpack("<Q", f.read(8))
+            bloom_off = bloom_bits = 0
+            if magic == _MAGIC2:
+                f.seek(size - _FOOTER2.size)
+                (idx_off, n, bloom_off, bloom_bits, want,
+                 magic) = _FOOTER2.unpack(f.read(_FOOTER2.size))
+                footer_size = _FOOTER2.size
+            elif magic == _MAGIC:
+                f.seek(size - _FOOTER.size)
+                idx_off, n, want, magic = _FOOTER.unpack(
+                    f.read(_FOOTER.size))
+                footer_size = _FOOTER.size
+            else:
                 raise IOError(f"bad sstable magic in {self.path}")
             f.seek(idx_off)
-            blob = f.read(size - _FOOTER.size - idx_off)
+            blob = f.read(size - footer_size - idx_off)
             if crc32c(blob) != want:
                 raise IOError(f"corrupt sstable index in {self.path}")
+            if bloom_bits:
+                boff = bloom_off - idx_off
+                self._bloom = blob[boff: boff + (bloom_bits + 7) // 8]
+                self._bloom_bits = bloom_bits
+                blob = blob[:boff]
             off = 0
             for _ in range(n):
                 (klen,) = struct.unpack_from("<I", blob, off)
@@ -75,17 +113,27 @@ class SSTable:
                 self._index.append((key, rec_off))
             self._data_end = idx_off
 
+    def _maybe_has(self, key: str) -> bool:
+        if not self._bloom_bits:
+            return True  # v1 table: no filter
+        for bit in _bloom_probes(key, self._bloom_bits):
+            if not (self._bloom[bit >> 3] >> (bit & 7)) & 1:
+                return False
+        return True
+
     @staticmethod
     def write(path: str, items: Iterator[Tuple[str, Optional[bytes]]]
               ) -> "SSTable":
         """Write sorted (key, value|None=tombstone) records + index."""
         tmp = path + ".tmp"
         index: List[Tuple[str, int]] = []
+        keys: List[str] = []
         with open(tmp, "wb") as f:
             i = 0
             for key, val in items:
                 if i % SSTable.SPARSE == 0:
                     index.append((key, f.tell()))
+                keys.append(key)
                 kb = key.encode("utf-8")
                 if val is None:
                     f.write(_REC.pack(len(kb), _TOMBSTONE) + kb)
@@ -98,10 +146,20 @@ class SSTable:
                 kb = key.encode("utf-8")
                 parts += [struct.pack("<I", len(kb)), kb,
                           struct.pack("<Q", off)]
-            blob = b"".join(parts)
-            f.write(blob)
-            f.write(_FOOTER.pack(idx_off, len(index), crc32c(blob),
-                                 _MAGIC))
+            iblob = b"".join(parts)
+            f.write(iblob)
+            # bloom filter over EVERY key (tombstones too: a filter
+            # miss must prove "this table says nothing about key")
+            nbits = max(1024, len(keys) * _BLOOM_BITS_PER_KEY)
+            bloom = bytearray((nbits + 7) // 8)
+            for key in keys:
+                for bit in _bloom_probes(key, nbits):
+                    bloom[bit >> 3] |= 1 << (bit & 7)
+            bloom_off = idx_off + len(iblob)
+            f.write(bloom)
+            f.write(_FOOTER2.pack(idx_off, len(index), bloom_off,
+                                  nbits, crc32c(iblob + bytes(bloom)),
+                                  _MAGIC2))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -125,12 +183,16 @@ class SSTable:
             yield key, val
 
     def get(self, key: str) -> Tuple[bool, Optional[bytes]]:
-        """(found, value|None-for-tombstone): sparse-index binary
-        search, then a bounded scan of at most SPARSE records."""
+        """(found, value|None-for-tombstone): bloom filter first (a
+        miss answers without touching the file), then sparse-index
+        binary search + a bounded scan of at most SPARSE records."""
         import bisect
 
+        if not self._maybe_has(key):
+            return False, None
         if not self._index or key < self._index[0][0]:
             return False, None
+        self.data_scans += 1
         i = bisect.bisect_right([k for k, _ in self._index], key) - 1
         start = self._index[i][1]
         end = (self._index[i + 1][1] if i + 1 < len(self._index)

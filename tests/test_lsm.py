@@ -146,3 +146,75 @@ def test_blockstore_on_lsm(tmp_path):
     assert bs2.read(coll, GHObject("o1")) == b"lsm-backed" * 100
     assert bs2.fsck() == []
     bs2.umount()
+
+
+def test_bloom_filter_skips_absent_keys(tmp_path):
+    """v2 SSTables carry a bloom filter: point misses answer without a
+    data-file scan (the RocksDB BloomFilterPolicy role)."""
+    from ceph_tpu.store.lsm import LSMStore, SSTable
+
+    db = LSMStore(str(tmp_path / "bloomdb"), memtable_bytes=1024)
+    db.open()
+    b = WriteBatch()
+    for i in range(500):
+        b.set("P", f"key{i:04d}", f"val{i}".encode())
+    db.submit(b)
+    db.flush()
+    assert db._tables, "flush should have produced an sstable"
+    t = db._tables[0]
+    base = t.data_scans
+    # hits scan
+    found, v = t.get("P\x00key0123")
+    assert found and v == b"val123"
+    assert t.data_scans == base + 1
+    # misses: ~1% FP rate means 200 absent keys trigger at most a few
+    scans_before = t.data_scans
+    for i in range(200):
+        found, _ = t.get(f"P\x00nope{i:04d}")
+        assert not found
+    assert t.data_scans - scans_before <= 8
+    db.close()
+
+    # restart reloads the filter from disk
+    db2 = LSMStore(str(tmp_path / "bloomdb"), memtable_bytes=1024)
+    db2.open()
+    t2 = db2._tables[0]
+    assert t2._bloom_bits > 0
+    for i in range(50):
+        assert not t2.get(f"P\x00nada{i}")[0]
+    assert t2.data_scans <= 3
+    assert db2.get("P", "key0001") == b"val1"
+    db2.close()
+
+
+def test_v1_sstable_without_bloom_still_loads(tmp_path):
+    """Back-compat: a pre-bloom (v1-footer) table loads and serves."""
+    import struct as _s
+
+    from ceph_tpu.store import lsm as L
+
+    path = str(tmp_path / "v1.sst")
+    # hand-write a v1 table: records + sparse index + v1 footer
+    items = [(f"k{i:03d}", f"v{i}".encode()) for i in range(100)]
+    index = []
+    with open(path, "wb") as f:
+        for i, (k, v) in enumerate(items):
+            if i % L.SSTable.SPARSE == 0:
+                index.append((k, f.tell()))
+            kb = k.encode()
+            f.write(L._REC.pack(len(kb), len(v)) + kb + v)
+        idx_off = f.tell()
+        parts = []
+        for k, off in index:
+            kb = k.encode()
+            parts += [_s.pack("<I", len(kb)), kb, _s.pack("<Q", off)]
+        blob = b"".join(parts)
+        f.write(blob)
+        from ceph_tpu.core.crc import crc32c
+        f.write(L._FOOTER.pack(idx_off, len(index), crc32c(blob),
+                               L._MAGIC))
+    t = L.SSTable(path)
+    assert t._bloom_bits == 0
+    assert t.get("k042") == (True, b"v42")
+    assert t.get("zzz")[0] is False
+    assert sorted(k for k, _ in t.iterate())[0] == "k000"
