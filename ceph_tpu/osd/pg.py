@@ -704,6 +704,13 @@ class PG:
         except ClsError as e:
             op.rval = e.errno
             return e.errno, False
+        except Exception:
+            # a buggy method (bad input types, etc.) must FAIL the op,
+            # not escape into the PG worker and leave the client
+            # waiting forever (reference: unexpected cls failures come
+            # back as -EIO, they never kill the op)
+            op.rval = -5  # EIO
+            return -5, False
         return 0, ctx.delete_object
 
     def _exec_read_op(self, op: OSDOp, state: Optional[ObjectState]) -> int:

@@ -26,6 +26,9 @@ from ceph_tpu.client.striper import RadosStriper
 from ceph_tpu.osd.cls import CLS_RD, CLS_WR, ClassHandler, ClsError
 
 ROOT_OID = "rgw.root"
+# the zone metadata log (mdlog role): ONE module-level name shared by
+# the gateway, RGWUserAdmin and the sync agent
+META_LOG_OID = "rgw.meta.log"
 
 
 class NoSuchBucket(KeyError):
@@ -101,37 +104,72 @@ def _register_rgw_cls() -> None:
         return json.dumps({"entries": out[:maxk],
                            "truncated": truncated}).encode()
 
-    def bilog_list(ctx, indata: bytes) -> bytes:
+    def _log_list(ctx, indata: bytes, prefix: str) -> bytes:
         req = json.loads(indata.decode() or "{}")
         after = int(req.get("after", 0))
         maxk = int(req.get("max", 1000))
         out = []
         if ctx.exists:
-            for k in sorted(ctx.omap_get()):
-                if not k.startswith(BILOG):
+            full = ctx.omap_get()  # ONE read; no per-entry re-fetch
+            for k in sorted(full):
+                if not k.startswith(prefix):
                     continue
-                seq = int(k[len(BILOG):])
+                seq = int(k[len(prefix):])
                 if seq <= after:
                     continue
-                out.append({"seq": seq, **json.loads(
-                    ctx.omap_get([k])[k].decode())})
+                out.append({"seq": seq, **json.loads(full[k].decode())})
                 if len(out) >= maxk:
                     break
         return json.dumps(out).encode()
 
-    def bilog_trim(ctx, indata: bytes) -> bytes:
+    def _log_trim(ctx, indata: bytes, prefix: str) -> bytes:
         upto = int(indata.decode() or "0")
         doomed = [k for k in ctx.omap_get()
-                  if k.startswith(BILOG) and int(k[len(BILOG):]) <= upto]
+                  if k.startswith(prefix) and int(k[len(prefix):]) <= upto]
         if doomed:
             ctx.omap_rm(doomed)
         return str(len(doomed)).encode()
+
+    def bilog_list(ctx, indata: bytes) -> bytes:
+        return _log_list(ctx, indata, BILOG)
+
+    def bilog_trim(ctx, indata: bytes) -> bytes:
+        return _log_trim(ctx, indata, BILOG)
+
+    # the METADATA log (reference rgw_sync.cc mdlog role): user/bucket
+    # metadata mutations append here so secondary zones can replay the
+    # metadata NAMESPACE (accounts, bucket existence), not just object
+    # data — one global log object per zone, same atomic append shape
+    # as the bilog
+    MDLOG = "~mdlog."
+    MDLOG_SEQ = "~mdlog_seq"
+
+    def mdlog_add(ctx, indata: bytes) -> bytes:
+        req = json.loads(indata.decode())
+        cur = (ctx.omap_get([MDLOG_SEQ]).get(MDLOG_SEQ, b"0")
+               if ctx.exists else b"0")
+        seq = int(cur) + 1
+        ctx.omap_set({
+            MDLOG_SEQ: str(seq).encode(),
+            f"{MDLOG}{seq:020d}": json.dumps(
+                {"section": req["section"], "name": req["name"],
+                 "op": req["op"]}).encode()})
+        return str(seq).encode()
+
+    def mdlog_list(ctx, indata: bytes) -> bytes:
+        return _log_list(ctx, indata, MDLOG)
+
+    def mdlog_trim(ctx, indata: bytes) -> bytes:
+        return _log_trim(ctx, indata, MDLOG)
 
     h.register("rgw", "index_put", CLS_RD | CLS_WR, index_put)
     h.register("rgw", "index_rm", CLS_RD | CLS_WR, index_rm)
     h.register("rgw", "index_list", CLS_RD, index_list)
     h.register("rgw", "bilog_list", CLS_RD, bilog_list)
     h.register("rgw", "bilog_trim", CLS_RD | CLS_WR, bilog_trim)
+    h.register("rgw", "mdlog_add", CLS_RD | CLS_WR, mdlog_add)
+    h.register("rgw", "mdlog_list", CLS_RD, mdlog_list)
+    h.register("rgw", "mdlog_trim", CLS_RD | CLS_WR, mdlog_trim)
 
 
 _register_rgw_cls()
@@ -145,11 +183,27 @@ class RGW:
                                     stripe_count=4,
                                     object_size=object_size)
 
+    # metadata log object: user/bucket namespace mutations append here
+    # (the rgw_sync.cc mdlog role; tailed by RGWZoneSync.meta sync)
+    META_LOG_OID = META_LOG_OID  # class alias of the module constant
+
+    def _mdlog(self, section: str, name: str, op: str) -> None:
+        try:
+            self.io.call(self.META_LOG_OID, "rgw", "mdlog_add",
+                         json.dumps({"section": section, "name": name,
+                                     "op": op}).encode())
+        except RadosError:
+            pass  # the log is an aux feed, never a mutation blocker
+
     # -- buckets -----------------------------------------------------------
     def _index_oid(self, bucket: str) -> str:
         return f"rgw.bucket.{bucket}"
 
-    def create_bucket(self, name: str) -> None:
+    def create_bucket(self, name: str, log_meta: bool = True) -> None:
+        """log_meta=False is the SYNC-REPLAY entry (RGWZoneSync): a
+        replayed mutation must not append to THIS zone's mdlog, or
+        active-active sync echoes it back — a bounced 'remove' would
+        force-clean a bucket the source has since recreated."""
         try:
             known = self.io.omap_get(ROOT_OID, [name])
         except RadosError:
@@ -159,6 +213,8 @@ class RGW:
         self.io.write_full(self._index_oid(name), b"")
         meta = {"created": time.time()}
         self.io.omap_set(ROOT_OID, {name: json.dumps(meta).encode()})
+        if log_meta:
+            self._mdlog("bucket", name, "write")
 
     def list_buckets(self) -> List[str]:
         try:
@@ -174,15 +230,31 @@ class RGW:
         if name not in known:
             raise NoSuchBucket(name)
 
-    def delete_bucket(self, name: str) -> None:
+    def delete_bucket(self, name: str, log_meta: bool = True) -> None:
         self._require_bucket(name)
-        if self.list_objects(name, max_keys=1)[0]:
+        # emptiness must consult the RAW index: an in-progress
+        # multipart entry (_mp_/...) sorts before most user keys, so a
+        # filtered listing could report "empty" while live objects and
+        # part data remain (S3: DeleteBucket fails on in-progress
+        # uploads too)
+        got = self.io.call(self._index_oid(name), "rgw", "index_list",
+                           json.dumps({"max_keys": 1}).encode())
+        if json.loads(got.decode())["entries"]:
             raise BucketNotEmpty(name)
         try:
             self.io.remove(self._index_oid(name))
         except RadosError:
             pass
         self.io.operate(ROOT_OID, [_omap_rm(name)])
+        # the bilog died with the index object: zone data cursors for
+        # it are meaningless (a recreated bucket restarts at seq 1) —
+        # drop the sync-status object so every zone restarts clean
+        try:
+            self.io.remove(f"rgw.sync.{name}")
+        except RadosError:
+            pass
+        if log_meta:
+            self._mdlog("bucket", name, "remove")
 
     # -- objects -----------------------------------------------------------
     def _data_oid(self, bucket: str, key: str) -> str:

@@ -21,8 +21,13 @@ replays the changes against its own store.  Re-derived here:
   consumer bookkeeping out of the bucket index omap the S3 listings
   iterate.
 
-Buckets themselves (metadata sync) replicate on sight: a source
-bucket missing on the destination is created before its log replays.
+METADATA sync (the reference's rgw_sync.cc companion to data sync):
+user/bucket namespace mutations append to the source zone's mdlog
+(`rgw.meta.log`, see gateway mdlog_add) and are replayed here —
+account records (with their key index) copy verbatim, bucket removes
+propagate (force-cleaning any object data the removed source bilog
+can no longer replay).  Buckets additionally replicate on sight
+during data sync so object replay never races the namespace.
 """
 
 from __future__ import annotations
@@ -56,8 +61,9 @@ class RGWZoneSync:
     def _status_oid(self, bucket: str) -> str:
         return f"rgw.sync.{bucket}"
 
-    def _cursor(self, bucket: str) -> int:
-        oid = self._status_oid(bucket)
+    def _cursor_at(self, oid: str) -> int:
+        """Read (registering on first contact) this zone's commit
+        cursor on a sync-status object."""
         try:
             got = self.src.io.call(oid, "journal", "get_client",
                                    self._client_id().encode())
@@ -74,11 +80,16 @@ class RGWZoneSync:
             raise
         return int(json.loads(got.decode()).get("commit", 0))
 
-    def _commit(self, bucket: str, seq: int) -> None:
-        self.src.io.call(self._status_oid(bucket), "journal",
-                         "client_commit",
+    def _commit_at(self, oid: str, seq: int) -> None:
+        self.src.io.call(oid, "journal", "client_commit",
                          json.dumps({"id": self._client_id(),
                                      "commit": seq}).encode())
+
+    def _cursor(self, bucket: str) -> int:
+        return self._cursor_at(self._status_oid(bucket))
+
+    def _commit(self, bucket: str, seq: int) -> None:
+        self._commit_at(self._status_oid(bucket), seq)
 
     # -- one pass ----------------------------------------------------------
     def _bilog(self, bucket: str, after: int) -> List[dict]:
@@ -87,10 +98,107 @@ class RGWZoneSync:
                                json.dumps({"after": after}).encode())
         return json.loads(got.decode())
 
+    # -- metadata sync (mdlog replay) --------------------------------------
+    META_SYNC_OID = "rgw.meta.sync"
+
+    def _meta_cursor(self) -> int:
+        return self._cursor_at(self.META_SYNC_OID)
+
+    def meta_sync_once(self) -> int:
+        """Replay the source mdlog: user records copy verbatim (same
+        access/secret keys authenticate in either zone), bucket
+        removes force-clean the destination (a removed source bucket's
+        bilog is gone, so the remove IS the authoritative end state)."""
+        from ceph_tpu.rgw.users import KEYS_OID, USERS_OID
+
+        cursor = self._meta_cursor()
+        got = self.src.io.call(
+            self.src.META_LOG_OID, "rgw", "mdlog_list",
+            json.dumps({"after": cursor}).encode())
+        last, n = cursor, 0
+        for ev in json.loads(got.decode()):
+            section, name, op = ev["section"], ev["name"], ev["op"]
+            if section == "user":
+                if op == "write":
+                    raw = self.src.io.omap_get(USERS_OID, [name]
+                                               ).get(name)
+                    if raw is not None:
+                        rec = json.loads(raw.decode())
+                        self.dst.io.omap_set(USERS_OID, {name: raw})
+                        self.dst.io.omap_set(
+                            KEYS_OID,
+                            {rec["access_key"]: name.encode()})
+                else:
+                    try:
+                        raw = self.dst.io.omap_get(USERS_OID, [name]
+                                                   ).get(name)
+                        if raw is not None:
+                            rec = json.loads(raw.decode())
+                            self.dst.io.omap_rm(USERS_OID, [name])
+                            self.dst.io.omap_rm(
+                                KEYS_OID, [rec["access_key"]])
+                    except RadosError:
+                        pass
+            elif section == "bucket":
+                # log_meta=False everywhere: a REPLAYED mutation must
+                # not append to the destination's own mdlog — in
+                # active-active sync the echoed event would bounce
+                # back (a bounced remove force-cleans a bucket the
+                # source has since recreated: data loss)
+                if op == "write":
+                    try:
+                        self.dst.create_bucket(name, log_meta=False)
+                    except Exception:
+                        pass  # already present
+                else:
+                    try:
+                        self._force_remove_bucket(name)
+                    except NoSuchBucket:
+                        pass
+                    except RadosError as e:
+                        if e.rc == -16:
+                            # not yet drainable: stop the batch HERE so
+                            # the cursor stays before this event and
+                            # the next tick retries it
+                            break
+            last = ev["seq"]
+            n += 1
+        if last != cursor:
+            self._commit_at(self.META_SYNC_OID, last)
+        return n
+
+    def _force_remove_bucket(self, name: str) -> None:
+        """Apply an authoritative source-side bucket removal: drain
+        EVERY page of remaining replicated objects, then drop the
+        bucket (without echoing to this zone's mdlog)."""
+        from ceph_tpu.rgw.gateway import BucketNotEmpty
+
+        while True:
+            keys, truncated = self.dst.list_objects(name,
+                                                    max_keys=1000)
+            for ent in keys:
+                try:
+                    self.dst.delete_object(name, ent["Key"])
+                except (NoSuchKey, NoSuchBucket):
+                    pass
+            if not truncated:
+                break
+        try:
+            self.dst.delete_bucket(name, log_meta=False)
+        except BucketNotEmpty:
+            # residue the filtered listing can't see (e.g. in-progress
+            # multipart bookkeeping): leave the bucket; the next tick
+            # retries from the uncommitted event
+            raise RadosError(-16, f"{name}: not yet drainable")
+
     def sync_once(self) -> int:
-        """Tail every source bucket's change log once; returns the
-        number of applied changes."""
-        n = 0
+        """Replay the zone mdlog (metadata), then tail every source
+        bucket's change log (data); returns the number of applied
+        changes.  Order doesn't matter for correctness — bucket
+        removes force-clean, creates are idempotent, and data sync
+        creates buckets on sight — but metadata-first surfaces new
+        accounts before their buckets fill."""
+        n = self.meta_sync_once()
         for bucket in self.src.list_buckets():
             try:
                 self.dst.create_bucket(bucket)  # metadata sync on sight

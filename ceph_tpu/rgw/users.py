@@ -70,6 +70,18 @@ class RGWUserAdmin:
     def _put(self, oid: str, kv: Dict[str, bytes]) -> None:
         self.io.omap_set(oid, kv)
 
+    def _mdlog(self, uid: str, op: str) -> None:
+        """User mutations feed the zone metadata log (rgw_sync mdlog
+        role) so secondary zones replicate the account namespace."""
+        from ceph_tpu.rgw.gateway import META_LOG_OID
+
+        try:
+            self.io.call(META_LOG_OID, "rgw", "mdlog_add",
+                         json.dumps({"section": "user", "name": uid,
+                                     "op": op}).encode())
+        except RadosError:
+            pass
+
     # -- user CRUD ---------------------------------------------------------
     def user_create(self, uid: str, display_name: str = "") -> Dict:
         if self._get(USERS_OID, uid) is not None:
@@ -81,6 +93,7 @@ class RGWUserAdmin:
                 "suspended": False}
         self._put(USERS_OID, {uid: json.dumps(user).encode()})
         self._put(KEYS_OID, {access_key: uid.encode()})
+        self._mdlog(uid, "write")
         return user
 
     def user_info(self, uid: str) -> Dict:
@@ -104,11 +117,13 @@ class RGWUserAdmin:
         self.io.operate(KEYS_OID,
                         [OSDOp(t_.OP_OMAP_RM,
                                keys=[user["access_key"]])])
+        self._mdlog(uid, "remove")
 
     def user_suspend(self, uid: str, suspended: bool = True) -> None:
         user = self.user_info(uid)
         user["suspended"] = suspended
         self._put(USERS_OID, {uid: json.dumps(user).encode()})
+        self._mdlog(uid, "write")
 
     # -- auth --------------------------------------------------------------
     def resolve_key(self, access_key: str) -> Dict:

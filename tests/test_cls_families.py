@@ -234,3 +234,20 @@ def test_cls_otp(io):
     with pytest.raises(RadosError):
         io.call(oid, "otp", "set", json.dumps(
             {"id": "t2", "seed": "zz"}).encode())  # non-hex seed
+
+
+def test_buggy_cls_method_fails_op_instead_of_hanging(io):
+    """A cls method that raises a non-ClsError must come back as -EIO
+    (the reference's unexpected-failure contract) — before this guard
+    the exception escaped the PG worker and the op TIMED OUT."""
+    from ceph_tpu.client.rados import RadosError
+    from ceph_tpu.osd.cls import CLS_RD, CLS_WR, ClassHandler
+
+    h = ClassHandler.instance()
+    if h.get("testbug.boom") is None:
+        def boom(ctx, indata):
+            raise TypeError("not a ClsError")
+        h.register("testbug", "boom", CLS_RD | CLS_WR, boom)
+    with pytest.raises(RadosError) as ei:
+        io.call("bugobj", "testbug", "boom", b"")
+    assert ei.value.rc == -5  # EIO, and promptly
