@@ -128,7 +128,12 @@ class Objecter(Dispatcher):
             pending = list(self.ops.values())
         for op in pending:
             tgt = self._calc_target(op.pool, op.oid)
-            if tgt != op.target or op.target[1] < 0:
+            # also kick never-sent ops: one born while the primary's
+            # address was unknown parks homeless, and if the SAME
+            # (pg, primary) later becomes reachable the target
+            # comparison alone would never fire (thrash-hunt find: a
+            # 30 s client stall with the whole cluster healthy)
+            if tgt != op.target or op.target[1] < 0 or not op.last_send:
                 self._send_op(op)
         # re-register watches whose primary moved (linger resend)
         with self._lock:
@@ -304,8 +309,13 @@ class Objecter(Dispatcher):
                 elif op.retry_at and now >= op.retry_at:
                     op.retry_at = 0.0
                     self._send_op(op)
-                elif (op.last_send
-                      and now - op.last_send > self.resend_interval):
+                elif not op.last_send:
+                    # never sent: the op parked homeless at submit (no
+                    # address for its primary) — keep re-attempting;
+                    # _send_op parks it again harmlessly while the
+                    # address is still unknown
+                    self._send_op(op)
+                elif now - op.last_send > self.resend_interval:
                     # no reply: primary may have died before the map
                     # noticed; resend to the current target (reqid dedup
                     # makes this safe)

@@ -566,7 +566,14 @@ class Messenger:
             return
         # dup suppression must survive socket turnover: key the
         # cumulative dispatched-seq by (src, nonce), one logical
-        # lossless session per peer incarnation
+        # lossless session per peer incarnation.  The delivered-seq
+        # state advances ONLY AFTER dispatch returns: a dispatch that
+        # dies (e.g. a message landing in an OSD's kill window, work
+        # queue already stopped) must leave the frame "undelivered" so
+        # the peer's replay re-dispatches it — advancing first turned
+        # such frames into permanently lost ops (the thrash hunt's
+        # 30 s client timeouts with every PG active).
+        session = None
         if msg.src is not None and msg.nonce:
             src = str(msg.src)
             nonce, sids = self._peer_in_seq.get(src, (0, {}))
@@ -579,16 +586,19 @@ class Messenger:
                 # the session; re-ack so the replayer trims
                 self._send_ack(conn, ack_writer, last)
                 return
+            session = (src, nonce, sids)
+        elif msg.seq <= conn.in_seq:
+            return  # duplicate within this socket
+        await self._dispatch(conn, msg, len(body))
+        if session is not None:
+            src, nonce, sids = session
             if msg.sid in sids:
                 del sids[msg.sid]  # re-insert: LRU move-to-end
             elif len(sids) >= self._max_sids_per_peer:
                 sids.pop(next(iter(sids)))  # evict least-recent
             sids[msg.sid] = msg.seq
             self._peer_in_seq[src] = (nonce, sids)
-        elif msg.seq <= conn.in_seq:
-            return  # duplicate within this socket
         conn.in_seq = msg.seq
-        await self._dispatch(conn, msg, len(body))
         self._send_ack(conn, ack_writer, conn.in_seq)
 
     def _send_ack(self, conn: Connection, ack_writer, ack_seq: int) -> None:
@@ -623,6 +633,15 @@ class Messenger:
             handled = await asyncio.to_thread(self._dispatch_sync, conn, msg)
             if not handled:
                 self._log(0, f"unhandled message {msg!r}")
+        except Exception as e:
+            # a dispatcher that raises (daemon mid-shutdown: stopped
+            # work queue) means the frame was NOT delivered — drop the
+            # socket so the peer replays it to the next incarnation,
+            # instead of letting the exception escape as an unhandled
+            # asyncio task error with the frame in limbo
+            self._log(1, f"dispatch failed for {msg!r}: {e!r}; "
+                         "closing session for replay")
+            raise ConnectionResetError("dispatch failed") from e
         finally:
             self._dispatch_budget += size
             if self._dispatch_budget > 0 and self._budget_free is not None:

@@ -559,3 +559,29 @@ def test_scheduled_scrub_detects_corruption():
     finally:
         cl.shutdown()
         c.shutdown()
+
+
+def test_homeless_op_sends_once_address_appears(cluster, client):
+    """An op submitted while the primary's ADDRESS is unknown (the
+    addrbook lags the map during kill/revive churn) parks homeless.
+    When the SAME (pg, primary) becomes reachable again, the op must
+    still go out — the thrash hunt caught ops stalling their full 30 s
+    timeout against a healthy cluster because the target-CHANGE check
+    alone never fired (same pg, same primary, address back)."""
+    ob = client.rc.objecter
+    oid = "homeless_obj"
+    pool = REP_POOL
+    _pgid, primary = ob._calc_target(pool, oid)
+    # simulate the addrbook lag: drop only the primary's address
+    saved = dict(ob.addrbook)
+    with ob._lock:
+        ob.addrbook = {k: v for k, v in saved.items() if k != primary}
+    op = ob.op_submit(pool, oid,
+                      [t_.OSDOp(t_.OP_WRITEFULL, data=b"homeless")],
+                      timeout=15.0)
+    assert op.last_send == 0.0  # parked, never sent
+    # address comes back; target (pg, primary) is UNCHANGED
+    ob.handle_osdmap(cluster.osdmap, saved)
+    rep = op.result(10.0)
+    assert rep.result == 0
+    assert client.get(pool, oid) == b"homeless"
