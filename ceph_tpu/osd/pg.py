@@ -343,7 +343,10 @@ class PG:
         gen = self._obc.generation()
 
         def fill(state: Optional[ObjectState]) -> None:
-            if state is not None:
+            # READ_RETRY is a sentinel, not a state: caching it crashed
+            # the EC read-timeout timer thread (hunt find), wedging the
+            # op — pass it through for the caller's retry logic only
+            if state is not None and state is not READ_RETRY:
                 self._obc_put(oid, state, gen=gen)
             done(state)
 
