@@ -528,7 +528,11 @@ class PG:
         import json
 
         snapid = int(msg.ops[0].off)
-        state = self._read_state_sync(msg.oid)
+        state = self._read_state_sync(msg.oid, raw_retry=True)
+        if state is READ_RETRY:
+            reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                msg.ops, result=EAGAIN))
+            return
         if state is None or self.is_ec():
             reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                 msg.ops, result=ENOENT))
@@ -779,7 +783,13 @@ class PG:
         # queued op dispatches, so two writes to one object can never
         # read the same base state (per-PG ordering, the reference's
         # strictly-ordered RMW pipeline, ECBackend.cc:2098)
-        state = self._read_state_sync(msg.oid)
+        state = self._read_state_sync(msg.oid, raw_retry=True)
+        if state is READ_RETRY:
+            # ambiguous base state (shards unreachable mid-churn): a
+            # write built on "absent" would fork history — retryable
+            reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                msg.ops, result=EAGAIN))
+            return
         committed = threading.Event()
         # exactly one reply per op, whether commit or timeout wins
         _replied = [False]
@@ -1443,8 +1453,15 @@ class PG:
             en = self.log.latest_for(oid)
             return en is not None and en.op == t_.LOG_DELETE
 
-    def _read_state_sync(self, oid: str,
-                         timeout: float = 30.0) -> Optional[ObjectState]:
+    def _read_state_sync(self, oid: str, timeout: float = 30.0,
+                         raw_retry: bool = False
+                         ) -> Optional[ObjectState]:
+        """raw_retry=True returns the READ_RETRY sentinel for
+        ambiguous reads (current holders unresponsive, or wait
+        timeout) instead of None — "couldn't read right now" must
+        never masquerade as "doesn't exist" on a path that acts on
+        absence (the RMW write base state; the open thrash-hunt
+        divergence is the suspected consequence)."""
         done = threading.Event()
         box: List[Optional[ObjectState]] = [None]
 
@@ -1453,8 +1470,11 @@ class PG:
             done.set()
 
         self._get_state(oid, got)
-        done.wait(timeout)
-        return None if box[0] is READ_RETRY else box[0]
+        ok = done.wait(timeout)
+        st = box[0]
+        if st is READ_RETRY or not ok:
+            return READ_RETRY if raw_retry else None
+        return st
 
     def _push_msg(self, oid: str, state: Optional[ObjectState],
                   shard: int) -> m.MPGPush:
