@@ -556,6 +556,15 @@ class OSDService(Dispatcher):
 
     # -- dispatch ---------------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if not self.up:
+            # a DOWN daemon must not touch anything: its store may
+            # already be mounted by a successor incarnation, and a
+            # late recovery push / sub-op applied here races the
+            # successor's reads (thrash-hunt divergence find — real
+            # OSDs get this for free from process death).  Refusing
+            # (dispatch error) drops the session; the peer replays to
+            # the live incarnation.
+            raise RuntimeError(f"osd.{self.whoami} is down")
         if isinstance(msg, m.MOSDPing):
             return self._handle_ping(conn, msg)  # legacy single-msgr path
         if isinstance(msg, (m.MOSDRepOpReply, m.MECSubWriteReply)):

@@ -82,6 +82,10 @@ class PG:
         # reservation role): acquired non-blocking by MPGCommand
         self.maintenance_guard = threading.Lock()
         self.missing: Dict[str, EVersion] = {}  # objects this osd lacks
+        # map epoch at which the current interval began (the reference's
+        # same_interval_since): replica-op messages from older epochs
+        # are DROPPED, not applied
+        self.interval_epoch = 0
         self.peer_info: Dict[int, PGInfo] = {}
         # reqid -> committed version: completed-op replay so client
         # resends are exactly-once across primary failover (the
@@ -157,8 +161,14 @@ class PG:
             if (list(acting) != self.acting
                     or primary != self.primary):
                 # interval change: this PG must re-peer before serving
-                # ops again (the do_op peering gate keys off this)
+                # ops again (the do_op peering gate keys off this).
+                # interval_epoch gates replica ops: a sub-write minted
+                # in an older interval (e.g. replayed by a lossless
+                # session onto a revived/recycled peer) must NOT apply
+                # over recovered data (reference: ops are discarded
+                # when msg epoch < same_interval_since)
                 self.state = STATE_PEERING
+                self.interval_epoch = self.osd.epoch()
             if prior is not None:
                 # prior-interval holders (the past_intervals role): when
                 # placement moves wholesale (pgp_num change, crush
@@ -930,10 +940,18 @@ class PG:
             n - len(self.acting))
         off, length = be.sinfo.chunk_extent(s0, s1)
         extents: Dict[int, bytes] = {}
-        for shard in be.local_shards(acting):
-            c = be.read_local_chunk(oid, shard)
-            if c is not None and len(c) >= off + length:
-                extents[shard] = c[off: off + length]
+        with self.lock:
+            local_stale = oid in self.missing
+        if not local_stale:
+            # a primary that hasn't recovered this object yet must not
+            # feed its own stale chunk into the RMW base (the full-read
+            # path has the same guard; its absence HERE was the
+            # thrash-hunt divergence: a partial write rebuilt a shard
+            # from a pre-takeover image)
+            for shard in be.local_shards(acting):
+                c = be.read_local_chunk(oid, shard)
+                if c is not None and len(c) >= off + length:
+                    extents[shard] = c[off: off + length]
         if not set(range(be.k)) <= set(extents):
             remote = [
                 (acting[s], m.MECSubRead(self.pgid, self.osd.epoch(), s,
@@ -956,6 +974,12 @@ class PG:
         writes of only the touched stripes."""
         wop = msg.ops[0]
         be: ECBackend = self.backend  # type: ignore[assignment]
+        with self.lock:
+            if msg.oid in self.missing:
+                # unrecovered locally: the full write path reconstructs
+                # degraded-aware; the extent path must not run off a
+                # stale local image
+                return False
         if not be.can_partial(msg.oid, wop.off, len(wop.data)):
             return False
         width = be.stripe_width
@@ -1062,6 +1086,8 @@ class PG:
     # -- replica apply ----------------------------------------------------
     def handle_rep_op(self, msg: m.MOSDRepOp, conn) -> None:
         with self.lock:
+            if msg.epoch < self.interval_epoch:
+                return  # old-interval replica op: see handle_sub_write
             self.backend.apply_rep_op(msg.txn)
             self._note_entries(msg.entries)
         rep = m.MOSDRepOpReply(self.pgid, self.osd.epoch(), 0)
@@ -1070,6 +1096,14 @@ class PG:
 
     def handle_sub_write(self, msg: m.MECSubWrite, conn) -> None:
         with self.lock:
+            if msg.epoch < self.interval_epoch:
+                # minted in an OLDER interval (a lossless session can
+                # replay unacked sub-writes onto a revived peer —
+                # potentially onto a RECYCLED port): applying it would
+                # overwrite recovered data with the past.  Drop; the
+                # primary's interval change already restarted or
+                # re-resolved the repop (thrash-hunt divergence find).
+                return
             self.backend.apply_sub_write(msg.txn)
             self._note_entries(msg.entries)
         rep = m.MECSubWriteReply(self.pgid, self.osd.epoch(), msg.shard, 0)
