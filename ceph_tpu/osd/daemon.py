@@ -1010,6 +1010,13 @@ class _HBDispatcher(Dispatcher):
         self.osd = osd
 
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if not self.osd.up:
+            # a down daemon must not answer pings either: a lingering
+            # hb listener that keeps replying would stop peers from
+            # ever reporting us to the mon — no new map, no
+            # re-peering, writes to our PGs wedge (review find on the
+            # down-dispatch gate)
+            raise RuntimeError(f"osd.{self.osd.whoami} is down")
         if isinstance(msg, m.MOSDPing):
             return self.osd._handle_ping(conn, msg)
         return False

@@ -166,7 +166,14 @@ class PG:
                 # in an older interval (e.g. replayed by a lossless
                 # session onto a revived/recycled peer) must NOT apply
                 # over recovered data (reference: ops are discarded
-                # when msg epoch < same_interval_since)
+                # when msg epoch < same_interval_since).  Known
+                # approximation: this is the DETECTION epoch, which
+                # can overshoot the true interval start when maps
+                # arrive batched — a same-interval primary one epoch
+                # behind then has its sub-write dropped and the client
+                # retries after it catches up (bounded by map
+                # propagation).  Deriving same_interval_since from map
+                # history would remove the overshoot (round-5 item).
                 self.state = STATE_PEERING
                 self.interval_epoch = self.osd.epoch()
             if prior is not None:
@@ -974,12 +981,6 @@ class PG:
         writes of only the touched stripes."""
         wop = msg.ops[0]
         be: ECBackend = self.backend  # type: ignore[assignment]
-        with self.lock:
-            if msg.oid in self.missing:
-                # unrecovered locally: the full write path reconstructs
-                # degraded-aware; the extent path must not run off a
-                # stale local image
-                return False
         if not be.can_partial(msg.oid, wop.off, len(wop.data)):
             return False
         width = be.stripe_width
