@@ -151,6 +151,55 @@ class BalancerModule(MgrModule):
         }
 
 
+class TelemetryModule(MgrModule):
+    """`telemetry show`: the anonymized cluster report (reference
+    src/pybind/mgr/telemetry/module.py role, local-only — nothing is
+    ever sent anywhere)."""
+
+    name = "telemetry"
+
+    def report(self) -> dict:
+        import hashlib
+
+        mgr = self.mgr
+        counters = mgr.collect()
+        n_counters = sum(len(c) for subs in counters.values()
+                         for c in subs.values())
+        osdmap = mgr.osdmap
+        pools = []
+        osds = {"count": 0, "up": 0}
+        if osdmap is not None:
+            for pid, p in sorted(getattr(osdmap, "pools", {}).items()):
+                pools.append({
+                    "id": pid,
+                    "type": "erasure" if getattr(p, "pool_type", 1) == 3
+                    else "replicated",
+                    "pg_num": getattr(p, "pg_num", 0),
+                    "size": getattr(p, "size", 0)})
+            ups = getattr(osdmap, "osd_state_up", None)
+            if ups is not None:
+                osds = {"count": int(len(ups)),
+                        "up": int(sum(bool(u) for u in ups))}
+        # cluster id is a HASH of the daemon roster: stable for one
+        # cluster, reveals nothing (the reference hashes the fsid)
+        ident = hashlib.sha1(",".join(
+            sorted(mgr.daemons)).encode()).hexdigest()[:16]
+        return {
+            "report_id": ident,
+            "daemons": {"registered": sorted(mgr.daemons)},
+            "osds": osds,
+            "pools": pools,
+            "perf_counter_count": n_counters,
+            "last_collect": mgr.last_collect,
+            "channel": "local-only (never transmitted)",
+        }
+
+    def handle_command(self, cmd):
+        if cmd.get("prefix") != "telemetry show":
+            return None
+        return 0, self.report()
+
+
 class MgrDaemon:
     """The aggregation point: daemons register, modules serve."""
 
@@ -165,7 +214,7 @@ class MgrDaemon:
 
         for m in (StatusModule(self), PrometheusModule(self),
                   CrashModule(self), BalancerModule(self),
-                  DashboardModule(self)):
+                  DashboardModule(self), TelemetryModule(self)):
             self.modules[m.name] = m
 
     def register_daemon(self, name: str, ctx) -> None:

@@ -1041,6 +1041,25 @@ class Monitor(Dispatcher):
                         "utilization": round(used / total, 4)
                         if total else 0.0})
                 return 0, {"nodes": rows}
+        if prefix == "df":
+            # cluster + per-pool usage (the `ceph df` surface,
+            # reference OSDMonitor 'df' via the pg stats feed)
+            with self.lock:
+                used = sum(u for u, _ in self.osd_fullness.values())
+                total = sum(t for _, t in self.osd_fullness.values())
+                per_pool: Dict[int, int] = {}
+                for osd, (stamp, pgs) in self.pg_stats.items():
+                    for (pool, ps, state, n, lu_e, lu_v, prim) in pgs:
+                        if prim:
+                            per_pool[pool] = per_pool.get(pool, 0) + n
+                pools = []
+                if self.osdmap is not None:
+                    for pid, p in sorted(self.osdmap.pools.items()):
+                        pools.append({"name": p.name, "id": pid,
+                                      "objects": per_pool.get(pid, 0)})
+                return 0, {"total_bytes": total, "used_bytes": used,
+                           "avail_bytes": max(0, total - used),
+                           "pools": pools}
         if prefix in ("pg scrub", "pg deep-scrub", "pg repair"):
             # relay to the PG's primary OSD (the reference mon builds an
             # MOSDScrub for `ceph pg repair`, src/mon/MonCmds.h) — the

@@ -73,3 +73,19 @@ def test_404_and_command(cluster):
         assert e.code == 404
     rc, out = cluster.mgr.handle_command({"prefix": "dashboard status"})
     assert rc == 0 and out["running"] and str(cluster._dash_port) in out["url"]
+
+
+def test_df_command_and_telemetry(cluster):
+    rc, out = cluster.command({"prefix": "df"})
+    assert rc == 0
+    assert out["total_bytes"] > 0
+    assert any(p["name"] == "data" for p in out["pools"])
+    data = next(p for p in out["pools"] if p["name"] == "data")
+    assert data["objects"] >= 1  # obj1 written in the fixture
+
+    rc, rep = cluster.mgr.handle_command({"prefix": "telemetry show"})
+    assert rc == 0
+    assert rep["channel"].startswith("local-only")
+    assert rep["osds"]["count"] == 3 and rep["osds"]["up"] == 3
+    assert any(p["type"] == "replicated" for p in rep["pools"])
+    assert len(rep["report_id"]) == 16

@@ -59,6 +59,8 @@ def _parse(tokens):
         return {"prefix": "mds fail", "rank": t[2]}
     if t[0] == "osd" and t[1] == "tree":
         return {"prefix": "osd tree"}
+    if t[0] == "df":
+        return {"prefix": "df"}
     if t[0] == "status":
         return {"prefix": "status"}
     if t[0] == "health":
