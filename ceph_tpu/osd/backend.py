@@ -643,8 +643,16 @@ class ECBackend(PGBackend):
             t = Transaction()
             g = GHObject(oid, shard=shard)
             t.write(self.coll, g, ext_off, payload)
-            # whole-chunk crc can't survive an extent write (see _hinfo)
-            t.setattrs(self.coll, g, {"hinfo": _hinfo(b"", size, False)})
+            # whole-chunk crc can't survive an extent write (see
+            # _hinfo).  _av: partial writes stamp the shard version
+            # like full writes do, so the NEXT RMW base read can
+            # version-check its extents (a stale shard — degraded-
+            # skipped or not-yet-recovered — carries an older stamp
+            # and is excluded instead of corrupting the base)
+            attrs = {"hinfo": _hinfo(b"", size, False)}
+            if entries:
+                attrs["_av"] = _av_stamp(entries[-1].version)
+            t.setattrs(self.coll, g, attrs)
             if log_omap:
                 t.touch(self.coll, _meta_oid())
                 t.omap_setkeys(self.coll, _meta_oid(), log_omap)

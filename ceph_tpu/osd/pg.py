@@ -941,14 +941,27 @@ class PG:
         """Old content of stripes [s0, s1): local shard extents first,
         then ranged sub-reads; decodes when data shards are missing
         (reference try_state_to_reads, ECBackend.cc:1817)."""
+        from ceph_tpu.osd.backend import _av_stamp
+
         be: ECBackend = self.backend  # type: ignore[assignment]
         n = be.k + be.m
         acting = list(self.acting[:n]) + [CRUSH_ITEM_NONE] * (
             n - len(self.acting))
         off, length = be.sinfo.chunk_extent(s0, s1)
-        extents: Dict[int, bytes] = {}
+        # version discipline (thrash-hunt divergence class): per-PG
+        # write ordering means every live shard of this object carries
+        # the _av stamp of its newest log entry — an extent with any
+        # OTHER stamp is stale (degraded-skipped write, not-yet-applied
+        # recovery push, zombie store) and must not enter the RMW base.
+        # Objects predating the stamp (or with no log entry) fall back
+        # to the full write path, which reads degraded-aware.
         with self.lock:
+            en = self.log.latest_for(oid)
             local_stale = oid in self.missing
+        if en is None or en.op == t_.LOG_DELETE:
+            return None
+        want_av = _av_stamp(en.version)
+        extents: Dict[int, bytes] = {}
         if not local_stale:
             # a primary that hasn't recovered this object yet must not
             # feed its own stale chunk into the RMW base (the full-read
@@ -956,6 +969,9 @@ class PG:
             # thrash-hunt divergence: a partial write rebuilt a shard
             # from a pre-takeover image)
             for shard in be.local_shards(acting):
+                attrs, _omap = be.shard_meta(oid, shard)
+                if attrs.get("_av") != want_av:
+                    continue
                 c = be.read_local_chunk(oid, shard)
                 if c is not None and len(c) >= off + length:
                     extents[shard] = c[off: off + length]
@@ -972,7 +988,8 @@ class PG:
                 for rep in self.osd.rpc(remote, timeout=10.0):
                     if (isinstance(rep, m.MECSubReadReply)
                             and rep.result == 0
-                            and len(rep.data) == length):
+                            and len(rep.data) == length
+                            and rep.attrs.get("_av") == want_av):
                         extents[rep.shard] = rep.data
         return be.assemble_range(extents, s0, s1)
 
