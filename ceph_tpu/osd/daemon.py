@@ -928,8 +928,12 @@ class OSDService(Dispatcher):
         pg._ec_read_object(oid, got)
         done.wait(timeout=30.0)
         state = box[0]
-        if state is None:
-            return  # not enough fresh shards: stays missing, retried
+        from ceph_tpu.osd.pg import READ_RETRY
+
+        if state is None or state is READ_RETRY:
+            return  # not reconstructable right now: stays missing,
+            # retried (READ_RETRY = holders unresponsive or chunks
+            # version-rejected; both heal)
         chunks, _ = be._encode_object(state.data)
         from ceph_tpu.osd.backend import _hinfo
 

@@ -1195,14 +1195,35 @@ class PG:
             cand_av = attrs.get("_av", b"")
             if box[0] is None or cand_av > box[0][0].get("_av", b""):
                 box[0] = (dict(attrs), dict(omap))
+        # version discipline (the same _av check the RMW base read
+        # uses): when the log still holds this object's newest entry,
+        # every usable chunk must carry that entry's stamp — assembling
+        # MIXED shard versions returns silently wrong bytes for
+        # systematic reads (thrash-hunt divergence: one stale shard
+        # served zeros straight into the result).  Mismatched chunks
+        # count as failed answers, so the RETRYABLE path fires and the
+        # client waits out recovery instead of reading garbage.
         with self.lock:
             local_stale = oid in self.missing
+            _en = self.log.latest_for(oid)
+        want_av = None
+        if _en is not None and _en.op != t_.LOG_DELETE:
+            from ceph_tpu.osd.backend import _av_stamp
+
+            want_av = _av_stamp(_en.version)
+
+        def _av_ok(attrs) -> bool:
+            return want_av is None or attrs.get("_av") == want_av
+        av_reject0 = False  # local chunk version-rejected
         if not local_stale:
             for shard in be.local_shards(acting):
+                attrs, omap = be.shard_meta(oid, shard)
+                if not _av_ok(attrs):
+                    av_reject0 = True
+                    continue
                 c = be.read_local_chunk(oid, shard)
                 if c is not None:
                     cur_avail[shard] = c
-                    attrs, omap = be.shard_meta(oid, shard)
                     _better_meta(cur_meta, attrs, omap)
         remote = [(s, o, True) for s, o in enumerate(acting)
                   if o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
@@ -1240,6 +1261,8 @@ class PG:
             return
         lock = threading.Lock()
         fired = [False]
+        # any chunk version-rejected (local pre-scan or on_reply)
+        av_reject = [av_reject0]
 
         def finish(timed_out: bool = False) -> None:
             with lock:
@@ -1250,10 +1273,13 @@ class PG:
                 meta = cur_meta[0] or prior_meta[0]
                 hung_cur = any(v > 0 for v in pending_cur.values())
             timer.cancel()
-            if len(av) < be.k and timed_out and hung_cur:
-                # a current holder never answered: its shard may exist
-                # and a prior holder's chunk must not substitute (mixed
-                # generations decode to garbage) — retryable, not gone
+            if len(av) < be.k and ((timed_out and hung_cur)
+                                   or av_reject[0]):
+                # a current holder never answered (its shard may exist
+                # and a prior holder's chunk must not substitute —
+                # mixed generations decode to garbage), or chunks were
+                # version-rejected (recovery will bring them forward):
+                # retryable, not gone
                 done(READ_RETRY)
                 return
             done(be.reconstruct(oid, av, meta) if av else None)
@@ -1264,7 +1290,15 @@ class PG:
                     return
                 src = rep.src.num if rep.src else -1
                 is_cur = holder_of.get((rep.shard, src), False)
-                if rep.result == 0 and rep.oid == oid:
+                if (rep.result == 0 and rep.oid == oid
+                        and not _av_ok(rep.attrs)):
+                    # version-mismatched chunk: a failed answer for the
+                    # pending bookkeeping, and the read must end
+                    # RETRYABLE (the shard exists, recovery will bring
+                    # it forward) rather than reporting absence
+                    av_reject[0] = True
+                if (rep.result == 0 and rep.oid == oid
+                        and _av_ok(rep.attrs)):
                     if is_cur:
                         cur_avail[rep.shard] = rep.data
                         if "hinfo" in rep.attrs:
