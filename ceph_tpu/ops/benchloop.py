@@ -4,10 +4,22 @@ The axon tunnel (~94 ms RTT; block_until_ready not a true sync) makes
 per-dispatch timing meaningless, so every EC engine benchmark measures
 the same way: iterations loop INSIDE one jit, each iteration XORs an
 anti-hoisting seed into the input (so XLA cannot hoist the encode as
-loop-invariant), outputs fold into an xor accumulator, and only a u32
-digest is fetched.  bench.py, tools/tpu_minibench.py and
-tools/tpu_tune.py all use THIS helper — the measurement protocol lives
-in one place (review finding: four hand copies drift).
+loop-invariant), each iteration's output reduces to a SCALAR digest
+accumulated across the loop (sum_digest_runner; the xor-fold variant
+seeded_loop_runner survives for comparisons but adds a full-size
+accumulator pass a pallas_call cannot fuse away), and only that digest
+is fetched.  bench.py, tools/tpu_minibench.py and tools/tpu_tune.py
+all measure through THIS module — the protocol lives in one place
+(review finding: four hand copies drift).
+
+Round-5 finding (PROBE2/PROBE3 artifacts): at FIXED small iteration
+counts every engine "measured" (iters x size)/RTT — wall time was one
+tunnel round trip no matter the work, so the number was the tunnel's,
+not the chip's (the round-4 artifacts' 5-12 GB/s EC rates and the
+27 GB/s session-2 observation were all this).  `calibrated_rate` is
+the fix: grow the in-jit iteration count until one dispatch's wall
+clock dwarfs the RTT, capped below the ~100 s axon worker-crash
+threshold.  With it, the same kernels measure 180-290 GB/s.
 """
 
 from __future__ import annotations
@@ -84,3 +96,64 @@ def loop_rate_gbps(enc, w3, out_shape, iters: int, object_bytes: int,
     """GB/s of `enc` over `iters` in-jit iterations on batch `w3`."""
     dt = timed_best(seeded_loop_runner(enc, out_shape, iters), w3, reps)
     return iters * object_bytes / dt / 1e9
+
+
+def sum_digest_runner(enc, iters: int):
+    """jit'd runner: per-iteration scalar digest (sum of out & 0xff)
+    accumulated as a scalar.  Cheaper than the xor-fold runner for
+    pallas engines: the fold's full-size accumulator pass cannot be
+    fused into a pallas_call the way XLA fuses it into its own graph."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def run(w3):
+        def body(i, acc):
+            s = jnp.full((1,), i, jnp.uint32)
+            return acc + jnp.sum(enc(w3, s) & 0xFF, dtype=jnp.uint32)
+        return lax.fori_loop(0, iters, body, jnp.uint32(0))
+
+    return run
+
+
+def calibrate_loop(make_run, *, start_iters: int = 16,
+                   target_s: float = 1.5, cap_s: float = 25.0,
+                   max_iters: int = 1 << 20):
+    """(iters, wall_s): grow an in-jit iteration count until one
+    dispatch's wall clock reaches `target_s` — the only honest timing
+    on a tunnel whose RTT swallows fixed-iteration runs whole (see
+    module docstring).  `make_run(iters)` returns a zero-arg callable
+    whose invocation runs + truly syncs (fetches) one dispatch.
+    The projected next dispatch is clamped to `cap_s` (the axon worker
+    crashes ~100 s dispatches) and `max_iters`."""
+    iters = int(start_iters)
+    while True:
+        run = make_run(iters)
+        run()  # compile + warm (fetch = the only true sync)
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        if dt >= target_s or iters >= max_iters:
+            return iters, dt
+        ips = iters / max(dt, 1e-4)  # iters/s, floor-biased by the RTT
+        want_s = min(target_s * 1.3, cap_s)
+        nxt = max(iters * 2, int(ips * want_s))
+        # real dispatch-wall clamp on BOTH growth arms (the doubling
+        # arm can outrun the projection when target_s approaches cap_s)
+        iters = min(max_iters, nxt, max(iters, int(ips * cap_s)))
+
+
+def calibrated_rate(enc, w3, object_bytes: int, *, start_iters: int = 16,
+                    target_s: float = 1.5, cap_s: float = 25.0,
+                    max_iters: int = 1 << 20, runner=sum_digest_runner):
+    """(gbps, iters, wall_s) for an engine over batch `w3` under the
+    calibrated protocol (see calibrate_loop)."""
+    def make_run(iters):
+        run = runner(enc, iters)
+        return lambda: int(run(w3))
+
+    iters, dt = calibrate_loop(make_run, start_iters=start_iters,
+                               target_s=target_s, cap_s=cap_s,
+                               max_iters=max_iters)
+    return object_bytes * iters / dt / 1e9, iters, dt

@@ -93,7 +93,7 @@ def _make_kernel(matrix: np.ndarray, mul_shift: bool = False) -> Callable:
 @functools.lru_cache(maxsize=64)
 def _compiled(matrix_bytes: bytes, shape: Tuple[int, int], tile: int,
               interpret: bool, mul_shift: bool = False,
-              donate: bool = False) -> Callable:
+              donate: bool = False, dimsem: str = "arbitrary") -> Callable:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -121,7 +121,7 @@ def _compiled(matrix_bytes: bytes, shape: Tuple[int, int], tile: int,
             out_specs=pl.BlockSpec((R, tile, LANES), lambda i: (0, i, 0),
                                    memory_space=pltpu.VMEM),
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("arbitrary",)),
+                dimension_semantics=(dimsem,)),
             input_output_aliases=alias,
             interpret=interpret,
         )(seed, words3)
@@ -132,7 +132,8 @@ def _compiled(matrix_bytes: bytes, shape: Tuple[int, int], tile: int,
 
 def encode_planes(matrix: np.ndarray, words3, seed=None, *,
                   tile: int = DEFAULT_TILE, interpret: bool | None = None,
-                  mul_shift: bool = False, donate: bool = False):
+                  mul_shift: bool = False, donate: bool = False,
+                  dimsem: str = "arbitrary"):
     """Apply GF(2^8) matrix (R x k) to packed planes u32 [k, T, 128].
 
     T must be a multiple of `tile` (callers control the batch shape; the
@@ -148,7 +149,7 @@ def encode_planes(matrix: np.ndarray, words3, seed=None, *,
     if seed is None:
         seed = jnp.zeros((1,), jnp.uint32)
     fn = _compiled(matrix.tobytes(), matrix.shape, tile, interpret,
-                   mul_shift, donate)
+                   mul_shift, donate, dimsem)
     return fn(jnp.asarray(words3, dtype=jnp.uint32), seed)
 
 

@@ -1,0 +1,161 @@
+"""Probe 2: decompose the ~6 GB/s cap that probe 1 found.
+
+Probe 1 (PROBE_KERNEL.json) showed copy-kernel == network-kernel ==
+XLA-graph ~= 5-6 GB/s while chained f32 HBM runs 130 GB/s: every
+engine pays a shared per-iteration cost.  Candidates: u32 elementwise
+traffic being slower than f32, lax.fori_loop overhead around a
+pallas_call, pallas launch fixed cost (amortized by bigger T), or the
+seed plumbing.  Each experiment isolates one.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+K, M, LANES = 8, 4, 128
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ceph_tpu.ec import matrices
+    from ceph_tpu.ops import gf256_pallas
+    from ceph_tpu.ops.benchloop import gen_planes, timed_best
+
+    out = {"backend": jax.default_backend(),
+           "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "results": {}}
+    res = out["results"]
+    path = sys.argv[1] if len(sys.argv) > 1 else "PROBE2.json"
+
+    def flush():
+        with open(path, "w") as f:
+            f.write(json.dumps(out) + "\n")
+
+    coding = matrices.isa_cauchy(K, M)
+
+    def copy_engine(T, tile, dimsem="parallel"):
+        def copy_kernel(seed_ref, x_ref, o_ref):
+            s = seed_ref[0]
+            for i in range(M):
+                o_ref[i] = x_ref[i] ^ s
+
+        def enc(w, s):
+            return pl.pallas_call(
+                copy_kernel,
+                out_shape=jax.ShapeDtypeStruct((M, T, LANES), jnp.uint32),
+                grid=(T // tile,),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec((K, tile, LANES), lambda i: (0, i, 0),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((M, tile, LANES), lambda i: (0, i, 0),
+                                       memory_space=pltpu.VMEM),
+                compiler_params=pltpu.CompilerParams(
+                    dimension_semantics=(dimsem,)),
+            )(s, w)
+        return enc
+
+    def sum_runner(enc, iters):
+        @jax.jit
+        def run(w):
+            def body(i, acc):
+                s = jnp.full((1,), i, jnp.uint32)
+                return acc + jnp.sum(enc(w, s) & 0xFF, dtype=jnp.uint32)
+            return lax.fori_loop(0, iters, body, jnp.uint32(0))
+        return run
+
+    def measure(tag, runner, w, obj, iters):
+        try:
+            dt = timed_best(runner, w)
+            res[tag] = round(iters * obj / dt / 1e9, 2)
+        except Exception as e:  # noqa: BLE001
+            res[tag] = "error: %s: %s" % (type(e).__name__, str(e)[:200])
+        flush()
+
+    # --- A: u32 elementwise HBM rate (no pallas, no digest-per-iter) --
+    T = 4096
+    OBJ = T * LANES * 4 * K
+    w3 = gen_planes(K, T)
+
+    @jax.jit
+    def u32_pass(w):
+        def body(i, acc):
+            return acc ^ w ^ i
+        o = lax.fori_loop(jnp.uint32(0), jnp.uint32(64), body,
+                          jnp.zeros_like(w))
+        return jnp.sum(o & 0xFF, dtype=jnp.uint32)
+    # traffic/iter = read acc K + read w K + write K planes = 3*OBJ
+    try:
+        dt = timed_best(u32_pass, w3)
+        res["u32_elementwise_hbm_gbps"] = round(64 * 3 * OBJ / dt / 1e9, 2)
+    except Exception as e:  # noqa: BLE001
+        res["u32_elementwise_hbm_gbps"] = "error: %s" % str(e)[:200]
+    flush()
+
+    # --- B: copy kernel, iteration-count sweep (fixed-vs-variable) ----
+    for iters in (6, 24, 96):
+        measure("copy_T4096_i%d" % iters,
+                sum_runner(copy_engine(T, 512), iters), w3, OBJ, iters)
+
+    # --- C: copy kernel, batch-size sweep -----------------------------
+    for TT in (1024, 16384, 32768):
+        wT = gen_planes(K, TT)
+        measure("copy_T%d_i6" % TT,
+                sum_runner(copy_engine(TT, 512), 6), wT,
+                TT * LANES * 4 * K, 6)
+
+    # --- D: XLA slice-copy, no pallas at all --------------------------
+    def xla_copy(w, s):
+        return w[:M] ^ s[0]
+
+    measure("xlacopy_T4096_i24", sum_runner(xla_copy, 24), w3, OBJ, 24)
+
+    # --- E: unrolled python loop (no fori) around the pallas call -----
+    enc512 = copy_engine(T, 512)
+
+    @jax.jit
+    def unrolled(w):
+        acc = jnp.uint32(0)
+        for i in range(8):
+            s = jnp.full((1,), i, jnp.uint32)
+            acc = acc + jnp.sum(enc512(w, s) & 0xFF, dtype=jnp.uint32)
+        return acc
+
+    measure("copy_unrolled8_T4096", unrolled, w3, OBJ, 8)
+
+    # --- F: network kernel at 64 MiB (amortization check) -------------
+    w16 = gen_planes(K, 16384)
+
+    def pall(tile):
+        return lambda w, s: gf256_pallas.encode_planes(
+            coding, w, s, tile=tile, interpret=False, dimsem="parallel")
+
+    measure("net_T16384_i6", sum_runner(pall(512), 6), w16,
+            16384 * LANES * 4 * K, 6)
+
+    # --- G: fori around pallas WITHOUT digest (xor-fold into planes) --
+    @jax.jit
+    def xorfold(w):
+        def body(i, acc):
+            s = jnp.full((1,), i, jnp.uint32)
+            return acc ^ enc512(w, s)
+        o = lax.fori_loop(0, 24, body,
+                          jnp.zeros((M, T, LANES), jnp.uint32))
+        return jnp.sum(o & 0xFF, dtype=jnp.uint32)
+
+    measure("copy_xorfold_T4096_i24", xorfold, w3, OBJ, 24)
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
