@@ -115,8 +115,8 @@ def envelope(jax, out):
     # carry gets VMEM-promoted by XLA (v5e VMEM = 128 MB) and measures
     # on-chip bandwidth instead (round-5 finding: the r1-r4 "hbm"
     # envelope row used 64 MB and so reported neither cleanly)
-    def chained_rate(buf_mb):
-        big = jnp.zeros((buf_mb, 1024, 1024), jnp.float32)
+    def chained_rate(n_blocks4m):  # working set = n_blocks4m * 4 MB
+        big = jnp.zeros((n_blocks4m, 1024, 1024), jnp.float32)
 
         def make(iters):
             @jax.jit
@@ -301,7 +301,7 @@ def _ec_device(jax, out):
     batches = {}
     iters_seed = {}
 
-    def rate_at(matrix, T, R, start_iters=64):
+    def rate_at(matrix, T, start_iters=64):
         kk = (T, win_inter)
         if kk not in batches:
             batches[kk] = gen(T, interleaved=win_inter)
@@ -331,7 +331,7 @@ def _ec_device(jax, out):
         # rig has seen — its failure must not erase the measured rows
         # ("an engine variant failing is data", same rule as the tune)
         try:
-            gbps = rate_at(coding, T, M, start)
+            gbps = rate_at(coding, T, start)
         except Exception as e:  # noqa: BLE001
             sweep[str(size)] = {"encode_gbps": f"error: {e!r}"[:120]}
             continue
@@ -372,7 +372,7 @@ def _ec_device(jax, out):
         # stand-in survivor planes (same shapes/throughput as data)
         try:
             sweep[str(size)]["decode_gbps"] = round(
-                rate_at(rec, T, K, start), 3)
+                rate_at(rec, T, start), 3)
         except Exception as e:  # noqa: BLE001
             sweep[str(size)]["decode_gbps"] = f"error: {e!r}"[:120]
 

@@ -54,9 +54,12 @@ def _configure(L: ctypes.CDLL) -> None:
     L.gf256_rs_encode.restype = None
     L.gf256_rs_encode.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p, u8p,
                                   ctypes.c_int64]
+    # c_void_p: accepts both POINTER instances and raw .ctypes.data
+    # ints — the latter is the lean hot path (see rs_encode_simd)
     L.gf256_rs_encode_simd.restype = None
-    L.gf256_rs_encode_simd.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p,
-                                       u8p, ctypes.c_int64]
+    L.gf256_rs_encode_simd.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int, ctypes.c_void_p,
+                                       ctypes.c_void_p, ctypes.c_int64]
     L.gf256_simd_available.restype = ctypes.c_int
     L.gf256_simd_available.argtypes = []
     L.gf256_mat_invert.restype = ctypes.c_int
@@ -120,13 +123,21 @@ def rs_encode(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
 
 def rs_encode_simd(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
     """ISA-L-class encode (AVX2 split-nibble PSHUFB when compiled in,
-    scalar fallback otherwise) — the honest CPU bench baseline."""
+    scalar fallback otherwise) — the honest CPU bench baseline.
+
+    Kept LEAN on purpose: this is the product CPU-backend hot path for
+    small ops (the 4 KiB BASELINE row), where ctypes marshalling used
+    to cost ~3x the kernel itself.  The C side memsets `coding`, so
+    np.empty suffices; pointer ints ride the c_void_p argtypes."""
     m, k = matrix.shape
     length = data.shape[1]
-    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
-    data = np.ascontiguousarray(data, dtype=np.uint8)
-    coding = np.zeros((m, length), dtype=np.uint8)
-    lib().gf256_rs_encode_simd(_u8(matrix), k, m, _u8(data), _u8(coding),
+    if matrix.dtype != np.uint8 or not matrix.flags.c_contiguous:
+        matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    if data.dtype != np.uint8 or not data.flags.c_contiguous:
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+    coding = np.empty((m, length), dtype=np.uint8)
+    lib().gf256_rs_encode_simd(matrix.ctypes.data, k, m,
+                               data.ctypes.data, coding.ctypes.data,
                                length)
     return coding
 

@@ -166,11 +166,16 @@ class ErasureCode:
         self, want_to_encode: Iterable[int], data: bytes
     ) -> Dict[int, np.ndarray]:
         planes, _ = self.encode_prepare(data)
-        coding = self.encode_array(planes)
-        allchunks = np.concatenate([planes, np.asarray(coding)], axis=0)
+        coding = np.asarray(self.encode_array(planes))
+        if not coding.flags.writeable:
+            # accelerator backends hand back read-only views; callers
+            # historically received writable chunks (np.concatenate)
+            coding = np.array(coding)
+        # row views, no concatenation: the copy mattered at the 4 KiB
+        # BASELINE row where python-side overhead IS the benchmark
         out: Dict[int, np.ndarray] = {}
         for i in want_to_encode:
-            out[i] = allchunks[i]
+            out[i] = planes[i] if i < self.k else coding[i - self.k]
         return out
 
     def decode(

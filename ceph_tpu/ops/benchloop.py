@@ -127,6 +127,7 @@ def calibrate_loop(make_run, *, start_iters: int = 16,
     whose invocation runs + truly syncs (fetches) one dispatch.
     The projected next dispatch is clamped to `cap_s` (the axon worker
     crashes ~100 s dispatches) and `max_iters`."""
+    target_s = min(target_s, cap_s)  # a target past the cap can't halt
     iters = int(start_iters)
     while True:
         run = make_run(iters)

@@ -1,0 +1,92 @@
+/* _fastec: CPython extension for the CPU-backend small-op hot path.
+ *
+ * The per-object encode cost at the 4 KiB BASELINE row (BASELINE.md
+ * row 1, reference harness src/test/erasure-code/
+ * ceph_erasure_code_benchmark.cc:151-190) is pure interpreter + ctypes
+ * overhead: split/pad in numpy + a ctypes call measured ~15 us while
+ * the AVX2 kernel itself runs ~1 us.  This extension collapses
+ * split + zero-pad + encode into ONE C call returning the full
+ * (k+m, blocksize) chunk array (reference semantics:
+ * jerasure_matrix_encode, src/erasure-code/jerasure/
+ * ErasureCodeJerasure.cc:155 — data chunks are views of the padded
+ * object, coding chunks follow).
+ *
+ * The GF kernel is the same gf256_rs_encode_simd exported by
+ * libceph_tpu_native.so (csrc/gf256_simd.cc), linked directly.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+#include <stdint.h>
+#include <string.h>
+
+extern void gf256_rs_encode_simd(const uint8_t *matrix, int k, int m,
+                                 const uint8_t *data, uint8_t *coding,
+                                 int64_t len);
+
+static PyObject *encode_obj(PyObject *self, PyObject *args) {
+  PyObject *mobj;
+  Py_buffer dbuf;
+  Py_ssize_t blocksize;
+  (void)self;
+  if (!PyArg_ParseTuple(args, "Oy*n", &mobj, &dbuf, &blocksize))
+    return NULL;
+  if (!PyArray_Check(mobj)) {
+    PyBuffer_Release(&dbuf);
+    PyErr_SetString(PyExc_TypeError, "matrix must be an ndarray");
+    return NULL;
+  }
+  PyArrayObject *marr = (PyArrayObject *)mobj;
+  if (PyArray_TYPE(marr) != NPY_UINT8 || !PyArray_IS_C_CONTIGUOUS(marr) ||
+      PyArray_NDIM(marr) != 2) {
+    PyBuffer_Release(&dbuf);
+    PyErr_SetString(PyExc_TypeError,
+                    "matrix must be C-contiguous uint8 of shape (m, k)");
+    return NULL;
+  }
+  int m = (int)PyArray_DIM(marr, 0);
+  int k = (int)PyArray_DIM(marr, 1);
+  if (blocksize <= 0 || dbuf.len > (Py_ssize_t)k * blocksize) {
+    PyBuffer_Release(&dbuf);
+    PyErr_SetString(PyExc_ValueError, "data longer than k * blocksize");
+    return NULL;
+  }
+  npy_intp dims[2] = {k + m, blocksize};
+  PyArrayObject *out = (PyArrayObject *)PyArray_SimpleNew(2, dims, NPY_UINT8);
+  if (out == NULL) {
+    PyBuffer_Release(&dbuf);
+    return NULL;
+  }
+  uint8_t *base = (uint8_t *)PyArray_DATA(out);
+  size_t dlen = (size_t)dbuf.len;
+  memcpy(base, dbuf.buf, dlen);
+  memset(base + dlen, 0, (size_t)k * (size_t)blocksize - dlen);
+  gf256_rs_encode_simd((const uint8_t *)PyArray_DATA(marr), k, m, base,
+                       base + (size_t)k * (size_t)blocksize,
+                       (int64_t)blocksize);
+  PyBuffer_Release(&dbuf);
+  return (PyObject *)out;
+}
+
+static PyMethodDef Methods[] = {
+    {"encode_obj", encode_obj, METH_VARARGS,
+     "encode_obj(matrix_u8[m,k], data_buffer, blocksize) -> uint8 "
+     "ndarray (k+m, blocksize): split + zero-pad + RS encode in one "
+     "call"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_fastec",
+    "one-call split+pad+encode for the CPU small-op hot path", -1,
+    Methods, NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit__fastec(void) {
+  PyObject *mod = PyModule_Create(&moduledef);
+  if (mod == NULL)
+    return NULL;
+  import_array();
+  return mod;
+}
