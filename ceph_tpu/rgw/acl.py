@@ -1,0 +1,179 @@
+"""S3 access control lists (reference src/rgw/rgw_acl.h +
+rgw_acl_s3.cc).
+
+An ACL is a plain dict — {"owner": uid, "grants": [{"grantee": g,
+"perm": p}, ...]} — stored inline in bucket metadata and object index
+entries (the reference serializes RGWAccessControlPolicy into the
+bucket instance / object attrs; same placement, JSON instead of
+ceph-encode).
+
+Grantee forms (reference ACLGranteeType):
+  - a user id (CanonicalUser)
+  - "*"     — the AllUsers group (anonymous included)
+  - "auth"  — the AuthenticatedUsers group
+
+Permissions: READ, WRITE, READ_ACP, WRITE_ACP, FULL_CONTROL, with
+FULL_CONTROL implying the rest and the owner always holding
+FULL_CONTROL (rgw_acl.h RGW_PERM_FULL_CONTROL semantics).
+
+Canned ACLs mirror rgw_acl_s3.cc's (private, public-read,
+public-read-write, authenticated-read, bucket-owner-read,
+bucket-owner-full-control).
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import Dict, List, Optional
+from xml.sax.saxutils import escape
+
+PERMS = ("READ", "WRITE", "READ_ACP", "WRITE_ACP", "FULL_CONTROL")
+
+ALL_USERS = "*"
+AUTH_USERS = "auth"
+
+_GROUP_URI = {
+    ALL_USERS: "http://acs.amazonaws.com/groups/global/AllUsers",
+    AUTH_USERS: "http://acs.amazonaws.com/groups/global/AuthenticatedUsers",
+}
+_URI_GROUP = {v: k for k, v in _GROUP_URI.items()}
+
+CANNED = ("private", "public-read", "public-read-write",
+          "authenticated-read", "bucket-owner-read",
+          "bucket-owner-full-control")
+
+
+class InvalidAcl(ValueError):
+    pass
+
+
+def canned_acl(owner: str, name: str = "private",
+               bucket_owner: Optional[str] = None) -> Dict:
+    """Build the policy for a canned ACL header value
+    (reference rgw_acl_s3.cc create_canned)."""
+    grants: List[Dict] = []
+    if name == "private" or not name:
+        pass
+    elif name == "public-read":
+        grants.append({"grantee": ALL_USERS, "perm": "READ"})
+    elif name == "public-read-write":
+        grants.append({"grantee": ALL_USERS, "perm": "READ"})
+        grants.append({"grantee": ALL_USERS, "perm": "WRITE"})
+    elif name == "authenticated-read":
+        grants.append({"grantee": AUTH_USERS, "perm": "READ"})
+    elif name == "bucket-owner-read":
+        if bucket_owner and bucket_owner != owner:
+            grants.append({"grantee": bucket_owner, "perm": "READ"})
+    elif name == "bucket-owner-full-control":
+        if bucket_owner and bucket_owner != owner:
+            grants.append({"grantee": bucket_owner,
+                           "perm": "FULL_CONTROL"})
+    else:
+        raise InvalidAcl(f"unknown canned ACL {name!r}")
+    return {"owner": owner, "grants": grants}
+
+
+def allows(acl: Optional[Dict], actor: Optional[str], perm: str) -> bool:
+    """Does `actor` hold `perm` under `acl`?  The owner holds
+    FULL_CONTROL implicitly; actor None means anonymous (matches only
+    the AllUsers group)."""
+    if perm not in PERMS:
+        raise InvalidAcl(f"unknown permission {perm!r}")
+    if acl is None:
+        return False
+    if actor is not None and actor == acl.get("owner"):
+        return True
+    for g in acl.get("grants", []):
+        grantee = g.get("grantee")
+        if not (grantee == ALL_USERS
+                or (grantee == AUTH_USERS and actor is not None)
+                or (actor is not None and grantee == actor)):
+            continue
+        if g.get("perm") == perm or g.get("perm") == "FULL_CONTROL":
+            return True
+    return False
+
+
+def validate(acl: Dict) -> Dict:
+    """Normalize + validate a policy dict (PUT ?acl body or API)."""
+    if not isinstance(acl, dict) or not acl.get("owner"):
+        raise InvalidAcl("policy requires an owner")
+    grants = []
+    for g in acl.get("grants", []):
+        if g.get("perm") not in PERMS:
+            raise InvalidAcl(f"unknown permission {g.get('perm')!r}")
+        if not g.get("grantee"):
+            raise InvalidAcl("grant requires a grantee")
+        grants.append({"grantee": g["grantee"], "perm": g["perm"]})
+    return {"owner": acl["owner"], "grants": grants}
+
+
+# ---------------------------------------------------------------------------
+# XML (the S3 REST wire form, reference rgw_acl_s3.cc to_xml/parse)
+# ---------------------------------------------------------------------------
+
+def to_xml(acl: Dict) -> str:
+    rows = []
+    for g in acl.get("grants", []):
+        grantee = g["grantee"]
+        if grantee in _GROUP_URI:
+            gx = ("<Grantee xmlns:xsi=\"http://www.w3.org/2001/"
+                  "XMLSchema-instance\" xsi:type=\"Group\">"
+                  f"<URI>{_GROUP_URI[grantee]}</URI></Grantee>")
+        else:
+            gx = ("<Grantee xmlns:xsi=\"http://www.w3.org/2001/"
+                  "XMLSchema-instance\" xsi:type=\"CanonicalUser\">"
+                  f"<ID>{escape(grantee)}</ID></Grantee>")
+        rows.append(f"<Grant>{gx}<Permission>{g['perm']}</Permission>"
+                    "</Grant>")
+    return ("<?xml version=\"1.0\"?><AccessControlPolicy>"
+            f"<Owner><ID>{escape(acl['owner'])}</ID></Owner>"
+            f"<AccessControlList>{''.join(rows)}</AccessControlList>"
+            "</AccessControlPolicy>")
+
+
+def _local(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def from_xml(body: bytes) -> Dict:
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError as e:
+        raise InvalidAcl(f"malformed ACL XML: {e}")
+    if _local(root.tag) != "AccessControlPolicy":
+        raise InvalidAcl("expected AccessControlPolicy")
+    owner = None
+    grants: List[Dict] = []
+    for child in root:
+        if _local(child.tag) == "Owner":
+            for sub in child:
+                if _local(sub.tag) == "ID":
+                    owner = (sub.text or "").strip()
+        elif _local(child.tag) == "AccessControlList":
+            for grant in child:
+                if _local(grant.tag) != "Grant":
+                    continue
+                grantee = None
+                perm = None
+                for sub in grant:
+                    t = _local(sub.tag)
+                    if t == "Grantee":
+                        for gsub in sub:
+                            gt = _local(gsub.tag)
+                            if gt == "ID":
+                                grantee = (gsub.text or "").strip()
+                            elif gt == "URI":
+                                uri = (gsub.text or "").strip()
+                                if uri not in _URI_GROUP:
+                                    raise InvalidAcl(
+                                        f"unknown group URI {uri!r}")
+                                grantee = _URI_GROUP[uri]
+                    elif t == "Permission":
+                        perm = (sub.text or "").strip()
+                if grantee is None or perm is None:
+                    raise InvalidAcl("grant missing grantee/permission")
+                grants.append({"grantee": grantee, "perm": perm})
+    if not owner:
+        raise InvalidAcl("policy missing owner")
+    return validate({"owner": owner, "grants": grants})

@@ -39,6 +39,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from xml.sax.saxutils import escape
 
+from ceph_tpu.rgw import acl as _acl
 from ceph_tpu.rgw import gateway as gw
 from ceph_tpu.rgw.users import AuthFailure, RGWUserAdmin
 
@@ -212,6 +213,7 @@ class _Handler(BaseHTTPRequestHandler):
             raise
         except Exception:
             raise _S3Error(401, "Unauthorized", "unknown user")
+        actor = entry[0]
         parsed = urllib.parse.urlsplit(self.path)
         q = dict(urllib.parse.parse_qsl(parsed.query,
                                         keep_blank_values=True))
@@ -230,18 +232,19 @@ class _Handler(BaseHTTPRequestHandler):
             elif not obj:
                 if meth == "PUT":
                     try:
-                        rgw.create_bucket(container)
+                        rgw.create_bucket(container, actor=actor)
                         self._reply(201)
                     except gw.BucketExists:
                         self._reply(202)  # swift: idempotent PUT
                 elif meth == "DELETE":
-                    rgw.delete_bucket(container)
+                    rgw.delete_bucket(container, actor=actor)
                     self._reply(204)
                 elif meth in ("GET", "HEAD"):
                     entries, _tr = rgw.list_objects(
                         container, prefix=q.get("prefix", ""),
                         marker=q.get("marker", ""),
-                        max_keys=int(q.get("limit", 1000)))
+                        max_keys=int(q.get("limit", 1000)),
+                        actor=actor)
                     if q.get("format") == "json":
                         rows = json.dumps(
                             [{"name": e["Key"], "bytes": e["Size"],
@@ -260,10 +263,11 @@ class _Handler(BaseHTTPRequestHandler):
                             for k, v in self.headers.items()
                             if k.lower().startswith("x-object-meta-")}
                     etag = rgw.put_object(container, obj, body,
-                                          metadata=meta)
+                                          metadata=meta, actor=actor)
                     self._reply(201, extra={"ETag": etag})
                 elif meth == "GET":
-                    data, head = rgw.get_object(container, obj)
+                    data, head = rgw.get_object(container, obj,
+                                                actor=actor)
                     extra = {"ETag": head["etag"]}
                     extra.update({f"X-Object-Meta-{k}": v for k, v in
                                   head.get("meta", {}).items()})
@@ -271,13 +275,13 @@ class _Handler(BaseHTTPRequestHandler):
                                 ctype="application/octet-stream",
                                 extra=extra)
                 elif meth == "HEAD":
-                    head = rgw.head_object(container, obj)
+                    head = rgw.head_object(container, obj, actor=actor)
                     self.send_response(200)
                     self.send_header("Content-Length", str(head["size"]))
                     self.send_header("ETag", head["etag"])
                     self.end_headers()
                 elif meth == "DELETE":
-                    rgw.delete_object(container, obj)
+                    rgw.delete_object(container, obj, actor=actor)
                     self._reply(204)
                 else:
                     raise _S3Error(405, "MethodNotAllowed")
@@ -287,6 +291,8 @@ class _Handler(BaseHTTPRequestHandler):
             raise _S3Error(404, "NoSuchObject")
         except gw.BucketNotEmpty:
             raise _S3Error(409, "Conflict")
+        except gw.AccessDenied as e:
+            raise _S3Error(403, "AccessDenied", str(e))
 
     def _route(self) -> None:
         body = self._read_body()
@@ -297,7 +303,7 @@ class _Handler(BaseHTTPRequestHandler):
             if self.path.startswith("/swift/v1"):
                 self._swift_route(body)
                 return
-            self._authenticate(body)
+            user = self._authenticate(body)
             parsed = urllib.parse.urlsplit(self.path)
             q = dict(urllib.parse.parse_qsl(parsed.query,
                                             keep_blank_values=True))
@@ -305,15 +311,21 @@ class _Handler(BaseHTTPRequestHandler):
             bucket = urllib.parse.unquote(parts[0]) if parts[0] else ""
             key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
             try:
-                self._dispatch(bucket, key, q, body)
+                self._dispatch(bucket, key, q, body, user["uid"])
             except gw.NoSuchBucket:
                 raise _S3Error(404, "NoSuchBucket")
+            except gw.NoSuchVersion:
+                raise _S3Error(404, "NoSuchVersion")
             except gw.NoSuchKey:
                 raise _S3Error(404, "NoSuchKey")
             except gw.BucketExists:
                 raise _S3Error(409, "BucketAlreadyExists")
             except gw.BucketNotEmpty:
                 raise _S3Error(409, "BucketNotEmpty")
+            except gw.AccessDenied as e:
+                raise _S3Error(403, "AccessDenied", str(e))
+            except _acl.InvalidAcl as e:
+                raise _S3Error(400, "MalformedACLError", str(e))
         except _S3Error as e:
             self._error(e)
         except Exception as e:  # storage-layer failure
@@ -322,8 +334,11 @@ class _Handler(BaseHTTPRequestHandler):
     do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _route
 
     # -- S3 ops -----------------------------------------------------------
+    def _canned(self) -> str:
+        return self.headers.get("x-amz-acl", "private") or "private"
+
     def _dispatch(self, bucket: str, key: str, q: Dict[str, str],
-                  body: bytes) -> None:
+                  body: bytes, actor: str) -> None:
         rgw = self.server.frontend.rgw
         meth = self.command
         if not bucket:
@@ -338,43 +353,185 @@ class _Handler(BaseHTTPRequestHandler):
                 "</ListAllMyBucketsResult>").encode())
             return
         if not key:
-            if meth == "PUT":
-                rgw.create_bucket(bucket)
+            self._dispatch_bucket(rgw, bucket, q, body, actor)
+            return
+        self._dispatch_object(rgw, bucket, key, q, body, actor)
+
+    def _dispatch_bucket(self, rgw, bucket: str, q: Dict[str, str],
+                         body: bytes, actor: str) -> None:
+        meth = self.command
+        # subresources (reference rgw_rest_s3.cc op routing)
+        if "acl" in q:
+            if meth == "GET":
+                self._reply(200, _acl.to_xml(
+                    rgw.get_bucket_acl(bucket, actor=actor)).encode())
+            elif meth == "PUT":
+                if body:
+                    policy = _acl.from_xml(body)
+                else:
+                    owner = rgw.get_bucket_acl(bucket,
+                                               actor=actor)["owner"]
+                    policy = _acl.canned_acl(owner, self._canned())
+                rgw.put_bucket_acl(bucket, policy, actor=actor)
                 self._reply(200)
-            elif meth == "DELETE":
-                rgw.delete_bucket(bucket)
-                self._reply(204)
-            elif meth in ("GET", "HEAD"):
-                entries, truncated = rgw.list_objects(
-                    bucket, prefix=q.get("prefix", ""),
-                    marker=q.get("marker", q.get("start-after", "")),
-                    max_keys=int(q.get("max-keys", 1000)))
-                rows = "".join(
-                    f"<Contents><Key>{escape(e['Key'])}</Key>"
-                    f"<Size>{e['Size']}</Size>"
-                    f"<ETag>&quot;{e['ETag']}&quot;</ETag></Contents>"
-                    for e in entries)
-                self._reply(200, (
-                    "<?xml version=\"1.0\"?><ListBucketResult>"
-                    f"<Name>{escape(bucket)}</Name>"
-                    f"<IsTruncated>{str(truncated).lower()}</IsTruncated>"
-                    f"{rows}</ListBucketResult>").encode())
             else:
                 raise _S3Error(405, "MethodNotAllowed")
             return
-        # object-scoped ops
+        if "versioning" in q:
+            if meth == "GET":
+                st = rgw.get_versioning(bucket, actor=actor)
+                inner = f"<Status>{st}</Status>" if st else ""
+                self._reply(200, (
+                    "<?xml version=\"1.0\"?>"
+                    f"<VersioningConfiguration>{inner}"
+                    "</VersioningConfiguration>").encode())
+            elif meth == "PUT":
+                import xml.etree.ElementTree as ET
+
+                try:
+                    root = ET.fromstring(body)
+                    st = ""
+                    for c in root.iter():
+                        if c.tag.rsplit('}', 1)[-1] == "Status":
+                            st = (c.text or "").strip()
+                    rgw.set_versioning(bucket, st, actor=actor)
+                except (ValueError, ET.ParseError) as e:
+                    # ParseError is a SyntaxError, NOT a ValueError
+                    raise _S3Error(400, "IllegalVersioningConfiguration"
+                                        "Exception", str(e))
+                self._reply(200)
+            else:
+                raise _S3Error(405, "MethodNotAllowed")
+            return
+        if "versions" in q:
+            if meth != "GET":
+                raise _S3Error(405, "MethodNotAllowed")
+            rows, truncated = rgw.list_object_versions(
+                bucket, prefix=q.get("prefix", ""),
+                key_marker=q.get("key-marker", ""),
+                max_keys=int(q.get("max-keys", 1000)), actor=actor)
+            xml_rows = []
+            for r in rows:
+                tag = ("DeleteMarker" if r["IsDeleteMarker"]
+                       else "Version")
+                inner = (
+                    f"<Key>{escape(r['Key'])}</Key>"
+                    f"<VersionId>{escape(r['VersionId'])}</VersionId>"
+                    f"<IsLatest>{str(r['IsLatest']).lower()}"
+                    "</IsLatest>")
+                if not r["IsDeleteMarker"]:
+                    inner += (f"<Size>{r['Size']}</Size>"
+                              f"<ETag>&quot;{r['ETag']}&quot;</ETag>")
+                xml_rows.append(f"<{tag}>{inner}</{tag}>")
+            self._reply(200, (
+                "<?xml version=\"1.0\"?><ListVersionsResult>"
+                f"<Name>{escape(bucket)}</Name>"
+                f"<IsTruncated>{str(truncated).lower()}</IsTruncated>"
+                f"{''.join(xml_rows)}</ListVersionsResult>").encode())
+            return
+        if "lifecycle" in q:
+            if meth == "GET":
+                rules = rgw.get_lifecycle(bucket, actor=actor)
+                xr = []
+                for r in rules:
+                    exp = ""
+                    if "expiration_days" in r:
+                        exp += (f"<Expiration><Days>"
+                                f"{r['expiration_days']}"
+                                "</Days></Expiration>")
+                    if "noncurrent_days" in r:
+                        exp += ("<NoncurrentVersionExpiration>"
+                                "<NoncurrentDays>"
+                                f"{r['noncurrent_days']}"
+                                "</NoncurrentDays>"
+                                "</NoncurrentVersionExpiration>")
+                    xr.append(
+                        f"<Rule><ID>{escape(r['id'])}</ID>"
+                        f"<Prefix>{escape(r['prefix'])}</Prefix>"
+                        f"<Status>{r['status']}</Status>{exp}</Rule>")
+                self._reply(200, (
+                    "<?xml version=\"1.0\"?>"
+                    "<LifecycleConfiguration>"
+                    f"{''.join(xr)}</LifecycleConfiguration>").encode())
+            elif meth == "PUT":
+                try:
+                    rules = _parse_lifecycle_xml(body)
+                    rgw.put_lifecycle(bucket, rules, actor=actor)
+                except ValueError as e:
+                    raise _S3Error(400, "MalformedXML", str(e))
+                self._reply(200)
+            elif meth == "DELETE":
+                rgw.delete_lifecycle(bucket, actor=actor)
+                self._reply(204)
+            else:
+                raise _S3Error(405, "MethodNotAllowed")
+            return
+        if meth == "PUT":
+            rgw.create_bucket(bucket, actor=actor,
+                              canned=self._canned())
+            self._reply(200)
+        elif meth == "DELETE":
+            rgw.delete_bucket(bucket, actor=actor)
+            self._reply(204)
+        elif meth in ("GET", "HEAD"):
+            entries, truncated = rgw.list_objects(
+                bucket, prefix=q.get("prefix", ""),
+                marker=q.get("marker", q.get("start-after", "")),
+                max_keys=int(q.get("max-keys", 1000)), actor=actor)
+            rows = "".join(
+                f"<Contents><Key>{escape(e['Key'])}</Key>"
+                f"<Size>{e['Size']}</Size>"
+                f"<ETag>&quot;{e['ETag']}&quot;</ETag></Contents>"
+                for e in entries)
+            self._reply(200, (
+                "<?xml version=\"1.0\"?><ListBucketResult>"
+                f"<Name>{escape(bucket)}</Name>"
+                f"<IsTruncated>{str(truncated).lower()}</IsTruncated>"
+                f"{rows}</ListBucketResult>").encode())
+        else:
+            raise _S3Error(405, "MethodNotAllowed")
+
+    def _dispatch_object(self, rgw, bucket: str, key: str,
+                         q: Dict[str, str], body: bytes,
+                         actor: str) -> None:
+        meth = self.command
+        vid = q.get("versionId")
+        if "acl" in q:
+            if meth == "GET":
+                self._reply(200, _acl.to_xml(rgw.get_object_acl(
+                    bucket, key, actor=actor)).encode())
+            elif meth == "PUT":
+                if body:
+                    policy = _acl.from_xml(body)
+                else:
+                    owner = rgw.get_object_acl(bucket, key,
+                                               actor=actor)["owner"]
+                    policy = _acl.canned_acl(owner, self._canned())
+                rgw.put_object_acl(bucket, key, policy, actor=actor)
+                self._reply(200)
+            else:
+                raise _S3Error(405, "MethodNotAllowed")
+            return
         if meth == "PUT":
             if "partNumber" in q and "uploadId" in q:
                 etag = rgw.upload_part(bucket, key, q["uploadId"],
-                                       int(q["partNumber"]), body)
+                                       int(q["partNumber"]), body,
+                                       actor=actor)
+                self._reply(200, extra={"ETag": f'"{etag}"'})
             else:
                 meta = {k[11:]: v for k, v in self.headers.items()
                         if k.lower().startswith("x-amz-meta-")}
-                etag = rgw.put_object(bucket, key, body, metadata=meta)
-            self._reply(200, extra={"ETag": f'"{etag}"'})
+                res = rgw.put_object2(bucket, key, body, metadata=meta,
+                                      actor=actor,
+                                      canned=self._canned())
+                extra = {"ETag": f'"{res["etag"]}"'}
+                if "version_id" in res:
+                    extra["x-amz-version-id"] = res["version_id"]
+                self._reply(200, extra=extra)
         elif meth == "POST":
             if "uploads" in q:
-                uid = rgw.create_multipart_upload(bucket, key)
+                uid = rgw.create_multipart_upload(bucket, key,
+                                                  actor=actor)
                 self._reply(200, (
                     "<?xml version=\"1.0\"?>"
                     "<InitiateMultipartUploadResult>"
@@ -384,7 +541,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "</InitiateMultipartUploadResult>").encode())
             elif "uploadId" in q:
                 etag = rgw.complete_multipart_upload(bucket, key,
-                                                     q["uploadId"])
+                                                     q["uploadId"],
+                                                     actor=actor)
                 self._reply(200, (
                     "<?xml version=\"1.0\"?>"
                     "<CompleteMultipartUploadResult>"
@@ -393,16 +551,22 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 raise _S3Error(405, "MethodNotAllowed")
         elif meth == "GET":
-            data, head = rgw.get_object(bucket, key)
+            data, head = rgw.get_object(bucket, key, version_id=vid,
+                                        actor=actor)
             extra = {"ETag": f'"{head["etag"]}"'}
+            if head.get("vid"):
+                extra["x-amz-version-id"] = head["vid"]
             extra.update({f"x-amz-meta-{k}": v
                           for k, v in head.get("meta", {}).items()})
             self._reply(200, data, ctype="application/octet-stream",
                         extra=extra)
         elif meth == "HEAD":
-            head = rgw.head_object(bucket, key)
+            head = rgw.head_object(bucket, key, version_id=vid,
+                                   actor=actor)
             extra = {"ETag": f'"{head["etag"]}"',
                      "x-amz-object-size": str(head["size"])}
+            if head.get("vid"):
+                extra["x-amz-version-id"] = head["vid"]
             self.send_response(200)
             self.send_header("Content-Length", str(head["size"]))
             for k, v in extra.items():
@@ -410,12 +574,64 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
         elif meth == "DELETE":
             if "uploadId" in q:
-                rgw.abort_multipart_upload(bucket, key, q["uploadId"])
+                rgw.abort_multipart_upload(bucket, key, q["uploadId"],
+                                           actor=actor)
+                self._reply(204)
             else:
-                rgw.delete_object(bucket, key)
-            self._reply(204)
+                res = rgw.delete_object(bucket, key, version_id=vid,
+                                        actor=actor)
+                extra = {}
+                if res.get("version_id"):
+                    extra["x-amz-version-id"] = res["version_id"]
+                if res.get("delete_marker"):
+                    extra["x-amz-delete-marker"] = "true"
+                self._reply(204, extra=extra)
         else:
             raise _S3Error(405, "MethodNotAllowed")
+
+
+def _parse_lifecycle_xml(body: bytes):
+    """Minimal LifecycleConfiguration parser (reference
+    rgw_lc_s3.cc): Rule{ID, Prefix/Filter.Prefix, Status,
+    Expiration.Days, NoncurrentVersionExpiration.NoncurrentDays}."""
+    import xml.etree.ElementTree as ET
+
+    def local(t):
+        return t.rsplit("}", 1)[-1]
+
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError as e:
+        raise ValueError(f"malformed lifecycle XML: {e}")
+    rules = []
+    for rule in root:
+        if local(rule.tag) != "Rule":
+            continue
+        r = {}
+        for c in rule:
+            t = local(c.tag)
+            if t == "ID":
+                r["id"] = (c.text or "").strip()
+            elif t == "Status":
+                r["status"] = (c.text or "").strip()
+            elif t == "Prefix":
+                r["prefix"] = (c.text or "").strip()
+            elif t == "Filter":
+                for f in c:
+                    if local(f.tag) == "Prefix":
+                        r["prefix"] = (f.text or "").strip()
+            elif t == "Expiration":
+                for f in c:
+                    if local(f.tag) == "Days":
+                        r["expiration_days"] = int((f.text or "0"))
+            elif t == "NoncurrentVersionExpiration":
+                for f in c:
+                    if local(f.tag) == "NoncurrentDays":
+                        r["noncurrent_days"] = int((f.text or "0"))
+        rules.append(r)
+    if not rules:
+        raise ValueError("no Rule elements")
+    return rules
 
 
 class RGWFrontend:

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Tuple
 from ceph_tpu.client.rados import IoCtx, RadosError
 from ceph_tpu.client.striper import RadosStriper
 from ceph_tpu.osd.cls import CLS_RD, CLS_WR, ClassHandler, ClsError
+from ceph_tpu.rgw import acl as acl_mod
 
 ROOT_OID = "rgw.root"
 # the zone metadata log (mdlog role): ONE module-level name shared by
@@ -44,6 +45,14 @@ class BucketExists(ValueError):
 
 
 class BucketNotEmpty(ValueError):
+    pass
+
+
+class AccessDenied(PermissionError):
+    pass
+
+
+class NoSuchVersion(KeyError):
     pass
 
 
@@ -162,9 +171,146 @@ def _register_rgw_cls() -> None:
     def mdlog_trim(ctx, indata: bytes) -> bytes:
         return _log_trim(ctx, indata, MDLOG)
 
+    # -- versioned-object index rows (reference rgw_rados olh/instance
+    # entries, src/cls/rgw/cls_rgw.cc bucket_link_olh): each versioned
+    # key keeps an ordered version list in one "~olh/<key>" omap row
+    # (oldest..newest; the last entry is current), while the PLAIN key
+    # row mirrors the current version so unversioned listings/reads
+    # are unchanged.  All transitions are ONE atomic cls call.
+    OLH = "~olh/"
+
+    def _cur_row(ver: dict) -> bytes:
+        e = {kk: ver[kk] for kk in ("size", "etag", "mtime", "meta",
+                                    "owner", "acl", "manifest", "oid",
+                                    "vid") if kk in ver}
+        return json.dumps(e).encode()
+
+    def ver_put(ctx, indata: bytes) -> bytes:
+        req = json.loads(indata.decode())
+        key, ver = req["key"], req["ver"]
+        if key.startswith("~"):
+            raise ClsError(-22, "object keys may not start with '~'")
+        olhk = OLH + key
+        got = ctx.omap_get([olhk]) if ctx.exists else {}
+        olh = json.loads(got.get(olhk, b"[]").decode())
+        replaced = None
+        if req.get("replace_null"):
+            # suspended-versioning semantics: the "null" version is
+            # replaced in place (reference rgw_rados null-instance)
+            for v in olh:
+                if v["vid"] == "null":
+                    replaced = v
+            olh = [v for v in olh if v["vid"] != "null"]
+        olh.append(ver)
+        sets = {olhk: json.dumps(olh).encode()}
+        if ver.get("delete_marker"):
+            if key in ctx.omap_get([key]):
+                ctx.omap_rm([key])
+            ctx.omap_set(sets)
+            _bilog_append(ctx, "rm", key)
+        else:
+            sets[key] = _cur_row(ver)
+            ctx.omap_set(sets)
+            _bilog_append(ctx, "put", key)
+        return json.dumps({"replaced": replaced}).encode()
+
+    def ver_rm(ctx, indata: bytes) -> bytes:
+        req = json.loads(indata.decode())
+        key, vid = req["key"], req["vid"]
+        olhk = OLH + key
+        got = ctx.omap_get([olhk]) if ctx.exists else {}
+        if olhk not in got:
+            raise ClsError(-2, "no such versioned object")
+        olh = json.loads(got[olhk].decode())
+        hit = [v for v in olh if v["vid"] == vid]
+        if not hit:
+            raise ClsError(-2, "no such version")
+        keep = [v for v in olh if v["vid"] != vid]
+        was_current = olh[-1]["vid"] == vid
+        if keep:
+            sets = {olhk: json.dumps(keep).encode()}
+            if was_current:
+                cur = keep[-1]
+                if cur.get("delete_marker"):
+                    ctx.omap_set(sets)
+                    if key in ctx.omap_get([key]):
+                        ctx.omap_rm([key])
+                    _bilog_append(ctx, "rm", key)
+                else:
+                    sets[key] = _cur_row(cur)
+                    ctx.omap_set(sets)
+                    _bilog_append(ctx, "put", key)
+            else:
+                ctx.omap_set(sets)
+        else:
+            doomed = [olhk]
+            if key in ctx.omap_get([key]):
+                doomed.append(key)
+            ctx.omap_rm(doomed)
+            _bilog_append(ctx, "rm", key)
+        return json.dumps(hit[0]).encode()
+
+    def ver_update(ctx, indata: bytes) -> bytes:
+        """Patch mutable fields (acl/owner/meta) of ONE version in
+        place — no history reorder, no bilog entry (ACL changes are
+        not data mutations the zone sync replays)."""
+        req = json.loads(indata.decode())
+        key, vid, patch = req["key"], req["vid"], req["patch"]
+        olhk = OLH + key
+        got = ctx.omap_get([olhk]) if ctx.exists else {}
+        if olhk not in got:
+            raise ClsError(-2, "no such versioned object")
+        olh = json.loads(got[olhk].decode())
+        hit = None
+        for v in olh:
+            if v["vid"] == vid:
+                for f in ("acl", "owner", "meta"):
+                    if f in patch:
+                        v[f] = patch[f]
+                hit = v
+        if hit is None:
+            raise ClsError(-2, "no such version")
+        sets = {olhk: json.dumps(olh).encode()}
+        if olh[-1]["vid"] == vid and not hit.get("delete_marker"):
+            sets[key] = _cur_row(hit)
+        ctx.omap_set(sets)
+        return b""
+
+    def olh_get(ctx, indata: bytes) -> bytes:
+        key = indata.decode()
+        olhk = OLH + key
+        got = ctx.omap_get([olhk]) if ctx.exists else {}
+        if olhk not in got:
+            raise ClsError(-2, "no such versioned object")
+        return got[olhk]
+
+    def olh_list(ctx, indata: bytes) -> bytes:
+        req = json.loads(indata.decode() or "{}")
+        prefix = req.get("prefix", "")
+        marker = req.get("key_marker", "")
+        maxk = int(req.get("max_keys", 1000))
+        out = []
+        full = ctx.omap_get() if ctx.exists else {}
+        for kk in sorted(full):
+            if not kk.startswith(OLH):
+                continue
+            key = kk[len(OLH):]
+            if key <= marker or not key.startswith(prefix):
+                continue
+            out.append((key, json.loads(full[kk].decode())))
+            if len(out) >= maxk + 1:
+                break
+        return json.dumps({"entries": out[:maxk],
+                           "truncated": len(out) > maxk}).encode()
+
     h.register("rgw", "index_put", CLS_RD | CLS_WR, index_put)
     h.register("rgw", "index_rm", CLS_RD | CLS_WR, index_rm)
     h.register("rgw", "index_list", CLS_RD, index_list)
+    h.register("rgw", "ver_put", CLS_RD | CLS_WR, ver_put)
+    h.register("rgw", "ver_rm", CLS_RD | CLS_WR, ver_rm)
+    h.register("rgw", "ver_update", CLS_RD | CLS_WR, ver_update)
+    h.register("rgw", "olh_get", CLS_RD, olh_get)
+    h.register("rgw", "olh_list", CLS_RD, olh_list)
     h.register("rgw", "bilog_list", CLS_RD, bilog_list)
     h.register("rgw", "bilog_trim", CLS_RD | CLS_WR, bilog_trim)
     h.register("rgw", "mdlog_add", CLS_RD | CLS_WR, mdlog_add)
@@ -199,7 +345,66 @@ class RGW:
     def _index_oid(self, bucket: str) -> str:
         return f"rgw.bucket.{bucket}"
 
-    def create_bucket(self, name: str, log_meta: bool = True) -> None:
+    # -- access control (reference rgw_op.cc verify_*_permission) ----
+    def _bucket_meta(self, name: str) -> Dict:
+        try:
+            known = self.io.omap_get(ROOT_OID, [name])
+        except RadosError:
+            raise NoSuchBucket(name)
+        if name not in known:
+            raise NoSuchBucket(name)
+        return json.loads(known[name].decode())
+
+    def _save_bucket_meta(self, name: str, meta: Dict) -> None:
+        self.io.omap_set(ROOT_OID, {name: json.dumps(meta).encode()})
+
+    @staticmethod
+    def _bucket_acl(meta: Dict) -> Optional[Dict]:
+        a = meta.get("acl")
+        if a is None and meta.get("owner"):
+            a = {"owner": meta["owner"], "grants": []}
+        return a
+
+    def _check_bucket(self, meta: Dict, actor, perm: str) -> None:
+        """actor None = internal caller (sync agents, lifecycle, raw
+        library users) — never gated, like the reference's system
+        users.  A bucket with no recorded owner (pre-ACL metadata)
+        stays open for compatibility."""
+        if actor is None:
+            return
+        a = self._bucket_acl(meta)
+        if a is None:
+            return
+        if not acl_mod.allows(a, actor, perm):
+            raise AccessDenied(f"{actor!r} lacks {perm} on bucket")
+
+    @staticmethod
+    def _check_owner(meta: Dict, actor, what: str) -> None:
+        """Owner-only operations (delete bucket, versioning,
+        lifecycle): one definition so policy tweaks stay in sync."""
+        if actor is not None and meta.get("owner") not in (None, actor):
+            raise AccessDenied(f"only the bucket owner may {what}")
+
+    def _check_object(self, bmeta: Dict, entry: Dict, actor,
+                      perm: str) -> None:
+        if actor is None:
+            return
+        a = entry.get("acl")
+        if a is None:
+            owner = entry.get("owner") or bmeta.get("owner")
+            if owner is None:
+                return
+            a = {"owner": owner, "grants": []}
+        # the bucket owner always retains READ_ACP/WRITE_ACP-grade
+        # control in S3; modeled as bucket-owner bypass
+        if actor == bmeta.get("owner"):
+            return
+        if not acl_mod.allows(a, actor, perm):
+            raise AccessDenied(f"{actor!r} lacks {perm} on object")
+
+    def create_bucket(self, name: str, log_meta: bool = True, *,
+                      actor: Optional[str] = None,
+                      canned: str = "private") -> None:
         """log_meta=False is the SYNC-REPLAY entry (RGWZoneSync): a
         replayed mutation must not append to THIS zone's mdlog, or
         active-active sync echoes it back — a bounced 'remove' would
@@ -210,11 +415,52 @@ class RGW:
             known = {}
         if name in known:
             raise BucketExists(name)
+        # ACL validation BEFORE the index object exists: an invalid
+        # x-amz-acl must not leak an orphan index object
+        meta: Dict = {"created": time.time()}
+        if actor is not None:
+            meta["owner"] = actor
+            meta["acl"] = acl_mod.canned_acl(actor, canned)
         self.io.write_full(self._index_oid(name), b"")
-        meta = {"created": time.time()}
         self.io.omap_set(ROOT_OID, {name: json.dumps(meta).encode()})
         if log_meta:
             self._mdlog("bucket", name, "write")
+
+    # -- bucket ACL subresource --------------------------------------
+    def get_bucket_acl(self, name: str, *,
+                       actor: Optional[str] = None) -> Dict:
+        meta = self._bucket_meta(name)
+        self._check_bucket(meta, actor, "READ_ACP")
+        a = self._bucket_acl(meta)
+        if a is None:
+            raise NoSuchKey("bucket has no ACL (pre-ACL metadata)")
+        return a
+
+    def put_bucket_acl(self, name: str, policy: Dict, *,
+                       actor: Optional[str] = None) -> None:
+        meta = self._bucket_meta(name)
+        self._check_bucket(meta, actor, "WRITE_ACP")
+        meta["acl"] = acl_mod.validate(policy)
+        meta.setdefault("owner", meta["acl"]["owner"])
+        self._save_bucket_meta(name, meta)
+        self._mdlog("bucket", name, "write")
+
+    # -- versioning subresource (reference rgw_rados versioning) -----
+    def set_versioning(self, name: str, status: str, *,
+                       actor: Optional[str] = None) -> None:
+        if status not in ("Enabled", "Suspended"):
+            raise ValueError(f"bad versioning status {status!r}")
+        meta = self._bucket_meta(name)
+        self._check_owner(meta, actor, "set versioning")
+        meta["versioning"] = status
+        self._save_bucket_meta(name, meta)
+        self._mdlog("bucket", name, "write")
+
+    def get_versioning(self, name: str, *,
+                       actor: Optional[str] = None) -> Optional[str]:
+        meta = self._bucket_meta(name)
+        self._check_bucket(meta, actor, "READ")
+        return meta.get("versioning")
 
     def list_buckets(self) -> List[str]:
         try:
@@ -230,8 +476,10 @@ class RGW:
         if name not in known:
             raise NoSuchBucket(name)
 
-    def delete_bucket(self, name: str, log_meta: bool = True) -> None:
-        self._require_bucket(name)
+    def delete_bucket(self, name: str, log_meta: bool = True, *,
+                      actor: Optional[str] = None) -> None:
+        meta = self._bucket_meta(name)
+        self._check_owner(meta, actor, "delete it")
         # emptiness must consult the RAW index: an in-progress
         # multipart entry (_mp_/...) sorts before most user keys, so a
         # filtered listing could report "empty" while live objects and
@@ -241,6 +489,16 @@ class RGW:
                            json.dumps({"max_keys": 1}).encode())
         if json.loads(got.decode())["entries"]:
             raise BucketNotEmpty(name)
+        # versioned buckets: ANY surviving version or delete marker
+        # blocks deletion (S3 semantics)
+        try:
+            vgot = self.io.call(self._index_oid(name), "rgw",
+                                "olh_list",
+                                json.dumps({"max_keys": 1}).encode())
+            if json.loads(vgot.decode())["entries"]:
+                raise BucketNotEmpty(name)
+        except RadosError:
+            pass
         try:
             self.io.remove(self._index_oid(name))
         except RadosError:
@@ -260,30 +518,120 @@ class RGW:
     def _data_oid(self, bucket: str, key: str) -> str:
         return f"rgw.obj.{bucket}/{key}"
 
+    def _ver_oid(self, bucket: str, vid: str, key: str) -> str:
+        # vid-first namespace: version ids are hex tokens, so no user
+        # key can collide with another version's oid
+        return f"rgw.ver.{bucket}/{vid}/{key}"
+
+    @staticmethod
+    def _new_vid() -> str:
+        import secrets
+
+        return f"{int(time.time() * 1000):013d}-{secrets.token_hex(4)}"
+
+    def _olh(self, bucket: str, key: str) -> List[Dict]:
+        try:
+            got = self.io.call(self._index_oid(bucket), "rgw",
+                               "olh_get", key.encode())
+        except RadosError as e:
+            if e.rc == -2:
+                raise NoSuchKey(f"{bucket}/{key}")
+            raise
+        return json.loads(got.decode())
+
+    def _migrate_null(self, bucket: str, key: str) -> None:
+        """First versioned op on a key that predates versioning: its
+        plain entry becomes the 'null' version (reference rgw_rados
+        null-instance semantics), keeping its legacy data oid."""
+        try:
+            entry = self.head_object(bucket, key)
+        except NoSuchKey:
+            return
+        if entry.get("vid"):
+            return  # already versioned
+        ver = dict(entry)
+        ver["vid"] = "null"
+        ver.setdefault("oid", self._data_oid(bucket, key))
+        self.io.call(self._index_oid(bucket), "rgw", "ver_put",
+                     json.dumps({"key": key, "ver": ver,
+                                 "replace_null": True}).encode())
+
     def put_object(self, bucket: str, key: str, data: bytes,
-                   metadata: Optional[Dict[str, str]] = None) -> str:
-        self._require_bucket(bucket)
+                   metadata: Optional[Dict[str, str]] = None, *,
+                   actor: Optional[str] = None,
+                   canned: str = "private") -> str:
+        return self.put_object2(bucket, key, data, metadata,
+                                actor=actor, canned=canned)["etag"]
+
+    def put_object2(self, bucket: str, key: str, data: bytes,
+                    metadata: Optional[Dict[str, str]] = None, *,
+                    actor: Optional[str] = None,
+                    canned: str = "private") -> Dict:
+        """PUT returning {etag, version_id?} (the frontend needs the
+        x-amz-version-id response header)."""
+        bmeta = self._bucket_meta(bucket)
+        self._check_bucket(bmeta, actor, "WRITE")
         etag = hashlib.md5(data).hexdigest()
+        entry: Dict = {"size": len(data), "etag": etag,
+                       "mtime": time.time(), "meta": metadata or {}}
+        owner = actor or bmeta.get("owner")
+        if owner:
+            entry["owner"] = owner
+            entry["acl"] = acl_mod.canned_acl(
+                owner, canned, bucket_owner=bmeta.get("owner"))
+        vstatus = bmeta.get("versioning")
+        if vstatus in ("Enabled", "Suspended"):
+            self._migrate_null(bucket, key)
+            vid = "null" if vstatus == "Suspended" else self._new_vid()
+            oid = self._ver_oid(bucket, vid, key)
+            self.striper.write(oid, data)
+            entry["vid"] = vid
+            entry["oid"] = oid
+            got = self.io.call(self._index_oid(bucket), "rgw",
+                               "ver_put",
+                               json.dumps({"key": key, "ver": entry,
+                                           "replace_null":
+                                               vid == "null"}).encode())
+            replaced = json.loads(got.decode()).get("replaced")
+            if replaced and (replaced.get("manifest")
+                             or replaced.get("oid") != oid):
+                # a replaced null version whose data does NOT share
+                # this write's oid (legacy-migrated or multipart)
+                self._remove_version_data(bucket, replaced)
+            return {"etag": etag, "version_id": vid}
         self.striper.write(self._data_oid(bucket, key), data)
-        entry = {"size": len(data), "etag": etag,
-                 "mtime": time.time(), "meta": metadata or {}}
         # ATOMIC index update inside the PG (cls_rgw role)
         self.io.call(self._index_oid(bucket), "rgw", "index_put",
                      json.dumps({"key": key, "entry": entry}).encode())
-        return etag
+        return {"etag": etag}
 
-    def head_object(self, bucket: str, key: str) -> Dict:
-        self._require_bucket(bucket)
+    def head_object(self, bucket: str, key: str, *,
+                    version_id: Optional[str] = None,
+                    actor: Optional[str] = None) -> Dict:
+        bmeta = self._bucket_meta(bucket)
+        if version_id is not None:
+            for v in self._olh(bucket, key):
+                if v["vid"] == version_id:
+                    if v.get("delete_marker"):
+                        raise NoSuchKey(f"{bucket}/{key}")
+                    self._check_object(bmeta, v, actor, "READ")
+                    return v
+            raise NoSuchVersion(f"{bucket}/{key}@{version_id}")
         got = self.io.call(self._index_oid(bucket), "rgw", "index_list",
                            json.dumps({"prefix": key,
                                        "max_keys": 1}).encode())
         entries = json.loads(got.decode())["entries"]
         if not entries or entries[0][0] != key:
             raise NoSuchKey(f"{bucket}/{key}")
-        return json.loads(entries[0][1])
+        entry = json.loads(entries[0][1])
+        self._check_object(bmeta, entry, actor, "READ")
+        return entry
 
-    def get_object(self, bucket: str, key: str) -> Tuple[bytes, Dict]:
-        head = self.head_object(bucket, key)
+    def get_object(self, bucket: str, key: str, *,
+                   version_id: Optional[str] = None,
+                   actor: Optional[str] = None) -> Tuple[bytes, Dict]:
+        head = self.head_object(bucket, key, version_id=version_id,
+                                actor=actor)
         manifest = head.get("manifest")
         if manifest:
             # multipart object: stitch the parts in order
@@ -293,12 +641,99 @@ class RGW:
                     seg["size"])
                 for seg in manifest)
         else:
-            data = self.striper.read(self._data_oid(bucket, key),
-                                     head["size"])
+            oid = head.get("oid") or self._data_oid(bucket, key)
+            data = self.striper.read(oid, head["size"])
         return data, head
 
-    def delete_object(self, bucket: str, key: str) -> None:
-        self._require_bucket(bucket)
+    # -- object ACL subresource --------------------------------------
+    def get_object_acl(self, bucket: str, key: str, *,
+                       actor: Optional[str] = None) -> Dict:
+        bmeta = self._bucket_meta(bucket)
+        entry = self.head_object(bucket, key)
+        self._check_object(bmeta, entry, actor, "READ_ACP")
+        a = entry.get("acl")
+        if a is None:
+            owner = entry.get("owner") or bmeta.get("owner")
+            if owner is None:
+                raise NoSuchKey("object has no ACL (pre-ACL entry)")
+            a = {"owner": owner, "grants": []}
+        return a
+
+    def put_object_acl(self, bucket: str, key: str, policy: Dict, *,
+                       actor: Optional[str] = None) -> None:
+        bmeta = self._bucket_meta(bucket)
+        entry = self.head_object(bucket, key)
+        self._check_object(bmeta, entry, actor, "WRITE_ACP")
+        policy = acl_mod.validate(policy)
+        if entry.get("vid"):
+            # ONE atomic in-place patch of the version row (ver_update
+            # — a drop+re-add would reorder history and a crash
+            # between the calls would lose the version)
+            self.io.call(
+                self._index_oid(bucket), "rgw", "ver_update",
+                json.dumps({"key": key, "vid": entry["vid"],
+                            "patch": {"acl": policy,
+                                      "owner": entry.get(
+                                          "owner",
+                                          policy["owner"])}}).encode())
+            return
+        entry["acl"] = policy
+        entry.setdefault("owner", policy["owner"])
+        self.io.call(self._index_oid(bucket), "rgw", "index_put",
+                     json.dumps({"key": key, "entry": entry}).encode())
+
+    def delete_object(self, bucket: str, key: str, *,
+                      version_id: Optional[str] = None,
+                      actor: Optional[str] = None) -> Dict:
+        """Returns {} for plain deletes, {delete_marker: True,
+        version_id} when a marker was created, {version_id} when a
+        specific version was removed (the S3 response headers)."""
+        bmeta = self._bucket_meta(bucket)
+        self._check_bucket(bmeta, actor, "WRITE")
+        vstatus = bmeta.get("versioning")
+        if version_id is not None:
+            try:
+                got = self.io.call(
+                    self._index_oid(bucket), "rgw", "ver_rm",
+                    json.dumps({"key": key,
+                                "vid": version_id}).encode())
+            except RadosError as e:
+                if e.rc == -2:
+                    raise NoSuchVersion(f"{bucket}/{key}@{version_id}")
+                raise
+            removed = json.loads(got.decode())
+            self._remove_version_data(bucket, removed)
+            return {"version_id": version_id,
+                    "delete_marker": bool(removed.get("delete_marker"))}
+        if vstatus in ("Enabled", "Suspended"):
+            self._migrate_null(bucket, key)
+            # Idempotence guard (deliberate S3 divergence: S3 stacks a
+            # marker per DELETE even on absent keys).  A replayed zone-
+            # sync 'rm' or a retried drain must CONVERGE: absent key ->
+            # NoSuchKey like the unversioned path; already-deleted ->
+            # return the existing marker instead of stacking another.
+            try:
+                olh = self._olh(bucket, key)
+            except NoSuchKey:
+                olh = []
+            if not olh:
+                raise NoSuchKey(f"{bucket}/{key}")
+            if olh[-1].get("delete_marker"):
+                return {"delete_marker": True,
+                        "version_id": olh[-1]["vid"]}
+            vid = "null" if vstatus == "Suspended" else self._new_vid()
+            marker = {"vid": vid, "mtime": time.time(),
+                      "delete_marker": True,
+                      "owner": actor or bmeta.get("owner")}
+            got = self.io.call(self._index_oid(bucket), "rgw", "ver_put",
+                               json.dumps({"key": key, "ver": marker,
+                                           "replace_null":
+                                               vid == "null"}).encode())
+            replaced = json.loads(got.decode()).get("replaced")
+            if replaced:
+                # suspended delete removes the null version's data
+                self._remove_version_data(bucket, replaced)
+            return {"delete_marker": True, "version_id": vid}
         try:
             head = self.head_object(bucket, key)
         except NoSuchKey:
@@ -320,14 +755,60 @@ class RGW:
             self.striper.remove(self._data_oid(bucket, key))
         except RadosError:
             pass
+        return {}
+
+    def _remove_version_data(self, bucket: str, ver: Dict) -> None:
+        if ver.get("delete_marker"):
+            return
+        for seg in ver.get("manifest", []):
+            try:
+                self.striper.remove(self._mp_oid(
+                    bucket, seg["upload_id"], seg["part"]))
+            except RadosError:
+                pass
+        oid = ver.get("oid")
+        if oid and not ver.get("manifest"):
+            try:
+                self.striper.remove(oid)
+            except RadosError:
+                pass
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             key_marker: str = "",
+                             max_keys: int = 1000, *,
+                             actor: Optional[str] = None
+                             ) -> Tuple[List[Dict], bool]:
+        """S3 ListObjectVersions: newest-first per key, is_latest on
+        the current version (reference rgw_rados list_objects with
+        list_versions=true)."""
+        bmeta = self._bucket_meta(bucket)
+        self._check_bucket(bmeta, actor, "READ")
+        got = self.io.call(self._index_oid(bucket), "rgw", "olh_list",
+                           json.dumps({"prefix": prefix,
+                                       "key_marker": key_marker,
+                                       "max_keys": max_keys}).encode())
+        out = json.loads(got.decode())
+        rows: List[Dict] = []
+        for key, olh in out["entries"]:
+            for idx, v in enumerate(reversed(olh)):
+                rows.append({
+                    "Key": key, "VersionId": v["vid"],
+                    "IsLatest": idx == 0,
+                    "IsDeleteMarker": bool(v.get("delete_marker")),
+                    "Size": v.get("size", 0),
+                    "ETag": v.get("etag", ""),
+                    "LastModified": v.get("mtime", 0.0),
+                })
+        return rows, out["truncated"]
 
     # -- multipart upload (reference rgw_multipart.* / RGWMultipart*:
     # parts land as separate striped objects; complete writes a
     # manifest entry whose ETag is md5(part-md5s)-N, and GET stitches
     # the parts in order) --------------------------------------------------
     def create_multipart_upload(self, bucket: str, key: str,
-                                metadata: Optional[Dict] = None) -> str:
-        self._require_bucket(bucket)
+                                metadata: Optional[Dict] = None, *,
+                                actor: Optional[str] = None) -> str:
+        self._check_bucket(self._bucket_meta(bucket), actor, "WRITE")
         import secrets
 
         upload_id = secrets.token_hex(8)
@@ -343,8 +824,9 @@ class RGW:
         return f"rgw.mp.{bucket}/{upload_id}/{part}"
 
     def upload_part(self, bucket: str, key: str, upload_id: str,
-                    part_number: int, data: bytes) -> str:
-        self._require_bucket(bucket)
+                    part_number: int, data: bytes, *,
+                    actor: Optional[str] = None) -> str:
+        self._check_bucket(self._bucket_meta(bucket), actor, "WRITE")
         if not 1 <= part_number <= 10000:
             raise ValueError("part number out of range")
         etag = hashlib.md5(data).hexdigest()
@@ -361,8 +843,10 @@ class RGW:
         return etag
 
     def complete_multipart_upload(self, bucket: str, key: str,
-                                  upload_id: str) -> str:
-        self._require_bucket(bucket)
+                                  upload_id: str, *,
+                                  actor: Optional[str] = None) -> str:
+        bmeta = self._bucket_meta(bucket)
+        self._check_bucket(bmeta, actor, "WRITE")
         mp_key = f"_mp_/{key}/{upload_id}"
         head = self.head_object(bucket, mp_key)
         parts = sorted(((int(n), p) for n, p in head["parts"].items()))
@@ -372,19 +856,44 @@ class RGW:
         # suffixed with the part count
         md5s = b"".join(bytes.fromhex(p["etag"]) for _, p in parts)
         etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
-        entry = {"size": sum(p["size"] for _, p in parts), "etag": etag,
-                 "mtime": time.time(), "meta": head.get("meta", {}),
-                 "manifest": [{"upload_id": upload_id, "part": n,
-                               "size": p["size"]} for n, p in parts]}
-        self.io.call(self._index_oid(bucket), "rgw", "index_put",
-                     json.dumps({"key": key, "entry": entry}).encode())
+        entry: Dict = {
+            "size": sum(p["size"] for _, p in parts), "etag": etag,
+            "mtime": time.time(), "meta": head.get("meta", {}),
+            "manifest": [{"upload_id": upload_id, "part": n,
+                          "size": p["size"]} for n, p in parts]}
+        owner = actor or bmeta.get("owner")
+        if owner:
+            entry["owner"] = owner
+            entry["acl"] = acl_mod.canned_acl(
+                owner, bucket_owner=bmeta.get("owner"))
+        if bmeta.get("versioning") in ("Enabled", "Suspended"):
+            # a completed multipart object versions like any PUT; its
+            # data lives in the upload's part objects (unique per
+            # upload id, so versions never collide)
+            self._migrate_null(bucket, key)
+            vid = ("null" if bmeta["versioning"] == "Suspended"
+                   else self._new_vid())
+            entry["vid"] = vid
+            got = self.io.call(self._index_oid(bucket), "rgw",
+                               "ver_put",
+                               json.dumps({"key": key, "ver": entry,
+                                           "replace_null":
+                                               vid == "null"}).encode())
+            replaced = json.loads(got.decode()).get("replaced")
+            if replaced:
+                self._remove_version_data(bucket, replaced)
+        else:
+            self.io.call(self._index_oid(bucket), "rgw", "index_put",
+                         json.dumps({"key": key,
+                                     "entry": entry}).encode())
         self.io.call(self._index_oid(bucket), "rgw", "index_rm",
                      mp_key.encode())
         return etag
 
     def abort_multipart_upload(self, bucket: str, key: str,
-                               upload_id: str) -> None:
-        self._require_bucket(bucket)
+                               upload_id: str, *,
+                               actor: Optional[str] = None) -> None:
+        self._check_bucket(self._bucket_meta(bucket), actor, "WRITE")
         mp_key = f"_mp_/{key}/{upload_id}"
         head = self.head_object(bucket, mp_key)
         for n in head["parts"]:
@@ -397,10 +906,11 @@ class RGW:
                      mp_key.encode())
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     marker: str = "", max_keys: int = 1000
+                     marker: str = "", max_keys: int = 1000, *,
+                     actor: Optional[str] = None
                      ) -> Tuple[List[Dict], bool]:
         """S3 ListObjects: ([{Key, Size, ETag}...], is_truncated)."""
-        self._require_bucket(bucket)
+        self._check_bucket(self._bucket_meta(bucket), actor, "READ")
         got = self.io.call(self._index_oid(bucket), "rgw", "index_list",
                            json.dumps({"prefix": prefix,
                                        "marker": marker,
@@ -414,6 +924,106 @@ class RGW:
             entries.append({"Key": k, "Size": e["size"],
                             "ETag": e["etag"], "Meta": e.get("meta", {})})
         return entries, out["truncated"]
+
+
+    # -- lifecycle (reference src/rgw/rgw_lc.cc RGWLC) ----------------
+    def put_lifecycle(self, bucket: str, rules: List[Dict], *,
+                      actor: Optional[str] = None) -> None:
+        meta = self._bucket_meta(bucket)
+        self._check_owner(meta, actor, "set lifecycle")
+        clean = []
+        for r in rules:
+            if r.get("status", "Enabled") not in ("Enabled", "Disabled"):
+                raise ValueError(f"bad rule status {r.get('status')!r}")
+            days = r.get("expiration_days")
+            nc = r.get("noncurrent_days")
+            if days is None and nc is None:
+                raise ValueError("rule needs expiration_days and/or "
+                                 "noncurrent_days")
+            if (days is not None and int(days) < 1) or \
+                    (nc is not None and int(nc) < 1):
+                raise ValueError("expiration days must be >= 1")
+            clean.append({
+                "id": r.get("id") or f"rule-{len(clean)}",
+                "prefix": r.get("prefix", ""),
+                "status": r.get("status", "Enabled"),
+                **({"expiration_days": int(days)}
+                   if days is not None else {}),
+                **({"noncurrent_days": int(nc)}
+                   if nc is not None else {}),
+            })
+        meta["lifecycle"] = clean
+        self._save_bucket_meta(bucket, meta)
+        self._mdlog("bucket", bucket, "write")
+
+    def get_lifecycle(self, bucket: str, *,
+                      actor: Optional[str] = None) -> List[Dict]:
+        meta = self._bucket_meta(bucket)
+        self._check_bucket(meta, actor, "READ")
+        lc = meta.get("lifecycle")
+        if not lc:
+            raise NoSuchKey(f"no lifecycle on {bucket}")
+        return lc
+
+    def delete_lifecycle(self, bucket: str, *,
+                         actor: Optional[str] = None) -> None:
+        meta = self._bucket_meta(bucket)
+        self._check_owner(meta, actor, "set lifecycle")
+        meta.pop("lifecycle", None)
+        self._save_bucket_meta(bucket, meta)
+
+    def lc_process(self, bucket: Optional[str] = None,
+                   now: Optional[float] = None) -> Dict:
+        """One lifecycle pass (the RGWLC::process worker role —
+        reference runs it on a schedule; tools/radosgw.py ticks it).
+        Expiration of CURRENT objects deletes them (which in a
+        versioned bucket lays a delete marker, rgw_lc.cc semantics);
+        noncurrent_days expires NONCURRENT versions for good."""
+        now = time.time() if now is None else now
+        stats = {"expired": 0, "noncurrent_expired": 0, "buckets": 0}
+        names = [bucket] if bucket else self.list_buckets()
+        for name in names:
+            try:
+                meta = self._bucket_meta(name)
+            except NoSuchBucket:
+                continue
+            rules = [r for r in meta.get("lifecycle", [])
+                     if r.get("status") == "Enabled"]
+            if not rules:
+                continue
+            stats["buckets"] += 1
+            for rule in rules:
+                pref = rule.get("prefix", "")
+                days = rule.get("expiration_days")
+                if days is not None:
+                    cutoff = now - days * 86400
+                    marker = ""
+                    while True:
+                        entries, truncated = self.list_objects(
+                            name, prefix=pref, marker=marker,
+                            max_keys=1000)
+                        for e in entries:
+                            head = self.head_object(name, e["Key"])
+                            if head.get("mtime", now) <= cutoff:
+                                self.delete_object(name, e["Key"])
+                                stats["expired"] += 1
+                            marker = e["Key"]
+                        if not truncated or not entries:
+                            break
+                nc = rule.get("noncurrent_days")
+                if nc is not None:
+                    cutoff = now - nc * 86400
+                    rows, _ = self.list_object_versions(
+                        name, prefix=pref, max_keys=100000)
+                    for row in rows:
+                        if row["IsLatest"]:
+                            continue
+                        if row["LastModified"] <= cutoff:
+                            self.delete_object(
+                                name, row["Key"],
+                                version_id=row["VersionId"])
+                            stats["noncurrent_expired"] += 1
+        return stats
 
 
 def _omap_rm(key: str):

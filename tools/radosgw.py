@@ -28,6 +28,9 @@ def main(argv=None) -> int:
     p.add_argument("--run-seconds", type=float, default=0.0,
                    help="serve for N seconds then exit (0 = forever); "
                         "used by tests/scripts")
+    p.add_argument("--lc-interval", type=float, default=60.0,
+                   help="seconds between lifecycle passes (reference "
+                        "RGWLC worker, src/rgw/rgw_lc.cc; 0 disables)")
     args = p.parse_args(argv)
 
     from ceph_tpu.rgw.frontend import RGWFrontend
@@ -50,6 +53,25 @@ def main(argv=None) -> int:
             except ValueError:
                 print(f"user {args.create_user} already exists",
                       flush=True)
+        stop = False
+        if args.lc_interval > 0:
+            import threading
+
+            def _lc_worker():
+                while not stop:
+                    time.sleep(args.lc_interval)
+                    if stop:
+                        return
+                    try:
+                        st = fe.rgw.lc_process()
+                        if st["expired"] or st["noncurrent_expired"]:
+                            print(f"radosgw: lc pass {st}", flush=True)
+                    except Exception as e:  # noqa: BLE001 — keep serving
+                        print(f"radosgw: lc pass failed: {e!r}",
+                              flush=True)
+
+            threading.Thread(target=_lc_worker, name="rgw-lc",
+                             daemon=True).start()
         try:
             if args.run_seconds > 0:
                 time.sleep(args.run_seconds)
@@ -59,6 +81,7 @@ def main(argv=None) -> int:
         except KeyboardInterrupt:
             pass
         finally:
+            stop = True
             fe.stop()
     return 0
 

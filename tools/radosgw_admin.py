@@ -64,12 +64,24 @@ def main(argv=None) -> int:
                     print(json.dumps(rgw.list_buckets()))
                 elif t[:2] == ["bucket", "stats"]:
                     bucket = t[2]
-                    objs = rgw.list_objects(bucket)["contents"]
+                    objs, _trunc = rgw.list_objects(bucket,
+                                                    max_keys=100000)
                     print(json.dumps({
                         "bucket": bucket,
                         "num_objects": len(objs),
-                        "size": sum(o["size"] for o in objs),
+                        "size": sum(o["Size"] for o in objs),
                     }, indent=1))
+                elif t[:2] == ["lc", "process"]:
+                    target = t[2] if len(t) > 2 else None
+                    print(json.dumps(rgw.lc_process(target)))
+                elif t[:2] == ["lc", "list"]:
+                    out = {}
+                    for b in rgw.list_buckets():
+                        try:
+                            out[b] = rgw.get_lifecycle(b)
+                        except KeyError:
+                            pass
+                    print(json.dumps(out, indent=1))
                 else:
                     print(f"unknown command: {line!r}", file=sys.stderr)
                     return 22
