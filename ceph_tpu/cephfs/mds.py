@@ -83,6 +83,18 @@ class MDSDaemon(Dispatcher):
         # fault injection for crash tests: apply only the first N
         # backing-store steps of the next event, then die
         self._apply_steps_left: Optional[int] = None
+        # -- dynamic subtree balancing (reference MDBalancer.cc) ------
+        # per-subtree request counters, decayed at each publish; every
+        # rank publishes its load row to fs.meta and rank 0 re-pins a
+        # hot subtree onto the least-loaded rank when the spread is
+        # wide enough (the migration itself is the pin-table change:
+        # metadata lives in shared RADOS objects, so handoff = old
+        # owner starts ESTALE'ing within pin_ttl and clients follow)
+        self._req_load: Dict[str, float] = {}
+        self.bal_interval = 5.0       # publish+balance cadence
+        self.bal_min_ratio = 2.0      # act when max > ratio * min
+        self.bal_min_load = 20.0      # ...and the hot rank is busy
+        self._bal_stop = threading.Event()
         self.msgr = Messenger(ctx, EntityName("mds", 0),
                               bind_port=bind_port)
         self.msgr.add_dispatcher(self)
@@ -110,6 +122,9 @@ class MDSDaemon(Dispatcher):
             target=lambda: [time.sleep(interval) or send_all()
                             for _ in range(retries)],
             name=f"mds{self.rank}-beacon", daemon=True).start()
+        threading.Thread(target=self._balance_loop,
+                         name=f"mds{self.rank}-balancer",
+                         daemon=True).start()
 
     # -- lifecycle / journal ----------------------------------------------
     def replay(self) -> None:
@@ -130,11 +145,125 @@ class MDSDaemon(Dispatcher):
             self.journal.commit(self._applied_seq)
 
     def shutdown(self) -> None:
+        self._bal_stop.set()
         self.msgr.shutdown()
 
     def kill(self) -> None:
         """Crash (no journal commit, no flush) — the test hook."""
+        self._bal_stop.set()
         self.msgr.shutdown()
+
+    # -- dynamic subtree balancing (reference src/mds/MDBalancer.cc:
+    # per-rank load epochs + Migrator-driven subtree moves; here the
+    # move is the pin-table flip, see __init__ comment) ---------------
+    def _account(self, path: str) -> None:
+        """Charge one request to the path's top-level subtree."""
+        p = self.fs._norm(path)
+        parts = [s for s in p.split("/") if s]
+        if not parts:
+            return
+        sub = "/" + parts[0]
+        with self.lock:
+            self._req_load[sub] = self._req_load.get(sub, 0.0) + 1.0
+
+    def _publish_load(self) -> None:
+        """Decay + publish this rank's per-subtree load row (the
+        mds_load exchange, MDBalancer.cc send_heartbeat role)."""
+        with self.lock:
+            snap = dict(self._req_load)
+            for k in list(self._req_load):
+                self._req_load[k] *= 0.5
+                if self._req_load[k] < 0.5:
+                    del self._req_load[k]
+        try:
+            self.io.omap_set("fs.meta", {
+                f"load.{self.rank}": json.dumps(
+                    {"t": time.time(), "subs": snap}).encode()})
+        except RadosError:
+            pass
+
+    def _balance_once(self) -> Optional[Tuple[str, int]]:
+        """Rank 0's rebalance decision (MDBalancer.cc prep_rebalance):
+        move the hottest subtree of the most-loaded rank to the
+        least-loaded LIVE rank when the spread justifies it.  Returns
+        (subtree, target_rank) when a migration was committed."""
+        if self.rank != 0:
+            return None
+        try:
+            om = self.io.omap_get("fs.meta")
+        except RadosError:
+            return None
+        now = time.time()
+        loads: Dict[int, Dict[str, float]] = {}
+        for k, v in om.items():
+            if not k.startswith("load."):
+                continue
+            try:
+                row = json.loads(v.decode())
+            except ValueError:
+                continue
+            if now - row.get("t", 0) > 4 * self.bal_interval:
+                continue  # stale row: rank likely dead
+            loads[int(k[len("load."):])] = row.get("subs", {})
+        if len(loads) < 2:
+            return None
+        totals = {r: sum(s.values()) for r, s in loads.items()}
+        hot_rank = max(totals, key=totals.get)
+        cold_rank = min(totals, key=totals.get)
+        if hot_rank == cold_rank:
+            return None
+        if totals[hot_rank] < self.bal_min_load or \
+                totals[hot_rank] < self.bal_min_ratio * max(
+                    totals[cold_rank], 1.0):
+            return None
+        pins = {k[len("subtree."):]: int(v) for k, v in om.items()
+                if k.startswith("subtree.")}
+
+        def owner_of(p: str) -> int:
+            # longest-prefix over the FRESH pin table (the rank-local
+            # cache may be pin_ttl stale — not good enough to decide a
+            # migration against)
+            best_pp, r = "", 0
+            for pp, rr in pins.items():
+                if (p == pp or p.startswith(pp.rstrip("/") + "/")) \
+                        and len(pp) > len(best_pp):
+                    best_pp, r = pp, rr
+            return r
+
+        # hottest subtree the hot rank actually OWNS whose move
+        # STRICTLY shrinks the spread: new spread |diff - 2*load| must
+        # beat diff, i.e. 0 < load < diff — a subtree carrying the
+        # whole imbalance would merely reverse it (and then ping-pong
+        # back every interval)
+        diff = totals[hot_rank] - totals[cold_rank]
+        best = None
+        for sub, load in sorted(loads[hot_rank].items(),
+                                key=lambda kv: -kv[1]):
+            if owner_of(sub) != hot_rank:
+                continue
+            if 0 < load < diff:
+                best = (sub, load)
+                break
+        if best is None:
+            return None
+        sub, _ = best
+        self.io.omap_set("fs.meta", {
+            f"subtree.{sub}": str(cold_rank).encode()})
+        with self.lock:
+            self._pin_gen += 1
+            self._pin_cache = (0.0, {})
+        self._log(1, f"mds: balancer migrated {sub} "
+                     f"rank {hot_rank} -> {cold_rank} "
+                     f"(loads {totals})")
+        return (sub, cold_rank)
+
+    def _balance_loop(self) -> None:
+        while not self._bal_stop.wait(self.bal_interval):
+            try:
+                self._publish_load()
+                self._balance_once()
+            except Exception:  # noqa: BLE001 — balancer must not die
+                pass
 
     # -- journaled mutation pipeline --------------------------------------
     def _submit(self, ev: dict) -> None:
@@ -375,6 +504,7 @@ class MDSDaemon(Dispatcher):
             # wrong rank: redirect the client (reference forwards
             # requests between MDSs; the hint keeps it one hop)
             return cm.MClientReply(self.ESTALE, {"rank": owner})
+        self._account(path)  # balancer load sample (served here only)
         if op == "rename" and self.owner_rank(args["dst"]) != self.rank:
             return cm.MClientReply(
                 -18, {"error": "cross-rank rename (EXDEV): subtrees "

@@ -156,3 +156,121 @@ class UpmapBalancer:
         pools = (list(pool_ids) if pool_ids is not None
                  else list(self.osdmap.pools))
         return [self.optimize_pool(p) for p in pools]
+
+
+class CrushCompatBalancer:
+    """The balancer's crush-compat mode: optimize the COMPAT weight-set
+    (choose_args id "-1") toward even PG counts, leaving client-visible
+    weights and the upmap table untouched.
+
+    Reference: src/pybind/mgr/balancer/module.py:17 (mode crush-compat)
+    + :68 (do_crush_compat) — adjust leaf weight-set entries by each
+    OSD's over/under-fullness, rebuild parent bucket entries as child
+    sums, keep the map iff stddev improved.  The mapper consumes the
+    set in bucket_straw2_choose (reference crush_choose_arg;
+    ceph_tpu/osd/osdmap.py _flatten substitutes it for both the scalar
+    oracle and the vmapped sweep)."""
+
+    def __init__(self, osdmap: OSDMap, step: float = 0.25,
+                 max_iterations: int = 12) -> None:
+        self.osdmap = osdmap
+        self.step = step
+        self.max_iterations = max_iterations
+
+    # reuse the upmap balancer's metrics helpers
+    _counts = UpmapBalancer._counts
+    _eligible = UpmapBalancer._eligible
+    _stddev = staticmethod(UpmapBalancer._stddev)
+
+    def _pool_counts(self, pool_ids) -> np.ndarray:
+        total = np.zeros(self.osdmap.max_osd, dtype=np.int64)
+        for pid in pool_ids:
+            total += self._counts(self.osdmap.map_pgs(pid)["up"])
+        return total
+
+    def _leaf_positions(self):
+        """osd -> (bucket_id, position) for every OSD leaf."""
+        out = {}
+        for bid, b in self.osdmap.crush.buckets.items():
+            for pos, it in enumerate(b.items):
+                if it >= 0:
+                    out[it] = (bid, pos)
+        return out
+
+    def _current_weights(self) -> Dict[int, List[int]]:
+        """Working weight-set: start from the existing compat set or
+        the buckets' real weights."""
+        ca = self.osdmap.crush.choose_args.get("-1")
+        if ca:
+            return {bid: list(ws) for bid, ws in ca.items()}
+        return {bid: list(b.weights)
+                for bid, b in self.osdmap.crush.buckets.items()}
+
+    def _rebuild_parents(self, ws: Dict[int, List[int]]) -> None:
+        """Parent bucket entries = sum of child weight-set entries
+        (bottom-up, so inter-host draws follow the adjusted leaves)."""
+        buckets = self.osdmap.crush.buckets
+        # children first: iterate until fixpoint over the shallow trees
+        for _ in range(8):
+            changed = False
+            for bid, b in buckets.items():
+                row = ws.get(bid)
+                if row is None:
+                    continue
+                for pos, it in enumerate(b.items):
+                    if it < 0 and it in buckets:
+                        s = sum(ws.get(it, buckets[it].weights))
+                        if row[pos] != s:
+                            row[pos] = s
+                            changed = True
+            if not changed:
+                break
+
+    def optimize(self,
+                 pool_ids: Optional[Sequence[int]] = None
+                 ) -> BalanceReport:
+        m = self.osdmap
+        pools = (list(pool_ids) if pool_ids is not None
+                 else list(m.pools))
+        eligible = self._eligible()
+        leafpos = self._leaf_positions()
+        counts = self._pool_counts(pools)
+        before = self._stddev(counts, eligible)
+        best = before
+        best_ca = (None if "-1" not in m.crush.choose_args
+                   else {b: list(w) for b, w in
+                         m.crush.choose_args["-1"].items()})
+        ws = self._current_weights()
+        for _ in range(self.max_iterations):
+            target = counts[eligible].mean() if eligible.any() else 0.0
+            if target <= 0:
+                break
+            for osd in np.nonzero(eligible)[0]:
+                osd = int(osd)
+                if osd not in leafpos:
+                    continue
+                bid, pos = leafpos[osd]
+                ratio = counts[osd] / target
+                w = ws[bid][pos]
+                # nudge against fullness; floor keeps the OSD drawable
+                neww = int(max(w * (1.0 - self.step * (ratio - 1.0)),
+                               0x1000))
+                ws[bid][pos] = neww
+            self._rebuild_parents(ws)
+            m.crush.choose_args["-1"] = {b: list(w)
+                                         for b, w in ws.items()}
+            m.bump_epoch()
+            counts = self._pool_counts(pools)
+            sd = self._stddev(counts, eligible)
+            if sd < best:
+                best = sd
+                best_ca = {b: list(w) for b, w in ws.items()}
+        # keep the best map seen (reference: balancer rejects plans
+        # that don't improve the score)
+        if best_ca is None:
+            m.crush.choose_args.pop("-1", None)
+        else:
+            m.crush.choose_args["-1"] = best_ca
+        m.bump_epoch()
+        return BalanceReport(pools[0] if pools else -1, before, best,
+                             moves=[])

@@ -201,7 +201,23 @@ class OSDMap:
     # -- placement pipeline (scalar host path) ----------------------------
     def _flatten(self) -> cmap.FlatMap:
         if self._flat is None:
-            self._flat = self.crush.flatten()
+            flat = self.crush.flatten()
+            # the COMPAT weight-set (reference choose_args id -1,
+            # written by the balancer's crush-compat mode and read by
+            # bucket_straw2_choose): substitute straw2 draw weights in
+            # the flat map so BOTH the scalar native oracle and the
+            # vmapped sweep consume it — one source of truth
+            ca = self.crush.choose_args.get("-1")
+            if ca:
+                w = np.asarray(flat.weights).copy()
+                algs = np.asarray(flat.algs)
+                for bid, ws in ca.items():
+                    bno = -1 - bid
+                    if (0 <= bno < w.shape[0]
+                            and algs[bno] == cmap.ALG_STRAW2):
+                        w[bno, : len(ws)] = ws
+                flat = dataclasses.replace(flat, weights=w)
+            self._flat = flat
         return self._flat
 
     def object_to_pg(self, pool_id: int, name, nspace=b"") -> Tuple[int, int]:

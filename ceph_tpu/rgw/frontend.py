@@ -392,7 +392,7 @@ class _Handler(BaseHTTPRequestHandler):
                     root = ET.fromstring(body)
                     st = ""
                     for c in root.iter():
-                        if c.tag.rsplit('}', 1)[-1] == "Status":
+                        if _acl._local(c.tag) == "Status":
                             st = (c.text or "").strip()
                     rgw.set_versioning(bucket, st, actor=actor)
                 except (ValueError, ET.ParseError) as e:
@@ -506,7 +506,9 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     owner = rgw.get_object_acl(bucket, key,
                                                actor=actor)["owner"]
-                    policy = _acl.canned_acl(owner, self._canned())
+                    bowner = rgw._bucket_meta(bucket).get("owner")
+                    policy = _acl.canned_acl(owner, self._canned(),
+                                             bucket_owner=bowner)
                 rgw.put_object_acl(bucket, key, policy, actor=actor)
                 self._reply(200)
             else:
@@ -596,8 +598,7 @@ def _parse_lifecycle_xml(body: bytes):
     Expiration.Days, NoncurrentVersionExpiration.NoncurrentDays}."""
     import xml.etree.ElementTree as ET
 
-    def local(t):
-        return t.rsplit("}", 1)[-1]
+    local = _acl._local
 
     try:
         root = ET.fromstring(body)

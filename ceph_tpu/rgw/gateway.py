@@ -440,8 +440,15 @@ class RGW:
                        actor: Optional[str] = None) -> None:
         meta = self._bucket_meta(name)
         self._check_bucket(meta, actor, "WRITE_ACP")
-        meta["acl"] = acl_mod.validate(policy)
-        meta.setdefault("owner", meta["acl"]["owner"])
+        policy = acl_mod.validate(policy)
+        # ownership is immutable via ?acl (S3: a policy whose Owner
+        # differs from the actual owner is rejected) — otherwise a
+        # WRITE_ACP grantee could take the bucket over and lock the
+        # real owner out
+        if meta.get("owner") and policy["owner"] != meta["owner"]:
+            raise AccessDenied("ACL owner must match the bucket owner")
+        meta["acl"] = policy
+        meta.setdefault("owner", policy["owner"])
         self._save_bucket_meta(name, meta)
         self._mdlog("bucket", name, "write")
 
@@ -490,15 +497,13 @@ class RGW:
         if json.loads(got.decode())["entries"]:
             raise BucketNotEmpty(name)
         # versioned buckets: ANY surviving version or delete marker
-        # blocks deletion (S3 semantics)
-        try:
-            vgot = self.io.call(self._index_oid(name), "rgw",
-                                "olh_list",
-                                json.dumps({"max_keys": 1}).encode())
-            if json.loads(vgot.decode())["entries"]:
-                raise BucketNotEmpty(name)
-        except RadosError:
-            pass
+        # blocks deletion (S3 semantics).  A transient error here must
+        # PROPAGATE — swallowing it could delete a bucket whose olh
+        # rows (and their rgw.ver.* data) still exist
+        vgot = self.io.call(self._index_oid(name), "rgw", "olh_list",
+                            json.dumps({"max_keys": 1}).encode())
+        if json.loads(vgot.decode())["entries"]:
+            raise BucketNotEmpty(name)
         try:
             self.io.remove(self._index_oid(name))
         except RadosError:
@@ -610,7 +615,21 @@ class RGW:
                     actor: Optional[str] = None) -> Dict:
         bmeta = self._bucket_meta(bucket)
         if version_id is not None:
-            for v in self._olh(bucket, key):
+            try:
+                olh = self._olh(bucket, key)
+            except NoSuchKey:
+                olh = None
+            if olh is None:
+                if version_id == "null":
+                    # implicit null: the object predates versioning
+                    # and no versioned op has migrated it yet — S3
+                    # defines it as version "null" from the moment
+                    # versioning is enabled
+                    entry = self.head_object(bucket, key, actor=actor)
+                    if not entry.get("vid"):
+                        return dict(entry, vid="null")
+                raise NoSuchVersion(f"{bucket}/{key}@{version_id}")
+            for v in olh:
                 if v["vid"] == version_id:
                     if v.get("delete_marker"):
                         raise NoSuchKey(f"{bucket}/{key}")
@@ -665,6 +684,9 @@ class RGW:
         entry = self.head_object(bucket, key)
         self._check_object(bmeta, entry, actor, "WRITE_ACP")
         policy = acl_mod.validate(policy)
+        cur_owner = entry.get("owner") or bmeta.get("owner")
+        if cur_owner and policy["owner"] != cur_owner:
+            raise AccessDenied("ACL owner must match the object owner")
         if entry.get("vid"):
             # ONE atomic in-place patch of the version row (ver_update
             # — a drop+re-add would reorder history and a crash
@@ -692,6 +714,10 @@ class RGW:
         self._check_bucket(bmeta, actor, "WRITE")
         vstatus = bmeta.get("versioning")
         if version_id is not None:
+            if version_id == "null":
+                # a legacy pre-versioning object IS the null version:
+                # materialize its olh row so ver_rm can act on it
+                self._migrate_null(bucket, key)
             try:
                 got = self.io.call(
                     self._index_oid(bucket), "rgw", "ver_rm",
@@ -788,18 +814,41 @@ class RGW:
                                        "key_marker": key_marker,
                                        "max_keys": max_keys}).encode())
         out = json.loads(got.decode())
-        rows: List[Dict] = []
+        per_key: Dict[str, List[Dict]] = {}
         for key, olh in out["entries"]:
-            for idx, v in enumerate(reversed(olh)):
-                rows.append({
-                    "Key": key, "VersionId": v["vid"],
-                    "IsLatest": idx == 0,
-                    "IsDeleteMarker": bool(v.get("delete_marker")),
-                    "Size": v.get("size", 0),
-                    "ETag": v.get("etag", ""),
-                    "LastModified": v.get("mtime", 0.0),
-                })
-        return rows, out["truncated"]
+            per_key[key] = [{
+                "Key": key, "VersionId": v["vid"],
+                "IsLatest": idx == 0,
+                "IsDeleteMarker": bool(v.get("delete_marker")),
+                "Size": v.get("size", 0),
+                "ETag": v.get("etag", ""),
+                "LastModified": v.get("mtime", 0.0),
+            } for idx, v in enumerate(reversed(olh))]
+        # implicit null versions: plain rows that predate versioning
+        # and were never touched by a versioned op have no olh row —
+        # S3 still lists them as the latest "null" version
+        pgot = self.io.call(self._index_oid(bucket), "rgw",
+                            "index_list",
+                            json.dumps({"prefix": prefix,
+                                        "marker": key_marker,
+                                        "max_keys": max_keys}).encode())
+        pout = json.loads(pgot.decode())
+        for key, blob in pout["entries"]:
+            if key in per_key or key.startswith("_mp_/"):
+                continue
+            e = json.loads(blob)
+            if e.get("vid"):
+                continue
+            per_key[key] = [{
+                "Key": key, "VersionId": "null", "IsLatest": True,
+                "IsDeleteMarker": False, "Size": e.get("size", 0),
+                "ETag": e.get("etag", ""),
+                "LastModified": e.get("mtime", 0.0),
+            }]
+        rows: List[Dict] = []
+        for key in sorted(per_key):
+            rows.extend(per_key[key])
+        return rows, bool(out["truncated"] or pout["truncated"])
 
     # -- multipart upload (reference rgw_multipart.* / RGWMultipart*:
     # parts land as separate striped objects; complete writes a
@@ -1013,16 +1062,22 @@ class RGW:
                 nc = rule.get("noncurrent_days")
                 if nc is not None:
                     cutoff = now - nc * 86400
-                    rows, _ = self.list_object_versions(
-                        name, prefix=pref, max_keys=100000)
-                    for row in rows:
-                        if row["IsLatest"]:
-                            continue
-                        if row["LastModified"] <= cutoff:
-                            self.delete_object(
-                                name, row["Key"],
-                                version_id=row["VersionId"])
-                            stats["noncurrent_expired"] += 1
+                    kmarker = ""
+                    while True:
+                        rows, truncated = self.list_object_versions(
+                            name, prefix=pref, key_marker=kmarker,
+                            max_keys=1000)
+                        for row in rows:
+                            kmarker = row["Key"]
+                            if row["IsLatest"]:
+                                continue
+                            if row["LastModified"] <= cutoff:
+                                self.delete_object(
+                                    name, row["Key"],
+                                    version_id=row["VersionId"])
+                                stats["noncurrent_expired"] += 1
+                        if not truncated or not rows:
+                            break
         return stats
 
 

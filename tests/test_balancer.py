@@ -7,6 +7,7 @@ import pytest
 
 from ceph_tpu.crush import map as cmap
 from ceph_tpu.mgr import UpmapBalancer
+from ceph_tpu.mgr.balancer import CrushCompatBalancer
 from ceph_tpu.osd import map_codec
 from ceph_tpu.osd.osdmap import (
     CRUSH_ITEM_NONE,
@@ -81,3 +82,36 @@ def test_balancer_large_skewed_map():
     (rep,) = bal.optimize([1])
     assert rep.after_stddev <= rep.before_stddev
     assert rep.moves
+
+
+def test_crush_compat_reduces_stddev_via_choose_args_only():
+    """crush-compat mode (reference balancer module.py:17,68): the
+    COMPAT weight-set alone evens PG counts — no upmap entries, no
+    client-visible weight changes."""
+    m = build_map()
+    before_weights = {bid: list(b.weights)
+                      for bid, b in m.crush.buckets.items()}
+    bal = CrushCompatBalancer(m, step=0.3, max_iterations=10)
+    rep = bal.optimize([1])
+    assert rep.after_stddev < rep.before_stddev, (
+        f"stddev {rep.before_stddev:.2f} -> {rep.after_stddev:.2f}")
+    # ONLY choose_args changed
+    assert not m.pg_upmap_items and not m.pg_upmap
+    assert "-1" in m.crush.choose_args
+    for bid, b in m.crush.buckets.items():
+        assert list(b.weights) == before_weights[bid]
+
+
+def test_crush_compat_scalar_and_sweep_agree():
+    """The compat weight-set must flow through BOTH placement paths
+    (the _flatten substitution feeds the native oracle and the
+    vmapped sweep alike)."""
+    m = build_map(n_osds=16, hosts=4, pg_num=64)
+    CrushCompatBalancer(m, step=0.3, max_iterations=6).optimize([1])
+    assert "-1" in m.crush.choose_args
+    sweep = m.map_pgs(1)
+    for pg in range(0, 64, 7):
+        up, up_primary, _, _ = m.pg_to_up_acting((1, pg))
+        row = [o for o in sweep["up"][pg]
+               if o != CRUSH_ITEM_NONE]
+        assert row == [o for o in up if o != CRUSH_ITEM_NONE], pg

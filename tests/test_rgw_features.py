@@ -234,6 +234,42 @@ def test_versioned_object_acl_patch(stack):
     assert f"<VersionId>{v2}</VersionId>".encode() in body
 
 
+def test_acl_owner_takeover_rejected(stack):
+    """A WRITE_ACP grantee may edit grants but NOT the Owner — the
+    takeover path (policy with a different Owner) is rejected."""
+    fe, sa, sb = stack
+    sa.request("PUT", "/own-b")
+    policy = {"owner": "alice",
+              "grants": [{"grantee": "bob", "perm": "WRITE_ACP"},
+                         {"grantee": "bob", "perm": "READ"}]}
+    assert sa.request("PUT", "/own-b", query="acl",
+                      body=acl_mod.to_xml(policy).encode())[0] == 200
+    steal = {"owner": "bob", "grants": []}
+    code, _, body = sb.request("PUT", "/own-b", query="acl",
+                               body=acl_mod.to_xml(steal).encode())
+    assert code == 403 and b"owner" in body
+    # alice still rules
+    assert sa.request("GET", "/own-b", query="acl")[0] == 200
+
+
+def test_implicit_null_version_visible(stack):
+    """Objects that predate versioning are version 'null' the moment
+    versioning turns on — readable, listable, deletable by versionId
+    with no intervening write."""
+    fe, sa, _ = stack
+    sa.request("PUT", "/leg-b")
+    sa.request("PUT", "/leg-b/old", body=b"pre-versioning")
+    _enable_versioning(sa, "leg-b")
+    assert sa.request("GET", "/leg-b/old",
+                      query="versionId=null")[2] == b"pre-versioning"
+    code, _, body = sa.request("GET", "/leg-b", query="versions")
+    assert b"<Key>old</Key>" in body and \
+        b"<VersionId>null</VersionId>" in body
+    assert sa.request("DELETE", "/leg-b/old",
+                      query="versionId=null")[0] == 204
+    assert sa.request("GET", "/leg-b/old")[0] == 404
+
+
 def test_multipart_versioned(stack):
     fe, sa, _ = stack
     sa.request("PUT", "/mpv-b")
