@@ -110,12 +110,20 @@ class MDSDaemon(Dispatcher):
         `fs status`."""
         from ceph_tpu.mon import messages as mm
 
+        # a fresh incarnation nonce per boot() call: resent/replayed
+        # beacons of THIS incarnation are idempotent, and a beacon
+        # replayed after `mds fail` cannot resurrect the failed
+        # incarnation (only a new boot() re-registers) — see MMDSBoot
+        self._boot_gen = getattr(self, "_boot_gen", 0) + 1
+        bnonce = ((self.msgr.nonce & 0xFFFFFFFF) << 16) | self._boot_gen
+
         def send_all() -> None:
             for addr in monmap.addrs:
                 if addr is not None:
                     self.msgr.send_message(
                         mm.MMDSBoot(self.rank, self.addr[0],
-                                    self.addr[1]), tuple(addr))
+                                    self.addr[1], boot_nonce=bnonce),
+                        tuple(addr))
 
         send_all()
         threading.Thread(
@@ -257,11 +265,30 @@ class MDSDaemon(Dispatcher):
                      f"(loads {totals})")
         return (sub, cold_rank)
 
+    def _retract_foreign_caps(self) -> None:
+        """Revoke capabilities this rank still holds on paths it no
+        longer owns (a balancer re-pin — or a manual export-pin —
+        moved the subtree; an idle EXCL holder would otherwise never
+        learn, and the new owner could grant a SECOND EXCL)."""
+        with self.lock:
+            held = [(p, list(hs)) for p, hs in self.caps.items() if hs]
+        for path, holders in held:
+            try:
+                if self.owner_rank(path) == self.rank:
+                    continue
+            except Exception:  # noqa: BLE001 — table read raced
+                continue
+            for client in holders:
+                self._revoke(path, client, 0)
+            with self.lock:
+                self.caps.pop(path, None)
+
     def _balance_loop(self) -> None:
         while not self._bal_stop.wait(self.bal_interval):
             try:
                 self._publish_load()
                 self._balance_once()
+                self._retract_foreign_caps()
             except Exception:  # noqa: BLE001 — balancer must not die
                 pass
 

@@ -307,20 +307,35 @@ class MPGStats(Message):
 @register
 class MMDSBoot(Message):
     """mds -> mon: rank R serves at this address (reference MMDSBeacon
-    boot, src/messages/MMDSBeacon.h — the FSMap feed)."""
+    boot, src/messages/MMDSBeacon.h — the FSMap feed).
+
+    `nonce` identifies the boot INCARNATION (the reference beacon's
+    gid/seq role): beacons are resent until committed AND ride
+    lossless sessions, so a replayed stale beacon can arrive after an
+    `mds fail` — the FSMap must not let it resurrect the failed
+    incarnation.  Decodes nonce=0 from pre-round-5 blobs (corpus
+    back-compat)."""
 
     TYPE = 45
 
-    def __init__(self, rank: int = -1, ip: str = "", port: int = 0) -> None:
+    def __init__(self, rank: int = -1, ip: str = "", port: int = 0,
+                 boot_nonce: int = 0) -> None:
         super().__init__()
         self.rank = rank
         self.ip = ip
         self.port = port
+        # NOT named `nonce`: the messenger stamps msg.nonce with its
+        # own session nonce on every send (messenger.py), which would
+        # clobber this field
+        self.boot_nonce = boot_nonce
 
     def encode_payload(self, e: Encoder) -> None:
         e.s32(self.rank).string(self.ip).u32(self.port)
+        e.u64(self.boot_nonce)
 
     def decode_payload(self, d: Decoder) -> None:
         self.rank = d.s32()
         self.ip = d.string()
         self.port = d.u32()
+        self.boot_nonce = (d.u64() if d.remaining_in_frame() >= 8
+                           else 0)

@@ -1214,7 +1214,8 @@ class Monitor(Dispatcher):
             with self.lock:
                 if self.state == STATE_LEADER:
                     self.services["mdsmap"].handle_boot(
-                        msg.rank, (msg.ip, msg.port))
+                        msg.rank, (msg.ip, msg.port),
+                        getattr(msg, "boot_nonce", 0))
             return True
         if isinstance(msg, mm.MPGStats):
             with self.lock:

@@ -488,7 +488,8 @@ class MDSMonitor(PaxosService):
         op = payload["op"]
         rank = str(payload["rank"])
         if op == "boot":
-            self.ranks[rank] = {"addr": payload["addr"], "up": True}
+            self.ranks[rank] = {"addr": payload["addr"], "up": True,
+                                "nonce": payload.get("nonce", 0)}
         elif op == "fail":
             if rank in self.ranks:
                 self.ranks[rank]["up"] = False
@@ -503,11 +504,19 @@ class MDSMonitor(PaxosService):
         self.ranks = {k: dict(v) for k, v in snap["ranks"].items()}
         self._persist(batch)
 
-    def handle_boot(self, rank: int, addr) -> None:
+    def handle_boot(self, rank: int, addr, nonce: int = 0) -> None:
         cur = self.ranks.get(str(rank))
         if cur and cur.get("up") and tuple(cur["addr"]) == tuple(addr):
             return  # duplicate boot retry
-        self.propose({"op": "boot", "rank": rank, "addr": list(addr)})
+        if (cur and not cur.get("up") and nonce
+                and cur.get("nonce") == nonce):
+            # a REPLAYED/resent beacon of the very incarnation that was
+            # failed (beacons are resent until committed and ride
+            # lossless sessions): it must not resurrect the rank — only
+            # a NEW boot incarnation (fresh nonce) re-registers
+            return
+        self.propose({"op": "boot", "rank": rank, "addr": list(addr),
+                      "nonce": nonce})
 
     def command(self, cmd: dict) -> Optional[Tuple[int, dict]]:
         prefix = cmd.get("prefix", "")

@@ -845,10 +845,25 @@ class RGW:
                 "ETag": e.get("etag", ""),
                 "LastModified": e.get("mtime", 0.0),
             }]
+        # dual-listing truncation: each page enumerates keys up to ITS
+        # OWN last key — a merged page may only extend to the SMALLER
+        # of the two bounds, or marker-based continuation skips keys
+        # between the truncation points (review finding)
+        truncated = bool(out["truncated"] or pout["truncated"])
+        if truncated:
+            bounds = []
+            if out["truncated"] and out["entries"]:
+                bounds.append(out["entries"][-1][0])
+            if pout["truncated"] and pout["entries"]:
+                bounds.append(pout["entries"][-1][0])
+            if bounds:
+                bound = min(bounds)
+                per_key = {k: v for k, v in per_key.items()
+                           if k <= bound}
         rows: List[Dict] = []
         for key in sorted(per_key):
             rows.extend(per_key[key])
-        return rows, bool(out["truncated"] or pout["truncated"])
+        return rows, truncated
 
     # -- multipart upload (reference rgw_multipart.* / RGWMultipart*:
     # parts land as separate striped objects; complete writes a

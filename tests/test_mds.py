@@ -34,6 +34,9 @@ def test_dynamic_subtree_balancing(cluster, rc):
         c.mkdir("/hot/d")
         c.mkdir("/coldside")
         c.set_pin("/coldside", 1)   # rank 1 owns a (quiet) subtree
+        # an EXCL holder on the hot subtree (whose caps must be
+        # retracted by the old owner after the migration)
+        c.create("/hot/d/excl", wants=CAP_RD | CAP_WR | CAP_EXCL)
         # hammer /hot on rank 0 while rank 1 idles
         for i in range(60):
             c.create(f"/hot/d/f{i}", wants=CAP_RD)
@@ -62,6 +65,12 @@ def test_dynamic_subtree_balancing(cluster, rc):
             raise AssertionError("rank 1 never served /hot after "
                                  "migration")
         assert c.listdir("/hot") == ["d"]
+        # the OLD owner retracts caps it holds under the moved
+        # subtree (otherwise an idle EXCL holder and a new-owner
+        # grant could coexist)
+        assert mds0.caps.get("/hot/d/excl"), "precondition: caps held"
+        mds0._retract_foreign_caps()
+        assert not mds0.caps.get("/hot/d/excl")
         # balanced now: a second pass finds nothing move-worthy
         mds0._publish_load()
         mds1._publish_load()

@@ -63,6 +63,52 @@ def _forensics(c, cl, pool: int, oid: str) -> None:
         traceback.print_exc()
 
 
+def _timeout_forensics(c, cl, pool: int, errmsg: str) -> None:
+    """Dump the liveness-class evidence: the client's map view vs the
+    cluster's truth for the timed-out op's target (round-5 hunt —
+    stale map? stale addrbook? dead primary still targeted?)."""
+    try:
+        oid = errmsg.split("oid=")[1].strip("'\")") if "oid=" in errmsg \
+            else "?"
+        ob = cl.rc.objecter
+        cmap_ep = ob.osdmap.epoch if ob.osdmap else -1
+        print(f"  t-forensics: oid={oid!r} client_epoch={cmap_ep} "
+              f"cluster_epoch={c.osdmap.epoch}", flush=True)
+        print(f"  t-forensics: up_per_map="
+              f"{[o for o in range(c.osdmap.max_osd)
+                  if c.osdmap.is_up(o)]} "
+              f"alive={[i for i, s in sorted(c.osds.items()) if s.up]}",
+              flush=True)
+        if ob.osdmap is not None and oid != "?":
+            pgid, primary = ob._calc_target(pool, oid)
+            addr = ob.addrbook.get(primary)
+            real = c.osds.get(primary)
+            print(f"  t-forensics: target pg={pgid} primary={primary} "
+                  f"client_addr={addr} "
+                  f"real_addr={getattr(real, 'addr', None)} "
+                  f"real_up={getattr(real, 'up', None)}", flush=True)
+            if real is not None and real.up:
+                pg = real.pgs.get(pgid)
+                if pg is not None:
+                    print(f"  t-forensics: primary pg state={pg.state} "
+                          f"acting={list(pg.acting)} "
+                          f"interval_epoch="
+                          f"{getattr(pg, 'interval_epoch', None)}",
+                          flush=True)
+                else:
+                    print("  t-forensics: primary has NO pg instance",
+                          flush=True)
+        # any other in-flight ops stuck alongside?
+        with ob._lock:
+            stuck = [(o.tid, o.oid, o.attempts,
+                      round(time.monotonic() - o.last_send, 1)
+                      if o.last_send else None)
+                     for o in ob.ops.values()]
+        print(f"  t-forensics: pending_ops={stuck}", flush=True)
+    except Exception:
+        traceback.print_exc()
+
+
 def run_one(seed: int, pool_kind: str, rounds: int = 200) -> bool:
     sys.path.insert(0, "tests")
     from test_rados_model import _run_model_sequence
@@ -103,6 +149,14 @@ def run_one(seed: int, pool_kind: str, rounds: int = 200) -> bool:
         msg = str(e)
         if ":" in msg:
             _forensics(c, cl, pool, msg.split(":")[0].strip())
+        traceback.print_exc()
+    except TimeoutError as e:
+        print(f"FAIL {pool_kind} seed={seed:#x}: {e!r}", flush=True)
+        # freeze the cluster FIRST: forensics under a live thrasher
+        # would snapshot mid-churn state, not the timeout's cause
+        stop.set()
+        th.join(timeout=10)
+        _timeout_forensics(c, cl, pool, str(e))
         traceback.print_exc()
     except Exception as e:
         print(f"FAIL {pool_kind} seed={seed:#x}: {e!r}", flush=True)
