@@ -112,6 +112,9 @@ class OSDService(Dispatcher):
         self.up = True
         if self.osdmap is not None:
             self._load_pgs()
+        threading.Thread(target=self._peering_watchdog_loop,
+                         daemon=True,
+                         name=f"osd{self.whoami}-peerwd").start()
         if self.ctx.admin is not None:
             # `ceph daemon osd.N bench` / `ceph tell osd.N bench` role
             # (reference OSD::bench behind the 'bench' command): raw
@@ -513,8 +516,26 @@ class OSDService(Dispatcher):
         return out
 
     def activate_pgs(self) -> None:
+        # async per-PG: one blocked peer RPC must not serialize every
+        # other PG's convergence behind it (round-5 liveness fix)
         for pg in list(self.pgs.values()):
-            pg.activate()
+            pg.activate_async()
+
+    def _peering_watchdog_loop(self) -> None:
+        """Re-kick activation for PGs wedged in PEERING (a peer reply
+        lost in a kill window, or a stale activation discarded by the
+        interval token, left the gate closed with nothing scheduled to
+        reopen it — the round-5 hunt's 0.7%-of-loaded-runs op-timeout
+        class, t-forensics: 'state=peering, all OSDs up, 35 EAGAIN
+        attempts')."""
+        while self.up:
+            time.sleep(1.0)
+            try:
+                for pg in list(self.pgs.values()):
+                    if pg.peering_stuck():
+                        pg.activate_async()
+            except Exception:  # noqa: BLE001 — watchdog never dies
+                pass
 
     # -- messaging --------------------------------------------------------
     def send_to_osd(self, osd_id: int, msg: Message) -> None:

@@ -86,6 +86,12 @@ class PG:
         # same_interval_since): replica-op messages from older epochs
         # are DROPPED, not applied
         self.interval_epoch = 0
+        # async-activation plumbing (round-5 liveness fix): activation
+        # runs on its own thread, never in the map-refresh caller, and
+        # a request arriving while one is in flight queues ONE re-run
+        self._activating = False
+        self._activate_again = False
+        self._peering_since = time.monotonic()
         self.peer_info: Dict[int, PGInfo] = {}
         # reqid -> committed version: completed-op replay so client
         # resends are exactly-once across primary failover (the
@@ -175,6 +181,7 @@ class PG:
                 # propagation).  Deriving same_interval_since from map
                 # history would remove the overshoot (round-5 item).
                 self.state = STATE_PEERING
+                self._peering_since = time.monotonic()
                 self.interval_epoch = self.osd.epoch()
             if prior is not None:
                 # prior-interval holders (the past_intervals role): when
@@ -1331,6 +1338,47 @@ class PG:
             self.osd.send_to_osd(osd, rd)
 
     # -- peering + recovery (primary, linearized) -------------------------
+    def activate_async(self) -> None:
+        """Kick activation WITHOUT blocking the caller (round-5
+        liveness fix: synchronous activation in the map-refresh path
+        serialized every PG behind one blocked peer RPC — a peer that
+        died mid-peering could hold the whole cluster's convergence,
+        and a stale activation losing the interval race left PEERING
+        with no retrigger).  At most one activation runs per PG; a kick
+        during one queues exactly one re-run so the final run always
+        sees the newest interval."""
+        with self.lock:
+            if self._activating:
+                self._activate_again = True
+                return
+            self._activating = True
+        threading.Thread(target=self._activate_loop, daemon=True,
+                         name=f"pg{t_.pgid_str(self.pgid)}-act").start()
+
+    def _activate_loop(self) -> None:
+        while True:
+            try:
+                self.activate()
+            except Exception as e:  # noqa: BLE001 — must not die wedged
+                self.osd._log(1, f"pg {self.pgid}: activation failed: "
+                                 f"{e!r}")
+            with self.lock:
+                if self._activate_again:
+                    self._activate_again = False
+                    continue
+                self._activating = False
+                return
+
+    def peering_stuck(self, threshold_s: float = 3.0) -> bool:
+        """Watchdog predicate: in PEERING past the threshold with no
+        activation in flight (a lost peer reply or a discarded stale
+        activation would otherwise wedge the gate forever)."""
+        with self.lock:
+            return (self.state == STATE_PEERING
+                    and not self._activating
+                    and time.monotonic() - self._peering_since
+                    > threshold_s)
+
     def activate(self) -> None:
         """Collect peer infos+logs, converge, then go active.
 

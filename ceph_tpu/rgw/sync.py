@@ -38,7 +38,8 @@ import time
 from typing import Dict, List, Optional
 
 from ceph_tpu.client.rados import RadosError
-from ceph_tpu.rgw.gateway import RGW, NoSuchBucket, NoSuchKey
+from ceph_tpu.rgw.gateway import (RGW, BucketExists, NoSuchBucket,
+                                  NoSuchKey)
 
 
 class RGWZoneSync:
@@ -148,8 +149,16 @@ class RGWZoneSync:
                 if op == "write":
                     try:
                         self.dst.create_bucket(name, log_meta=False)
-                    except Exception:
-                        pass  # already present
+                    except BucketExists:
+                        pass  # replayed create: already converged
+                    except RadosError:
+                        # TRANSIENT failure: stop the batch with the
+                        # cursor still before this event so the next
+                        # tick retries — swallowing it would advance
+                        # past a create that never happened (ADVICE
+                        # r4: data sync's create-on-sight would heal
+                        # it only much later)
+                        break
                 else:
                     try:
                         self._force_remove_bucket(name)
