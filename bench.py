@@ -900,7 +900,7 @@ def aux_section(jax, out):
     for k in ("clay_repair_gbps", "clay_repair_read_frac_vs_rs",
               "jerasure_k4m2_4k_encode_gbps", "lrc_profile",
               "lrc_local_repair_reads", "lrc_local_repair_gbps",
-              "cluster_io"):
+              "cluster_io", "cluster_io_ec"):
         if k in sub:
             out[k] = sub[k]
     # surface the subprocess's own failures in THIS artifact: missing

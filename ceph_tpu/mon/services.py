@@ -507,7 +507,15 @@ class MDSMonitor(PaxosService):
     def handle_boot(self, rank: int, addr, nonce: int = 0) -> None:
         cur = self.ranks.get(str(rank))
         if cur and cur.get("up") and tuple(cur["addr"]) == tuple(addr):
-            return  # duplicate boot retry
+            # duplicate boot retry — but only for the SAME incarnation.
+            # An MDS that restarted on the same address carries a fresh
+            # nonce and must re-register it: suppressing it would leave
+            # the OLD nonce stored, so a later `mds fail` could be
+            # undone by the new incarnation's retried beacons (their
+            # nonce wouldn't match the stored one and the replay guard
+            # below wouldn't hold them back)
+            if not nonce or cur.get("nonce") == nonce:
+                return
         if (cur and not cur.get("up") and nonce
                 and cur.get("nonce") == nonce):
             # a REPLAYED/resent beacon of the very incarnation that was

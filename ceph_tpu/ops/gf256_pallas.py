@@ -39,6 +39,13 @@ LANES = 128
 DEFAULT_TILE = 512  # sublane rows per grid step: (k, 512, 128) u32 = 2 MiB for k=8
 
 
+def _compiler_params(pltpu, **kw):
+    # jax >= 0.5 renamed TPUCompilerParams -> CompilerParams
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 def _net_matrix_meta(matrix: np.ndarray):
     mat = [[int(c) for c in row] for row in matrix]
     R, k = matrix.shape
@@ -120,8 +127,8 @@ def _compiled(matrix_bytes: bytes, shape: Tuple[int, int], tile: int,
             ],
             out_specs=pl.BlockSpec((R, tile, LANES), lambda i: (0, i, 0),
                                    memory_space=pltpu.VMEM),
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=(dimsem,)),
+            compiler_params=_compiler_params(
+                pltpu, dimension_semantics=(dimsem,)),
             input_output_aliases=alias,
             interpret=interpret,
         )(seed, words3)
@@ -221,8 +228,8 @@ def _compiled_interleaved(matrix_bytes: bytes, shape: Tuple[int, int],
             ],
             out_specs=pl.BlockSpec((tile, R, LANES), lambda i: (i, 0, 0),
                                    memory_space=pltpu.VMEM),
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("arbitrary",)),
+            compiler_params=_compiler_params(
+                pltpu, dimension_semantics=("arbitrary",)),
             interpret=interpret,
         )(seed, words3)
 

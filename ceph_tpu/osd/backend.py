@@ -105,6 +105,21 @@ class PGBackend:
         self.tids = 0
         self.in_flight: Dict[int, InFlightOp] = {}
         self._lock = threading.Lock()
+        # roll-forward watermark provider, bound by the PG to its
+        # info.committed_to (rides EC sub-writes so shards learn which
+        # entries are beyond divergent rollback)
+        self.committed_fn: Callable[[], EVersion] = EVersion
+
+    def roll_back_entry(self, entry: LogEntry,
+                        meta_omap: Optional[Dict[str, bytes]] = None
+                        ) -> bool:
+        """Undo one divergent entry's local mutations from its
+        persisted rollback record; False = no record (the caller falls
+        back to re-replication).  `meta_omap` lets a multi-entry
+        rewind fetch the pg-meta omap once instead of per entry.
+        Replicated PGs converge by log/push alone, so only ECBackend
+        implements this."""
+        return False
 
     # -- common helpers ---------------------------------------------------
     def _new_tid(self) -> int:
@@ -272,6 +287,23 @@ def hinfo_decode(blob: bytes) -> Tuple[int, int, bool]:
     return size, crc, valid
 
 
+# -- EC write rollback records ----------------------------------------------
+# The src/osd/ECTransaction.h rollback-extents discipline: every EC
+# shard write snapshots the state it overwrites into a rollback record
+# persisted in the SAME store transaction (keyed by the entry's version
+# in the pg meta omap, see pglog.rollback_key).  Peering's divergent-
+# entry handling consumes the records: a shard that committed a stripe
+# the authoritative log never saw restores its pre-write extents
+# instead of being re-replicated wholesale (pg._rollback_to).  Records
+# trim with their log entries.
+
+RB_FULL = 1    # whole-shard replace (full-object write / delete)
+RB_EXTENT = 2  # ranged chunk-extent overwrite (partial-stripe RMW)
+# a shard state too large to snapshot is not captured: rollback of
+# that entry falls back to the re-replication convergence path
+RB_MAX_CAPTURE = 1 << 20
+
+
 class ExtentCache:
     """Overwrite pipeline cache (reference: ExtentCache.h role).
 
@@ -414,8 +446,132 @@ class ECBackend(PGBackend):
             t.touch(self.coll, _meta_oid())
             t.omap_setkeys(self.coll, _meta_oid(), log_omap)
         if log_rm:
-            t.omap_rmkeys(self.coll, _meta_oid(), log_rm)
+            t.omap_rmkeys(self.coll, _meta_oid(),
+                          list(log_rm) + self._rb_trim_keys(log_rm))
         return t
+
+    def _rb_trim_keys(self, log_rm: Sequence[str]) -> List[str]:
+        """Rollback-record keys trimmed alongside their log entries
+        (an entry beyond the log window can't be rolled back anyway —
+        the trim_to/roll_forward_to horizon)."""
+        n = self.k + self.m
+        return [f"rb_{key}.{s}" for key in log_rm for s in range(n)]
+
+    def rb_capture(self, txn: Transaction, oid: str, shard: int,
+                   kind: int, off: int, length: int, version) -> None:
+        """Snapshot the local shard state `txn` is about to overwrite
+        into a rollback record carried by the SAME transaction (crash
+        atomicity: record and mutation land together).  Called right
+        before queue_transaction, while the store still holds the
+        pre-write image."""
+        from ceph_tpu.osd.pglog import rollback_key
+
+        g = GHObject(oid, shard=shard)
+        e = Encoder()
+        e.start(1, 1)
+        e.u8(kind)
+        exists = self.store.exists(self.coll, g)
+        e.u8(1 if exists else 0)
+        if exists:
+            try:
+                data = self.store.read(self.coll, g)
+                attrs = dict(self.store.getattrs(self.coll, g))
+            except Exception:
+                return  # unreadable shard: no record, rollback falls back
+            if kind == RB_EXTENT:
+                old = data[off: off + length]
+                if len(old) > RB_MAX_CAPTURE:
+                    return
+                e.u64(off).blob(old).u64(len(data))
+                # only the attrs an extent write touches; an attr
+                # absent before is recorded empty and removed on restore
+                e.mapping({k: attrs.get(k, b"")
+                           for k in ("hinfo", "_av")},
+                          lambda enc, k: enc.string(k),
+                          lambda enc, v: enc.blob(v))
+            else:
+                if len(data) > RB_MAX_CAPTURE:
+                    return
+                omap = dict(self.store.omap_get(self.coll, g))
+                e.blob(data)
+                e.mapping(attrs, lambda enc, k: enc.string(k),
+                          lambda enc, v: enc.blob(v))
+                e.mapping(omap, lambda enc, k: enc.string(k),
+                          lambda enc, v: enc.blob(v))
+        e.finish()
+        txn.touch(self.coll, _meta_oid())
+        txn.omap_setkeys(self.coll, _meta_oid(),
+                         {rollback_key(version, shard): e.bytes()})
+
+    def roll_back_entry(self, entry: LogEntry,
+                        meta_omap: Optional[Dict[str, bytes]] = None
+                        ) -> bool:
+        """Undo one divergent entry: restore every local shard's
+        pre-write state from the records persisted with it, and drop
+        the entry's log row.  False when no record exists (pre-
+        machinery entry, capture skipped, or applied elsewhere) — the
+        caller falls back to marking the object missing."""
+        from ceph_tpu.osd.pglog import _logkey, rollback_prefix
+
+        omap = (meta_omap if meta_omap is not None
+                else self.store.omap_get(self.coll, _meta_oid()))
+        pre = rollback_prefix(entry.version)
+        keys = sorted(k for k in omap if k.startswith(pre))
+        if not keys:
+            return False
+        t = Transaction()
+        for key in keys:
+            try:
+                shard = int(key[len(pre):])
+                self._rb_restore(t, entry.oid, shard, omap[key])
+            except Exception:
+                return False  # undecodable record: fall back whole-entry
+        t.omap_rmkeys(self.coll, _meta_oid(),
+                      keys + [_logkey(entry.version)])
+        self.store.queue_transaction(t)
+        self.cache.invalidate(entry.oid)
+        return True
+
+    def _rb_restore(self, t: Transaction, oid: str, shard: int,
+                    blob: bytes) -> None:
+        d = Decoder(blob)
+        d.start(1)
+        kind = d.u8()
+        existed = bool(d.u8())
+        g = GHObject(oid, shard=shard)
+        if not existed:
+            # the write CREATED this shard object: rollback removes it
+            t.try_remove(self.coll, g)
+            d.end()
+            return
+        if kind == RB_EXTENT:
+            off = d.u64()
+            old = d.blob()
+            old_len = d.u64()
+            attrs = d.mapping(lambda dd: dd.string(),
+                              lambda dd: dd.blob())
+            t.truncate(self.coll, g, old_len)
+            if old:
+                t.write(self.coll, g, off, old)
+            live = {k: v for k, v in attrs.items() if v}
+            if live:
+                t.setattrs(self.coll, g, live)
+            for k, v in attrs.items():
+                if not v:  # captured-absent attr must not survive
+                    t.rmattr(self.coll, g, k)
+        else:
+            data = d.blob()
+            attrs = d.mapping(lambda dd: dd.string(),
+                              lambda dd: dd.blob())
+            omap = d.mapping(lambda dd: dd.string(),
+                             lambda dd: dd.blob())
+            t.try_remove(self.coll, g)
+            t.write(self.coll, g, 0, data)
+            if attrs:
+                t.setattrs(self.coll, g, attrs)
+            if omap:
+                t.omap_setkeys(self.coll, g, omap)
+        d.end()
 
     def on_peer_change(self, alive: set) -> None:
         # an interval change invalidates the overwrite cache: a new
@@ -441,9 +597,9 @@ class ECBackend(PGBackend):
         op = InFlightOp(waiting, lambda: (self._done(tid), on_commit()))
         self.in_flight[tid] = op
         av = None
-        if entries:
-            v = entries[-1].version
-            av = _av_stamp(v)
+        version = entries[-1].version if entries else None
+        if version is not None:
+            av = _av_stamp(version)
         for shard, osd in enumerate(shard_osds):
             if osd == CRUSH_ITEM_NONE or osd < 0:
                 continue
@@ -452,18 +608,36 @@ class ECBackend(PGBackend):
                 chunks[shard] if state is not None else None,
                 state, log_omap, log_rm, av=av)
             if osd == self.whoami:
+                if version is not None:
+                    self.rb_capture(txn, oid, shard, RB_FULL, 0, 0,
+                                    version)
                 self.store.queue_transaction(txn)
                 op.ack((shard, osd))
             else:
-                msg = m.MECSubWrite(self.pgid, self.epoch_fn(), shard,
-                                    txn.to_bytes(), entries)
+                msg = m.MECSubWrite(
+                    self.pgid, self.epoch_fn(), shard, txn.to_bytes(),
+                    entries, oid=oid,
+                    rb_kind=RB_FULL if version is not None else 0,
+                    committed_to=self.committed_fn())
                 msg.tid = tid
                 self.osd_send(osd, msg)
 
-    def apply_sub_write(self, txn_bytes: bytes) -> None:
+    def apply_sub_write(self, msg) -> None:
         """Shard side of MECSubWrite (handle_sub_write,
-        ECBackend.cc:880): log + data in ONE transaction."""
-        self.store.queue_transaction(Transaction.from_bytes(txn_bytes))
+        ECBackend.cc:880): log + data in ONE transaction — with the
+        overwritten state snapshotted into the entry's rollback record
+        first, so the same transaction also makes the entry undoable.
+        Accepts raw txn bytes for rollback-less applies (recovery
+        tooling, legacy tests)."""
+        if isinstance(msg, (bytes, bytearray)):
+            self.store.queue_transaction(Transaction.from_bytes(msg))
+            return
+        txn = Transaction.from_bytes(msg.txn)
+        if msg.rb_kind and msg.entries:
+            self.rb_capture(txn, msg.oid, msg.shard, msg.rb_kind,
+                            msg.rb_off, msg.rb_len,
+                            msg.entries[-1].version)
+        self.store.queue_transaction(txn)
 
     # -- reads ------------------------------------------------------------
     def read_local_chunk(self, oid: str, shard: int) -> Optional[bytes]:
@@ -657,12 +831,19 @@ class ECBackend(PGBackend):
                 t.touch(self.coll, _meta_oid())
                 t.omap_setkeys(self.coll, _meta_oid(), log_omap)
             if log_rm:
-                t.omap_rmkeys(self.coll, _meta_oid(), log_rm)
+                t.omap_rmkeys(self.coll, _meta_oid(),
+                              list(log_rm) + self._rb_trim_keys(log_rm))
             if osd == self.whoami:
+                if entries:
+                    self.rb_capture(t, oid, shard, RB_EXTENT, ext_off,
+                                    len(payload), entries[-1].version)
                 self.store.queue_transaction(t)
                 op.ack((shard, osd))
             else:
-                msg = m.MECSubWrite(self.pgid, self.epoch_fn(), shard,
-                                    t.to_bytes(), entries)
+                msg = m.MECSubWrite(
+                    self.pgid, self.epoch_fn(), shard, t.to_bytes(),
+                    entries, oid=oid, rb_kind=RB_EXTENT, rb_off=ext_off,
+                    rb_len=len(payload),
+                    committed_to=self.committed_fn())
                 msg.tid = tid
                 self.osd_send(osd, msg)

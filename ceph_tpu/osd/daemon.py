@@ -29,22 +29,41 @@ Addr = Tuple[str, int]
 
 
 class _Waiter:
-    """Synchronous request/reply correlation by message tid."""
+    """Synchronous request/reply correlation by message tid.
 
-    def __init__(self, expect: int) -> None:
-        self.expect = expect
+    Tracks WHICH peers still owe a reply so the map can fail them
+    fast: a peer marked down mid-wait can never answer, and waiting
+    out the full RPC window for it serialized peering behind every
+    death (10s x PGs — the round-5/6 activation-starvation source)."""
+
+    def __init__(self, peers) -> None:
+        self.pending: Dict[int, int] = {}
+        for p in peers:
+            self.pending[p] = self.pending.get(p, 0) + 1
         self.replies: List[Message] = []
         self.cond = threading.Condition()
 
-    def add(self, msg: Message) -> None:
+    def add(self, msg: Message, src: int = -1) -> None:
         with self.cond:
             self.replies.append(msg)
+            left = self.pending.get(src, 0)
+            if left > 1:
+                self.pending[src] = left - 1
+            else:
+                self.pending.pop(src, None)
+            self.cond.notify_all()
+
+    def fail_peers(self, dead) -> None:
+        """A peer transitioned to down: its replies will never come."""
+        with self.cond:
+            for o in list(self.pending):
+                if o in dead:
+                    del self.pending[o]
             self.cond.notify_all()
 
     def wait(self, timeout: float) -> List[Message]:
         with self.cond:
-            self.cond.wait_for(lambda: len(self.replies) >= self.expect,
-                               timeout)
+            self.cond.wait_for(lambda: not self.pending, timeout)
             return list(self.replies)
 
 
@@ -388,6 +407,16 @@ class OSDService(Dispatcher):
         if addr_book:
             self.addr_book.update(addr_book)
         if old is not None:
+            # fail in-flight RPC waits on peers this map marks down:
+            # their replies can never come, and burning the full RPC
+            # window per dead peer serialized every PG's activation
+            # behind one death (the round-6 thrash trace: three PGs x
+            # 10s stalls, client ops starved behind the peering gate)
+            dead = {o for o in range(osdmap.max_osd)
+                    if old.is_up(o) and not osdmap.is_up(o)}
+            if dead:
+                for w in list(self._waiters.values()):
+                    w.fail_peers(dead)
             # pg_num growth splits parents IN PLACE (reference PG::split
             # discipline): with pgp_num unchanged, children fold to the
             # parent's pps (raw_pg_to_pps stable_mods ps by pgp_num), so
@@ -515,11 +544,37 @@ class OSDService(Dispatcher):
                         lu.epoch, lu.version, pg.is_primary()))
         return out
 
-    def activate_pgs(self) -> None:
+    def activate_pgs(self, wait_s: float = 0.0) -> None:
         # async per-PG: one blocked peer RPC must not serialize every
         # other PG's convergence behind it (round-5 liveness fix)
         for pg in list(self.pgs.values()):
             pg.activate_async()
+        if wait_s > 0:
+            self.wait_pgs_settled(wait_s)
+
+    def wait_pgs_settled(self, timeout_s: float) -> bool:
+        """Block (bounded) until every PG's current activation PASS has
+        finished — peer infos converged, authoritative log pulled, and
+        the pass's recovery attempts done.  Client ops are NOT gated on
+        this (the peering gate opens mid-pass); it exists for cluster
+        drivers (boot, thrash harnesses, vstart) whose next destructive
+        step must not race the recovery a revive just made possible —
+        the round-6 trace: async activation let the thrash kill land
+        before the revived shard-holder was caught up, leaving an acked
+        stripe below k live holders.  Dead peers can't stall this wait:
+        map-down transitions fail their RPCs immediately."""
+        from ceph_tpu.osd.pg import STATE_PEERING
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if not self.up:
+                return False
+            busy = [pg for pg in list(self.pgs.values())
+                    if pg._activating or pg.state == STATE_PEERING]
+            if not busy:
+                return True
+            time.sleep(0.02)
+        return False
 
     def _peering_watchdog_loop(self) -> None:
         """Re-kick activation for PGs wedged in PEERING (a peer reply
@@ -603,13 +658,13 @@ class OSDService(Dispatcher):
             else:
                 w = self._waiters.get(msg.tid)
                 if w:
-                    w.add(msg)
+                    w.add(msg, self._osd_of(msg))
             return True
         if isinstance(msg, (m.MPGInfo, m.MScrubMap, m.MPGPushReply,
                             m.MPGRecoveryProbeReply)):
             w = self._waiters.get(msg.tid)
             if w:
-                w.add(msg)
+                w.add(msg, self._osd_of(msg))
             return True
         if isinstance(msg, m.MPGCommand):
             # operator maintenance (`ceph pg scrub|repair` relayed by
@@ -711,9 +766,18 @@ class OSDService(Dispatcher):
         # until commit — two primaries waiting on each other's shard
         # acks could deadlock on a shard-hash collision
         if isinstance(msg, (m.MOSDRepOp, m.MECSubWrite, m.MECSubRead,
-                            m.MPGQuery, m.MScrub, m.MPGRecoveryProbe)):
+                            m.MPGQuery, m.MScrub, m.MPGRecoveryProbe,
+                            m.MPGRollback, m.MECCommitNote)):
             pg = self.pgs.get(msg.pgid)
             if pg is None:
+                # answer "I have nothing" instead of silently dropping:
+                # the sender's waiter otherwise burns its FULL timeout
+                # per query (10s x PGs during churn was a prime
+                # peering-starvation source — an osd mid-boot or with a
+                # lagging map stalls every activation that asks it).
+                # Messages whose reply would claim state we don't have
+                # (pushes) still drop.
+                self._nack_unknown_pg(msg, conn)
                 return True
             if isinstance(msg, m.MOSDRepOp):
                 pg.handle_rep_op(msg, conn)
@@ -723,6 +787,10 @@ class OSDService(Dispatcher):
                 pg.handle_sub_read(msg, conn)
             elif isinstance(msg, m.MPGRecoveryProbe):
                 pg.handle_recovery_probe(msg, conn)
+            elif isinstance(msg, m.MPGRollback):
+                pg.handle_rollback(msg, conn)
+            elif isinstance(msg, m.MECCommitNote):
+                pg.handle_commit_note(msg, conn)
             elif isinstance(msg, m.MPGQuery):
                 pg.handle_query(msg, conn)
             elif isinstance(msg, m.MScrub):
@@ -770,6 +838,37 @@ class OSDService(Dispatcher):
     def _osd_of(self, msg: Message) -> int:
         return msg.src.num if msg.src and msg.src.kind == "osd" else -1
 
+    def _nack_unknown_pg(self, msg: Message, conn: Connection) -> None:
+        """Definitive empty answers for peering/scrub RPCs targeting a
+        PG this osd doesn't hold (yet): collections are instantiated at
+        mount, so "unknown" really means "nothing stored here" — and a
+        prompt empty reply keeps the asker's activation from waiting
+        out its whole RPC window."""
+        omap = self.osdmap
+        if omap is None or msg.epoch > omap.epoch:
+            # the sender's map is NEWER than ours: "unknown pg" may
+            # just mean we haven't consumed the split/creation that
+            # minted it, while our store (e.g. a pre-split parent)
+            # holds its data — a definitive "empty" here would feed
+            # the asker false testimony.  Stay silent; the asker
+            # retries after we catch up.
+            return
+        rep: Optional[Message] = None
+        if isinstance(msg, (m.MPGQuery, m.MPGRollback)):
+            rep = m.MPGInfo(msg.pgid, self.epoch(),
+                            PGInfo(pgid=msg.pgid), [])
+        elif isinstance(msg, m.MScrub):
+            rep = m.MScrubMap(msg.pgid, self.epoch(), {}, [])
+        elif isinstance(msg, m.MPGRecoveryProbe):
+            rep = m.MPGRecoveryProbeReply(msg.pgid, self.epoch(),
+                                          msg.oid, 0)
+        elif isinstance(msg, m.MECSubRead):
+            rep = m.MECSubReadReply(msg.pgid, self.epoch(), msg.shard,
+                                    msg.oid, b"", -5, {}, {})  # EIO
+        if rep is not None:
+            rep.tid = msg.tid
+            conn.send(rep)
+
     # -- heartbeats -------------------------------------------------------
     def _hb_loop(self, interval: float) -> None:
         grace = self.ctx.conf.get("osd_heartbeat_grace")
@@ -812,12 +911,18 @@ class OSDService(Dispatcher):
     def _rpc(self, peers_msgs: List[Tuple[int, Message]],
              timeout: float = 10.0) -> List[Message]:
         tid = self.new_tid()
-        w = _Waiter(len(peers_msgs))
+        w = _Waiter([osd_id for osd_id, _ in peers_msgs])
         self._waiters[tid] = w
         try:
+            unsendable = set()
             for osd_id, msg in peers_msgs:
                 msg.tid = tid
+                if self.addr_book.get(osd_id) is None:
+                    unsendable.add(osd_id)  # nowhere to send: no reply
+                    continue
                 self.send_to_osd(osd_id, msg)
+            if unsendable:
+                w.fail_peers(unsendable)
             return w.wait(timeout)
         finally:
             self._waiters.pop(tid, None)
@@ -877,6 +982,26 @@ class OSDService(Dispatcher):
                 # deleted objects must not survive in the context cache
                 pg._obc_invalidate()
         with pg.lock:
+            # adopt the authoritative log BEFORE recovery runs: the
+            # recovery read's _av discipline and the rebuilt shard's
+            # stamp both come from log.latest_for(oid) — recovering
+            # first stamped the fresh bytes with the PRE-pull head
+            # (or accepted unchecked chunks when the object predated
+            # our log), so the shard read as stale forever after and
+            # one more holder death made the object unreconstructable
+            # (sweep-seed find: fresh data, wrong generation stamp)
+            for en in sorted(info_msg.entries, key=lambda e: e.version):
+                if en.version > pg.log.head:
+                    pg.log.append(en)
+            if info_msg.info.last_update > pg.info.last_update:
+                pg.info.last_update = info_msg.info.last_update
+                pg.info.last_complete = info_msg.info.last_update
+            # NOT persisted yet: the missing fence is memory-only, so
+            # a crash between "claim the authoritative head" and "hold
+            # the data" would restart this osd asserting a log it
+            # cannot serve (and replicated pools have no _av stamp to
+            # catch it).  The persist lands after recovery below; a
+            # crash mid-recovery re-peers from the OLD durable state.
             for oid, en in latest.items():
                 if en.op != t_.LOG_DELETE:
                     # our local copy/shards are STALE for these objects
@@ -909,12 +1034,8 @@ class OSDService(Dispatcher):
                             m.MPGPull(pg.pgid, self.epoch(), pulls))],
                           timeout=30.0)
         with pg.lock:
-            for en in sorted(info_msg.entries, key=lambda e: e.version):
-                if en.version > pg.log.head:
-                    pg.log.append(en)
-            if info_msg.info.last_update > pg.info.last_update:
-                pg.info.last_update = info_msg.info.last_update
-                pg.info.last_complete = info_msg.info.last_update
+            # recovery ran (or left its failures in pg.missing): NOW
+            # the adopted log + head are safe to make durable
             pg._persist_meta(pg.log.omap_additions(pg.log.entries))
 
     def _ec_self_recover(self, pg: PG, oid: str, en) -> None:

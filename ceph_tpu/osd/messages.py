@@ -155,28 +155,62 @@ class MOSDRepOpReply(_PGMessage):
 @register
 class MECSubWrite(_PGMessage):
     """Primary -> EC shard: shard-local transaction + log entries
-    (src/messages/MOSDECSubOpWrite.h; handled at ECBackend.cc:880)."""
+    (src/messages/MOSDECSubOpWrite.h; handled at ECBackend.cc:880).
+
+    `oid` + the rb_* fields describe what the transaction mutates so
+    the RECEIVING shard can snapshot the overwritten state into a
+    rollback record in the same store transaction (the ECTransaction
+    rollback-extents discipline): rb_kind selects full-replace vs
+    extent overwrite (RB_* in osd/backend.py), rb_off/rb_len bound the
+    extent.  `committed_to` piggybacks the primary's roll-forward
+    watermark so shards learn which entries are beyond rollback.
+
+    v2 appended oid/rb_*/committed_to; COMPAT stays 1 — a v1 blob
+    (committed golden corpus, a not-yet-upgraded peer) decodes with
+    the tail defaulted, costing only this write's rollback record."""
 
     TYPE = 14
+    VERSION = 2
 
     def __init__(self, pgid=(0, 0), epoch=0, shard: int = -1,
                  txn: bytes = b"",
-                 entries: Optional[List[LogEntry]] = None) -> None:
+                 entries: Optional[List[LogEntry]] = None,
+                 oid: str = "", rb_kind: int = 0,
+                 rb_off: int = 0, rb_len: int = 0,
+                 committed_to: Optional[EVersion] = None) -> None:
         super().__init__(pgid, epoch)
         self.shard = shard
         self.txn = txn
         self.entries = entries or []
+        self.oid = oid
+        self.rb_kind = rb_kind
+        self.rb_off = rb_off
+        self.rb_len = rb_len
+        self.committed_to = committed_to or EVersion()
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
         e.s32(self.shard).blob(self.txn)
         e.seq(self.entries, lambda enc, en: en.encode(enc))
+        e.string(self.oid).u8(self.rb_kind)
+        e.u64(self.rb_off).u64(self.rb_len)
+        self.committed_to.encode(e)
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.shard = d.s32()
         self.txn = d.blob()
         self.entries = d.seq(LogEntry.decode)
+        if d.remaining_in_frame():  # v2 tail
+            self.oid = d.string()
+            self.rb_kind = d.u8()
+            self.rb_off = d.u64()
+            self.rb_len = d.u64()
+            self.committed_to = EVersion.decode(d)
+        else:
+            self.oid, self.rb_kind = "", 0
+            self.rb_off = self.rb_len = 0
+            self.committed_to = EVersion()
 
 
 @register
@@ -595,3 +629,56 @@ class MPGCommand(_PGMessage):
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.action = d.string()
+
+
+@register
+class MPGRollback(_PGMessage):
+    """Primary -> peer during peering: rewind your log to `to_version`,
+    undoing each divergent entry's shard mutation from its persisted
+    rollback record (the divergent-entry handling of the reference's
+    PGLog merge: entries the authoritative log never saw are rolled
+    BACK, not re-replicated).  The peer answers with an MPGInfo
+    carrying its post-rollback info so the primary's peer view stays
+    current without a second query round."""
+
+    TYPE = 46
+
+    def __init__(self, pgid=(0, 0), epoch=0,
+                 to_version: Optional[EVersion] = None) -> None:
+        super().__init__(pgid, epoch)
+        self.to_version = to_version or EVersion()
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        self.to_version.encode(e)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.to_version = EVersion.decode(d)
+
+
+@register
+class MECCommitNote(_PGMessage):
+    """Primary -> acting EC shards, fired the moment an op gets its
+    LAST shard ack (before the client reply): "entries <= committed_to
+    are acked — never roll them back".  The piggyback on the next
+    sub-write is not enough on its own: an acked write followed by the
+    primary's death leaves the watermark ONLY on the dead primary, and
+    the next peering round would count < k holders and rewind an
+    acknowledged write (the round-6 thrash data-loss trace).  Shards
+    persist the watermark so it survives their own restart."""
+
+    TYPE = 47
+
+    def __init__(self, pgid=(0, 0), epoch=0,
+                 committed_to: Optional[EVersion] = None) -> None:
+        super().__init__(pgid, epoch)
+        self.committed_to = committed_to or EVersion()
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        self.committed_to.encode(e)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.committed_to = EVersion.decode(d)

@@ -125,6 +125,15 @@ class PG:
             self.backend = ReplicatedBackend(
                 pgid, self.coll, osd.store, osd.whoami, osd.send_to_osd,
                 osd.epoch)
+        # roll-forward watermark rides EC sub-writes (divergent-entry
+        # rollback must never rewind past an acked write)
+        self.backend.committed_fn = lambda: self.info.committed_to
+        # peering-watchdog backoff state (exponential per PG)
+        self._wd_backoff = 0.0
+        self._wd_next = 0.0
+        # leaf lock for the roll-forward watermark CAS (commit
+        # callbacks race it from shard-ack threads)
+        self._ct_lock = threading.Lock()
 
     # -- identity ---------------------------------------------------------
     def is_primary(self) -> bool:
@@ -183,6 +192,9 @@ class PG:
                 self.state = STATE_PEERING
                 self._peering_since = time.monotonic()
                 self.interval_epoch = self.osd.epoch()
+                # fresh interval, fresh watchdog fuse
+                self._wd_backoff = 0.0
+                self._wd_next = 0.0
             if prior is not None:
                 # prior-interval holders (the past_intervals role): when
                 # placement moves wholesale (pgp_num change, crush
@@ -808,12 +820,46 @@ class PG:
         # read the same base state (per-PG ordering, the reference's
         # strictly-ordered RMW pipeline, ECBackend.cc:2098)
         state = self._read_state_sync(msg.oid, raw_retry=True)
+        supersede = False
         if state is READ_RETRY:
-            # ambiguous base state (shards unreachable mid-churn): a
-            # write built on "absent" would fork history — retryable
-            reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
-                                msg.ops, result=EAGAIN))
-            return
+            if (self.is_ec() and msg.ops
+                    and all(op.op == t_.OP_WRITEFULL for op in msg.ops)):
+                # the current generation is unreconstructable (fresh
+                # shards behind down/stale holders) but every op here
+                # REPLACES the object wholesale — prior bytes are
+                # irrelevant.  EAGAIN would wedge the client until the
+                # dead holder returns (the sweep-seed starvation):
+                # proceed from absent instead.  The commit mints a
+                # NEWER generation on the live shards and the _av
+                # stamp fences the old chunks when their holder
+                # revives.  Ops that read-modify or need existence
+                # (ranged write, delete) still wait out recovery.
+                state, supersede = None, True
+                # WRITEFULL replaces DATA but keeps xattrs/omap —
+                # forking from fully-absent silently wiped them
+                # (model-thrash omap-loss find).  Best effort: carry
+                # the meta of the freshest local shard; its data may
+                # be a stale generation but the newest local stamp is
+                # the best testimony reachable without the dead holder.
+                best = None
+                for shard in self.backend.local_shards(self.acting):
+                    attrs, omap = self.backend.shard_meta(
+                        msg.oid, shard)
+                    if (attrs or omap) and (
+                            best is None or attrs.get("_av", b"")
+                            > best[0].get("_av", b"")):
+                        best = (dict(attrs), dict(omap))
+                if best is not None:
+                    xa = {k: v for k, v in best[0].items()
+                          if k not in ("hinfo", "_av")}
+                    state = ObjectState(b"", xa, best[1])
+            else:
+                # ambiguous base state (shards unreachable mid-churn):
+                # a write built on "absent" would fork history —
+                # retryable
+                reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                    msg.oid, msg.ops, result=EAGAIN))
+                return
         committed = threading.Event()
         # exactly one reply per op, whether commit or timeout wins
         _replied = [False]
@@ -881,6 +927,12 @@ class PG:
                     delete = False
             self._commit_write(msg, commit_state, delete,
                                reply_once, committed, pre_txn=pre)
+            if supersede:
+                # the full rewrite just queued supersedes the
+                # unrecovered generation — the missing marker (if any)
+                # refers to history this write replaced, and leaving it
+                # would EAGAIN every read of the now-current object
+                self.missing.pop(msg.oid, None)
         # wait OUTSIDE the lock: inline replica handlers need it
         if not committed.wait(timeout=30.0):
             # a shard never acked and no map change resolved it: answer
@@ -983,6 +1035,7 @@ class PG:
                 if c is not None and len(c) >= off + length:
                     extents[shard] = c[off: off + length]
         if not set(range(be.k)) <= set(extents):
+            omap_ = self.osd.osdmap
             remote = [
                 (acting[s], m.MECSubRead(self.pgid, self.osd.epoch(), s,
                                          oid, off, length))
@@ -990,7 +1043,8 @@ class PG:
                 if s not in extents
                 and acting[s] not in (self.osd.whoami, CRUSH_ITEM_NONE)
                 and acting[s] >= 0 and acting[s] not in self.stale_peers
-            ]
+                and (omap_ is None or omap_.is_up(acting[s]))  # down:
+            ]   # can never answer — don't burn the read window on it
             if remote:
                 for rep in self.osd.rpc(remote, timeout=10.0):
                     if (isinstance(rep, m.MECSubReadReply)
@@ -1053,6 +1107,7 @@ class PG:
 
             def on_commit() -> None:
                 self._note_reqid(entry)
+                self._note_committed(version)
                 reply_once(m.MOSDOpReply(
                     self.pgid, self.osd.epoch(), msg.oid, msg.ops,
                     result=0, version=version))
@@ -1094,6 +1149,7 @@ class PG:
             # that never reached quorum (EAGAIN to client) must not be
             # answered as done on resend
             self._note_reqid(entry)
+            self._note_committed(version)
             reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                 msg.ops, result=0, version=version))
             if committed is not None:
@@ -1129,8 +1185,14 @@ class PG:
                 # primary's interval change already restarted or
                 # re-resolved the repop (thrash-hunt divergence find).
                 return
-            self.backend.apply_sub_write(msg.txn)
+            self.backend.apply_sub_write(msg)
             self._note_entries(msg.entries)
+            with self._ct_lock:
+                if msg.committed_to > self.info.committed_to:
+                    # the primary's roll-forward watermark: entries at
+                    # or below it are acked and beyond divergent
+                    # rollback
+                    self.info.committed_to = msg.committed_to
         rep = m.MECSubWriteReply(self.pgid, self.osd.epoch(), msg.shard, 0)
         rep.tid = msg.tid
         conn.send(rep)
@@ -1144,6 +1206,52 @@ class PG:
         if self.log.head > self.info.last_update:
             self.info.last_update = self.log.head
             self.info.last_complete = self.log.head
+
+    def _note_committed(self, version: EVersion) -> None:
+        """Advance the roll-forward watermark: the op at `version` got
+        its LAST shard ack, so every acting shard holds it and
+        divergent-entry rollback must never rewind past it (the
+        reference's roll_forward_to).
+
+        EC primaries broadcast the advance to their acting shards
+        IMMEDIATELY (MECCommitNote, sent before the client reply is
+        enqueued) rather than only piggybacking it on the next
+        sub-write: an acked write with no successor, followed by the
+        primary's death, otherwise leaves the watermark solely on the
+        dead primary — and the next peering round, seeing < k
+        reachable holders and no watermark, would roll back an
+        acknowledged write (the round-6 thrash data-loss trace).
+
+        Called from commit callbacks with and without the pg lock
+        held: the check-then-set runs under a dedicated leaf lock
+        (never the pg lock — lockdep's checked mutex is not
+        reentrant), because two shard-ack threads racing it bare
+        could store out of order and REGRESS the watermark below an
+        already-broadcast note."""
+        with self._ct_lock:
+            if version <= self.info.committed_to:
+                return
+            self.info.committed_to = version
+        if not self.is_ec() or self.primary != self.osd.whoami:
+            return
+        note = None
+        for osd_id in self.acting:
+            if osd_id in (self.osd.whoami, CRUSH_ITEM_NONE) or osd_id < 0:
+                continue
+            note = m.MECCommitNote(self.pgid, self.osd.epoch(), version)
+            self.osd.send_to_osd(osd_id, note)
+
+    def handle_commit_note(self, msg: m.MECCommitNote, conn) -> None:
+        """Shard side of the roll-forward watermark: merge and PERSIST
+        it (a revived shard must still refuse to rewind acked
+        entries).  No reply — the note is advisory; losing one only
+        defers protection to the piggyback on the next sub-write."""
+        with self.lock:
+            with self._ct_lock:
+                if msg.committed_to <= self.info.committed_to:
+                    return
+                self.info.committed_to = msg.committed_to
+            self._persist_meta()
 
     # -- reqid replay (exactly-once resends) ------------------------------
     def _note_reqid(self, en: LogEntry) -> None:
@@ -1232,16 +1340,31 @@ class PG:
                 if c is not None:
                     cur_avail[shard] = c
                     _better_meta(cur_meta, attrs, omap)
+        omap_ = self.osd.osdmap
+
+        def _up(o: int) -> bool:
+            return omap_ is None or omap_.is_up(o)
+
         remote = [(s, o, True) for s, o in enumerate(acting)
                   if o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
-                  and o not in self.stale_peers]  # stale shards can't serve
+                  and o not in self.stale_peers  # stale shards can't serve
+                  and _up(o)]
+        # a DOWN current holder can never answer: skipping it (instead
+        # of waiting out the 10s read window for silence) turns reads
+        # of its objects into prompt EAGAINs — but its shard may hold
+        # the freshest extent, so a short read must stay RETRYABLE,
+        # never report absence (down_cur below)
+        down_cur = any(o not in (self.osd.whoami, CRUSH_ITEM_NONE)
+                       and o >= 0 and o not in self.stale_peers
+                       and not _up(o)
+                       for o in acting)
         # wholesale remap: a freshly-placed member has nothing yet — ask
         # the prior-interval holder of each shard too (fallback source)
         prior = list(self.prior_acting[:n])
         for s in range(min(n, len(prior))):
             o = prior[s]
             if (o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
-                    and s not in cur_avail
+                    and _up(o) and s not in cur_avail
                     and (s, o, True) not in remote):
                 remote.append((s, o, False))
         # outstanding CURRENT-holder requests per shard: a prior
@@ -1264,6 +1387,9 @@ class PG:
 
         if not remote or len(cur_avail) >= be.k:
             av = cur_avail
+            if len(av) < be.k and (down_cur or av_reject0):
+                done(READ_RETRY)  # short of k only because holders are
+                return            # down/stale: recovery will serve it
             done(be.reconstruct(oid, av, cur_meta[0]) if av else None)
             return
         lock = threading.Lock()
@@ -1281,7 +1407,7 @@ class PG:
                 hung_cur = any(v > 0 for v in pending_cur.values())
             timer.cancel()
             if len(av) < be.k and ((timed_out and hung_cur)
-                                   or av_reject[0]):
+                                   or av_reject[0] or down_cur):
                 # a current holder never answered (its shard may exist
                 # and a prior holder's chunk must not substitute —
                 # mixed generations decode to garbage), or chunks were
@@ -1372,12 +1498,25 @@ class PG:
     def peering_stuck(self, threshold_s: float = 3.0) -> bool:
         """Watchdog predicate: in PEERING past the threshold with no
         activation in flight (a lost peer reply or a discarded stale
-        activation would otherwise wedge the gate forever)."""
+        activation would otherwise wedge the gate forever).
+
+        Each True ARMS an exponentially longer per-PG fuse (1s, 2s,
+        4s, ... capped at 30s) before the next trip: the round-5
+        regression was a fixed 1s tick re-kicking activation runs that
+        each lost the interval race, so the gate never opened and
+        admitted ops starved behind an EAGAIN storm.  The fuse resets
+        on an interval change and on reaching Active."""
         with self.lock:
-            return (self.state == STATE_PEERING
-                    and not self._activating
-                    and time.monotonic() - self._peering_since
-                    > threshold_s)
+            if self.state != STATE_PEERING or self._activating:
+                return False
+            now = time.monotonic()
+            if now - self._peering_since <= threshold_s:
+                return False
+            if now < self._wd_next:
+                return False
+            self._wd_backoff = min(max(2 * self._wd_backoff, 1.0), 30.0)
+            self._wd_next = now + self._wd_backoff
+            return True
 
     def activate(self) -> None:
         """Collect peer infos+logs, converge, then go active.
@@ -1412,6 +1551,14 @@ class PG:
         if down_peers:
             infos.update(self.osd.collect_pg_infos(
                 self, down_peers, timeout=1.0))
+        # EC divergent-entry arbitration BEFORE authoritative-log
+        # selection: a member whose head only it (or < k members)
+        # committed holds an un-acked leftover of a partially-committed
+        # write — it rolls BACK from its persisted rollback records;
+        # picking it as "best" instead would wedge recovery asking for
+        # k fresh chunks that never existed (EAGAIN storm)
+        if self.is_ec():
+            infos = self._resolve_divergent(infos)
         with self.lock:
             self.peer_info = infos
             # authoritative log: highest last_update among self + peers
@@ -1429,6 +1576,24 @@ class PG:
                 osd_id for osd_id, info in infos.items()
                 if info.last_update < self.info.last_update
             }
+            # "Active accepts ops while recovery proceeds" (reference
+            # PG.h:1955): with peer infos converged, the authoritative
+            # log pulled, and behind peers fenced from reads, the
+            # peering gate opens NOW — laggard pushes and EC
+            # self-recovery run with the PG serving (degraded) ops.
+            # Holding PEERING through the whole recovery phase was the
+            # round-5 regression: admitted ops starved in EAGAIN storms
+            # behind slow pushes.
+            if (tuple(self.acting), self.primary) != interval:
+                self._activate_again = True  # newer interval re-runs
+                return
+            degraded = (any(o == CRUSH_ITEM_NONE or o < 0
+                            for o in self.acting)
+                        or len(self.acting) < self._want_size()
+                        or bool(self.missing) or bool(self.stale_peers))
+            self.state = STATE_DEGRADED if degraded else STATE_ACTIVE
+            self._wd_backoff = 0.0
+            self._wd_next = 0.0
         self._push_laggards(infos)
         # objects still missing from an EARLIER interval (recovery was
         # short of fresh shards then): retry now — a peer holding them
@@ -1449,6 +1614,156 @@ class PG:
 
     def _want_size(self) -> int:
         return self.pool.size
+
+    # -- EC divergent-entry rollback (reference ECBackend
+    # trim_to/roll_forward_to, ECBackend.cc:1443-1444, + PGLog.cc
+    # divergent-entry handling) ------------------------------------------
+    def _resolve_divergent(self, infos: Dict[int, PGInfo]
+                           ) -> Dict[int, PGInfo]:
+        """Arbitrate roll-forward vs roll-back across the acting set.
+
+        The authoritative head is the newest version that can actually
+        be SERVED: one at least k acting members committed (k distinct
+        shards exist — those entries roll forward through normal
+        log-based recovery), or one at/below the cluster's
+        committed_to watermark (acked writes are never rewound, even
+        when deaths leave < k reachable holders — the data may return
+        with a revived peer).  Heads beyond that are un-acked leftovers
+        of a partially-committed write: every holder (self included)
+        rewinds them via its persisted rollback records, replacing the
+        old convergence path (mark-missing + EAGAIN until
+        re-replication) that the thrash hunt kept tripping over.
+        Returns the peer-info map with rolled-back peers' refreshed
+        infos merged in."""
+        with self.lock:
+            acting = {o for o in self.acting
+                      if o >= 0 and o != CRUSH_ITEM_NONE}
+            width = len(self.acting)
+            lus = {self.osd.whoami: self.info.last_update}
+            committed = self.info.committed_to
+            for osd_id, info in infos.items():
+                if osd_id in acting:
+                    lus[osd_id] = info.last_update
+                if info.committed_to > committed:
+                    committed = info.committed_to
+            k = self.backend.k
+            m_ = self.backend.m
+        if len(acting) < min(width, k + m_):
+            # the acting set has a hole: a DEAD member may hold — and
+            # may have completed the ack of — the very entries a
+            # rewind would drop.  A degraded EC write commits on
+            # exactly k live shards, and its commit-note watermark
+            # broadcast races the primary's death: counting holders
+            # without the dead member's testimony rolled back an ACKED
+            # write (model-thrash data-loss find, 382B of zeros where
+            # the acked 1271B image should be).  No rollback until the
+            # set is whole again; until then unreconstructable heads
+            # serve EAGAIN, which is transient and honest.
+            return infos
+        heads = sorted(set(lus.values()), reverse=True)
+        auth = None
+        for v in heads:
+            if v <= committed:
+                # FLOOR at the watermark itself, not this head: when
+                # the newest head at/below committed sits strictly
+                # below it (the acked entries' holders died or were
+                # remapped out), rewinding to that head would destroy
+                # the acked entries on the one member still carrying
+                # them — the exact writes committed_to promises never
+                # to rewind
+                auth = committed
+                break
+            if sum(1 for lu in lus.values() if lu >= v) >= k:
+                auth = v
+                break
+        if auth is None or auth >= heads[0]:
+            return infos  # nothing divergent / nothing safely rewindable
+        if any(o not in lus for o in acting):
+            # an acting member never answered: it may hold (and its ack
+            # may have completed) the very entries a rewind would drop
+            # — rollback needs the WHOLE acting set's testimony.  Fall
+            # back to the old convergence path: the newest head stays
+            # authoritative and its objects serve EAGAIN until the
+            # holder returns (correct, merely slow).
+            return infos
+        if self.info.last_update > auth:
+            self._rollback_to(auth)
+        divergent_peers = [o for o, lu in lus.items()
+                           if o != self.osd.whoami and lu > auth]
+        if divergent_peers:
+            reps = self.osd.rpc(
+                [(o, m.MPGRollback(self.pgid, self.osd.epoch(), auth))
+                 for o in divergent_peers], timeout=10.0)
+            for rep in reps:
+                if isinstance(rep, m.MPGInfo):
+                    src = rep.src.num if rep.src else -1
+                    if src >= 0:
+                        infos[src] = rep.info
+        return infos
+
+    def _rollback_to(self, target: EVersion) -> None:
+        """Rewind the local log above `target`, undoing each divergent
+        entry's shard mutations from its persisted rollback records
+        (newest first, so the final image is the pre-divergence one).
+        An entry with no usable record falls back to the old
+        convergence path: its object is marked missing and recovery
+        re-replicates it."""
+        from ceph_tpu.osd.pglog import _logkey, rollback_prefix
+
+        with self.lock:
+            divergent = self.log.rewind_to(target)
+            if self.info.last_update > target:
+                self.info.last_update = target
+            if self.info.last_complete > self.info.last_update:
+                self.info.last_complete = self.info.last_update
+            if not divergent:
+                self._persist_meta()
+                return
+            n = (self.backend.k + self.backend.m if self.is_ec()
+                 else len(self.acting))
+            meta_omap = None
+            if self.is_ec():
+                from ceph_tpu.osd.backend import _meta_oid
+
+                # one fetch for the whole rewind: per-entry re-reads
+                # of the full pg-meta omap made a multi-entry rollback
+                # O(entries x log size) right when the PG is peering
+                meta_omap = self.backend.store.omap_get(
+                    self.backend.coll, _meta_oid())
+            fallback_rm: List[str] = []
+            for en in divergent:  # newest first
+                if not self.backend.roll_back_entry(en, meta_omap):
+                    # no record: local state for this object is suspect
+                    # — recovery must re-replicate it
+                    self.missing.setdefault(en.oid, target)
+                    fallback_rm.append(_logkey(en.version))
+                    fallback_rm += [rollback_prefix(en.version) + str(s)
+                                    for s in range(n)]
+            if fallback_rm:
+                t = Transaction()
+                t.omap_rmkeys(self.coll, GHObject("_pgmeta_"),
+                              fallback_rm)
+                self.osd.store.queue_transaction(t)
+            self._persist_meta()
+            self._reindex_reqids()
+            self.osd._log(1, f"pg {t_.pgid_str(self.pgid)}: rolled back "
+                             f"{len(divergent)} divergent entries to "
+                             f"{target}")
+        # rolled-back objects must not serve from the context cache
+        self._obc_invalidate()
+
+    def handle_rollback(self, msg: m.MPGRollback, conn) -> None:
+        """Peer side of divergent-entry rollback: the primary's
+        authoritative log never saw our newest entries.  Replies with
+        our post-rollback info so the primary's peer view refreshes
+        without a second query round."""
+        with self.lock:
+            stale = msg.epoch < self.interval_epoch
+        if not stale:
+            self._rollback_to(msg.to_version)
+        rep = m.MPGInfo(self.pgid, self.osd.epoch(), self.info, [])
+        rep.tid = msg.tid
+        conn.send(rep)
 
     def _push_laggards(self, infos: Dict[int, PGInfo]) -> None:
         for osd_id, info in infos.items():

@@ -26,6 +26,20 @@ def _logkey(v: EVersion) -> str:
     return f"{v.epoch:010d}.{v.version:020d}"
 
 
+def rollback_key(v: EVersion, shard: int) -> str:
+    """PG-meta omap key of one shard's persisted rollback record for
+    the entry at `v` (the ECTransaction rollback-extents role): written
+    in the SAME store transaction as the entry itself, consumed by
+    divergent-entry rollback during peering, trimmed with the entry.
+    The "rb_" prefix keeps it out of from_omap's digit-keyed log scan."""
+    return f"rb_{_logkey(v)}.{shard}"
+
+
+def rollback_prefix(v: EVersion) -> str:
+    """Prefix matching every shard's rollback record for `v`."""
+    return f"rb_{_logkey(v)}."
+
+
 class PGLog:
     def __init__(self) -> None:
         self.entries: List[LogEntry] = []
@@ -49,6 +63,22 @@ class PGLog:
         self.entries = self.entries[cut:]
         self.tail = trimmed[-1].version
         return trimmed
+
+    def rewind_to(self, target: EVersion) -> List[LogEntry]:
+        """Drop entries strictly newer than `target` (the reference's
+        PGLog rewind_divergent_log): run during peering when the
+        authoritative log never saw them.  Returns the divergent
+        entries NEWEST FIRST — the order their shard mutations must be
+        rolled back in (each rollback record restores the pre-entry
+        state, so newest-first lands on the pre-divergence image)."""
+        divergent = [en for en in self.entries if en.version > target]
+        if not divergent:
+            return []
+        self.entries = [en for en in self.entries
+                        if en.version <= target]
+        self.head = (self.entries[-1].version if self.entries
+                     else self.tail)
+        return list(reversed(divergent))
 
     # -- queries ----------------------------------------------------------
     def latest_for(self, oid: str):

@@ -90,19 +90,28 @@ class PGInfo:
     last_complete: EVersion = field(default_factory=EVersion)
     log_tail: EVersion = field(default_factory=EVersion)
     epoch_created: int = 0
+    # roll-forward watermark (the reference's last_update_applied /
+    # roll_forward_to role): every acting shard is known to have
+    # committed entries <= committed_to, so divergent-entry rollback
+    # during peering must never rewind past it — those writes were
+    # acked to clients.  Advanced by the primary when an op's last
+    # shard ack lands; lazily persisted (a crash regresses it, which
+    # only makes rollback MORE reliant on the holder-count rule).
+    committed_to: EVersion = field(default_factory=EVersion)
 
     def encode(self, e: Encoder) -> None:
-        e.start(1, 1)
+        e.start(2, 1)
         e.s64(self.pgid[0]).u32(self.pgid[1])
         self.last_update.encode(e)
         self.last_complete.encode(e)
         self.log_tail.encode(e)
         e.u32(self.epoch_created)
+        self.committed_to.encode(e)
         e.finish()
 
     @classmethod
     def decode(cls, d: Decoder) -> "PGInfo":
-        d.start(1)
+        v = d.start(2)
         out = cls(
             pgid=(d.s64(), d.u32()),
             last_update=EVersion.decode(d),
@@ -110,6 +119,8 @@ class PGInfo:
             log_tail=EVersion.decode(d),
             epoch_created=d.u32(),
         )
+        if v >= 2:
+            out.committed_to = EVersion.decode(d)
         d.end()
         return out
 

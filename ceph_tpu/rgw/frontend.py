@@ -406,10 +406,11 @@ class _Handler(BaseHTTPRequestHandler):
         if "versions" in q:
             if meth != "GET":
                 raise _S3Error(405, "MethodNotAllowed")
-            rows, truncated = rgw.list_object_versions(
+            rows, truncated, next_key = rgw.list_object_versions(
                 bucket, prefix=q.get("prefix", ""),
                 key_marker=q.get("key-marker", ""),
-                max_keys=int(q.get("max-keys", 1000)), actor=actor)
+                max_keys=int(q.get("max-keys", 1000)), actor=actor,
+                with_marker=True)
             xml_rows = []
             for r in rows:
                 tag = ("DeleteMarker" if r["IsDeleteMarker"]
@@ -423,11 +424,22 @@ class _Handler(BaseHTTPRequestHandler):
                     inner += (f"<Size>{r['Size']}</Size>"
                               f"<ETag>&quot;{r['ETag']}&quot;</ETag>")
                 xml_rows.append(f"<{tag}>{inner}</{tag}>")
+            # S3 pagination contract: a truncated page names where the
+            # next one starts — without these a client (or our own
+            # lc_process) resuming from its last visible row can loop
+            # or abandon the listing when the page's rows all filtered
+            nxt = ""
+            if truncated:
+                next_vid = rows[-1]["VersionId"] if rows else ""
+                nxt = (f"<NextKeyMarker>{escape(next_key)}"
+                       "</NextKeyMarker>"
+                       f"<NextVersionIdMarker>{escape(next_vid)}"
+                       "</NextVersionIdMarker>")
             self._reply(200, (
                 "<?xml version=\"1.0\"?><ListVersionsResult>"
                 f"<Name>{escape(bucket)}</Name>"
                 f"<IsTruncated>{str(truncated).lower()}</IsTruncated>"
-                f"{''.join(xml_rows)}</ListVersionsResult>").encode())
+                f"{nxt}{''.join(xml_rows)}</ListVersionsResult>").encode())
             return
         if "lifecycle" in q:
             if meth == "GET":

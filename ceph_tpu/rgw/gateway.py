@@ -276,6 +276,26 @@ def _register_rgw_cls() -> None:
         ctx.omap_set(sets)
         return b""
 
+    def index_update(ctx, indata: bytes) -> bytes:
+        """Patch mutable fields (acl/owner/meta) of ONE plain index
+        row in place (ver_update's non-versioned twin): the merge
+        happens inside the cls handler against the row AS STORED, so a
+        PUT racing an ACL change keeps its size/etag/oid — the
+        read-modify-write the gateway used to do round-tripped a
+        stale entry and clobbered the winner.  No bilog entry: ACL
+        changes are not data mutations the zone sync replays."""
+        req = json.loads(indata.decode())
+        key, patch = req["key"], req["patch"]
+        got = ctx.omap_get([key]) if ctx.exists else {}
+        if key not in got:
+            raise ClsError(-2, "no such key")
+        entry = json.loads(got[key].decode())
+        for f in ("acl", "owner", "meta"):
+            if f in patch:
+                entry[f] = patch[f]
+        ctx.omap_set({key: json.dumps(entry).encode()})
+        return b""
+
     def olh_get(ctx, indata: bytes) -> bytes:
         key = indata.decode()
         olhk = OLH + key
@@ -304,6 +324,7 @@ def _register_rgw_cls() -> None:
                            "truncated": len(out) > maxk}).encode()
 
     h.register("rgw", "index_put", CLS_RD | CLS_WR, index_put)
+    h.register("rgw", "index_update", CLS_RD | CLS_WR, index_update)
     h.register("rgw", "index_rm", CLS_RD | CLS_WR, index_rm)
     h.register("rgw", "index_list", CLS_RD, index_list)
     h.register("rgw", "ver_put", CLS_RD | CLS_WR, ver_put)
@@ -699,10 +720,16 @@ class RGW:
                                           "owner",
                                           policy["owner"])}}).encode())
             return
-        entry["acl"] = policy
-        entry.setdefault("owner", policy["owner"])
-        self.io.call(self._index_oid(bucket), "rgw", "index_put",
-                     json.dumps({"key": key, "entry": entry}).encode())
+        # same atomic in-place discipline as the versioned branch: the
+        # cls handler merges acl/owner into the row AS STORED, so a
+        # concurrent PUT's fresh size/etag/oid survives (round-tripping
+        # the stale `entry` here lost the race)
+        self.io.call(
+            self._index_oid(bucket), "rgw", "index_update",
+            json.dumps({"key": key,
+                        "patch": {"acl": policy,
+                                  "owner": entry.get(
+                                      "owner", policy["owner"])}}).encode())
 
     def delete_object(self, bucket: str, key: str, *,
                       version_id: Optional[str] = None,
@@ -802,11 +829,17 @@ class RGW:
     def list_object_versions(self, bucket: str, prefix: str = "",
                              key_marker: str = "",
                              max_keys: int = 1000, *,
-                             actor: Optional[str] = None
-                             ) -> Tuple[List[Dict], bool]:
+                             actor: Optional[str] = None,
+                             with_marker: bool = False):
         """S3 ListObjectVersions: newest-first per key, is_latest on
         the current version (reference rgw_rados list_objects with
-        list_versions=true)."""
+        list_versions=true).
+
+        `with_marker=True` appends the raw continuation key-marker: the
+        dual-listing bound clamp below can drop EVERY visible row from
+        a truncated page, and a pager resuming from its last visible
+        key would then re-fetch the same page forever (or give up and
+        abandon the bucket — the lc_process stall)."""
         bmeta = self._bucket_meta(bucket)
         self._check_bucket(bmeta, actor, "READ")
         got = self.io.call(self._index_oid(bucket), "rgw", "olh_list",
@@ -850,6 +883,7 @@ class RGW:
         # of the two bounds, or marker-based continuation skips keys
         # between the truncation points (review finding)
         truncated = bool(out["truncated"] or pout["truncated"])
+        next_key = ""
         if truncated:
             bounds = []
             if out["truncated"] and out["entries"]:
@@ -860,9 +894,12 @@ class RGW:
                 bound = min(bounds)
                 per_key = {k: v for k, v in per_key.items()
                            if k <= bound}
+                next_key = bound
         rows: List[Dict] = []
         for key in sorted(per_key):
             rows.extend(per_key[key])
+        if with_marker:
+            return rows, truncated, next_key
         return rows, truncated
 
     # -- multipart upload (reference rgw_multipart.* / RGWMultipart*:
@@ -971,9 +1008,16 @@ class RGW:
 
     def list_objects(self, bucket: str, prefix: str = "",
                      marker: str = "", max_keys: int = 1000, *,
-                     actor: Optional[str] = None
-                     ) -> Tuple[List[Dict], bool]:
-        """S3 ListObjects: ([{Key, Size, ETag}...], is_truncated)."""
+                     actor: Optional[str] = None,
+                     with_marker: bool = False):
+        """S3 ListObjects: ([{Key, Size, ETag}...], is_truncated).
+
+        `with_marker=True` appends the RAW continuation marker (the
+        last index key the page scanned, hidden `_mp_/` rows included):
+        a truncated page whose visible entries all filtered out
+        otherwise gives the caller nothing to resume from, and pagers
+        that track the last VISIBLE key abandon the rest of the bucket
+        (the lc_process stall)."""
         self._check_bucket(self._bucket_meta(bucket), actor, "READ")
         got = self.io.call(self._index_oid(bucket), "rgw", "index_list",
                            json.dumps({"prefix": prefix,
@@ -987,6 +1031,10 @@ class RGW:
             e = json.loads(blob)
             entries.append({"Key": k, "Size": e["size"],
                             "ETag": e["etag"], "Meta": e.get("meta", {})})
+        if with_marker:
+            nxt = (out["entries"][-1][0]
+                   if out["truncated"] and out["entries"] else "")
+            return entries, out["truncated"], nxt
         return entries, out["truncated"]
 
 
@@ -1063,27 +1111,31 @@ class RGW:
                     cutoff = now - days * 86400
                     marker = ""
                     while True:
-                        entries, truncated = self.list_objects(
+                        entries, truncated, nxt = self.list_objects(
                             name, prefix=pref, marker=marker,
-                            max_keys=1000)
+                            max_keys=1000, with_marker=True)
                         for e in entries:
                             head = self.head_object(name, e["Key"])
                             if head.get("mtime", now) <= cutoff:
                                 self.delete_object(name, e["Key"])
                                 stats["expired"] += 1
-                            marker = e["Key"]
-                        if not truncated or not entries:
+                        # continue from the RAW last key scanned, not
+                        # the last visible entry: a truncated page of
+                        # nothing but hidden rows used to abandon the
+                        # rest of the bucket here
+                        if not truncated or nxt <= marker:
                             break
+                        marker = nxt
                 nc = rule.get("noncurrent_days")
                 if nc is not None:
                     cutoff = now - nc * 86400
                     kmarker = ""
                     while True:
-                        rows, truncated = self.list_object_versions(
-                            name, prefix=pref, key_marker=kmarker,
-                            max_keys=1000)
+                        rows, truncated, nxt = \
+                            self.list_object_versions(
+                                name, prefix=pref, key_marker=kmarker,
+                                max_keys=1000, with_marker=True)
                         for row in rows:
-                            kmarker = row["Key"]
                             if row["IsLatest"]:
                                 continue
                             if row["LastModified"] <= cutoff:
@@ -1091,8 +1143,13 @@ class RGW:
                                     name, row["Key"],
                                     version_id=row["VersionId"])
                                 stats["noncurrent_expired"] += 1
-                        if not truncated or not rows:
+                        # raw continuation marker: the dual-listing
+                        # bound clamp can leave a truncated page with
+                        # zero visible rows — resuming from the last
+                        # visible key would abandon the bucket
+                        if not truncated or nxt <= kmarker:
                             break
+                        kmarker = nxt
         return stats
 
 

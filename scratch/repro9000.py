@@ -1,0 +1,170 @@
+"""Instrumented repro of test_thrash_ec_sweep[0] (seed 9000) — dump
+cluster state when a client op times out."""
+import random, sys, os, time
+sys.path.insert(0, "tests")
+sys.path.insert(0, ".")
+from test_osd_cluster import MiniCluster, LibClient, EC_POOL, N_OSDS
+from ceph_tpu.osd import types as t_
+from ceph_tpu.osd.pg import PG, STATE_PEERING
+
+
+def dump(c, cl, note):
+    print(f"==== DUMP {note} t={time.monotonic():.2f}", flush=True)
+    for osd_id, osd in c.osds.items():
+        if not osd.up:
+            print(f" osd.{osd_id}: DOWN", flush=True)
+            continue
+        for pgid, pg in osd.pgs.items():
+            inf = pg.backend.in_flight
+            if pg.state == STATE_PEERING or inf or pg.missing:
+                print(f" osd.{osd_id} pg{pgid}: state={pg.state} "
+                      f"activating={pg._activating} "
+                      f"lu={pg.info.last_update} ct={pg.info.committed_to} "
+                      f"missing={dict(pg.missing)} stale={pg.stale_peers} "
+                      f"acting={pg.acting} "
+                      f"inflight={[(tid, sorted(op.waiting)) for tid, op in inf.items()]}",
+                      flush=True)
+    ops = cl.rc.objecter.ops
+    print(f" client ops: {[(o.tid, o.oid, o.attempts, o.target) for o in ops.values()]}",
+          flush=True)
+    for o in list(ops.values()):
+        inspect_oid(c, o.oid, o.target[0])
+
+
+def inspect_oid(c, oid, pgid):
+    from ceph_tpu.osd.backend import _av_stamp
+    print(f" ---- {oid} pg{pgid}", flush=True)
+    for osd_id, osd in c.osds.items():
+        if not osd.up:
+            continue
+        pg = osd.pgs.get(tuple(pgid))
+        if pg is None:
+            continue
+        en = pg.log.latest_for(oid)
+        want = _av_stamp(en.version) if en else None
+        be = pg.backend
+        shards = []
+        for shard in range(be.k + be.m):
+            attrs, _ = be.shard_meta(oid, shard)
+            chunk = be.read_local_chunk(oid, shard)
+            if chunk is not None or attrs:
+                shards.append((shard, len(chunk) if chunk else None,
+                               attrs.get("_av"), attrs.get("_av") == want))
+        print(f"  osd.{osd_id}: state={pg.state} acting={pg.acting} "
+              f"latest={en.version if en else None} want_av={want!r} "
+              f"lu={pg.info.last_update} ct={pg.info.committed_to} "
+              f"shards={shards}", flush=True)
+
+
+WATCH_PG = (2, 7)
+WATCH_OID = "t23"
+
+
+def instrument():
+    from ceph_tpu.osd.daemon import OSDService
+    from ceph_tpu.osd.pg import PG as _PG
+
+    orig_pull = OSDService.pull_from_peer
+    orig_rec = OSDService._ec_self_recover
+    orig_act = _PG.activate
+
+    def pull(self, pg, best_osd, since):
+        if tuple(pg.pgid) == WATCH_PG:
+            print(f"[{time.monotonic():.2f}] osd.{self.whoami} "
+                  f"PULL pg{pg.pgid} from osd.{best_osd} since={since}",
+                  flush=True)
+        r = orig_pull(self, pg, best_osd, since)
+        if tuple(pg.pgid) == WATCH_PG:
+            print(f"[{time.monotonic():.2f}] osd.{self.whoami} "
+                  f"PULL DONE pg{pg.pgid} missing={dict(pg.missing)} "
+                  f"lu={pg.info.last_update}", flush=True)
+        return r
+
+    def rec(self, pg, oid, en):
+        r = orig_rec(self, pg, oid, en)
+        if tuple(pg.pgid) == WATCH_PG:
+            print(f"[{time.monotonic():.2f}] osd.{self.whoami} "
+                  f"RECOVER pg{pg.pgid} {oid} v={en.version} -> "
+                  f"still_missing={oid in pg.missing}", flush=True)
+        return r
+
+    def act(self):
+        if tuple(self.pgid) == WATCH_PG:
+            print(f"[{time.monotonic():.2f}] osd.{self.osd.whoami} "
+                  f"ACTIVATE pg{self.pgid} acting={self.acting} "
+                  f"primary={self.primary} lu={self.info.last_update} "
+                  f"missing={dict(self.missing)}", flush=True)
+        r = orig_act(self)
+        if tuple(self.pgid) == WATCH_PG:
+            print(f"[{time.monotonic():.2f}] osd.{self.osd.whoami} "
+                  f"ACTIVATE DONE pg{self.pgid} state={self.state} "
+                  f"missing={dict(self.missing)} again={self._activate_again}",
+                  flush=True)
+        return r
+
+    OSDService.pull_from_peer = pull
+    OSDService._ec_self_recover = rec
+    _PG.activate = act
+
+
+def main():
+    instrument()
+    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 9000
+    rng = random.Random(seed)
+    c = MiniCluster()
+    cl = LibClient(c)
+    expected = {}
+    io = cl.rc.ioctx(EC_POOL)
+    down = None
+    try:
+        for r in range(6):
+            for i in range(6):
+                oid = f"t{rng.randrange(24)}"
+                data = (f"{oid}-r{r}-{i}-".encode() * rng.randrange(10, 120))
+                print(f"-- r{r} i{i} WRITE {oid} ({len(data)}B) down={down}",
+                      flush=True)
+                try:
+                    rep = io.operate(
+                        oid, [t_.OSDOp(t_.OP_WRITEFULL, data=data)],
+                        timeout=20.0)
+                except TimeoutError as e:
+                    print(f"!! WRITE TIMEOUT {oid}: {e}", flush=True)
+                    dump(c, cl, f"write {oid} r{r} i{i}")
+                    return
+                assert rep.result == 0, (oid, rep.result)
+                expected[oid] = data
+            for oid in rng.sample(sorted(expected), min(4, len(expected))):
+                try:
+                    end = time.time() + 20.0
+                    ok = False
+                    while time.time() < end:
+                        rep = io.operate(oid, [t_.OSDOp(t_.OP_READ)],
+                                         timeout=20.0)
+                        if rep.result == 0:
+                            ok = True
+                            break
+                        time.sleep(0.1)
+                    if not ok:
+                        print(f"!! READ STUCK {oid} rc={rep.result}", flush=True)
+                        dump(c, cl, f"read {oid}")
+                        return
+                    assert rep.ops[0].out_data == expected[oid], f"mid {oid}"
+                except TimeoutError as e:
+                    print(f"!! READ TIMEOUT {oid}: {e}", flush=True)
+                    dump(c, cl, f"read {oid}")
+                    return
+            if down is not None:
+                print(f"-- r{r} REVIVE {down}", flush=True)
+                c.revive(down)
+                down = None
+            if rng.random() < 0.7:
+                down = rng.randrange(N_OSDS)
+                print(f"-- r{r} KILL {down}", flush=True)
+                c.kill(down)
+        print("PASSED", flush=True)
+    finally:
+        cl.shutdown()
+        c.shutdown()
+
+
+main()

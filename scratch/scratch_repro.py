@@ -1,0 +1,117 @@
+"""Instrumented repro of test_thrash_ec seed 4321 — trace rollback decisions."""
+import random, sys, os, time, threading
+sys.path.insert(0, "tests")
+from test_osd_cluster import MiniCluster, LibClient, EC_POOL, N_OSDS
+from ceph_tpu.osd import types as t_
+from ceph_tpu.osd.pg import PG
+
+orig_resolve = PG._resolve_divergent
+orig_rollback = PG._rollback_to
+orig_handle = PG.handle_rollback
+orig_note = PG._note_committed
+
+def ts():
+    return f"{time.monotonic():.3f}"
+
+def resolve(self, infos):
+    with self.lock:
+        lus = {self.osd.whoami: self.info.last_update}
+        committed = self.info.committed_to
+    print(f"[{ts()}] osd.{self.osd.whoami} pg{self.pgid} RESOLVE acting={self.acting} "
+          f"self_lu={self.info.last_update} committed={self.info.committed_to} "
+          f"peers={{{', '.join(f'{o}: lu={i.last_update} ct={i.committed_to}' for o, i in infos.items())}}}", flush=True)
+    return orig_resolve(self, infos)
+
+def rollback(self, target):
+    print(f"[{ts()}] osd.{self.osd.whoami} pg{self.pgid} ROLLBACK to {target} "
+          f"(lu={self.info.last_update}) log_heads={[ (e.oid, str(e.version)) for e in self.log.entries[-6:] ]}", flush=True)
+    return orig_rollback(self, target)
+
+def handle(self, msg, conn):
+    print(f"[{ts()}] osd.{self.osd.whoami} pg{self.pgid} HANDLE_ROLLBACK to {msg.to_version} epoch={msg.epoch} interval={self.interval_epoch}", flush=True)
+    return orig_handle(self, msg, conn)
+
+PG._resolve_divergent = resolve
+PG._rollback_to = rollback
+PG.handle_rollback = handle
+
+from ceph_tpu.osd.daemon import OSDService
+orig_collect = OSDService.collect_pg_infos
+orig_hq = PG.handle_query
+
+def collect(self, pg, peers, timeout=10.0):
+    t0 = time.monotonic()
+    out = orig_collect(self, pg, peers, timeout)
+    dt = time.monotonic() - t0
+    if dt > 0.3 or (pg.pgid == (2, 5)):
+        print(f"[{ts()}] osd.{self.whoami} pg{pg.pgid} COLLECT peers={peers} "
+              f"got={list(out)} took={dt:.3f}", flush=True)
+    return out
+
+def hq(self, msg, conn):
+    src = msg.src.num if msg.src else -1
+    if self.pgid == (2, 5):
+        print(f"[{ts()}] osd.{self.osd.whoami} pg{self.pgid} HANDLE_QUERY from osd.{src}", flush=True)
+    return orig_hq(self, msg, conn)
+
+OSDService.collect_pg_infos = collect
+PG.handle_query = hq
+
+def _thrash(pool, rounds, seed):
+    rng = random.Random(seed)
+    c = MiniCluster()
+    cl = LibClient(c)
+    expected = {}
+    # find pg of t13
+    pgid13 = c.osdmap.object_to_pg(pool, "t13")
+    print("t13 pg:", pgid13, c.osdmap.pg_to_up_acting(pgid13), flush=True)
+    try:
+        io = cl.rc.ioctx(pool)
+        down = None
+        for r in range(rounds):
+            for i in range(6):
+                oid = f"t{rng.randrange(24)}"
+                data = (f"{oid}-r{r}-{i}-".encode() * rng.randrange(10, 120))
+                rep = io.operate(oid, [t_.OSDOp(t_.OP_WRITEFULL, data=data)], timeout=20.0)
+                assert rep.result == 0, (oid, rep.result)
+                expected[oid] = data
+                if oid == "t13":
+                    print(f"[{ts()}] WRITE t13 r{r}-{i} acked len={len(data)}", flush=True)
+            for oid in rng.sample(sorted(expected), min(4, len(expected))):
+                end = time.time() + 20.0
+                got = None
+                while time.time() < end:
+                    rep = io.operate(oid, [t_.OSDOp(t_.OP_READ)], timeout=20.0)
+                    if rep.result == 0:
+                        got = rep.ops[0].out_data
+                        break
+                    time.sleep(0.1)
+                if got != expected[oid]:
+                    print(f"[{ts()}] MISMATCH {oid} round {r}: got {got[:30] if got else None}... want {expected[oid][:30]}...", flush=True)
+                    # dump pg state on each osd
+                    for i2, osd in c.osds.items():
+                        pg = osd.pgs.get(pgid13)
+                        if pg is not None:
+                            print(f"  osd.{i2} up={osd.up} state={pg.state} acting={pg.acting} lu={pg.info.last_update} ct={pg.info.committed_to} "
+                                  f"log_t13={[str(e.version) for e in pg.log.entries if e.oid=='t13'][-3:]}", flush=True)
+                    raise AssertionError(f"mid {oid} round {r}")
+            if down is not None:
+                c.revive(down)
+                print(f"[{ts()}] REVIVE osd.{down}", flush=True)
+                down = None
+            if rng.random() < 0.7:
+                down = rng.randrange(N_OSDS)
+                c.kill(down)
+                print(f"[{ts()}] KILL osd.{down}", flush=True)
+        if down is not None:
+            c.revive(down)
+        time.sleep(0.5)
+        for oid, data in sorted(expected.items()):
+            rep = io.operate(oid, [t_.OSDOp(t_.OP_READ)], timeout=20.0)
+            assert rep.result == 0 and rep.ops[0].out_data == data, f"final {oid}"
+        print("PASS", flush=True)
+    finally:
+        cl.shutdown()
+        c.shutdown()
+
+_thrash(EC_POOL, 8, 4321)

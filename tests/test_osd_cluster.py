@@ -77,6 +77,12 @@ class MiniCluster:
         for o in self.osds.values():
             if o.up:
                 o.activate_pgs()
+        # the cluster driver's next step (a thrash kill, an assertion)
+        # must not race the recovery this map change just kicked off —
+        # the old synchronous activation gave that ordering for free
+        for o in self.osds.values():
+            if o.up:
+                o.wait_pgs_settled(15.0)
 
     def kill(self, osd_id: int) -> None:
         self.osds[osd_id].shutdown()

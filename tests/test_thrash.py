@@ -8,6 +8,8 @@ import random
 import sys, os
 import time
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(__file__))
 from test_osd_cluster import MiniCluster, LibClient, REP_POOL, EC_POOL, N_OSDS
 
@@ -74,3 +76,12 @@ def test_thrash_replicated():
 
 def test_thrash_ec():
     _thrash(EC_POOL, rounds=8, seed=4321)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(20))
+def test_thrash_ec_sweep(seed):
+    """Wide-seed EC thrash: the rollback/roll-forward machinery must
+    converge every kill/revive interleaving, not just the two seeds
+    the tier-1 tests pin (the round-5 regression was seed-dependent)."""
+    _thrash(EC_POOL, rounds=6, seed=9000 + seed)

@@ -84,14 +84,14 @@ def main():
             log("probe: TPU ALIVE")
             if not mini_done:
                 r = run_capture(os.path.join(REPO, "tools/tpu_minibench.py"),
-                                os.path.join(REPO, "BENCH_TPU_MINI.json"),
+                                os.path.join(REPO, "scratch", "BENCH_TPU_MINI.json"),
                                 timeout=900)
                 if r and r.get("backend") == "tpu":
                     mini_done = True
                     log(f"MINI captured: {json.dumps(r)}")
             if mini_done and not full_done:
                 r = run_capture(os.path.join(REPO, "bench.py"),
-                                os.path.join(REPO, "BENCH_TPU_EARLY.json"),
+                                os.path.join(REPO, "scratch", "BENCH_TPU_EARLY.json"),
                                 timeout=3600)
                 if r and r.get("backend") == "tpu":
                     full_done = True
