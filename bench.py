@@ -541,10 +541,16 @@ def small_stripe_batched(jax, out):
         f.result()
     dt = time.perf_counter() - t0
     q.stop()
+    # full precision + the raw elapsed: the r05 artifact recorded a
+    # flat 0.0 here because round(.., 3) floored a tunnel-bound run
+    # (~0.0005 GB/s) to zero, which read as "the queue path never ran"
+    # when stats showed 8192 jobs riding 6 batches
     out["small_stripe_4k_batched_gbps"] = round(
-        n_objs * 4096 / dt / 1e9, 3)
+        n_objs * 4096 / dt / 1e9, 6)
+    out["small_stripe_4k_elapsed_s"] = round(dt, 3)
     out["small_stripe_host_path"] = True
-    out["small_stripe_stats"] = {"batches": q.batches, "jobs": q.jobs}
+    out["small_stripe_stats"] = {"batches": q.batches, "jobs": q.jobs,
+                                 "bytes_in": q.bytes_in}
 
     # -- 3: device rate at the queue's recorded batch shapes ---------
     if jax.default_backend() == "cpu":
@@ -706,6 +712,7 @@ def cluster_io(jax, out):
         ioec = c.client().ioctx(ec_pool)
         dq = default_queue()
         jobs0, batches0 = dq.jobs, dq.batches
+        bytes0 = dq.bytes_in
         n_ec = 64
         t0 = time.perf_counter()
         pend = []
@@ -718,17 +725,28 @@ def cluster_io(jax, out):
             p.result(60.0)
         ec_wdt = time.perf_counter() - t0
         assert ioec.read("becq_0") == payload
+        # MEASURED batched-payload fraction (was a backend-name
+        # hardcode that reported 0.0 whenever the aux rows ran in the
+        # CPU subprocess, even though every write DID ride the queue):
+        # plane bytes the StripeBatchQueue actually carried vs client
+        # payload bytes — >= 1.0 means everything batched (padding and
+        # replica-side encodes can push it past 1)
+        q_bytes = dq.bytes_in - bytes0
+        frac = min(1.0, q_bytes / float(n_ec * len(payload)))
         out["cluster_io_ec"] = {
             "object_kib": 64, "objects": n_ec, "profile": "k=2 m=1",
+            "write_iops": round(n_ec / ec_wdt, 1),
             "write_mbps": round(n_ec * 65536 / ec_wdt / 1e6, 1),
             "queue_jobs": dq.jobs - jobs0,
             "queue_batches": dq.batches - batches0,
+            "queue_bytes": q_bytes,
             "engine_backend": jax.default_backend(),
-            "tpu_engine_byte_fraction": (
-                1.0 if jax.default_backend() != "cpu" else 0.0),
+            "batched_payload_fraction": round(frac, 3),
+            "tpu_engine_byte_fraction": round(
+                frac if jax.default_backend() != "cpu" else 0.0, 3),
             "note": "every EC stripe encode rode the StripeBatchQueue "
-                    "-> active engine; on the axon rig each batch pays "
-                    "the tunnel RTT (see envelope)",
+                    "-> active engine; batched_payload_fraction is "
+                    "measured from queue byte counters, not assumed",
         }
 
 

@@ -93,6 +93,7 @@ class Objecter(Dispatcher):
         self.resend_interval = resend_interval
         self.backoff = backoff
         self.osdmap: Optional[OSDMap] = None
+        self._map_event = threading.Event()  # set on first osdmap
         self.addrbook: Dict[int, object] = {}
         self.ops: Dict[int, ObjecterOp] = {}
         # linger (watch) registrations: cookie -> dict(pool, oid, cb,
@@ -126,6 +127,7 @@ class Objecter(Dispatcher):
             if book:
                 self.addrbook = book
             pending = list(self.ops.values())
+        self._map_event.set()
         for op in pending:
             tgt = self._calc_target(op.pool, op.oid)
             # also kick never-sent ops: one born while the primary's
@@ -144,11 +146,9 @@ class Objecter(Dispatcher):
                 self._send_watch(cookie, lg)
 
     def wait_for_map(self, timeout: float = 10.0) -> None:
-        deadline = time.monotonic() + timeout
-        while self.osdmap is None:
-            if time.monotonic() > deadline:
-                raise TimeoutError("no osdmap received")
-            time.sleep(0.02)
+        # event-driven (handle_osdmap sets it): no 20 ms poll loop
+        if not self._map_event.wait(timeout) or self.osdmap is None:
+            raise TimeoutError("no osdmap received")
 
     # -- submission --------------------------------------------------------
     def _calc_target(self, pool: int, oid: str):
@@ -253,6 +253,12 @@ class Objecter(Dispatcher):
         return None
 
     # -- replies -----------------------------------------------------------
+    def ms_can_fast_dispatch(self, msg) -> bool:
+        # op replies finish inline on the client loop: completion is an
+        # event set (+ an optional lightweight on_complete); skipping
+        # the thread-pool hop halves the wakeups per op round trip
+        return isinstance(msg, m.MOSDOpReply)
+
     def ms_dispatch(self, conn, msg) -> bool:
         if isinstance(msg, m.MWatchNotify):
             with self._lock:

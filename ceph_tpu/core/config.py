@@ -73,6 +73,9 @@ def _opts() -> List[Option]:
         O("ms_dispatch_throttle_bytes", int, 100 << 20,
           "max bytes of queued undispatched messages"),
         O("ms_crc_data", bool, True, "checksum message payloads"),
+        O("ms_ack_delay", float, 0.005,
+          "seconds to hold a dispatch ack hoping it piggybacks on "
+          "outgoing data before a dedicated ack frame is sent"),
         # -- monitor --------------------------------------------------------
         O("mon_lease", float, 5.0, "paxos lease seconds"),
         O("mon_tick_interval", float, 1.0, "monitor tick period"),

@@ -41,17 +41,19 @@ class PerfCounters:
         self._counters: Dict[str, _Counter] = {}
 
     # -- builder ----------------------------------------------------------
+    # adds are idempotent: two daemons sharing one counter set (an
+    # osd's data + heartbeat messengers) must not re-zero live counters
     def add_u64_counter(self, name: str, desc: str = "") -> None:
-        self._counters[name] = _Counter(name, TYPE_U64, desc)
+        self._counters.setdefault(name, _Counter(name, TYPE_U64, desc))
 
     def add_u64_gauge(self, name: str, desc: str = "") -> None:
-        self._counters[name] = _Counter(name, TYPE_GAUGE, desc)
+        self._counters.setdefault(name, _Counter(name, TYPE_GAUGE, desc))
 
     def add_time_avg(self, name: str, desc: str = "") -> None:
-        self._counters[name] = _Counter(name, TYPE_AVG, desc)
+        self._counters.setdefault(name, _Counter(name, TYPE_AVG, desc))
 
     def add_histogram(self, name: str, desc: str = "") -> None:
-        self._counters[name] = _Counter(name, TYPE_HIST, desc)
+        self._counters.setdefault(name, _Counter(name, TYPE_HIST, desc))
 
     # -- updates ----------------------------------------------------------
     def inc(self, name: str, by: int = 1) -> None:
@@ -118,6 +120,13 @@ class PerfCountersCollection:
             if pc is None:
                 pc = self._loggers[name] = PerfCounters(name)
             return pc
+
+    def register(self, name: str, pc: PerfCounters) -> None:
+        """Adopt an externally-built counter set (e.g. an ObjectStore's
+        own counters) so `perf dump` covers it without the owner
+        needing a Context at construction time."""
+        with self._lock:
+            self._loggers[name] = pc
 
     def get(self, name: str) -> Optional[PerfCounters]:
         with self._lock:

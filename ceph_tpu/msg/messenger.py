@@ -35,6 +35,12 @@ Addr = Tuple[str, int]
 class Dispatcher:
     """Reference src/msg/Dispatcher.h."""
 
+    def ms_can_fast_dispatch(self, msg: Message) -> bool:
+        """True = this message may dispatch INLINE on the messenger's
+        event loop (the reference ms_fast_dispatch): only for handlers
+        that never block — no store work, no lock waits, no RPCs."""
+        return False
+
     def ms_dispatch(self, conn: "Connection", msg: Message) -> bool:
         """Return True if handled; first dispatcher to claim it wins."""
         raise NotImplementedError
@@ -91,6 +97,11 @@ class Connection:
         self.out_seq = 0
         self.in_seq = 0
         self.acked = 0
+        # ack coalescing: highest in_seq this side has COMMUNICATED to
+        # the peer (piggybacked on an outgoing frame or flushed as a
+        # dedicated MAck); a pending flush timer dedups dedicated acks
+        self._ack_sent = 0
+        self._ack_timer = None
         self._unacked: List[Tuple[int, bytes]] = []  # (seq, frame)
         self._writer: Optional[asyncio.StreamWriter] = None
         self._send_q: asyncio.Queue = asyncio.Queue()
@@ -101,7 +112,7 @@ class Connection:
     # -- sender side ------------------------------------------------------
     def send(self, msg: Message) -> None:
         """Thread-safe enqueue; ordering = call order."""
-        self.msgr._loop_call(self._enqueue, msg)
+        self.msgr._cross_send(self, msg)
 
     def _enqueue(self, msg: Message) -> None:
         if self._closed:
@@ -109,6 +120,10 @@ class Connection:
         self.out_seq += 1
         msg.seq = self.out_seq
         msg.ack_seq = self.in_seq  # piggyback
+        if self.in_seq > self._ack_sent:
+            # this frame carries the ack: the deferred dedicated-ack
+            # flush (if armed) will see nothing left to say
+            self._ack_sent = self.in_seq
         msg.nonce = self.msgr.nonce
         msg.sid = self.sid
         if msg.src is None:
@@ -131,6 +146,9 @@ class Connection:
 
     def _close(self) -> None:
         self._closed = True
+        if self._ack_timer is not None:
+            self._ack_timer.cancel()  # no acks into a dead send queue
+            self._ack_timer = None
         if self._writer is not None:
             try:
                 self._writer.close()
@@ -166,6 +184,15 @@ class Messenger:
         self._thread = threading.Thread(
             target=self._loop.run_forever, name=f"msgr-{entity}", daemon=True
         )
+        # cross-thread send staging: N sends from commit/worker threads
+        # collapse into ONE loop wakeup (call_soon_threadsafe writes the
+        # self-pipe per call — per-message wakeups dominated the op
+        # path's CPU profile before this)
+        import collections
+
+        self._xq: "collections.deque" = collections.deque()
+        self._xq_lock = threading.Lock()
+        self._xq_armed = False
         self._server: Optional[asyncio.base_events.Server] = None
         self.addr: Optional[Addr] = None
         self._bind = (bind_ip, bind_port)
@@ -205,6 +232,19 @@ class Messenger:
         self._policies: Dict[str, Policy] = {}
         self._default_policy = Policy.lossless_peer()
         self._log = ctx.log.dout("ms") if ctx else (lambda lvl, s: None)
+        # deferred dedicated acks: hold each dispatch ack this long
+        # hoping an outgoing data frame piggybacks it first
+        self._ack_delay = (ctx.conf.get("ms_ack_delay") if ctx else 0.002)
+        self.perf = None
+        if ctx is not None:
+            pc = ctx.perf.create(f"msgr.{entity}")
+            pc.add_histogram("frames_per_drain",
+                             "frames coalesced into one socket write")
+            pc.add_u64_counter("acks_dedicated",
+                               "dedicated MAck frames sent")
+            pc.add_u64_counter("acks_piggybacked",
+                               "dispatch acks that rode outgoing data")
+            self.perf = pc
 
     def set_policy(self, peer_type: str, policy: Policy) -> None:
         self._policies[peer_type] = policy
@@ -300,6 +340,32 @@ class Messenger:
     def _loop_call(self, fn, *args) -> None:
         self._loop.call_soon_threadsafe(fn, *args)
 
+    def _cross_send(self, conn: Connection, msg: Message) -> None:
+        """Stage a send for the loop; arm at most ONE wakeup for any
+        number of staged messages.  Sends issued FROM the loop thread
+        (fast-dispatch replies) enqueue directly — no self-pipe at
+        all."""
+        if threading.current_thread() is self._thread:
+            conn._enqueue(msg)
+            return
+        with self._xq_lock:
+            self._xq.append((conn, msg))
+            if self._xq_armed:
+                return
+            self._xq_armed = True
+        self._loop.call_soon_threadsafe(self._drain_cross_sends)
+
+    def _drain_cross_sends(self) -> None:
+        while True:
+            with self._xq_lock:
+                if not self._xq:
+                    self._xq_armed = False
+                    return
+                items = list(self._xq)
+                self._xq.clear()
+            for conn, msg in items:
+                conn._enqueue(msg)
+
     def _spawn_outgoing(self, conn: Connection) -> None:
         self._loop.create_task(self._run_outgoing(conn))
 
@@ -354,11 +420,14 @@ class Messenger:
 
             async def _send_loop():
                 while True:
-                    frame = await conn._send_q.get()
-                    if frame is None:
+                    frames, fin = await self._next_send_batch(conn)
+                    if frames:
+                        writer.write(b"".join(frames))
+                        if self.perf is not None:
+                            self.perf.hinc("frames_per_drain", len(frames))
+                        await writer.drain()
+                    if fin:
                         raise ConnectionResetError
-                    writer.write(frame)
-                    await writer.drain()
 
             # a dead reader (peer EOF/reset) must also tear the session
             # down, or buffered writes mask the death and resend never
@@ -490,19 +559,54 @@ class Messenger:
         """Session-lifetime sender for the accepted side: drains the
         send queue onto the CURRENT socket; frames that miss (detached
         or dead writer) are not lost — they sit in _unacked and the
-        next reconnect replays them."""
+        next reconnect replays them.  Queued frames cork into one
+        write+drain like the dialing side."""
         while True:
-            frame = await conn._send_q.get()
-            if frame is None:
-                return
+            frames, fin = await self._next_send_batch(conn)
             w = conn._writer
-            if w is None:
-                continue
+            if frames and w is not None:
+                try:
+                    w.write(b"".join(frames))
+                    if self.perf is not None:
+                        self.perf.hinc("frames_per_drain", len(frames))
+                    await w.drain()
+                except (ConnectionError, OSError):
+                    pass
+            if fin:
+                return
+
+    async def _next_send_batch(self, conn: Connection):
+        """The cork: block for the first frame, then greedily collect
+        everything else already queued so the caller issues ONE
+        write+drain for the whole burst.  Returns (frames, fin); fin
+        means the close sentinel was seen — flush `frames` first, then
+        tear down (a sentinel arriving alone still terminates: it is
+        never swallowed)."""
+        frames: List[bytes] = []
+        fin = False
+        while True:
             try:
-                w.write(frame)
-                await w.drain()
-            except (ConnectionError, OSError):
-                continue
+                nxt = conn._send_q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if nxt is None:
+                return frames, True
+            frames.append(nxt)
+        if not frames:
+            first = await conn._send_q.get()
+            if first is None:
+                return frames, True
+            frames.append(first)
+            while True:
+                try:
+                    nxt = conn._send_q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if nxt is None:
+                    fin = True
+                    break
+                frames.append(nxt)
+        return frames, fin
 
     def _resolve_accepted(self, msg: Message, peer: Addr) -> Connection:
         """Find or create the persistent accepted-side session for the
@@ -599,21 +703,54 @@ class Messenger:
             sids[msg.sid] = msg.seq
             self._peer_in_seq[src] = (nonce, sids)
         conn.in_seq = msg.seq
-        self._send_ack(conn, ack_writer, conn.in_seq)
+        self._ack_later(conn, ack_writer)
 
-    def _send_ack(self, conn: Connection, ack_writer, ack_seq: int) -> None:
-        if ack_writer is None or not ack_seq:
+    def _ack_later(self, conn: Connection, ack_writer) -> None:
+        """Coalesced dispatch ack: hold the ack for ms_ack_delay hoping
+        an outgoing data frame piggybacks it (replies usually follow
+        dispatch within the window); only a session with no reverse
+        traffic pays a dedicated MAck — and one flush covers every
+        frame dispatched in the window, instead of one ack frame per
+        data frame."""
+        if ack_writer is None or conn.in_seq <= conn._ack_sent:
             return
+        if conn._ack_timer is not None:
+            return  # a flush is already armed; it reads the latest seq
+        conn._ack_timer = self._loop.call_later(
+            self._ack_delay, self._flush_ack, conn, ack_writer)
+
+    def _flush_ack(self, conn: Connection, ack_writer) -> None:
+        conn._ack_timer = None
+        if conn._closed:
+            return
+        if conn.in_seq <= conn._ack_sent:
+            if self.perf is not None:
+                self.perf.inc("acks_piggybacked")
+            return  # an outgoing frame carried it meanwhile
+        if self.perf is not None:
+            self.perf.inc("acks_dedicated")
+        conn._ack_sent = conn.in_seq
+        # ride the connection's send queue: the ack corks into the
+        # sender's next write instead of paying its own syscall (the
+        # sender task drains to the same socket ack_writer points at)
+        conn._send_q.put_nowait(self._ack_frame(conn.in_seq))
+
+    def _ack_frame(self, ack_seq: int) -> bytes:
         ack = MAck()
         ack.ack_seq = ack_seq
         ack.src = self.entity
         ack.nonce = self.nonce
         body = ack.to_bytes()
+        return _FRAME.pack(len(body),
+                           crc32c(body) if self.crc_data else 0) + body
+
+    def _send_ack(self, conn: Connection, ack_writer, ack_seq: int) -> None:
+        if ack_writer is None or not ack_seq:
+            return
+        if ack_seq > conn._ack_sent:
+            conn._ack_sent = ack_seq
         try:
-            ack_writer.write(
-                _FRAME.pack(len(body),
-                            crc32c(body) if self.crc_data else 0) + body
-            )
+            ack_writer.write(self._ack_frame(ack_seq))
         except (ConnectionError, OSError):
             pass
 
@@ -622,6 +759,20 @@ class Messenger:
         """Byte-budgeted: when ms_dispatch_throttle_bytes of payload are
         in flight to dispatchers, stop reading this socket (TCP then
         backpressures the peer — the reference policy throttle)."""
+        for d in self._dispatchers:
+            if d.ms_can_fast_dispatch(msg):
+                # fast dispatch (reference ms_fast_dispatch): run the
+                # handler inline on the loop — small control messages
+                # (write acks, pings) skip the thread-pool round trip
+                # and the byte budget
+                try:
+                    if not d.ms_dispatch(conn, msg):
+                        self._log(0, f"unhandled message {msg!r}")
+                except Exception as e:
+                    self._log(1, f"fast dispatch failed for {msg!r}: "
+                                 f"{e!r}; closing session for replay")
+                    raise ConnectionResetError("dispatch failed") from e
+                return
         if self._budget_free is None:
             self._budget_free = asyncio.Event()
             self._budget_free.set()

@@ -227,14 +227,19 @@ class ReplicatedBackend(PGBackend):
             msg = m.MOSDRepOp(self.pgid, self.epoch_fn(), body, entries)
             msg.tid = tid
             self.osd_send(peer, msg)
-        # local apply last: the store raises on real corruption, and the
-        # self-ack completes the op when peers already answered
-        self.store.queue_transaction(txn)
-        op.ack(self.whoami)
+        # local apply last: the store raises on real corruption, and
+        # the self-ack fires from the store's COMMIT callback (not
+        # inline) so the local fsync batches with every other write in
+        # flight — the op completes when peers and the commit thread
+        # have all answered
+        self.store.queue_transaction(
+            txn, on_commit=lambda: op.ack(self.whoami))
 
-    def apply_rep_op(self, txn_bytes: bytes) -> None:
-        """Replica side of MOSDRepOp (sub_op_modify)."""
-        self.store.queue_transaction(Transaction.from_bytes(txn_bytes))
+    def apply_rep_op(self, txn_bytes: bytes, on_commit=None) -> None:
+        """Replica side of MOSDRepOp (sub_op_modify); the sub-write ack
+        rides `on_commit` so replicas answer from the commit thread."""
+        self.store.queue_transaction(Transaction.from_bytes(txn_bytes),
+                                     on_commit=on_commit)
 
     def read_object(self, oid, acting, done):
         g = GHObject(oid)
@@ -611,8 +616,9 @@ class ECBackend(PGBackend):
                 if version is not None:
                     self.rb_capture(txn, oid, shard, RB_FULL, 0, 0,
                                     version)
-                self.store.queue_transaction(txn)
-                op.ack((shard, osd))
+                self.store.queue_transaction(
+                    txn,
+                    on_commit=lambda s=shard, o=osd: op.ack((s, o)))
             else:
                 msg = m.MECSubWrite(
                     self.pgid, self.epoch_fn(), shard, txn.to_bytes(),
@@ -622,22 +628,24 @@ class ECBackend(PGBackend):
                 msg.tid = tid
                 self.osd_send(osd, msg)
 
-    def apply_sub_write(self, msg) -> None:
+    def apply_sub_write(self, msg, on_commit=None) -> None:
         """Shard side of MECSubWrite (handle_sub_write,
         ECBackend.cc:880): log + data in ONE transaction — with the
         overwritten state snapshotted into the entry's rollback record
         first, so the same transaction also makes the entry undoable.
-        Accepts raw txn bytes for rollback-less applies (recovery
-        tooling, legacy tests)."""
+        The shard ack rides `on_commit` (fired from the store's commit
+        thread once the transaction is durable).  Accepts raw txn bytes
+        for rollback-less applies (recovery tooling, legacy tests)."""
         if isinstance(msg, (bytes, bytearray)):
-            self.store.queue_transaction(Transaction.from_bytes(msg))
+            self.store.queue_transaction(Transaction.from_bytes(msg),
+                                         on_commit=on_commit)
             return
         txn = Transaction.from_bytes(msg.txn)
         if msg.rb_kind and msg.entries:
             self.rb_capture(txn, msg.oid, msg.shard, msg.rb_kind,
                             msg.rb_off, msg.rb_len,
                             msg.entries[-1].version)
-        self.store.queue_transaction(txn)
+        self.store.queue_transaction(txn, on_commit=on_commit)
 
     # -- reads ------------------------------------------------------------
     def read_local_chunk(self, oid: str, shard: int) -> Optional[bytes]:
@@ -837,8 +845,8 @@ class ECBackend(PGBackend):
                 if entries:
                     self.rb_capture(t, oid, shard, RB_EXTENT, ext_off,
                                     len(payload), entries[-1].version)
-                self.store.queue_transaction(t)
-                op.ack((shard, osd))
+                self.store.queue_transaction(
+                    t, on_commit=lambda s=shard, o=osd: op.ack((s, o)))
             else:
                 msg = m.MECSubWrite(
                     self.pgid, self.epoch_fn(), shard, t.to_bytes(),

@@ -109,6 +109,9 @@ class OSDService(Dispatcher):
             slow_op_threshold=ctx.conf.get("osd_op_complaint_time"))
         self.up = False
         self._log = ctx.log.dout("osd")
+        # notified whenever a PG's activation pass finishes, so
+        # wait_pgs_settled blocks on a condition instead of polling
+        self._settle_cond = threading.Condition()
         self.on_failure_report: Optional[Callable[[int], None]] = None
         self.hb_stamps: Dict[int, float] = {}
         self.hb_replied: set = set()  # peers that ever answered a ping
@@ -121,6 +124,12 @@ class OSDService(Dispatcher):
         pc.add_time_avg("op_w_latency")
         pc.add_u64_counter("recovery_pushes")
         self.perf = pc
+        # surface the store's group-commit counters (commit-batch
+        # histogram, WAL fsyncs, commit latency) in this context's
+        # `perf dump` alongside the daemon's own
+        store_pc = getattr(store, "perf", None)
+        if store_pc is not None:
+            ctx.perf.register(f"osd.{whoami}.store", store_pc)
 
     # -- lifecycle --------------------------------------------------------
     def init(self) -> None:
@@ -160,13 +169,27 @@ class OSDService(Dispatcher):
             self.store.queue_transaction(t)
         except Exception:
             pass  # collection may exist from a prior bench
+        # async submission against the store's group-commit pipeline:
+        # every queued transaction returns immediately and the commit
+        # thread batches the fsyncs — the same path PG writes ride
+        done = threading.Event()
+        left = [n]
+        lk = threading.Lock()
+
+        def committed() -> None:
+            with lk:
+                left[0] -= 1
+                if left[0] == 0:
+                    done.set()
+
         t0 = time.perf_counter()
         for i in range(n):
             t = Txn()
             g = GHObject(f"bench_{i}")
             t.touch(coll, g)
             t.write(coll, g, 0, payload)
-            self.store.queue_transaction(t)
+            self.store.queue_transaction(t, on_commit=committed)
+        done.wait()
         elapsed = time.perf_counter() - t0
         for i in range(n):  # clean up after ourselves
             t = Txn()
@@ -346,6 +369,7 @@ class OSDService(Dispatcher):
 
     def shutdown(self) -> None:
         self.up = False
+        self.note_pg_settled()  # unblock settle waiters promptly
         self._hb_stop.set()
         if self._hb_thread:
             self._hb_thread.join(timeout=5)
@@ -562,19 +586,27 @@ class OSDService(Dispatcher):
         the round-6 trace: async activation let the thrash kill land
         before the revived shard-holder was caught up, leaving an acked
         stripe below k live holders.  Dead peers can't stall this wait:
-        map-down transitions fail their RPCs immediately."""
+        map-down transitions fail their RPCs immediately.
+
+        Event-driven: activation passes notify `_settle_cond` as they
+        finish (note_pg_settled), so this waits on the condition
+        instead of a 20 ms poll loop."""
         from ceph_tpu.osd.pg import STATE_PEERING
 
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if not self.up:
-                return False
-            busy = [pg for pg in list(self.pgs.values())
-                    if pg._activating or pg.state == STATE_PEERING]
-            if not busy:
-                return True
-            time.sleep(0.02)
-        return False
+        def settled() -> bool:
+            return (not self.up
+                    or not any(pg._activating or pg.state == STATE_PEERING
+                               for pg in list(self.pgs.values())))
+
+        with self._settle_cond:
+            ok = self._settle_cond.wait_for(settled, timeout_s)
+        return ok and self.up
+
+    def note_pg_settled(self) -> None:
+        """A PG activation pass finished (or the daemon is going
+        down): wake wait_pgs_settled sleepers to re-check."""
+        with self._settle_cond:
+            self._settle_cond.notify_all()
 
     def _peering_watchdog_loop(self) -> None:
         """Re-kick activation for PGs wedged in PEERING (a peer reply
@@ -631,6 +663,24 @@ class OSDService(Dispatcher):
         return tid
 
     # -- dispatch ---------------------------------------------------------
+    def ms_can_fast_dispatch(self, msg: Message) -> bool:
+        # these run inline on the messenger loop (the reference's
+        # ms_fast_dispatch) because their handlers never block:
+        # - write-ack replies flip in-flight bookkeeping and fire
+        #   commit callbacks (client reply sends, event sets)
+        # - MOSDOp only creates a tracker entry and queues to the
+        #   sharded wq (the op itself runs on a worker)
+        # - waiter replies append to a condition-protected list
+        # Inline-apply messages (MOSDRepOp/MECSubWrite: store work +
+        # pg lock) and EC read replies (possible numpy decode in the
+        # completion) stay on the thread pool: a handler that can wait
+        # on a lock held across peer RPCs would wedge the loop that
+        # must read those peers' replies.
+        return isinstance(msg, (m.MOSDRepOpReply, m.MECSubWriteReply,
+                                m.MOSDOp, m.MPGInfo, m.MScrubMap,
+                                m.MPGPushReply, m.MPGRecoveryProbeReply,
+                                m.MWatchNotifyAck))
+
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
         if not self.up:
             # a DOWN daemon must not touch anything: its store may
@@ -1154,6 +1204,11 @@ class _HBDispatcher(Dispatcher):
 
     def __init__(self, osd: OSDService) -> None:
         self.osd = osd
+
+    def ms_can_fast_dispatch(self, msg: Message) -> bool:
+        # liveness probes answer from the loop: a busy thread pool must
+        # never delay a ping reply into the failure-report window
+        return isinstance(msg, m.MOSDPing)
 
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
         if not self.osd.up:

@@ -101,14 +101,15 @@ class MOSDOpReply(_PGMessage):
         self._enc_head(e)
         e.string(self.oid).s32(self.result)
         self.version.encode(e)
-        e.seq(self.ops, lambda enc, o: o.encode(enc))
+        # compact reply form: outputs only, never the request payload
+        e.seq(self.ops, lambda enc, o: o.encode_reply(enc))
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.oid = d.string()
         self.result = d.s32()
         self.version = EVersion.decode(d)
-        self.ops = d.seq(OSDOp.decode)
+        self.ops = d.seq(OSDOp.decode_reply)
 
 
 @register

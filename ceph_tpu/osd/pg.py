@@ -1165,17 +1165,31 @@ class PG:
                             self.acting, on_commit, **kw)
 
     # -- replica apply ----------------------------------------------------
+    # Sub-write acks fire from the STORE's commit callback, not inline:
+    # the dispatch thread applies (in-memory state + WAL append) and
+    # moves on, while the commit thread batches one fsync across every
+    # replica write in flight and then sends the replies — the replica
+    # half of the group-commit pipeline (a 16-deep primary queue lands
+    # 16 sub-writes in one fsync here instead of 16).
     def handle_rep_op(self, msg: m.MOSDRepOp, conn) -> None:
+        def _ack() -> None:
+            rep = m.MOSDRepOpReply(self.pgid, self.osd.epoch(), 0)
+            rep.tid = msg.tid
+            conn.send(rep)
+
         with self.lock:
             if msg.epoch < self.interval_epoch:
                 return  # old-interval replica op: see handle_sub_write
-            self.backend.apply_rep_op(msg.txn)
+            self.backend.apply_rep_op(msg.txn, on_commit=_ack)
             self._note_entries(msg.entries)
-        rep = m.MOSDRepOpReply(self.pgid, self.osd.epoch(), 0)
-        rep.tid = msg.tid
-        conn.send(rep)
 
     def handle_sub_write(self, msg: m.MECSubWrite, conn) -> None:
+        def _ack() -> None:
+            rep = m.MECSubWriteReply(self.pgid, self.osd.epoch(),
+                                     msg.shard, 0)
+            rep.tid = msg.tid
+            conn.send(rep)
+
         with self.lock:
             if msg.epoch < self.interval_epoch:
                 # minted in an OLDER interval (a lossless session can
@@ -1185,7 +1199,7 @@ class PG:
                 # primary's interval change already restarted or
                 # re-resolved the repop (thrash-hunt divergence find).
                 return
-            self.backend.apply_sub_write(msg)
+            self.backend.apply_sub_write(msg, on_commit=_ack)
             self._note_entries(msg.entries)
             with self._ct_lock:
                 if msg.committed_to > self.info.committed_to:
@@ -1193,9 +1207,6 @@ class PG:
                     # or below it are acked and beyond divergent
                     # rollback
                     self.info.committed_to = msg.committed_to
-        rep = m.MECSubWriteReply(self.pgid, self.osd.epoch(), msg.shard, 0)
-        rep.tid = msg.tid
-        conn.send(rep)
 
     def _note_entries(self, entries: List[LogEntry]) -> None:
         for en in entries:
@@ -1482,18 +1493,25 @@ class PG:
                          name=f"pg{t_.pgid_str(self.pgid)}-act").start()
 
     def _activate_loop(self) -> None:
-        while True:
-            try:
-                self.activate()
-            except Exception as e:  # noqa: BLE001 — must not die wedged
-                self.osd._log(1, f"pg {self.pgid}: activation failed: "
-                                 f"{e!r}")
-            with self.lock:
-                if self._activate_again:
-                    self._activate_again = False
-                    continue
-                self._activating = False
-                return
+        try:
+            while True:
+                try:
+                    self.activate()
+                except Exception as e:  # noqa: BLE001 — must not die wedged
+                    self.osd._log(1, f"pg {self.pgid}: activation failed: "
+                                     f"{e!r}")
+                with self.lock:
+                    if self._activate_again:
+                        self._activate_again = False
+                        continue
+                    self._activating = False
+                    return
+        finally:
+            # wake wait_pgs_settled sleepers (event-driven settle wait;
+            # osd is duck-typed, so tolerate hosts without the hook)
+            note = getattr(self.osd, "note_pg_settled", None)
+            if note is not None:
+                note()
 
     def peering_stuck(self, threshold_s: float = 3.0) -> bool:
         """Watchdog predicate: in PEERING past the threshold with no

@@ -201,5 +201,31 @@ class OSDOp:
         d.end()
         return out
 
+    def encode_reply(self, e: Encoder) -> None:
+        """Reply-path encoding: op identity + OUTPUTS only.  The input
+        payload (`data`, `kv`, `keys`) stays out — the client already
+        holds its request, and echoing a 64 KiB write body back doubled
+        the write path's wire bytes and crc work (the reference's
+        MOSDOpReply likewise returns ops without indata)."""
+        e.start(1, 1)
+        e.u8(self.op).u64(self.off).u64(self.length)
+        e.string(self.name)
+        e.blob(self.out_data)
+        e.mapping(self.out_kv, lambda enc, k: enc.string(k),
+                  lambda enc, v: enc.blob(v))
+        e.s32(self.rval)
+        e.finish()
+
+    @classmethod
+    def decode_reply(cls, d: Decoder) -> "OSDOp":
+        d.start(1)
+        out = cls(op=d.u8(), off=d.u64(), length=d.u64())
+        out.name = d.string()
+        out.out_data = d.blob()
+        out.out_kv = d.mapping(lambda dd: dd.string(), lambda dd: dd.blob())
+        out.rval = d.s32()
+        d.end()
+        return out
+
     def is_write(self) -> bool:
         return self.op in WRITE_OPS

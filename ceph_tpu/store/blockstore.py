@@ -42,10 +42,12 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 from ceph_tpu.core.crc import crc32c
 from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.core.perf import PerfCounters
 from ceph_tpu.store import objectstore as os_
 from ceph_tpu.store.kv import LogKV, WriteBatch
 from ceph_tpu.store.objectstore import (
     Collection,
+    CommitPipeline,
     GHObject,
     NoSuchCollection,
     NoSuchObject,
@@ -245,6 +247,17 @@ class BlockStore(ObjectStore):
             from ceph_tpu.compress import instance as _reg
 
             self._comp = _reg().factory(compression)
+        self._seq = 0
+        # kv_sync_thread analog (reference BlueStore._kv_sync_thread):
+        # submitters apply + stage metadata, ONE device fsync + ONE KV
+        # sync then commits the whole batch
+        pc = PerfCounters("blockstore")
+        pc.add_u64_counter("queued_txns", "transactions submitted")
+        pc.add_u64_counter("dev_fsyncs", "batched device fsyncs issued")
+        pc.add_histogram("commit_batch", "transactions per commit batch")
+        pc.add_time_avg("commit_lat", "batched sync+completion seconds")
+        self.perf = pc
+        self._pipeline = CommitPipeline(self._commit_sync, perf=pc)
 
     # -- lifecycle --------------------------------------------------------
     def mkfs(self) -> None:
@@ -277,8 +290,10 @@ class BlockStore(ObjectStore):
             self._onodes.clear()
             self._blobs.clear()
             self._mounted = True
+        self._pipeline.start()
 
     def umount(self) -> None:
+        self._pipeline.stop()  # drain completions before handles close
         with self._lock:
             if self._dev_fh:
                 self._dev_fh.flush()
@@ -371,7 +386,14 @@ class BlockStore(ObjectStore):
         return bytes(out[raw_off - base: raw_off - base + length])
 
     # -- txn machinery -----------------------------------------------------
-    def queue_transaction(self, t: Transaction) -> None:
+    def queue_transaction(self, t: Transaction, on_commit=None) -> int:
+        """Apply + stage metadata synchronously (read-your-writes on
+        return), commit asynchronously: the pipeline's commit thread
+        runs one device fsync + one KV sync for every transaction
+        staged since the last batch (the BlueStore kv_sync_thread
+        shape), then fires completions and releases each transaction's
+        deferred frees — freed blocks rejoin the allocator only once
+        the commit that stopped referencing them is durable."""
         with self._lock:
             assert self._mounted, "not mounted"
             self._validate(t)
@@ -388,11 +410,9 @@ class BlockStore(ObjectStore):
                 self._alloc_rollback(ctx)
                 raise
             # BlueStore commit order: data pages reach the device before
-            # the metadata batch that references them (fsync only under
-            # o_sync — see __init__ for the exact guarantee)
+            # the metadata batch that references them (fsync batched in
+            # the commit thread under o_sync — see __init__)
             self._dev_fh.flush()
-            if self._o_sync:
-                os.fsync(self._dev_fh.fileno())
             for key in ctx.dirty_onodes:
                 on = self._onodes.get(key)
                 if on is None:
@@ -409,10 +429,55 @@ class BlockStore(ObjectStore):
             batch.set(P_META, "next_blob", str(self._next_blob).encode())
             batch.set(P_META, "blocks",
                       str(self._alloc.nblocks()).encode())
-            self._kv.submit(batch, sync=self._o_sync)
-            # deferred release: freed blocks rejoin the allocator only
-            # after the commit that stops referencing them is durable
-            self._alloc.release(ctx.deferred_free)
+            self._kv.submit(batch)
+            self._seq += 1
+            seq = self._seq
+            deferred = ctx.deferred_free
+            self.perf.inc("queued_txns")
+
+            def complete(cb=on_commit, deferred=deferred):
+                if deferred:
+                    with self._lock:
+                        self._alloc.release(deferred)
+                if cb is not None:
+                    cb()
+
+            # submit INSIDE the lock: pending order must equal commit
+            # seq order or completions could fire out of order
+            done = None
+            inline = False
+            if on_commit is None:
+                if self._pipeline.in_commit_thread():
+                    inline = True
+                else:
+                    done = threading.Event()
+                    self._pipeline.submit(
+                        seq, lambda: (complete(cb=None), done.set()))
+            else:
+                self._pipeline.submit(seq, complete)
+        if inline:
+            self._commit_sync()
+            complete(cb=None)
+        elif done is not None:
+            done.wait()
+        return seq
+
+    def _commit_sync(self) -> None:
+        """Batched durability point (commit-thread only): one device
+        fsync, then one KV sync, covering every transaction staged
+        since the previous batch.  BOTH run under the store lock so no
+        transaction can apply between them — its metadata must never
+        become durable ahead of the device fsync that covers its data
+        (the data-before-metadata invariant, at batch granularity)."""
+        if not self._o_sync:
+            return  # no-fsync mode: apply is the commit point
+        with self._lock:
+            if self._dev_fh is None:
+                return
+            self._dev_fh.flush()
+            os.fsync(self._dev_fh.fileno())
+            self.perf.inc("dev_fsyncs")
+            self._kv.sync()
 
     def _alloc_rollback(self, ctx: "_TxnCtx") -> None:
         self._alloc.release(ctx.fresh_allocs)

@@ -108,6 +108,11 @@ class KeyValueDB:
     def submit(self, batch: WriteBatch, sync: bool = False) -> None:
         raise NotImplementedError
 
+    def sync(self) -> None:
+        """Make every submitted batch durable (one fsync for all of
+        them) — the group-commit hook: submit(sync=False) many times,
+        sync() once from a commit thread."""
+
     def get(self, prefix: str, key: str) -> Optional[bytes]:
         raise NotImplementedError
 
@@ -277,6 +282,12 @@ class LogKV(KeyValueDB):
     def compact(self) -> None:
         with self._lock:
             self._compact_locked()
+
+    def sync(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
 
     def get(self, prefix: str, key: str) -> Optional[bytes]:
         with self._lock:

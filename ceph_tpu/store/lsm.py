@@ -412,6 +412,12 @@ class LSMStore(KeyValueDB):
         with self._lock:
             self._flush_locked()
 
+    def sync(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
+
     def _compact_locked(self) -> None:
         """Merge every table into one, dropping shadowed values and
         tombstones (nothing older exists to resurrect)."""

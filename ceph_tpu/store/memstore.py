@@ -46,6 +46,7 @@ class MemStore(ObjectStore):
         self._colls: Dict[Collection, Dict[GHObject, _Obj]] = {}
         self._lock = threading.RLock()
         self._mounted = False
+        self._seq = 0
 
     # -- lifecycle --------------------------------------------------------
     def mkfs(self) -> None:
@@ -59,14 +60,20 @@ class MemStore(ObjectStore):
         self._mounted = False
 
     # -- transaction apply ------------------------------------------------
-    def queue_transaction(self, t: Transaction) -> None:
+    def queue_transaction(self, t: Transaction, on_commit=None) -> int:
         """All-or-nothing: a validation pass over an existence overlay
         raises before any mutation, so a failing op leaves no partial
-        effects (the mutation pass itself cannot fail)."""
+        effects (the mutation pass itself cannot fail).  RAM is the
+        durability point, so `on_commit` fires inline on apply."""
         with self._lock:
             self._validate(t)
             for op in t.ops:
                 self._apply(op)
+            self._seq += 1
+            seq = self._seq
+        if on_commit is not None:
+            on_commit()
+        return seq
 
     def _validate(self, t: Transaction) -> None:
         store = self

@@ -14,8 +14,10 @@ transactions to replica shards.
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ceph_tpu.core.encoding import Decoder, Encoder
 
@@ -338,11 +340,131 @@ def validate_op(op: Op, ov: ValidationOverlay) -> None:
     raise StoreError(f"unknown op {code}")
 
 
+class CommitPipeline:
+    """Group-commit thread shared by the durable backends — the
+    FileJournal group-commit / BlueStore `_kv_sync_thread` role.
+
+    Submitters append their completion to the in-memory pending batch
+    and return; the commit thread swaps the whole batch out (double
+    buffering: batch N+1 collects while batch N syncs), runs the
+    store's `sync_fn` ONCE for everything in it, then fires the
+    completions in submission (WAL-seq) order.  A 16-deep writer queue
+    therefore pays one fsync per BATCH, not one per transaction, and
+    callers with no callback block on an event submitted through the
+    same pipeline — so concurrent synchronous writers share fsyncs too.
+
+    `freeze()`/`thaw()` hold the commit thread between WAL append and
+    the batched sync: the crash-safety tests use the window to model a
+    kill mid-batch (records appended, nothing fsynced, no completion
+    fired).
+    """
+
+    def __init__(self, sync_fn: Callable[[], None],
+                 perf=None) -> None:
+        self._sync_fn = sync_fn
+        self._perf = perf  # PerfCounters with commit_batch/commit_lat
+        self._cond = threading.Condition()
+        self._pending: List[Tuple[int, Callable[[], None]]] = []
+        self._frozen = False
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        with self._cond:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stopping = False
+            self._thread = threading.Thread(
+                target=self._run, name="store-commit", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        """Drain every pending completion (final sync included), then
+        join the thread — the umount path."""
+        with self._cond:
+            if self._thread is None:
+                return
+            self._frozen = False
+            self._stopping = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10)
+        self._thread = None
+
+    def in_commit_thread(self) -> bool:
+        return threading.current_thread() is self._thread
+
+    # -- crash-window test hook -------------------------------------------
+    def freeze(self) -> None:
+        with self._cond:
+            self._frozen = True
+
+    def thaw(self) -> None:
+        with self._cond:
+            self._frozen = False
+            self._cond.notify_all()
+
+    # -- submission -------------------------------------------------------
+    def submit(self, seq: int, on_commit: Callable[[], None]) -> None:
+        """Stage a completion.  Callers submit while still holding the
+        store lock that ordered their WAL append, so the pending list
+        order IS WAL order.  A submit racing stop() (writer vs umount)
+        commits inline rather than stranding the completion forever."""
+        with self._cond:
+            if self._thread is not None and not self._stopping:
+                self._pending.append((seq, on_commit))
+                self._cond.notify_all()
+                return
+        try:
+            self._sync_fn()
+        except Exception:
+            pass
+        on_commit()
+
+    def flush(self) -> None:
+        """Block until everything submitted so far has committed."""
+        done = threading.Event()
+        self.submit(-1, done.set)
+        done.wait()
+
+    # -- the commit thread ------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: (self._pending and not self._frozen)
+                    or self._stopping)
+                if self._stopping and (not self._pending or self._frozen):
+                    return
+                batch, self._pending = self._pending, []
+            t0 = time.perf_counter()
+            try:
+                self._sync_fn()
+            except Exception:
+                # a failing sync must not strand submitters (there is
+                # no error channel on on_commit); the store's state is
+                # applied, durability degrades to wal_sync=False level
+                pass
+            for _seq, cb in batch:
+                try:
+                    cb()
+                except Exception:
+                    pass  # one completion's bug must not starve the rest
+            if self._perf is not None:
+                self._perf.hinc("commit_batch", len(batch))
+                self._perf.tinc("commit_lat", time.perf_counter() - t0)
+
+
 class ObjectStore:
     """Abstract backend. Writes go through queue_transaction; reads are
-    direct.  `queue_transaction` is synchronous-apply here (the
-    reference's commit callback collapses to the return), but backends
-    must make the batch atomic & durable as a unit."""
+    direct.  `queue_transaction(t, on_commit)` validates and applies
+    synchronously (read-your-writes holds on return) but DEFERS
+    durability: `on_commit` fires from the backend's commit thread once
+    the transaction is on stable storage, and many transactions ride
+    one sync (group commit).  With no callback the call blocks until
+    commit — the pre-async semantics — while still sharing the batched
+    sync with concurrent writers.  Returns the transaction's WAL/commit
+    sequence number."""
 
     # -- lifecycle --------------------------------------------------------
     def mkfs(self) -> None:
@@ -355,7 +477,9 @@ class ObjectStore:
         raise NotImplementedError
 
     # -- writes -----------------------------------------------------------
-    def queue_transaction(self, t: Transaction) -> None:
+    def queue_transaction(self, t: Transaction,
+                          on_commit: Optional[Callable[[], None]] = None
+                          ) -> int:
         raise NotImplementedError
 
     def statfs(self) -> Tuple[int, int]:

@@ -56,6 +56,7 @@ class StripeBatchQueue:
         self._lock = threading.Lock()
         self.batches = 0       # perf: device dispatches
         self.jobs = 0          # perf: logical encodes
+        self.bytes_in = 0      # perf: plane bytes that rode the queue
 
     def start(self) -> None:
         with self._lock:
@@ -203,6 +204,7 @@ class StripeBatchQueue:
                     off += w
             self.batches += 1
             self.jobs += len(batch)
+            self.bytes_in += sum(j.planes.nbytes for j in batch)
         except BaseException as e:  # noqa: BLE001 — propagate to callers
             for j in batch:
                 if not j.future.done():

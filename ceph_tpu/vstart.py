@@ -197,7 +197,14 @@ class VStartCluster:
         marker = "wal.log" if self.store_kind == "filestore" else "block"
         fresh = not os.path.exists(os.path.join(path, marker))
         os.makedirs(path, exist_ok=True)
-        return create(self.store_kind, path=path), fresh
+        kw = {}
+        # objectstore_wal_sync turns on per-batch durability (fsync in
+        # the group-commit thread): FileStore's WAL fsync / BlockStore's
+        # o_sync discipline
+        if self.ctx.conf.get("objectstore_wal_sync"):
+            kw["wal_sync" if self.store_kind == "filestore"
+               else "o_sync"] = True
+        return create(self.store_kind, path=path, **kw), fresh
 
     def _spawn_osd(self, i: int) -> OSDService:
         store, fresh = self._make_store(i)
