@@ -1,0 +1,31 @@
+"""cephlint — repo-native AST static analysis.
+
+The rules PR 1 and PR 2 shipped as comments ("fast-dispatch handlers
+never block", "versioned codecs decode older structs", "no sleep-poll
+loops") become machine-checked here, the way the reference tree's
+lockdep/mutex_debug make lock discipline a runtime invariant rather
+than tribal knowledge.
+
+Entry points:
+  - ``tools/cephlint.py`` CLI (``--json``, ``--write-baseline``)
+  - ``tests/test_lint.py`` runs the full suite in tier-1: any
+    violation not recorded in the committed suppressions baseline
+    (``tools/cephlint_baseline.json``) fails the build.
+
+Existing debt is *recorded*, not ignored: the baseline pins today's
+violation counts per (check, file, scope); new code cannot add to
+them.  Intentional exceptions annotate the offending line with
+``# cephlint: disable=<check-name>`` and say why.
+"""
+
+from ceph_tpu.analysis.framework import (  # noqa: F401
+    Check,
+    SourceFile,
+    Violation,
+    discover_files,
+    load_baseline,
+    new_violations,
+    run_checks,
+    violations_to_baseline,
+)
+from ceph_tpu.analysis.checks import ALL_CHECKS  # noqa: F401

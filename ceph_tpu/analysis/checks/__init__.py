@@ -1,0 +1,19 @@
+"""Check registry: one module per bug class this repo has shipped."""
+
+from ceph_tpu.analysis.checks.blocking import NoBlockingOnLoop
+from ceph_tpu.analysis.checks.codec import CodecSymmetry
+from ceph_tpu.analysis.checks.jax_purity import JaxPurity
+from ceph_tpu.analysis.checks.locks import NamedLocks
+from ceph_tpu.analysis.checks.silent_except import SilentExcept
+from ceph_tpu.analysis.checks.sleep_poll import NoSleepPoll
+
+ALL_CHECKS = (
+    NoBlockingOnLoop(),
+    NamedLocks(),
+    CodecSymmetry(),
+    NoSleepPoll(),
+    SilentExcept(),
+    JaxPurity(),
+)
+
+CHECKS_BY_NAME = {c.name: c for c in ALL_CHECKS}

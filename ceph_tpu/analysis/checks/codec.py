@@ -1,0 +1,204 @@
+"""encode/decode symmetry: wire/disk codecs must round-trip.
+
+Three rules, each a shipped bug class:
+
+1. pairing — a class defining ``encode``/``encode_payload`` defines the
+   matching ``decode``/``decode_payload`` (an encode-only type persists
+   bytes nothing can read back);
+2. field order — the ordered attribute sequence the encoder writes is
+   the sequence the decoder reads.  A transposed pair round-trips its
+   OWN tests (both sides transposed) and corrupts against every other
+   writer;
+3. version tolerance — a codec whose encoder writes struct version
+   >= 2 must gate its tail on the decoded version or on
+   ``remaining_in_frame()`` (the MECSubWrite v2 / PGInfo v2
+   discipline: a v1 blob from the golden corpus or a not-yet-upgraded
+   peer decodes with the tail defaulted).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ceph_tpu.analysis.framework import (
+    Check, SourceFile, Violation, call_name,
+)
+
+_PAIRS = (("encode_payload", "decode_payload"), ("encode", "decode"))
+_CODEC_PARAMS = {"e", "enc", "encoder", "d", "dec", "decoder", "buf"}
+
+
+def _methods(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _is_wire_codec(fn: ast.FunctionDef) -> bool:
+    """Distinguish wire codecs (encode(self, e: Encoder)) from
+    compute methods that happen to be named encode (an erasure codec's
+    shard math, a compressor): exactly one non-self/cls param, named
+    like an Encoder/Decoder cursor."""
+    args = [a.arg for a in fn.args.args if a.arg not in ("self", "cls")]
+    return len(args) == 1 and args[0] in _CODEC_PARAMS
+
+
+def _in_source_order(hits: List[Tuple[int, int, str]]) -> List[str]:
+    """Dedup to first occurrence, ordered by source position — codecs
+    execute strictly left-to-right/top-to-bottom, so token position IS
+    execution order (ast.walk is BFS and must not be trusted here)."""
+    seen: List[str] = []
+    for _, _, name in sorted(hits):
+        if name not in seen:
+            seen.append(name)
+    return seen
+
+
+def _enc_attr_seq(fn: ast.FunctionDef) -> List[str]:
+    """Distinct self.<attr> loads in an encoder body, source order."""
+    hits = [(node.lineno, node.col_offset, node.attr)
+            for node in ast.walk(fn)
+            if isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"]
+    return _in_source_order(hits)
+
+
+def _dec_attr_seq(fn: ast.FunctionDef) -> List[str]:
+    """Attributes a decoder populates, source order: `self.x = ` /
+    `out.x = ` stores plus keyword names of cls(...) construction."""
+    hits: List[Tuple[int, int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Store):
+            hits.append((node.lineno, node.col_offset, node.attr))
+        elif isinstance(node, ast.Call) and call_name(node) in (
+                "cls", fn.name):  # cls(kw=...) in a classmethod decode
+            for kw in node.keywords:
+                if kw.arg:
+                    hits.append((kw.value.lineno, kw.value.col_offset,
+                                 kw.arg))
+    return _in_source_order(hits)
+
+
+def _order_mismatch(enc: List[str], dec: List[str]
+                    ) -> Optional[Tuple[str, str]]:
+    """First adjacent common-attribute pair whose relative order flips."""
+    common = [a for a in enc if a in dec]
+    dec_pos = {a: i for i, a in enumerate(dec)}
+    for i in range(len(common) - 1):
+        a, b = common[i], common[i + 1]
+        if dec_pos[a] > dec_pos[b]:
+            return a, b
+    return None
+
+
+def _encoded_version(fn: ast.FunctionDef) -> int:
+    """Highest literal version passed to Encoder.start() in this body
+    (0 when the encoder writes no versioned frame itself)."""
+    best = 0
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and call_name(node).endswith(".start")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, int)):
+            best = max(best, node.args[0].value)
+    return best
+
+
+def _class_version(cls: ast.ClassDef) -> int:
+    for node in cls.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Name) and t.id == "VERSION"
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, int)):
+                    return node.value.value
+    return 0
+
+
+def _tolerates_old_versions(fn: ast.FunctionDef) -> bool:
+    """Decoder gates a tail: calls remaining_in_frame(), or compares a
+    variable assigned from .start()."""
+    version_vars = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            if call_name(node).endswith("remaining_in_frame"):
+                return True
+            continue
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and call_name(node.value).endswith(".start")):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    version_vars.add(t.id)
+    if not version_vars:
+        return False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id in version_vars:
+                    return True
+    return False
+
+
+class CodecSymmetry(Check):
+    name = "codec-symmetry"
+    description = ("encode/decode pairing, matching field order, and "
+                   "old-version tolerance for versioned codecs")
+    scopes = ("ceph_tpu",)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        out: List[Violation] = []
+        for f in files:
+            for cls in ast.walk(f.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                meths = _methods(cls)
+                for enc_name, dec_name in _PAIRS:
+                    enc = meths.get(enc_name)
+                    if enc is None:
+                        continue
+                    if enc_name == "encode" and not _is_wire_codec(enc):
+                        continue
+                    dec = meths.get(dec_name)
+                    if dec is None:
+                        out.append(Violation(
+                            check=self.name, path=f.rel, line=enc.lineno,
+                            scope=f"{cls.name}.{enc_name}",
+                            detail="missing-decode",
+                            message=(f"{cls.name} defines {enc_name} but "
+                                     f"no {dec_name}: encoded bytes nothing "
+                                     "can read back"),
+                        ))
+                        continue
+                    mism = _order_mismatch(_enc_attr_seq(enc),
+                                           _dec_attr_seq(dec))
+                    if mism is not None:
+                        out.append(Violation(
+                            check=self.name, path=f.rel, line=dec.lineno,
+                            scope=f"{cls.name}.{dec_name}",
+                            detail=f"order:{mism[0]}/{mism[1]}",
+                            message=(f"{cls.name}: encoder writes "
+                                     f"{mism[0]} before {mism[1]} but the "
+                                     "decoder reads them in the other "
+                                     "order"),
+                        ))
+                    version = max(_class_version(cls) if enc_name ==
+                                  "encode_payload" else 0,
+                                  _encoded_version(enc))
+                    if version >= 2 and not _tolerates_old_versions(dec):
+                        out.append(Violation(
+                            check=self.name, path=f.rel, line=dec.lineno,
+                            scope=f"{cls.name}.{dec_name}",
+                            detail="no-old-version-tolerance",
+                            message=(f"{cls.name} encodes struct v{version} "
+                                     "but its decoder never gates on the "
+                                     "decoded version or "
+                                     "remaining_in_frame(): a v1 blob "
+                                     "(golden corpus, older peer) would "
+                                     "misdecode"),
+                        ))
+        return out

@@ -1,0 +1,108 @@
+"""jax-purity: functions traced by jax.jit / pallas_call must be pure.
+
+A traced function runs ONCE at trace time; anything outside the jax
+ops — np.* math, time.* reads, Python RNG — is baked into the
+compiled artifact as a constant and silently stops varying at run
+time (the classic "my kernel ignores its input" bug).  float64
+mentions break under the default x32 mode on TPU.
+
+Traced roots are found syntactically: ``@jax.jit``/``@jit``/
+``@partial(jax.jit, ...)`` decorations, first arguments to
+``jax.jit(...)`` / ``pallas_call(...)`` / ``pl.pallas_call(...)``
+calls, and same-module helpers those roots call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set
+
+from ceph_tpu.analysis.framework import (
+    Check, SourceFile, Violation, call_name, dotted, qualname_index,
+)
+
+_TRACE_ENTRY = {"jax.jit", "jit", "pallas_call", "pl.pallas_call",
+                "jax.pmap", "pmap", "jax.vmap", "checkify.checkify"}
+_IMPURE_ROOTS = {"np", "numpy", "time", "random"}
+_F64 = {"np.float64", "numpy.float64", "jnp.float64"}
+
+
+def _decorator_traced(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        name = dotted(dec) if not isinstance(dec, ast.Call) else (
+            call_name(dec))
+        if name in _TRACE_ENTRY:
+            return True
+        if isinstance(dec, ast.Call) and call_name(dec) in (
+                "partial", "functools.partial") and dec.args:
+            if dotted(dec.args[0]) in _TRACE_ENTRY:
+                return True
+    return False
+
+
+class JaxPurity(Check):
+    name = "jax-purity"
+    description = ("jit/pallas-traced functions must not call np.*, "
+                   "time.*, Python RNG, or mention float64")
+    scopes = ("ceph_tpu",)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        out: List[Violation] = []
+        for f in files:
+            qn = qualname_index(f.tree)
+            funcs: Dict[str, ast.AST] = {
+                name: node for node, name in qn.items()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            # roots: decorated, or passed by (last-component) name into
+            # a trace entry point
+            roots: Set[str] = set()
+            for name, node in funcs.items():
+                if _decorator_traced(node):
+                    roots.add(name)
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Call) and (
+                        call_name(node) in _TRACE_ENTRY) and node.args:
+                    target = dotted(node.args[0])
+                    if target:
+                        for name in funcs:
+                            if name.split(".")[-1] == target.split(".")[-1]:
+                                roots.add(name)
+            if not roots:
+                continue
+            # reach same-module helpers by bare-name calls
+            reach = set(roots)
+            frontier = list(roots)
+            while frontier:
+                body = funcs[frontier.pop()]
+                for node in ast.walk(body):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    cn = call_name(node)
+                    for name in funcs:
+                        if name.split(".")[-1] == cn and name not in reach:
+                            reach.add(name)
+                            frontier.append(name)
+            for name in sorted(reach):
+                body = funcs[name]
+                for node in ast.walk(body):
+                    bad = None
+                    if isinstance(node, ast.Call):
+                        cn = call_name(node)
+                        root = cn.split(".")[0]
+                        if "." in cn and root in _IMPURE_ROOTS:
+                            bad = cn
+                    elif isinstance(node, ast.Attribute):
+                        dn = dotted(node)
+                        if dn in _F64:
+                            bad = dn
+                    if bad is None:
+                        continue
+                    out.append(Violation(
+                        check=self.name, path=f.rel, line=node.lineno,
+                        scope=name, detail=bad,
+                        message=(f"{bad} inside jit/pallas-traced "
+                                 f"{name}: traces to a baked-in constant "
+                                 "(or breaks x32 mode); use jnp/lax/"
+                                 "jax.random equivalents"),
+                    ))
+        return out

@@ -1,0 +1,61 @@
+"""named-locks: every threading.Lock()/RLock() under ceph_tpu/ must be
+created through core.lockdep.make_lock(name).
+
+Rationale: lockdep (the reference src/common/lockdep.cc port) can only
+order-check locks it can NAME.  A raw threading.Lock is invisible to
+the cycle detector, so a deadlock involving it stays a rare production
+hang instead of a deterministic test failure.  ceph_tpu/core/lockdep.py
+itself is exempt (it IS the factory).
+
+Legitimate raw locks exist — a Lock released by a different thread
+than its acquirer (pg.maintenance_guard) cannot become an RLock-backed
+DMutex — and annotate themselves with
+``# cephlint: disable=named-locks — <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from ceph_tpu.analysis.framework import (
+    Check, SourceFile, Violation, call_name, enclosing_scope,
+)
+
+
+class NamedLocks(Check):
+    name = "named-locks"
+    description = ("threading.Lock()/RLock() must be created via "
+                   "core.lockdep.make_lock(name)")
+    scopes = ("ceph_tpu",)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        out: List[Violation] = []
+        for f in files:
+            if f.rel.endswith("core/lockdep.py"):
+                continue
+            # only flag the bare names when they alias threading's
+            # (``from threading import Lock``), not some local Lock
+            imported_bare = set()
+            for node in ast.walk(f.tree):
+                if (isinstance(node, ast.ImportFrom)
+                        and node.module == "threading"):
+                    for alias in node.names:
+                        if alias.name in ("Lock", "RLock"):
+                            imported_bare.add(alias.asname or alias.name)
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = call_name(node)
+                if cn in ("threading.Lock", "threading.RLock") or (
+                        cn in imported_bare):
+                    kind = cn.rsplit(".", 1)[-1]
+                    out.append(Violation(
+                        check=self.name, path=f.rel, line=node.lineno,
+                        scope=enclosing_scope(f.tree, node.lineno),
+                        detail=kind,
+                        message=(f"raw threading.{kind}() — create via "
+                                 "core.lockdep.make_lock(name) so lockdep "
+                                 "can order-check it"),
+                    ))
+        return out

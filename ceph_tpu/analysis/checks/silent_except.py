@@ -1,0 +1,71 @@
+"""silent-except: `except Exception: pass` (or bare except) swallows
+everything — including the bug you are currently hunting.
+
+The PR 1/PR 2 postmortems both lost hours to handlers that ate a
+TypeError and presented as a liveness hang.  A handler may still
+swallow broadly, but it must either NARROW the type to what the
+best-effort operation actually throws (`except (ConnectionError,
+OSError)` around a socket close) or LOG the exception so the ring
+buffer shows it at crash-dump time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from ceph_tpu.analysis.framework import (
+    Check, SourceFile, Violation, dotted, enclosing_scope,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Attribute):
+        return dotted(t).split(".")[-1] in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(_is_broad(ast.ExceptHandler(type=el, name=None, body=[]))
+                   for el in t.elts)
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    """Body is only pass/... — no logging, no fallback assignment."""
+    return all(
+        isinstance(st, ast.Pass)
+        or (isinstance(st, ast.Expr)
+            and isinstance(st.value, ast.Constant)
+            and st.value.value is Ellipsis)
+        for st in handler.body)
+
+
+class SilentExcept(Check):
+    name = "silent-except"
+    description = ("`except Exception: pass` must narrow the type or "
+                   "log the exception")
+    scopes = ("ceph_tpu", "tools")
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        out: List[Violation] = []
+        for f in files:
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if _is_broad(node) and _is_silent(node):
+                    kind = ("bare except" if node.type is None
+                            else "except Exception")
+                    out.append(Violation(
+                        check=self.name, path=f.rel, line=node.lineno,
+                        scope=enclosing_scope(f.tree, node.lineno),
+                        detail=kind,
+                        message=(f"{kind}: pass — narrow to the exceptions "
+                                 "the operation actually throws, or log "
+                                 "before swallowing"),
+                    ))
+        return out

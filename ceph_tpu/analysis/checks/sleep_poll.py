@@ -1,0 +1,65 @@
+"""no-sleep-poll: `while ...: time.sleep(small)` polling is forbidden.
+
+Poll loops burn a core tick for latency: every condition change waits
+out the residual sleep (PR 2 killed the 20 ms poll loops in
+Objecter.wait_for_map / wait_pgs_settled for exactly this).  The
+conversion target is an Event/Condition the state-changer notifies —
+the waiter wakes immediately and shutdown can interrupt it.
+
+Only literal sleeps below the threshold inside a loop are flagged:
+long back-offs (30 s ticket refresh) and computed intervals
+(configurable periods) are deliberate pacing, not polling.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from ceph_tpu.analysis.framework import (
+    Check, SourceFile, Violation, call_name, enclosing_scope,
+)
+
+POLL_THRESHOLD_S = 1.0
+
+
+class NoSleepPoll(Check):
+    name = "no-sleep-poll"
+    description = ("time.sleep(<1s literal) inside a loop — use an "
+                   "Event/Condition wait the state-changer notifies")
+    scopes = ("ceph_tpu", "tools")
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        out: List[Violation] = []
+        for f in files:
+            seen = set()  # nested loops would re-visit the same call
+            for loop in ast.walk(f.tree):
+                if not isinstance(loop, (ast.While, ast.For)):
+                    continue
+                for node in ast.walk(loop):
+                    if (node.__class__ is ast.Call
+                            and (node.lineno, node.col_offset) in seen):
+                        continue
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if call_name(node) not in ("time.sleep", "sleep",
+                                               "_time.sleep"):
+                        continue
+                    if not node.args:
+                        continue
+                    arg = node.args[0]
+                    if not (isinstance(arg, ast.Constant)
+                            and isinstance(arg.value, (int, float))):
+                        continue  # computed interval: deliberate pacing
+                    if arg.value >= POLL_THRESHOLD_S:
+                        continue
+                    seen.add((node.lineno, node.col_offset))
+                    out.append(Violation(
+                        check=self.name, path=f.rel, line=node.lineno,
+                        scope=enclosing_scope(f.tree, node.lineno),
+                        detail=f"sleep({arg.value})",
+                        message=(f"time.sleep({arg.value}) in a loop is a "
+                                 "poll; wait on an Event/Condition that the "
+                                 "state change notifies"),
+                    ))
+        return out

@@ -1,0 +1,292 @@
+"""cephlint framework: file discovery, AST cache, violations, baseline.
+
+Checks are whole-program: each receives the full list of parsed
+``SourceFile``s so cross-module analyses (the fast-dispatch call
+graph, codec pairing) see everything at once.  Files are parsed once
+per process and shared across every check — the CLI and the tier-1
+test both lint ~120 files with six checks in well under the 30 s
+budget because the parse happens once, not once per check.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# (abspath, content-sha1) -> (tree, text, parse_error); the test and
+# the CLI each run in one process, so an in-proc cache is the whole
+# caching story — but it also makes repeated programmatic runs (unit
+# tests exercising individual checks) free.  Keyed by CONTENT, not
+# (mtime, size): a same-size rewrite inside the kernel's mtime
+# granularity (test fixtures do exactly this) must never serve the
+# stale tree, and reading+hashing ~140 files costs milliseconds.
+_AST_CACHE: Dict[Tuple[str, str],
+                 Tuple[ast.AST, str, Optional[Tuple[int, str]]]] = {}
+
+_SUPPRESS_RE = re.compile(r"#\s*cephlint:\s*disable=([\w,-]+)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    check: str      # check name, e.g. "named-locks"
+    path: str       # repo-relative posix path
+    line: int       # 1-based
+    scope: str      # enclosing qualname ("Class.method") or "<module>"
+    detail: str     # stable discriminator within the scope
+    message: str    # human-readable description
+
+    @property
+    def key(self) -> str:
+        """Baseline key: line-number-free so unrelated edits above a
+        baselined violation don't un-suppress it."""
+        return f"{self.check}::{self.path}::{self.scope}::{self.detail}"
+
+    def to_dict(self) -> dict:
+        return {
+            "check": self.check, "path": self.path, "line": self.line,
+            "scope": self.scope, "detail": self.detail,
+            "message": self.message, "key": self.key,
+        }
+
+
+class SourceFile:
+    """One parsed module plus the bookkeeping checks need."""
+
+    def __init__(self, abspath: str, rel: str) -> None:
+        import hashlib
+
+        self.abspath = abspath
+        self.rel = rel  # repo-relative, posix separators
+        with open(abspath, "rb") as f:
+            raw = f.read()
+        cache_key = (abspath, hashlib.sha1(raw).hexdigest())
+        hit = _AST_CACHE.get(cache_key)
+        if hit is None:
+            text = raw.decode("utf-8")
+            # a file THIS interpreter cannot parse cannot run on it
+            # either (the repo once shipped a tool in 3.12-only
+            # syntax): surface as a finding, not a linter crash
+            err = None
+            try:
+                tree = ast.parse(text, filename=rel)
+            except SyntaxError as e:
+                tree = ast.parse("", filename=rel)
+                err = (e.lineno or 1, e.msg or "syntax error")
+            _AST_CACHE[cache_key] = (tree, text, err)
+            hit = _AST_CACHE[cache_key]
+        self.tree, self.text, self.parse_error = hit
+        self.lines = self.text.splitlines()
+        # line -> set of check names disabled on that line
+        self._suppress: Dict[int, set] = {}
+        for i, ln in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(ln)
+            if m:
+                self._suppress[i] = {c.strip() for c in m.group(1).split(",")}
+
+    def suppressed(self, check: str, line: int) -> bool:
+        """True if `# cephlint: disable=<check>` annotates the line or
+        the contiguous comment block directly above it (rationales are
+        encouraged to span lines)."""
+        def hit(ln: int) -> bool:
+            names = self._suppress.get(ln)
+            return bool(names and (check in names or "all" in names))
+
+        if hit(line):
+            return True
+        ln = line - 1
+        while ln >= 1 and self.lines[ln - 1].strip().startswith("#"):
+            if hit(ln):
+                return True
+            ln -= 1
+        return False
+
+    def __repr__(self) -> str:
+        return f"SourceFile({self.rel})"
+
+
+class Check:
+    """Base class.  `scopes` limits which top-level dirs a check sees
+    ("ceph_tpu", "tools"); `run` gets every file in scope at once."""
+
+    name = ""
+    description = ""
+    scopes: Tuple[str, ...] = ("ceph_tpu",)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        raise NotImplementedError
+
+
+# -- discovery ---------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", "scratch", "csrc", "tests"}
+
+
+def repo_root(start: Optional[str] = None) -> str:
+    """The directory holding ceph_tpu/ — walk up from this module."""
+    d = start or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return d
+
+
+def discover_files(root: Optional[str] = None,
+                   subdirs: Iterable[str] = ("ceph_tpu", "tools"),
+                   ) -> List[SourceFile]:
+    root = repo_root(root)
+    out: List[SourceFile] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                abspath = os.path.join(dirpath, fn)
+                rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+                out.append(SourceFile(abspath, rel))
+    return out
+
+
+def run_checks(files: Sequence[SourceFile],
+               checks: Sequence[Check]) -> List[Violation]:
+    """Run every check, drop inline-suppressed hits, sort stably."""
+    by_rel = {f.rel: f for f in files}
+    out: List[Violation] = []
+    for f in files:
+        if f.parse_error is not None:
+            line, msg = f.parse_error
+            out.append(Violation(
+                check="parse-error", path=f.rel, line=line,
+                scope="<module>", detail="syntax",
+                message=(f"not parseable by this interpreter: {msg} — "
+                         "the file cannot run here either"),
+            ))
+    for chk in checks:
+        in_scope = [f for f in files
+                    if f.rel.split("/", 1)[0] in chk.scopes]
+        for v in chk.run(in_scope):
+            src = by_rel.get(v.path)
+            if src is not None and src.suppressed(v.check, v.line):
+                continue
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.check, v.detail))
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("entries", {}).items()}
+
+
+def violations_to_baseline(violations: Sequence[Violation]) -> dict:
+    counts: Dict[str, int] = {}
+    for v in violations:
+        counts[v.key] = counts.get(v.key, 0) + 1
+    return {
+        "comment": (
+            "cephlint suppressions baseline — existing debt, recorded. "
+            "New violations (any key whose live count exceeds its entry "
+            "here) fail tier-1 via tests/test_lint.py. Regenerate with "
+            "`python tools/cephlint.py --write-baseline` ONLY when "
+            "intentionally accepting new debt; shrink it by fixing "
+            "violations and regenerating."
+        ),
+        "entries": {k: counts[k] for k in sorted(counts)},
+    }
+
+
+def new_violations(violations: Sequence[Violation],
+                   baseline: Dict[str, int]) -> List[Violation]:
+    """Violations beyond the baselined count for their key.
+
+    Within one key the newest-looking instances (highest line) are
+    reported first-as-new; the baselined allowance covers the rest."""
+    by_key: Dict[str, List[Violation]] = {}
+    for v in violations:
+        by_key.setdefault(v.key, []).append(v)
+    out: List[Violation] = []
+    for key, group in by_key.items():
+        allowed = baseline.get(key, 0)
+        if len(group) <= allowed:
+            continue
+        group.sort(key=lambda v: v.line)
+        out.extend(group[allowed:])
+    out.sort(key=lambda v: (v.path, v.line, v.check, v.detail))
+    return out
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+_QUAL_CACHE: Dict[int, Dict[ast.AST, str]] = {}
+
+
+def qualname_index(tree: ast.AST) -> Dict[ast.AST, str]:
+    """Map every function/class node to its dotted qualname.  Cached
+    per tree: enclosing_scope() is called once per violation and the
+    re-index dominated the suite's runtime before caching."""
+    hit = _QUAL_CACHE.get(id(tree))
+    if hit is not None:
+        return hit
+    out: Dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                out[child] = qn
+                walk(child, qn)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    # safe to key by id(): trees live forever in _AST_CACHE, so ids
+    # are never recycled within a process
+    _QUAL_CACHE[id(tree)] = out
+    return out
+
+
+def enclosing_scope(tree: ast.AST, line: int) -> str:
+    """Qualname of the innermost def/class containing `line`."""
+    best = "<module>"
+    best_span = None
+    for node, qn in qualname_index(tree).items():
+        end = getattr(node, "end_lineno", node.lineno)
+        if node.lineno <= line <= end:
+            span = end - node.lineno
+            if best_span is None or span <= best_span:
+                best, best_span = qn, span
+    return best
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target, best-effort ("self.foo", "time.sleep",
+    "open"); empty for computed targets."""
+    return dotted(node.func)
+
+
+def dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        inner = dotted(node.func)
+        parts.append(f"{inner}()" if inner else "()")
+    elif parts:
+        parts.append("?")
+    else:
+        return ""
+    return ".".join(reversed(parts))

@@ -16,6 +16,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ceph_tpu.cephfs import messages as cm
+from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.cephfs.fs import CephFS
 from ceph_tpu.client.rados import IoCtx, RadosError
 from ceph_tpu.client.striper import RadosStriper
@@ -62,7 +63,8 @@ class FSClient(Dispatcher):
         self._waiters: Dict[int, _Waiter] = {}
         self.request_timeout = 30.0
         self._tid = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("cephfs.client")
+        self._closed = threading.Event()
         self.msgr = Messenger(ctx, EntityName("client", id(self) & 0xFFFF))
         self.msgr.add_dispatcher(self)
         self.msgr.start()
@@ -73,6 +75,7 @@ class FSClient(Dispatcher):
                           rank=rank)
 
     def shutdown(self) -> None:
+        self._closed.set()
         self.msgr.shutdown()
 
     # -- transport ---------------------------------------------------------
@@ -117,8 +120,11 @@ class FSClient(Dispatcher):
                 if hop >= 2:
                     # ranks briefly disagree right after a pin change
                     # (each refreshes its table within pin_ttl): wait
-                    # out the window instead of failing a valid op
-                    time.sleep(0.2)
+                    # out the window instead of failing a valid op —
+                    # interruptibly, so shutdown() never trails a
+                    # residual sleep
+                    if self._closed.wait(0.2):
+                        raise MDSError(-108, "client shut down")
                 continue
             break
         if rep.result < 0:

@@ -31,6 +31,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ceph_tpu.core.context import Context
+from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.osd import messages as m
 from ceph_tpu.osd.osdmap import OSDMap
@@ -101,7 +102,7 @@ class Objecter(Dispatcher):
         # Objecter::LingerOp / _linger_submit)
         self.lingers: Dict[int, Dict] = {}
         self._tid = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("objecter")
         self._stop = threading.Event()
         # client incarnation for exactly-once reqids (osd_reqid_t name +
         # the messenger nonce so a restarted client never collides)
@@ -261,6 +262,8 @@ class Objecter(Dispatcher):
 
     def ms_dispatch(self, conn, msg) -> bool:
         if isinstance(msg, m.MWatchNotify):
+            # cephlint: disable=no-blocking-on-loop — leaf lock,
+            # microsecond hold, never held across an RPC/store op
             with self._lock:
                 lg = self.lingers.get(msg.cookie)
             blob = b""
@@ -275,6 +278,8 @@ class Objecter(Dispatcher):
             return True
         if not isinstance(msg, m.MOSDOpReply):
             return False
+        # cephlint: disable=no-blocking-on-loop — leaf lock (op table),
+        # microsecond hold, never held across an RPC/store op
         with self._lock:
             op = self.ops.get(msg.tid)
             if op is None:

@@ -99,6 +99,8 @@ class RadosClient:
         return IoCtx(self, pool_id)
 
     def shutdown(self) -> None:
+        if self.monc is not None:
+            self.monc.close()  # wake command retries first
         self.objecter.shutdown()
         self.msgr.shutdown()
 

@@ -24,7 +24,7 @@ class AdminSocket:
         self._commands: Dict[str, tuple[Callable[[Dict[str, Any]], Any], str]] = {}
         self._sock: socket.socket | None = None
         self._thread: threading.Thread | None = None
-        self._stop = False
+        self._stop = threading.Event()
         self.register("help", lambda cmd: {
             name: desc for name, (_, desc) in sorted(self._commands.items())
         }, "list available commands")
@@ -52,19 +52,18 @@ class AdminSocket:
 
     def _serve(self) -> None:
         assert self._sock is not None
-        while not self._stop:
+        while not self._stop.is_set():
             try:
                 conn, _ = self._sock.accept()
             except socket.timeout:
                 continue
             except OSError:
-                if self._stop:
+                if self._stop.is_set():
                     return
                 # transient accept error (e.g. EMFILE): back off instead
-                # of spinning a core while the condition persists
-                import time
-
-                time.sleep(0.25)
+                # of spinning a core while the condition persists — on
+                # the stop event, so shutdown interrupts the back-off
+                self._stop.wait(0.25)
                 continue
             try:
                 data = b""
@@ -98,7 +97,7 @@ class AdminSocket:
         return json.dumps(out, default=str).encode("utf-8") + b"\n"
 
     def stop(self) -> None:
-        self._stop = True
+        self._stop.set()
         if self._sock is not None:
             self._sock.close()
         if self._thread is not None:

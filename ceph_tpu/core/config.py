@@ -76,6 +76,11 @@ def _opts() -> List[Option]:
         O("ms_ack_delay", float, 0.005,
           "seconds to hold a dispatch ack hoping it piggybacks on "
           "outgoing data before a dedicated ack frame is sent"),
+        O("ms_loop_stall_ms", float, 0.0,
+          "loop-stall sanitizer: record a fast-dispatched handler that "
+          "holds the messenger event loop longer than this many "
+          "milliseconds (0 = off; the test suite arms it via "
+          "CEPH_TPU_LOOP_STALL_MS)"),
         # -- monitor --------------------------------------------------------
         O("mon_lease", float, 5.0, "paxos lease seconds"),
         O("mon_tick_interval", float, 1.0, "monitor tick period"),

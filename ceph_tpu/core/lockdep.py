@@ -63,14 +63,36 @@ def _path(frm: str, to: str) -> Optional[List[str]]:
     return None
 
 
+_EMPTY: frozenset = frozenset()
+
+
 def will_lock(name: str) -> None:
     held = _held()
     if not held:
         return
+    _check_order(held, name)
+
+
+def _check_order(held: List[str], name: str) -> None:
+    """Validate held -> name order edges.  Steady state is LOCK-FREE:
+    set membership reads are GIL-atomic and the edge graph only ever
+    grows, so a present edge is proof this exact order was already
+    validated — the whole tier-1 suite runs with lockdep armed, and a
+    global mutex + BFS per acquisition was measurable suite time."""
+    g = _edges
+    need = None
+    for h in held:
+        if h != name and name not in g.get(h, _EMPTY):
+            if need is None:
+                need = [h]
+            else:
+                need.append(h)
+    if need is None:
+        return
     with _graph_lock:
-        for h in held:
-            if h == name:
-                continue  # re-entrant
+        for h in need:
+            if name in g.get(h, _EMPTY):
+                continue  # another thread validated it meanwhile
             # adding h -> name; a recorded name -> ... -> h closes a cycle
             cycle = _path(name, h)
             if cycle is not None:
@@ -79,7 +101,7 @@ def will_lock(name: str) -> None:
                     f"holding {h!r}, but the reverse order "
                     f"{' -> '.join(cycle)} was recorded earlier"
                 )
-            _edges.setdefault(h, set()).add(name)
+            g.setdefault(h, set()).add(name)
 
 
 def locked(name: str) -> None:
@@ -107,39 +129,90 @@ class DMutex:
         self._lock = threading.RLock()
 
     def _my_depth(self) -> Dict[int, int]:
-        if not hasattr(_local, "depth"):
+        try:
+            return _local.depth
+        except AttributeError:
             _local.depth = {}
-        return _local.depth
+            return _local.depth
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        depths = self._my_depth()
-        mine = depths.get(id(self), 0)
-        if _enabled and mine == 0:
-            will_lock(self.name)
+        # hand-flattened hot path: this wrapper runs on every named
+        # lock in the system for the whole lockdep-armed test suite
+        try:
+            depths = _local.depth
+        except AttributeError:
+            depths = _local.depth = {}
+        k = id(self)
+        mine = depths.get(k, 0)
+        first = mine == 0
+        if first and _enabled:
+            try:
+                held = _local.stack
+            except AttributeError:
+                held = _local.stack = []
+            if held:
+                _check_order(held, self.name)
         got = self._lock.acquire(blocking, timeout)
         if got:
-            depths[id(self)] = mine + 1
-            if _enabled and mine == 0:
-                locked(self.name)
+            depths[k] = mine + 1
+            if first and _enabled:
+                _local.stack.append(self.name)
         return got
 
     def release(self) -> None:
-        depths = self._my_depth()
-        mine = depths.get(id(self), 1) - 1
+        try:
+            depths = _local.depth
+        except AttributeError:
+            depths = _local.depth = {}
+        k = id(self)
+        mine = depths.get(k, 1) - 1
         if mine <= 0:
-            depths.pop(id(self), None)
+            depths.pop(k, None)
             if _enabled:
-                unlocked(self.name)
+                stack = getattr(_local, "stack", None)
+                if stack:
+                    name = self.name
+                    for i in range(len(stack) - 1, -1, -1):
+                        if stack[i] == name:
+                            del stack[i]
+                            break
         else:
-            depths[id(self)] = mine
+            depths[k] = mine
         self._lock.release()
 
-    def __enter__(self) -> "DMutex":
-        self.acquire()
-        return self
+    # exactly like CPython's C lock objects: __enter__ IS acquire
+    # (returns True, not self — nobody binds `with lock as x`), saving
+    # a frame per with-block on the hottest wrapper in the suite
+    __enter__ = acquire
 
     def __exit__(self, *exc) -> None:
         self.release()
+
+    # -- threading.Condition protocol -------------------------------------
+    # Condition(make_lock(...)) must behave exactly like
+    # Condition(RLock()): delegate the save/restore hooks to the inner
+    # RLock and keep our depth/held bookkeeping consistent across the
+    # wait window.  No order check on re-acquire: the wakeup restores
+    # an ordering that was already validated when the lock was first
+    # taken.
+
+    def _is_owned(self) -> bool:
+        return self._lock._is_owned()
+
+    def _release_save(self):
+        depths = self._my_depth()
+        mine = depths.pop(id(self), 0)
+        if _enabled and mine:
+            unlocked(self.name)
+        return (self._lock._release_save(), mine)
+
+    def _acquire_restore(self, saved) -> None:
+        state, mine = saved
+        self._lock._acquire_restore(state)
+        if mine:
+            self._my_depth()[id(self)] = mine
+            if _enabled:
+                locked(self.name)
 
 
 def make_lock(name: str):

@@ -1354,6 +1354,13 @@ def sweep(
     slow = compile_rule(flat, steps, result_max, choose_args)
     chunk = min(chunk, n)
     outs = []
+    # power-of-two padding bounds fixup shapes to O(log chunk); the
+    # high-water marks additionally make them MONOTONIC within one
+    # sweep: a later chunk with a smaller bad set reuses the largest
+    # already-compiled shape instead of compiling a fresh smaller one
+    # (pad lanes are free; a second ~5s XLA compile of the same
+    # program at 4096 lanes right after the 8192-lane one is not)
+    hw_mid = hw_slow = 0
     for off in range(0, n, chunk):
         sub = xs[off: off + chunk]
         if len(sub) < chunk:  # uniform shape: ONE compiled fast program
@@ -1363,8 +1370,8 @@ def sweep(
         res = np.array(res)  # writable host copy
         bad = np.nonzero(~np.asarray(clean))[0]
         if bad.size:
-            # power-of-two padding: O(log chunk) program shapes
             n_pad = 1 << max(0, int(bad.size - 1).bit_length())
+            n_pad = hw_mid = max(n_pad, hw_mid)
             padded = np.full(n_pad, sub[bad[0]], dtype=np.int32)
             padded[: bad.size] = sub[bad]
             res2, clean2 = mid(padded, dev_weights)
@@ -1372,6 +1379,7 @@ def sweep(
             bad2 = np.nonzero(~np.asarray(clean2)[: bad.size])[0]
             if bad2.size:
                 n_pad2 = 1 << max(0, int(bad2.size - 1).bit_length())
+                n_pad2 = hw_slow = max(n_pad2, hw_slow)
                 padded2 = np.full(n_pad2, padded[bad2[0]], dtype=np.int32)
                 padded2[: bad2.size] = padded[bad2]
                 fixed = np.asarray(slow(padded2, dev_weights))

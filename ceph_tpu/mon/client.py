@@ -13,6 +13,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ceph_tpu.msg.message import EntityName, Message
+from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
 from ceph_tpu.mon import messages as mm
 from ceph_tpu.mon.monitor import MonMap
@@ -28,12 +29,23 @@ class MonClient(Dispatcher):
         self.msgr = msgr
         self.monmap = monmap
         self._tid = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("monclient")
+        self._closed = threading.Event()
         self._waiters: Dict[int, list] = {}
         self.on_osdmap: Optional[Callable] = None
         self.osdmap = None  # the client's current map (inc base)
         self._last_epoch = 0
         msgr.add_dispatcher(self)
+
+    def close(self) -> None:
+        """Wake any in-flight command retry loop immediately — both
+        the redirect backoff and the per-RPC reply waits; the owning
+        daemon shuts the shared messenger itself."""
+        self._closed.set()
+        with self._lock:
+            waiters = list(self._waiters.values())
+        for w in waiters:
+            w[0].set()  # reply stays None; callers see closed and bail
 
     # -- dispatch ---------------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
@@ -94,6 +106,8 @@ class MonClient(Dispatcher):
         tries = 0
         rank = 0
         while tries < 2 * self.monmap.size:
+            if self._closed.is_set():
+                return -108, {"error": "mon client shut down"}
             rep = self._command_to(rank, cmd, timeout / 2)
             if rep is None:
                 rank = (rank + 1) % self.monmap.size
@@ -104,7 +118,10 @@ class MonClient(Dispatcher):
                 rank = leader if leader >= 0 else (
                     (rank + 1) % self.monmap.size)
                 tries += 1
-                time.sleep(0.2)
+                # election settling; interruptible so an owner tearing
+                # the messenger down doesn't strand a command retry
+                if self._closed.wait(0.2):
+                    return -108, {"error": "mon client shut down"}
                 continue
             return rep.code, rep.out
         return -110, {"error": "mon command timed out"}

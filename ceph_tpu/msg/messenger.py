@@ -20,16 +20,27 @@ send/stop APIs are thread-safe, so daemon code stays synchronous.
 from __future__ import annotations
 
 import asyncio
+import os
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.core.crc import crc32c
+from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.msg.message import MAck, Message
 
 _FRAME = struct.Struct("<II")  # body_len, crc32c(body)
 
 Addr = Tuple[str, int]
+
+# loop-stall sanitizer record: (entity, message type, seconds).  A
+# fast-dispatched handler that blocks past ms_loop_stall_ms lands
+# here; the tier-1 conftest fails the test that produced it.  The
+# reference analog is the suicide-grace heartbeat on dispatch threads
+# (HeartbeatMap) — here the asset being guarded is the event loop that
+# must keep reading every peer's replies.
+LOOP_STALLS: List[Tuple[str, str, float]] = []
 
 
 class Dispatcher:
@@ -107,7 +118,6 @@ class Connection:
         self._send_q: asyncio.Queue = asyncio.Queue()
         self._pump_task: Optional[asyncio.Task] = None  # accepted side
         self._closed = False
-        self._lock = threading.Lock()
 
     # -- sender side ------------------------------------------------------
     def send(self, msg: Message) -> None:
@@ -152,8 +162,8 @@ class Connection:
         if self._writer is not None:
             try:
                 self._writer.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # dead transport / loop already closed
         self._send_q.put_nowait(None)  # wake the writer task
 
     def __repr__(self) -> str:
@@ -191,7 +201,7 @@ class Messenger:
         import collections
 
         self._xq: "collections.deque" = collections.deque()
-        self._xq_lock = threading.Lock()
+        self._xq_lock = make_lock("msgr.xq")
         self._xq_armed = False
         self._server: Optional[asyncio.base_events.Server] = None
         self.addr: Optional[Addr] = None
@@ -202,7 +212,7 @@ class Messenger:
         )
         self._dispatch_budget = throttle_bytes
         self._budget_free: Optional[asyncio.Event] = None  # made on loop
-        self._conn_lock = threading.Lock()
+        self._conn_lock = make_lock("msgr.conns")
         self._accepted: set = set()  # live accepted-side connections
         # per-session cumulative dispatch seq, shared across the sockets
         # of one logical session so replays after reconnect are
@@ -235,6 +245,17 @@ class Messenger:
         # deferred dedicated acks: hold each dispatch ack this long
         # hoping an outgoing data frame piggybacks it first
         self._ack_delay = (ctx.conf.get("ms_ack_delay") if ctx else 0.002)
+        # loop-stall sanitizer: wall-time budget for an INLINE
+        # (fast-dispatch) handler.  0 = off (production default); the
+        # test conftest arms it via CEPH_TPU_LOOP_STALL_MS so a
+        # blocking handler fails the test that introduced it.
+        stall_ms = os.environ.get("CEPH_TPU_LOOP_STALL_MS")
+        if stall_ms is None and ctx is not None:
+            stall_ms = ctx.conf.get("ms_loop_stall_ms")
+        try:
+            self._stall_s = float(stall_ms or 0) / 1000.0
+        except ValueError:
+            self._stall_s = 0.0
         self.perf = None
         if ctx is not None:
             pc = ctx.perf.create(f"msgr.{entity}")
@@ -244,6 +265,9 @@ class Messenger:
                                "dedicated MAck frames sent")
             pc.add_u64_counter("acks_piggybacked",
                                "dispatch acks that rode outgoing data")
+            pc.add_u64_counter("loop_stalls",
+                               "fast-dispatch handlers that blocked the "
+                               "event loop past ms_loop_stall_ms")
             self.perf = pc
 
     def set_policy(self, peer_type: str, policy: Policy) -> None:
@@ -357,6 +381,8 @@ class Messenger:
 
     def _drain_cross_sends(self) -> None:
         while True:
+            # cephlint: disable=no-blocking-on-loop — staging-deque
+            # leaf lock; both sides hold it for an append/swap only
             with self._xq_lock:
                 if not self._xq:
                     self._xq_armed = False
@@ -461,8 +487,8 @@ class Messenger:
                                      return_exceptions=True)
                 try:
                     writer.close()
-                except Exception:
-                    pass
+                except (OSError, RuntimeError):
+                    pass  # dead transport / loop already closed
             if conn._closed or self._stopped:
                 break
             if conn.policy.lossy:
@@ -497,8 +523,8 @@ class Messenger:
                 ValueError):
             try:
                 writer.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # dead transport / loop already closed
             return
         if self._auth_verifier is not None:
             blob = getattr(first_msg, "auth_blob", b"")
@@ -512,8 +538,8 @@ class Messenger:
                              f"{first_msg.src} at {peer}")
                 try:
                     writer.close()
-                except Exception:
-                    pass
+                except (OSError, RuntimeError):
+                    pass  # dead transport / loop already closed
                 return
         conn = self._resolve_accepted(first_msg, peer)
         conn._writer = writer
@@ -552,8 +578,8 @@ class Messenger:
                         d.ms_handle_reset(conn)
             try:
                 writer.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # dead transport / loop already closed
 
     async def _pump_session(self, conn: Connection) -> None:
         """Session-lifetime sender for the accepted side: drains the
@@ -765,6 +791,7 @@ class Messenger:
                 # handler inline on the loop — small control messages
                 # (write acks, pings) skip the thread-pool round trip
                 # and the byte budget
+                t0 = time.perf_counter()
                 try:
                     if not d.ms_dispatch(conn, msg):
                         self._log(0, f"unhandled message {msg!r}")
@@ -772,6 +799,8 @@ class Messenger:
                     self._log(1, f"fast dispatch failed for {msg!r}: "
                                  f"{e!r}; closing session for replay")
                     raise ConnectionResetError("dispatch failed") from e
+                finally:
+                    self._note_stall(msg, time.perf_counter() - t0)
                 return
         if self._budget_free is None:
             self._budget_free = asyncio.Event()
@@ -797,6 +826,19 @@ class Messenger:
             self._dispatch_budget += size
             if self._dispatch_budget > 0 and self._budget_free is not None:
                 self._budget_free.set()
+
+    def _note_stall(self, msg: Message, elapsed: float) -> None:
+        """Loop-stall sanitizer: a fast-dispatched handler that held
+        the event loop past the threshold is a contract violation —
+        every connection this messenger serves stalled with it."""
+        if not self._stall_s or elapsed < self._stall_s:
+            return
+        LOOP_STALLS.append((str(self.entity), type(msg).__name__, elapsed))
+        self._log(0, f"LOOP STALL: fast dispatch of {type(msg).__name__} "
+                     f"held the event loop {elapsed * 1e3:.1f}ms "
+                     f"(threshold {self._stall_s * 1e3:.0f}ms)")
+        if self.perf is not None:
+            self.perf.inc("loop_stalls")
 
     def _dispatch_sync(self, conn: Connection, msg: Message) -> bool:
         for d in self._dispatchers:

@@ -31,6 +31,7 @@ import numpy as np
 
 from ceph_tpu.core.crc import crc32c
 from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.osd import messages as m
 from ceph_tpu.osd.types import EVersion, LogEntry, PGId
 from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
@@ -60,7 +61,7 @@ class InFlightOp:
     def __init__(self, waiting_on: set, on_commit: Callable[[], None]):
         self.waiting_on = waiting_on
         self.on_commit = on_commit
-        self.lock = threading.Lock()
+        self.lock = make_lock("backend.inflight")
 
     def ack(self, who) -> None:
         fire = False
@@ -104,7 +105,7 @@ class PGBackend:
         self.epoch_fn = epoch_fn
         self.tids = 0
         self.in_flight: Dict[int, InFlightOp] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("backend.inflight_table")
         # roll-forward watermark provider, bound by the PG to its
         # info.committed_to (rides EC sub-writes so shards learn which
         # entries are beyond divergent rollback)
@@ -326,7 +327,7 @@ class ExtentCache:
         self.max_stripes = max_stripes
         self._lru: "collections.OrderedDict[Tuple[str, int], bytes]" = (
             collections.OrderedDict())
-        self._lock = threading.Lock()
+        self._lock = make_lock("backend.stripe_cache")
         self.hits = 0
         self.misses = 0
 

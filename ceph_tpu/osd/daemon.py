@@ -17,6 +17,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ceph_tpu.core.workqueue import ShardedWorkQueue
+from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.msg.message import EntityName, Message
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
 from ceph_tpu.osd import messages as m
@@ -87,7 +88,7 @@ class OSDService(Dispatcher):
         self.hb_msgr.add_dispatcher(_HBDispatcher(self))
         self.addr_book: Dict[int, Addr] = {}
         self._tid = 0
-        self._tid_lock = threading.Lock()
+        self._tid_lock = make_lock("osd.tid")
         self._waiters: Dict[int, _Waiter] = {}
         self._read_cbs: Dict[int, Callable] = {}
         self._notify_cbs: Dict[int, Callable] = {}
@@ -167,14 +168,16 @@ class OSDService(Dispatcher):
         t.create_collection(coll)
         try:
             self.store.queue_transaction(t)
-        except Exception:
-            pass  # collection may exist from a prior bench
+        except Exception as e:
+            # collection may exist from a prior bench; anything else
+            # will resurface on the first payload write below
+            self._log(2, f"bench create_collection: {e!r}")
         # async submission against the store's group-commit pipeline:
         # every queued transaction returns immediately and the commit
         # thread batches the fsyncs — the same path PG writes ride
         done = threading.Event()
         left = [n]
-        lk = threading.Lock()
+        lk = make_lock("osd.bench_count")
 
         def committed() -> None:
             with lk:
@@ -247,7 +250,7 @@ class OSDService(Dispatcher):
                 self.hb_msgr.set_auth(verifier=_mk_verify(self.hb_msgr))
         self.on_failure_report = (
             lambda osd: self.monc.report_failure(osd))
-        self._map_lock = threading.Lock()
+        self._map_lock = make_lock("osd.map")
         self.monc.subscribe_osdmap(
             self._on_new_map,
             since=self.osdmap.epoch if self.osdmap else 0,
@@ -278,8 +281,11 @@ class OSDService(Dispatcher):
                         self.monc.send_pg_stats(
                             self.whoami, self.epoch(), self.pg_stats(),
                             used, total)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # mon unreachable mid-election: next tick
+                        # retries; losing one stats beat is harmless
+                        # but a persistent cause must be visible
+                        self._log(2, f"pg_stats send failed: {e!r}")
                 time.sleep(1.0)
 
         threading.Thread(target=_boot_loop, daemon=True,
@@ -297,8 +303,9 @@ class OSDService(Dispatcher):
         try:
             name, secret = self._cephx_cred
             self._cephx = self.monc.authenticate(name, secret)
-        except Exception:
-            pass  # mon unreachable: retry next tick, old ticket may live
+        except Exception as e:
+            # mon unreachable: retry next tick, old ticket may live
+            self._log(1, f"cephx ticket renew failed: {e!r}")
 
     def _on_new_map(self, osdmap: OSDMap) -> None:
         with self._map_lock:
@@ -369,6 +376,9 @@ class OSDService(Dispatcher):
 
     def shutdown(self) -> None:
         self.up = False
+        monc = getattr(self, "monc", None)
+        if monc is not None:
+            monc.close()  # wake command retries before the msgr dies
         self.note_pg_settled()  # unblock settle waiters promptly
         self._hb_stop.set()
         if self._hb_thread:
@@ -621,8 +631,8 @@ class OSDService(Dispatcher):
                 for pg in list(self.pgs.values()):
                     if pg.peering_stuck():
                         pg.activate_async()
-            except Exception:  # noqa: BLE001 — watchdog never dies
-                pass
+            except Exception as e:  # noqa: BLE001 — watchdog never dies
+                self._log(1, f"peering watchdog pass failed: {e!r}")
 
     # -- messaging --------------------------------------------------------
     def send_to_osd(self, osd_id: int, msg: Message) -> None:
@@ -850,6 +860,9 @@ class OSDService(Dispatcher):
                 # them keeps a backfill consumer from treating our
                 # incomplete store listing as the authoritative object
                 # set and deleting live objects (EC thrash-hunt find)
+                # cephlint: disable=no-blocking-on-loop — MScrub
+                # is not fast-dispatched (see ms_can_fast_dispatch):
+                # this branch always runs on the thread pool
                 with pg.lock:
                     for oid in pg.missing:
                         if oid not in digests and oid not in unreadable:

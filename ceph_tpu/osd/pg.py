@@ -26,7 +26,8 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ceph_tpu.core.crc import crc32c
-from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.core.lockdep import make_lock
+from ceph_tpu.core.encoding import DecodeError, Decoder, Encoder
 from ceph_tpu.osd import messages as m
 from ceph_tpu.osd import types as t_
 from ceph_tpu.osd.backend import (
@@ -39,7 +40,8 @@ from ceph_tpu.osd.backend import (
 )
 from ceph_tpu.osd.pglog import PGLog
 from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp, PGId, PGInfo
-from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
+from ceph_tpu.store.objectstore import (Collection, GHObject, StoreError,
+                                        Transaction)
 
 EPERM, ENOENT, EIO, EAGAIN, EINVAL = -1, -2, -5, -11, -22
 # EC reads that could not assemble k CURRENT chunks before the
@@ -74,12 +76,14 @@ class PG:
         self.acting: List[int] = []
         self.prior_acting: List[int] = []  # past_intervals role
         self.primary: int = -1
-        from ceph_tpu.core.lockdep import make_lock
 
         self.lock = make_lock(
             f"osd{osd.whoami}.pg{t_.pgid_str(pgid)}")
         # serializes operator scrub/repair (the reference's scrub
         # reservation role): acquired non-blocking by MPGCommand
+        # cephlint: disable=named-locks — acquired on the dispatch
+        # thread, released by the maintenance worker thread; the
+        # RLock backing a DMutex forbids cross-thread release
         self.maintenance_guard = threading.Lock()
         self.missing: Dict[str, EVersion] = {}  # objects this osd lacks
         # map epoch at which the current interval began (the reference's
@@ -133,7 +137,7 @@ class PG:
         self._wd_next = 0.0
         # leaf lock for the roll-forward watermark CAS (commit
         # callbacks race it from shard-ack threads)
-        self._ct_lock = threading.Lock()
+        self._ct_lock = make_lock("pg.committed_to")
 
     # -- identity ---------------------------------------------------------
     def is_primary(self) -> bool:
@@ -156,8 +160,11 @@ class PG:
             try:
                 blob = self.osd.store.getattr(self.coll, g, "info")
                 self.info = PGInfo.decode(Decoder(blob))
-            except Exception:
-                pass
+            except Exception as e:
+                # a meta object without/with a torn info attr: peering
+                # rebuilds it, but a decode regression must be seen
+                self.osd._log(1, f"pg {self.pgid}: pgmeta info "
+                                 f"unreadable: {e!r}")
             self.log = PGLog.from_omap(self.osd.store.omap_get(self.coll, g))
             if self.log.head > self.info.last_update:
                 # data+log landed but info didn't: log wins (replay)
@@ -331,7 +338,7 @@ class PG:
                                   msg.oid, notify_id, cookie, op.data)
             try:
                 wconn.send(note)
-            except Exception:
+            except (ConnectionError, OSError, RuntimeError):
                 pass  # dead watcher: the timeout covers it
 
         def finish() -> None:
@@ -534,7 +541,9 @@ class PG:
         if state is not None and "snapset" in state.xattrs:
             try:
                 return json.loads(state.xattrs["snapset"].decode())
-            except Exception:
+            except (ValueError, UnicodeDecodeError):
+                # unparsable snapset xattr == no snapset; scrub owns
+                # flagging the corruption
                 pass
         return {"seq": 0, "clones": []}
 
@@ -603,7 +612,7 @@ class PG:
         state.xattrs["snapset"] = json.dumps(ss).encode()
         committed = threading.Event()
         _replied = [False]
-        _rlock = threading.Lock()
+        _rlock = make_lock("pg.reply_once")
 
         def reply_once(rep) -> None:
             with _rlock:
@@ -658,8 +667,9 @@ class PG:
                               [self._snap_key(snapid, oid)])
                 try:
                     self.osd.store.queue_transaction(t)
-                except Exception:
-                    pass
+                except Exception as e:
+                    self.osd._log(1, f"pg {self.pgid}: dangling snap "
+                                     f"row drop failed: {e!r}")
                 stale += 1
             else:
                 failed += 1
@@ -863,7 +873,7 @@ class PG:
         committed = threading.Event()
         # exactly one reply per op, whether commit or timeout wins
         _replied = [False]
-        _rlock = threading.Lock()
+        _rlock = make_lock("pg.reply_once")
 
         def reply_once(rep) -> None:
             with _rlock:
@@ -1065,7 +1075,7 @@ class PG:
         s0, s1 = be.sinfo.stripe_range(wop.off, len(wop.data))
         committed = threading.Event()
         _replied = [False]
-        _rlock = threading.Lock()
+        _rlock = make_lock("pg.reply_once")
 
         def reply_once(rep) -> None:
             with _rlock:
@@ -1403,7 +1413,7 @@ class PG:
                 return            # down/stale: recovery will serve it
             done(be.reconstruct(oid, av, cur_meta[0]) if av else None)
             return
-        lock = threading.Lock()
+        lock = make_lock("pg.ec_read_gather")
         fired = [False]
         # any chunk version-rejected (local pre-scan or on_reply)
         av_reject = [av_reject0]
@@ -2040,8 +2050,8 @@ class PG:
             ver = EVersion.decode(d)
             if ver == msg.version:
                 recovered_to = d.u64()
-        except Exception:
-            pass
+        except (StoreError, DecodeError):
+            pass  # no/garbled progress marker: recovery starts at 0
         rep = m.MPGRecoveryProbeReply(self.pgid, self.osd.epoch(),
                                       msg.oid, recovered_to)
         rep.tid = msg.tid

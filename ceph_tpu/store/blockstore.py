@@ -41,6 +41,7 @@ import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ceph_tpu.core.crc import crc32c
+from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.core.encoding import Decoder, Encoder
 from ceph_tpu.core.perf import PerfCounters
 from ceph_tpu.store import objectstore as os_
@@ -235,7 +236,7 @@ class BlockStore(ObjectStore):
             self._kv = LogKV(os.path.join(path, "meta.kv"))
         self._dev_path = os.path.join(path, "block")
         self._dev_fh = None
-        self._lock = threading.RLock()
+        self._lock = make_lock("blockstore")
         self._mounted = False
         self._alloc = BitmapAllocator(0)
         self._init_blocks = device_blocks

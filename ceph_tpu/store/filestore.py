@@ -20,6 +20,7 @@ import threading
 from typing import Dict, List, Optional
 
 from ceph_tpu.core.crc import crc32c
+from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.core.encoding import Decoder, Encoder
 from ceph_tpu.core.perf import PerfCounters
 from ceph_tpu.store import objectstore as os_
@@ -62,7 +63,7 @@ class FileStore(ObjectStore):
         self._wal_path = os.path.join(path, "wal.log")
         self._wal_fh = None
         self._seq = 0
-        self._lock = threading.RLock()
+        self._lock = make_lock("filestore")
         self._mounted = False
         # inline object-data compression (the BlueStore-compression
         # role, reference src/compressor/ + BlueStore blob compression):

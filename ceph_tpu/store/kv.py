@@ -15,6 +15,7 @@ import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ceph_tpu.core.crc import crc32c
+from ceph_tpu.core.lockdep import make_lock
 
 _SEP = "\x00"
 
@@ -142,7 +143,7 @@ class KeyValueDB:
 class MemDB(KeyValueDB):
     def __init__(self) -> None:
         self._data: Dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("kv.memdb")
 
     def open(self) -> None:
         pass
@@ -188,7 +189,7 @@ class LogKV(KeyValueDB):
     def __init__(self, path: str) -> None:
         self.path = path
         self._data: Dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("kv.logkv")
         self._fh = None
         self._dirty_bytes = 0
 

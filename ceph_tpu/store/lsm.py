@@ -28,6 +28,7 @@ import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ceph_tpu.core.crc import crc32c
+from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.store.kv import KeyValueDB, KVIterator, WriteBatch
 
 _SEP = "\x00"
@@ -290,7 +291,7 @@ class LSMStore(KeyValueDB):
         self._tables: List[SSTable] = []  # newest first
         self._next_table = 0
         self._wal = None
-        self._lock = threading.RLock()
+        self._lock = make_lock("lsm")
 
     # -- lifecycle ---------------------------------------------------------
     def _wal_path(self) -> str:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List
 
+from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.store import objectstore as os_
 from ceph_tpu.store.objectstore import (
     Collection,
@@ -44,7 +45,7 @@ class _Obj:
 class MemStore(ObjectStore):
     def __init__(self) -> None:
         self._colls: Dict[Collection, Dict[GHObject, _Obj]] = {}
-        self._lock = threading.RLock()
+        self._lock = make_lock("memstore")
         self._mounted = False
         self._seq = 0
 

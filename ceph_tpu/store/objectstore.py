@@ -14,6 +14,7 @@ transactions to replica shards.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -360,9 +361,12 @@ class CommitPipeline:
     """
 
     def __init__(self, sync_fn: Callable[[], None],
-                 perf=None) -> None:
+                 perf=None, log: Optional[Callable[[str], None]] = None
+                 ) -> None:
         self._sync_fn = sync_fn
         self._perf = perf  # PerfCounters with commit_batch/commit_lat
+        self._log = log or (lambda s: print(f"store-commit: {s}",
+                                            file=sys.stderr))
         self._cond = threading.Condition()
         self._pending: List[Tuple[int, Callable[[], None]]] = []
         self._frozen = False
@@ -417,8 +421,8 @@ class CommitPipeline:
                 return
         try:
             self._sync_fn()
-        except Exception:
-            pass
+        except Exception as e:
+            self._log(f"inline sync during stop failed: {e!r}")
         on_commit()
 
     def flush(self) -> None:
@@ -440,16 +444,19 @@ class CommitPipeline:
             t0 = time.perf_counter()
             try:
                 self._sync_fn()
-            except Exception:
+            except Exception as e:
                 # a failing sync must not strand submitters (there is
                 # no error channel on on_commit); the store's state is
                 # applied, durability degrades to wal_sync=False level
-                pass
+                # — but degraded durability must be LOUD
+                self._log(f"batch sync failed: {e!r} (completions "
+                          "fire; durability degraded this batch)")
             for _seq, cb in batch:
                 try:
                     cb()
-                except Exception:
-                    pass  # one completion's bug must not starve the rest
+                except Exception as e:
+                    # one completion's bug must not starve the rest
+                    self._log(f"on_commit callback raised: {e!r}")
             if self._perf is not None:
                 self._perf.hinc("commit_batch", len(batch))
                 self._perf.tinc("commit_lat", time.perf_counter() - t0)

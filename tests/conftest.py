@@ -20,3 +20,43 @@ jax.config.update("jax_enable_x64", True)
 from ceph_tpu import _native
 
 _native.lib()  # build csrc/ once up front
+
+# -- runtime sanitizers (tier-1 runs with both armed) -----------------------
+#
+# lockdep: make_lock() hands out order-checked DMutexes for the whole
+# suite, so a lock-order cycle anywhere in the msg/store/osd/mon paths
+# is a deterministic LockOrderError, not a rare production hang.
+# Enabled at import time — locks decide checked-vs-plain when CREATED,
+# and daemons construct their locks inside tests.  CEPH_TPU_LOCKDEP=0
+# opts out (e.g. when bisecting a perf regression).
+#
+# loop-stall: a fast-dispatched handler that holds a messenger event
+# loop longer than CEPH_TPU_LOOP_STALL_MS fails the test that ran it.
+# The default 1000 ms is far above any legitimate inline handler
+# (microseconds) and far below the blocking bugs the contract exists
+# to catch (store fsyncs, lock waits held across RPCs, 10 s dials);
+# it also keeps 2-core CI scheduler hiccups from flaking tests.
+import pytest
+
+from ceph_tpu.core import lockdep
+
+_LOCKDEP = os.environ.get("CEPH_TPU_LOCKDEP", "1") != "0"
+if _LOCKDEP:
+    lockdep.enable(True)
+os.environ.setdefault("CEPH_TPU_LOOP_STALL_MS", "1000")
+
+from ceph_tpu.msg import messenger as _messenger
+
+
+@pytest.fixture(autouse=True)
+def _sanitizers():
+    if _LOCKDEP:
+        lockdep.enable(True)  # re-assert: a test may have toggled it
+    _messenger.LOOP_STALLS.clear()
+    yield
+    stalls, _messenger.LOOP_STALLS[:] = (list(_messenger.LOOP_STALLS), [])
+    if float(os.environ.get("CEPH_TPU_LOOP_STALL_MS", "0") or 0) > 0:
+        assert not stalls, (
+            "fast-dispatched handler(s) blocked the messenger event loop "
+            "(no store work, no lock waits, no RPCs inline on the loop): "
+            + "; ".join(f"{e}:{t} {s * 1e3:.0f}ms" for e, t, s in stalls))

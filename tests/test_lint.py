@@ -1,0 +1,321 @@
+"""cephlint tier-1 gate + per-check unit coverage.
+
+The gate: the repo at HEAD must have ZERO violations beyond the
+committed baseline (tools/cephlint_baseline.json).  New debt either
+gets fixed, gets an inline `# cephlint: disable=<check> — why`
+annotation, or is consciously accepted by regenerating the baseline —
+never silently merged.
+
+The unit tests feed each check synthetic modules with one planted bug
+and one clean variant: the gate is only as good as the checks'
+ability to actually catch the bug classes they claim.
+"""
+
+import os
+import sys
+import time
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+sys.path.insert(0, os.path.abspath(TOOLS))
+
+import cephlint  # noqa: E402
+
+from ceph_tpu.analysis import (  # noqa: E402
+    ALL_CHECKS,
+    SourceFile,
+    discover_files,
+    load_baseline,
+    new_violations,
+    run_checks,
+)
+from ceph_tpu.analysis.checks import CHECKS_BY_NAME  # noqa: E402
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+_SCAN = {}
+
+
+def _repo_scan():
+    """One repo-wide scan shared by the gate tests (the parse cache
+    makes re-parses free, but the checks themselves cost ~3s/pass on
+    the 2-core CI box — no reason to pay it three times)."""
+    if not _SCAN:
+        t0 = time.perf_counter()
+        files = discover_files()
+        violations = run_checks(files, ALL_CHECKS)
+        _SCAN.update(files=files, violations=violations,
+                     elapsed=time.perf_counter() - t0)
+    return _SCAN
+
+
+def test_repo_has_no_new_violations():
+    scan = _repo_scan()
+    violations, elapsed = scan["violations"], scan["elapsed"]
+    baseline = load_baseline(cephlint.DEFAULT_BASELINE)
+    new = new_violations(violations, baseline)
+    assert not new, (
+        "new cephlint violations (fix them, annotate the line with "
+        "'# cephlint: disable=<check> — why', or — for consciously "
+        "accepted debt — regenerate the baseline with "
+        "`python tools/cephlint.py --write-baseline`):\n" + "\n".join(
+            f"  {v.path}:{v.line}: [{v.check}] {v.message}" for v in new))
+    # the CI-budget contract: full suite, parse included, well under 30s
+    assert elapsed < 30.0, f"lint took {elapsed:.1f}s (budget 30s)"
+
+
+def test_baseline_never_grows_silently():
+    """Every baseline entry must still correspond to a live violation:
+    fixed debt leaves stale allowance behind, and stale allowance is
+    where a regression hides.  (Regenerate the baseline after fixing.)"""
+    live = {}
+    for v in _repo_scan()["violations"]:
+        live[v.key] = live.get(v.key, 0) + 1
+    baseline = load_baseline(cephlint.DEFAULT_BASELINE)
+    stale = {k: (n, live.get(k, 0)) for k, n in baseline.items()
+             if live.get(k, 0) < n}
+    assert not stale, (
+        "baseline entries exceed live violations — debt was fixed, "
+        "shrink the baseline (`python tools/cephlint.py "
+        f"--write-baseline`): {stale}")
+
+
+def test_cli_json_contract():
+    """--json exits 0 at HEAD and emits the machine-readable shape."""
+    import contextlib
+    import io
+    import json
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        # one check keeps this a CLI-contract test, not a third full
+        # scan (the gate itself is test_repo_has_no_new_violations)
+        rc = cephlint.main(["--json", "--checks", "no-sleep-poll"])
+    out = json.loads(buf.getvalue())
+    assert rc == 0
+    assert out["new"] == []
+    assert out["files_scanned"] > 100
+    assert out["checks"] == ["no-sleep-poll"]
+
+
+# -- per-check unit coverage -------------------------------------------------
+
+def _lint(tmp_path, code: str, check: str, rel: str = "ceph_tpu/fake.py"):
+    p = tmp_path / "snippet.py"
+    p.write_text(code)
+    return [v for v in run_checks([SourceFile(str(p), rel)],
+                                  [CHECKS_BY_NAME[check]])
+            if v.check == check]
+
+
+def test_named_locks_catches_raw_lock(tmp_path):
+    bad = _lint(tmp_path, (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self.lk = threading.Lock()\n"
+        "        self.r = threading.RLock()\n"), "named-locks")
+    assert [v.line for v in bad] == [4, 5]
+    ok = _lint(tmp_path, (
+        "from ceph_tpu.core.lockdep import make_lock\n"
+        "lk = make_lock('x')\n"), "named-locks")
+    assert not ok
+
+
+def test_named_locks_inline_suppression(tmp_path):
+    ok = _lint(tmp_path, (
+        "import threading\n"
+        "# cephlint: disable=named-locks — released cross-thread\n"
+        "guard = threading.Lock()\n"), "named-locks")
+    assert not ok
+
+
+def test_no_sleep_poll_flags_only_short_literal_in_loop(tmp_path):
+    code = (
+        "import time\n"
+        "def poll():\n"
+        "    while True:\n"
+        "        time.sleep(0.02)\n"       # flagged: the 20ms poll
+        "def pace():\n"
+        "    while True:\n"
+        "        time.sleep(30.0)\n"       # ok: deliberate long pacing
+        "def configurable(iv):\n"
+        "    while True:\n"
+        "        time.sleep(iv)\n"         # ok: computed interval
+        "def once():\n"
+        "    time.sleep(0.02)\n")          # ok: not in a loop
+    bad = _lint(tmp_path, code, "no-sleep-poll")
+    assert [v.line for v in bad] == [4]
+
+
+def test_silent_except_flags_broad_pass_only(tmp_path):
+    code = (
+        "def f(x):\n"
+        "    try:\n"
+        "        x()\n"
+        "    except Exception:\n"          # flagged
+        "        pass\n"
+        "    try:\n"
+        "        x()\n"
+        "    except (OSError, RuntimeError):\n"  # ok: narrowed
+        "        pass\n"
+        "    try:\n"
+        "        x()\n"
+        "    except Exception as e:\n"     # ok: logged
+        "        print(e)\n"
+        "    try:\n"
+        "        x()\n"
+        "    except:\n"                    # flagged: bare
+        "        pass\n")
+    bad = _lint(tmp_path, code, "silent-except")
+    assert [v.line for v in bad] == [4, 16]
+
+
+def test_codec_symmetry_missing_decode(tmp_path):
+    bad = _lint(tmp_path, (
+        "class T:\n"
+        "    def encode_payload(self, e):\n"
+        "        e.u32(self.x)\n"), "codec-symmetry")
+    assert len(bad) == 1 and bad[0].detail == "missing-decode"
+
+
+def test_codec_symmetry_transposed_fields(tmp_path):
+    code = (
+        "class T:\n"
+        "    def encode_payload(self, e):\n"
+        "        e.u32(self.a)\n"
+        "        e.u32(self.b)\n"
+        "    def decode_payload(self, d):\n"
+        "        self.b = d.u32()\n"       # transposed vs encode
+        "        self.a = d.u32()\n")
+    bad = _lint(tmp_path, code, "codec-symmetry")
+    assert len(bad) == 1 and bad[0].detail.startswith("order:")
+    ok = _lint(tmp_path, code.replace(
+        "        self.b = d.u32()\n        self.a = d.u32()\n",
+        "        self.a = d.u32()\n        self.b = d.u32()\n"),
+        "codec-symmetry")
+    assert not ok
+
+
+def test_codec_symmetry_version_tolerance(tmp_path):
+    intolerant = (
+        "class T:\n"
+        "    VERSION = 2\n"
+        "    def encode_payload(self, e):\n"
+        "        e.u32(self.a)\n"
+        "        e.u32(self.b)\n"
+        "    def decode_payload(self, d):\n"
+        "        self.a = d.u32()\n"
+        "        self.b = d.u32()\n")      # blind v2 read of a v1 blob
+    bad = _lint(tmp_path, intolerant, "codec-symmetry")
+    assert len(bad) == 1 and bad[0].detail == "no-old-version-tolerance"
+    tolerant = intolerant.replace(
+        "        self.b = d.u32()\n",
+        "        if d.remaining_in_frame():\n"
+        "            self.b = d.u32()\n"
+        "        else:\n"
+        "            self.b = 0\n")
+    assert not _lint(tmp_path, tolerant, "codec-symmetry")
+
+
+def test_codec_symmetry_start_gated_struct_ok(tmp_path):
+    ok = _lint(tmp_path, (
+        "class S:\n"
+        "    def encode(self, e):\n"
+        "        e.start(2, 1)\n"
+        "        e.u32(self.a)\n"
+        "        e.finish()\n"
+        "    @classmethod\n"
+        "    def decode(cls, d):\n"
+        "        v = d.start(2)\n"
+        "        out = cls(a=d.u32())\n"
+        "        if v >= 2:\n"
+        "            out.b = d.u32()\n"
+        "        d.end()\n"
+        "        return out\n"), "codec-symmetry")
+    assert not ok
+
+
+def test_blocking_flags_sleep_in_async_def(tmp_path):
+    code = (
+        "import asyncio, time\n"
+        "async def pump():\n"
+        "    time.sleep(0.1)\n"            # flagged: sync sleep on loop
+        "    await asyncio.sleep(0.1)\n")  # ok: awaited
+    bad = _lint(tmp_path, code, "no-blocking-on-loop")
+    assert [v.line for v in bad] == [3]
+
+
+def test_blocking_follows_fast_dispatch_call_graph(tmp_path):
+    code = (
+        "class D:\n"
+        "    def ms_can_fast_dispatch(self, msg):\n"
+        "        return True\n"
+        "    def ms_dispatch(self, conn, msg):\n"
+        "        self._helper()\n"
+        "        return True\n"
+        "    def _helper(self):\n"
+        "        self.lock.acquire()\n"    # flagged via the call graph
+        "        self.guard.acquire(blocking=False)\n")  # ok: non-block
+    bad = _lint(tmp_path, code, "no-blocking-on-loop")
+    assert [v.line for v in bad] == [8]
+
+
+def test_blocking_ignores_plain_dispatcher(tmp_path):
+    ok = _lint(tmp_path, (
+        "class D:\n"
+        "    def ms_can_fast_dispatch(self, msg):\n"
+        "        return False\n"           # slow path only: pool thread
+        "    def ms_dispatch(self, conn, msg):\n"
+        "        self.lock.acquire()\n"
+        "        return True\n"), "no-blocking-on-loop")
+    assert not ok
+
+
+def test_jax_purity_flags_np_and_time_in_traced_fn(tmp_path):
+    code = (
+        "import jax\n"
+        "import numpy as np\n"
+        "import time\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    t = time.time()\n"            # flagged
+        "    return np.sum(x) + t\n"       # flagged
+        "def untraced(x):\n"
+        "    return np.sum(x)\n")          # ok: not traced
+    bad = _lint(tmp_path, code, "jax-purity")
+    assert sorted(v.detail for v in bad) == ["np.sum", "time.time"]
+
+
+def test_jax_purity_follows_pallas_call_kernel(tmp_path):
+    code = (
+        "from jax.experimental import pallas as pl\n"
+        "import numpy as np\n"
+        "def _kern(ref, o_ref):\n"
+        "    o_ref[...] = np.dot(ref[...], ref[...])\n"  # flagged
+        "def run(x):\n"
+        "    return pl.pallas_call(_kern, out_shape=None)(x)\n")
+    bad = _lint(tmp_path, code, "jax-purity")
+    assert len(bad) == 1 and bad[0].detail == "np.dot"
+
+
+def test_parse_error_is_a_violation(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    vs = run_checks([SourceFile(str(p), "ceph_tpu/broken.py")], ALL_CHECKS)
+    assert len(vs) == 1 and vs[0].check == "parse-error"
+
+
+def test_baseline_allows_exact_count_only(tmp_path):
+    code = ("import threading\n"
+            "a = threading.Lock()\n"
+            "b = threading.Lock()\n")
+    p = tmp_path / "m.py"
+    p.write_text(code)
+    vs = run_checks([SourceFile(str(p), "ceph_tpu/m.py")],
+                    [CHECKS_BY_NAME["named-locks"]])
+    assert len(vs) == 2
+    key = vs[0].key
+    assert not new_violations(vs, {key: 2})      # both baselined
+    over = new_violations(vs, {key: 1})          # one new beyond debt
+    assert len(over) == 1 and over[0].line == 3  # newest-looking first

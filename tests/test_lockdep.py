@@ -11,10 +11,14 @@ from ceph_tpu.core.lockdep import DMutex, LockOrderError, make_lock
 
 @pytest.fixture(autouse=True)
 def _lockdep_on():
+    was = lockdep.enabled()
     lockdep.reset()
     lockdep.enable(True)
     yield
-    lockdep.enable(False)
+    # restore, don't blindly disable: the tier-1 conftest runs the
+    # whole suite with lockdep on, and tests after this module must
+    # keep their checked mutexes checking
+    lockdep.enable(was)
     lockdep.reset()
 
 

@@ -1,0 +1,142 @@
+"""Runtime sanitizer wiring: messenger loop-stall + lockdep-under-test.
+
+The loop-stall sanitizer is the runtime half of cephlint's
+no-blocking-on-loop check: static analysis catches what it can
+resolve, the sanitizer catches the rest by measuring what actually
+ran on the event loop.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.core import lockdep
+from ceph_tpu.core.lockdep import DMutex, make_lock
+from ceph_tpu.msg import messenger as msgr_mod
+from ceph_tpu.msg.message import EntityName, Message, MPing, register
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+
+
+class _BlockingFastDispatcher(Dispatcher):
+    """Deliberate contract violation: fast-dispatches pings, then
+    blocks the loop — exactly what the sanitizer exists to catch."""
+
+    def __init__(self, block_s: float) -> None:
+        self.block_s = block_s
+        self.got = threading.Event()
+
+    def ms_can_fast_dispatch(self, msg: Message) -> bool:
+        return isinstance(msg, MPing)
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if self.block_s:
+            time.sleep(self.block_s)  # the planted bug
+        self.got.set()
+        return True
+
+
+def _ping_through(dispatcher) -> None:
+    a = Messenger(None, EntityName("client", 1))
+    b = Messenger(None, EntityName("osd", 2))
+    b.add_dispatcher(dispatcher)
+    a.start()
+    b.start()
+    try:
+        a.connect(b.addr).send(MPing())
+        assert dispatcher.got.wait(10.0), "ping never dispatched"
+        time.sleep(0.05)  # let the stall record land
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_loop_stall_catches_blocking_fast_dispatch(monkeypatch):
+    """Acceptance demo: a fast-dispatched handler that blocks past the
+    threshold is DETECTED (and would fail the offending test via the
+    conftest fixture)."""
+    monkeypatch.setenv("CEPH_TPU_LOOP_STALL_MS", "30")
+    msgr_mod.LOOP_STALLS.clear()
+    _ping_through(_BlockingFastDispatcher(block_s=0.12))
+    stalls = list(msgr_mod.LOOP_STALLS)
+    # consume the records: THIS test plants the bug deliberately, so
+    # the autouse enforcement fixture must not re-fail on them
+    msgr_mod.LOOP_STALLS.clear()
+    assert stalls, "sanitizer missed a 120ms block at a 30ms threshold"
+    entity, mtype, elapsed = stalls[0]
+    assert mtype == "MPing" and elapsed >= 0.03
+
+
+def test_loop_stall_clean_handler_records_nothing(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_LOOP_STALL_MS", "30")
+    msgr_mod.LOOP_STALLS.clear()
+    _ping_through(_BlockingFastDispatcher(block_s=0.0))
+    assert not msgr_mod.LOOP_STALLS
+
+
+def test_loop_stall_disabled_by_zero_threshold(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_LOOP_STALL_MS", "0")
+    msgr_mod.LOOP_STALLS.clear()
+    _ping_through(_BlockingFastDispatcher(block_s=0.08))
+    assert not msgr_mod.LOOP_STALLS
+
+
+# -- lockdep wiring ----------------------------------------------------------
+
+def test_tier1_runs_with_lockdep_armed():
+    """The conftest arms lockdep for the whole suite: make_lock must
+    hand back checked mutexes inside any test (unless the operator
+    opted out via CEPH_TPU_LOCKDEP=0)."""
+    import os
+
+    if os.environ.get("CEPH_TPU_LOCKDEP", "1") == "0":
+        pytest.skip("lockdep disabled by env")
+    assert lockdep.enabled()
+    assert isinstance(make_lock("sanity"), DMutex)
+
+
+def test_condition_over_checked_mutex():
+    """threading.Condition(make_lock(...)) — the shape store commit
+    pipelines use — must wait/notify correctly through DMutex's
+    _release_save/_acquire_restore delegation."""
+    lk = DMutex("test.cv")
+    cv = threading.Condition(lk)
+    state = {"ready": False, "seen": False}
+
+    def waiter() -> None:
+        with cv:
+            while not state["ready"]:
+                cv.wait(5.0)
+            state["seen"] = True
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.02)
+    with cv:
+        state["ready"] = True
+        cv.notify_all()
+    th.join(5.0)
+    assert state["seen"]
+    # the wait window released the mutex for real: we could acquire it
+    assert not lk._is_owned()
+
+
+def test_condition_wait_restores_reentrant_depth():
+    lk = DMutex("test.cv.reentrant")
+    cv = threading.Condition(lk)
+    fired = threading.Event()
+
+    def poker() -> None:
+        fired.wait(5.0)
+        with cv:
+            cv.notify_all()
+
+    th = threading.Thread(target=poker)
+    th.start()
+    with lk:          # depth 1
+        with cv:      # depth 2 (cv's lock IS lk)
+            fired.set()
+            cv.wait(5.0)   # must drop BOTH levels, then restore them
+        # depth back to 1: release below must not underflow
+    th.join(5.0)
+    assert not lk._is_owned()

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""cephlint — run the repo-native AST analysis suite.
+
+    python tools/cephlint.py                 # human output, baseline applied
+    python tools/cephlint.py --json          # machine output
+    python tools/cephlint.py --no-baseline   # full debt view
+    python tools/cephlint.py --checks named-locks,no-sleep-poll
+    python tools/cephlint.py --write-baseline  # accept current state as debt
+
+Exit status: 0 = no violations beyond the committed baseline
+(tools/cephlint_baseline.json), 1 = new violations, 2 = usage error.
+
+Intentional one-off exceptions annotate the offending line with
+``# cephlint: disable=<check-name>`` and a reason; the baseline is for
+pre-existing debt only.  tests/test_lint.py runs this in tier-1, so a
+new violation fails the build, not the nightly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.analysis import (  # noqa: E402
+    ALL_CHECKS,
+    discover_files,
+    load_baseline,
+    new_violations,
+    run_checks,
+    violations_to_baseline,
+)
+from ceph_tpu.analysis.checks import CHECKS_BY_NAME  # noqa: E402
+from ceph_tpu.analysis.framework import repo_root  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "cephlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="cephlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*", default=None,
+                   help="top-level dirs to lint (default: ceph_tpu tools)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON document instead of text")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="suppressions baseline file")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report all violations")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from the current state "
+                        "(intentionally accepting today's debt) and exit 0")
+    p.add_argument("--checks", default="",
+                   help="comma-separated check names (default: all)")
+    args = p.parse_args(argv)
+
+    if args.checks:
+        try:
+            checks = [CHECKS_BY_NAME[n.strip()]
+                      for n in args.checks.split(",") if n.strip()]
+        except KeyError as e:
+            print(f"cephlint: unknown check {e.args[0]!r}; have: "
+                  f"{', '.join(sorted(CHECKS_BY_NAME))}", file=sys.stderr)
+            return 2
+    else:
+        checks = list(ALL_CHECKS)
+
+    subdirs = tuple(args.paths) if args.paths else ("ceph_tpu", "tools")
+    files = discover_files(subdirs=subdirs)
+    violations = run_checks(files, checks)
+
+    if args.write_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(violations_to_baseline(violations), f, indent=1,
+                      sort_keys=False)
+            f.write("\n")
+        print(f"cephlint: wrote {len(violations)} suppressions "
+              f"({len({v.key for v in violations})} keys) to "
+              f"{os.path.relpath(args.baseline, repo_root())}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new = new_violations(violations, baseline)
+
+    if args.as_json:
+        json.dump({
+            "files_scanned": len(files),
+            "checks": [c.name for c in checks],
+            "total_violations": len(violations),
+            "baselined": len(violations) - len(new),
+            "new": [v.to_dict() for v in new],
+        }, sys.stdout, indent=1)
+        print()
+    else:
+        for v in new:
+            print(f"{v.path}:{v.line}: [{v.check}] {v.message}")
+        print(f"cephlint: {len(files)} files, {len(violations)} violations "
+              f"({len(violations) - len(new)} baselined, {len(new)} new)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

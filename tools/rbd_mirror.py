@@ -71,10 +71,12 @@ def main(argv=None) -> int:
             print(f"rbd-mirror: tailing {args.src_pool}/{name} -> "
                   f"{args.dst_pool}/{name}", flush=True)
         try:
-            t0 = time.time()
-            while (args.run_seconds <= 0
-                   or time.time() - t0 < args.run_seconds):
-                time.sleep(0.2)
+            # a single interruptible wait (Ctrl-C still works: Event
+            # waits wake on signals in the main thread)
+            import threading
+
+            threading.Event().wait(
+                args.run_seconds if args.run_seconds > 0 else None)
         except KeyboardInterrupt:
             pass
         finally:

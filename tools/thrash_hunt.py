@@ -74,9 +74,9 @@ def _timeout_forensics(c, cl, pool: int, errmsg: str) -> None:
         cmap_ep = ob.osdmap.epoch if ob.osdmap else -1
         print(f"  t-forensics: oid={oid!r} client_epoch={cmap_ep} "
               f"cluster_epoch={c.osdmap.epoch}", flush=True)
-        print(f"  t-forensics: up_per_map="
-              f"{[o for o in range(c.osdmap.max_osd)
-                  if c.osdmap.is_up(o)]} "
+        up_per_map = [o for o in range(c.osdmap.max_osd)
+                      if c.osdmap.is_up(o)]
+        print(f"  t-forensics: up_per_map={up_per_map} "
               f"alive={[i for i, s in sorted(c.osds.items()) if s.up]}",
               flush=True)
         if ob.osdmap is not None and oid != "?":
