@@ -12,13 +12,20 @@ try_state_to_reads); the backend owns distribution:
   replica (MOSDRepOp; reference submit_transaction ->
   issue_op -> sub_op_modify).
 - ECBackend: the object buffer is padded and split into k data chunks,
-  coding chunks come back from the stripe-batch queue (ONE device
-  matmul may serve many concurrent writes), and each of the k+m shards
-  gets its own transaction (chunk payload + per-shard HashInfo crc
-  xattr, reference ECUtil.h:101) shipped as MECSubWrite
-  (ECBackend.cc:1997-2035 fan-out, :880 handle_sub_write).
+  coding chunks come back from the stripe-batch queue ASYNCHRONOUSLY
+  (encode_async: N concurrent writes' planes coalesce into ONE device
+  matmul — the point of the StripeBatchQueue), and the fan-out runs in
+  the future's callback: each PEER gets one MECSubWriteVec carrying a
+  single merged transaction for ALL of its shards (chunk payloads +
+  per-shard HashInfo crc xattrs, reference ECUtil.h:101) — one
+  message, one rollback-capture pass, one WAL append, one commit ack
+  per peer per write (ECBackend.cc:1997-2035 fan-out, :880
+  handle_sub_write).  A per-PG fan-out sequencer keeps dispatch in
+  version order even when some writes skip the encode (deletes), so
+  per-connection FIFO delivery preserves the replica-log ordering the
+  old synchronous path got for free.
 
-Completion: an op commits when every shard/replica acked
+Completion: an op commits when every PEER (not every shard) acked
 (all_commit discipline of try_finish_rmw, ECBackend.cc:2050).
 """
 
@@ -38,6 +45,29 @@ from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
 from ceph_tpu.tpu.queue import default_queue
 
 CRUSH_ITEM_NONE = 0x7FFFFFFF
+
+
+# Process-wide fan-out lane: encode futures hand their fan-out
+# closures here so the StripeBatchQueue's device worker gets straight
+# back to coalescing the next batch.  One worker, FIFO — combined with
+# the per-PG sequencer tickets this preserves version-ordered dispatch;
+# the closures only queue store transactions (return after apply) and
+# stage messenger sends, so nothing here blocks on network round-trips.
+# Submitted fns never raise (_fan_run contains its own failures), so
+# the swallowed-into-Future exception behavior is moot.
+_fanout_exec = None
+_fanout_exec_lock = make_lock("backend.fanout_exec_init")
+
+
+def _fanout_executor():
+    global _fanout_exec
+    with _fanout_exec_lock:
+        if _fanout_exec is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _fanout_exec = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="pg-fanout")
+        return _fanout_exec
 
 
 class ObjectState:
@@ -110,6 +140,20 @@ class PGBackend:
         # info.committed_to (rides EC sub-writes so shards learn which
         # entries are beyond divergent rollback)
         self.committed_fn: Callable[[], EVersion] = EVersion
+        # optional perf sink (the daemon's osd.N.pg counter set) and
+        # log hook, both bound by the host PG; no-ops stand alone so
+        # unit tests can drive a bare backend
+        self.perf = None
+        self.log: Callable[[int, str], None] = lambda lvl, msg: None
+        # fan-out sequencer: async encodes complete off-thread, and a
+        # write that SKIPS the encode (delete) must not overtake one
+        # that is still waiting on the device — per-peer FIFO delivery
+        # in version order is what lets replicas keep appending log
+        # entries in order (PGLog.append asserts monotonicity)
+        self._fan_lock = make_lock("backend.fanout_seq")
+        self._fan_tickets = 0
+        self._fan_next = 0
+        self._fan_pending: Dict[int, Callable[[], None]] = {}
 
     def roll_back_entry(self, entry: LogEntry,
                         meta_omap: Optional[Dict[str, bytes]] = None
@@ -148,14 +192,80 @@ class PGBackend:
     def _done(self, tid: int) -> None:
         self.in_flight.pop(tid, None)
 
+    # -- fan-out sequencer -------------------------------------------------
+    def _fan_ticket(self) -> int:
+        """Taken in version order (callers hold the pg lock through
+        submit), consumed by _fan_run in the same order."""
+        with self._fan_lock:
+            t = self._fan_tickets
+            self._fan_tickets += 1
+            return t
+
+    def _encode_then_fanout(self, planes, fanout, on_error) -> None:
+        """Shared async-encode scaffold: queue the planes, then run
+        `fanout(coding)` through the per-PG sequencer on the fan-out
+        executor — NOT on the StripeBatchQueue's device worker, which
+        must get back to coalescing the next batch (fan-out does store
+        applies and message sends; running it on the worker serialized
+        every write's fan-out behind the device thread and kept batch
+        width pinned near 1).  `on_error` runs if the encode itself
+        fails: nothing was fanned out anywhere, so the caller unwinds
+        its bookkeeping (in-flight op, gauge, projected state)."""
+        ticket = self._fan_ticket()
+        if self.perf is not None:
+            self.perf.inc("encode_batch_jobs")
+        try:
+            fut = self.queue.encode_async(self.codec, planes)
+        except BaseException:
+            self._fan_run(ticket, lambda: None)  # never park the line
+            raise
+
+        def finish(f) -> None:
+            try:
+                coding = f.result()
+            except Exception as e:  # noqa: BLE001 — device/codec error
+                self.log(0, f"pg {self.pgid}: encode failed: {e!r}")
+                on_error()
+                return
+            fanout(coding)
+
+        fut.add_done_callback(lambda f: _fanout_executor().submit(
+            lambda: self._fan_run(ticket, lambda: finish(f))))
+
+    def _fan_run(self, ticket: int, fn: Callable[[], None]) -> None:
+        """Run `fn` once every earlier ticket's fn has run; an earlier
+        completion drains any later fns already parked.  Encodes ride a
+        FIFO queue so in practice completions arrive in ticket order
+        and nothing parks — the sequencer only pays off when an
+        encode-less write (delete) would otherwise jump the line."""
+        ready: List[Callable[[], None]] = []
+        with self._fan_lock:
+            self._fan_pending[ticket] = fn
+            while self._fan_next in self._fan_pending:
+                ready.append(self._fan_pending.pop(self._fan_next))
+                self._fan_next += 1
+        for f in ready:
+            try:
+                f()
+            except Exception as e:  # noqa: BLE001 — one write's fan-out
+                # failure must not wedge every later write behind it
+                self.log(0, f"pg {self.pgid}: write fan-out failed: "
+                            f"{e!r}")
+
     # -- interface --------------------------------------------------------
     def submit(self, oid: str, state: Optional[ObjectState],
                entries: List[LogEntry], log_omap: Dict[str, bytes],
                acting: Sequence[int], on_commit: Callable[[], None],
-               log_rm: Optional[List[str]] = None) -> None:
+               log_rm: Optional[List[str]] = None,
+               on_submitted: Optional[Callable[[], None]] = None) -> None:
         """state=None means delete. `log_omap`/`log_rm` are pg-log omap
         updates/trims persisted in the same transaction (crash = replay
-        consistency)."""
+        consistency).  `on_submitted` fires once the write's
+        transactions have been queued locally and fanned out to every
+        peer (possibly on another thread — the EC encode is async):
+        the PG's per-object admission gate releases there, NOT at
+        commit, which is what lets same-object successors read the
+        projected state while this write's acks are still in flight."""
         raise NotImplementedError
 
     def read_object(self, oid: str, acting: Sequence[int],
@@ -209,7 +319,7 @@ class ReplicatedBackend(PGBackend):
         return t
 
     def submit(self, oid, state, entries, log_omap, acting, on_commit,
-               log_rm=None, pre_txn=None):
+               log_rm=None, pre_txn=None, on_submitted=None):
         txn = self._object_txn(oid, state, log_omap, log_rm)
         if pre_txn is not None:
             # snapshot clone-on-write rides the SAME transaction: the
@@ -235,6 +345,10 @@ class ReplicatedBackend(PGBackend):
         # have all answered
         self.store.queue_transaction(
             txn, on_commit=lambda: op.ack(self.whoami))
+        # replicated fan-out is synchronous and the caller holds the pg
+        # lock, so sends already leave in version order: submitted now
+        if on_submitted is not None:
+            on_submitted()
 
     def apply_rep_op(self, txn_bytes: bytes, on_commit=None) -> None:
         """Replica side of MOSDRepOp (sub_op_modify); the sub-write ack
@@ -408,8 +522,10 @@ class ECBackend(PGBackend):
     def _deinterleave(self, planes: np.ndarray, size: int) -> bytes:
         return self.sinfo.deinterleave(planes, size)
 
-    def _encode_object(self, data: bytes) -> Tuple[List[bytes], int]:
-        """Object buffer -> k+m chunk payloads via the batch queue."""
+    def _prep_planes(self, data: bytes) -> np.ndarray:
+        """Object buffer -> padded uint8 [k, cols] data planes (the
+        host-side half of the encode, shared by the sync and async
+        paths)."""
         planes, S = self._interleave(data)
         cols = S * self.unit
         # array codecs (clay) need columns divisible by sub_chunk_count
@@ -418,10 +534,24 @@ class ECBackend(PGBackend):
             planes = np.concatenate(
                 [planes,
                  np.zeros((self.k, D - cols % D), dtype=np.uint8)], axis=1)
+        return planes
+
+    @staticmethod
+    def _chunks_of(planes: np.ndarray, coding, k: int,
+                   m_: int) -> List[bytes]:
+        chunks = [planes[i].tobytes() for i in range(k)]
+        chunks += [np.asarray(coding[j]).tobytes() for j in range(m_)]
+        return chunks
+
+    def _encode_object(self, data: bytes) -> Tuple[List[bytes], int]:
+        """Object buffer -> k+m chunk payloads, BLOCKING on the batch
+        queue — recovery/scrub/tools path.  The client write path uses
+        encode_async inside submit() instead, so concurrent writes'
+        planes coalesce into one device matmul."""
+        planes = self._prep_planes(data)
         coding = self.queue.encode(self.codec, planes)
-        chunks = [planes[i].tobytes() for i in range(self.k)]
-        chunks += [np.asarray(coding[j]).tobytes() for j in range(self.m)]
-        return chunks, planes.shape[1]
+        return (self._chunks_of(planes, coding, self.k, self.m),
+                planes.shape[1])
 
     def _shard_txn(self, oid: str, shard: int, chunk: Optional[bytes],
                    state: Optional[ObjectState],
@@ -585,49 +715,122 @@ class ECBackend(PGBackend):
         self.cache.clear()
         super().on_peer_change(alive)
 
-    def submit(self, oid, state, entries, log_omap, acting, on_commit,
-               log_rm=None):
-        # full-object rewrite/delete supersedes any cached stripes
-        self.cache.invalidate(oid)
-        n = self.k + self.m
-        chunks: List[Optional[bytes]] = [None] * n
-        if state is not None:
-            chunks, _ = self._encode_object(state.data)
-        tid = self._new_tid()
-        shard_osds = list(acting[:n]) + [CRUSH_ITEM_NONE] * (n - len(acting))
-        waiting = set()
+    def _peer_map(self, shard_osds: Sequence[int]) -> Dict[int, List[int]]:
+        """osd -> the shards it holds; degraded (absent) shards skipped.
+        One wait key, one message, one merged transaction per PEER."""
+        peer_shards: Dict[int, List[int]] = {}
         for shard, osd in enumerate(shard_osds):
             if osd == CRUSH_ITEM_NONE or osd < 0:
                 continue  # degraded write: missing shard skipped
-            waiting.add((shard, osd))
-        op = InFlightOp(waiting, lambda: (self._done(tid), on_commit()))
+            peer_shards.setdefault(osd, []).append(shard)
+        return peer_shards
+
+    def _note_fanout(self, msgs: int) -> None:
+        if self.perf is not None:
+            self.perf.inc("subwrite_ops")
+            self.perf.inc("subwrite_msgs", msgs)
+
+    def submit(self, oid, state, entries, log_omap, acting, on_commit,
+               log_rm=None, on_submitted=None, on_error=None):
+        # full-object rewrite/delete supersedes any cached stripes
+        self.cache.invalidate(oid)
+        n = self.k + self.m
+        shard_osds = list(acting[:n]) + [CRUSH_ITEM_NONE] * (n - len(acting))
+        peer_shards = self._peer_map(shard_osds)
+        tid = self._new_tid()
+        op = InFlightOp(set(peer_shards),
+                        lambda: (self._done(tid), on_commit()))
         self.in_flight[tid] = op
-        av = None
         version = entries[-1].version if entries else None
-        if version is not None:
-            av = _av_stamp(version)
-        for shard, osd in enumerate(shard_osds):
-            if osd == CRUSH_ITEM_NONE or osd < 0:
-                continue
-            txn = self._shard_txn(
-                oid, shard,
-                chunks[shard] if state is not None else None,
-                state, log_omap, log_rm, av=av)
-            if osd == self.whoami:
-                if version is not None:
-                    self.rb_capture(txn, oid, shard, RB_FULL, 0, 0,
-                                    version)
-                self.store.queue_transaction(
-                    txn,
-                    on_commit=lambda s=shard, o=osd: op.ack((s, o)))
-            else:
-                msg = m.MECSubWrite(
-                    self.pgid, self.epoch_fn(), shard, txn.to_bytes(),
-                    entries, oid=oid,
-                    rb_kind=RB_FULL if version is not None else 0,
-                    committed_to=self.committed_fn())
-                msg.tid = tid
-                self.osd_send(osd, msg)
+        av = _av_stamp(version) if version is not None else None
+        rb_kind = RB_FULL if version is not None else 0
+        # epoch + watermark are minted NOW, under the pg lock — the
+        # fan-out closure may run after an interval change, and a
+        # stale sub-write stamped with the NEW epoch would evade the
+        # peer's interval_epoch drop-gate and apply over recovered
+        # data (the thrash-hunt divergence class the gate exists for)
+        epoch = self.epoch_fn()
+        committed_to = self.committed_fn()
+
+        def fanout(chunks: List[Optional[bytes]]) -> None:
+            try:
+                msgs = 0
+                for osd, shards in sorted(peer_shards.items()):
+                    txn = Transaction()
+                    for i, shard in enumerate(shards):
+                        # pg-log rows ride the merged transaction ONCE
+                        # per peer, not once per shard
+                        txn.append(self._shard_txn(
+                            oid, shard,
+                            chunks[shard] if state is not None else None,
+                            state, log_omap if i == 0 else {},
+                            log_rm if i == 0 else None, av=av))
+                    if osd == self.whoami:
+                        # one rollback-capture pass + one WAL append
+                        # for every local shard of this write
+                        if rb_kind:
+                            for shard in shards:
+                                self.rb_capture(txn, oid, shard, rb_kind,
+                                                0, 0, version)
+                        self.store.queue_transaction(
+                            txn, on_commit=lambda o=osd: op.ack(o))
+                    else:
+                        msg = m.MECSubWriteVec(
+                            self.pgid, epoch, oid,
+                            txn.to_bytes(), entries,
+                            rb=[(shard, rb_kind, 0, 0)
+                                for shard in shards],
+                            committed_to=committed_to)
+                        msg.tid = tid
+                        self.osd_send(osd, msg)
+                        msgs += 1
+                self._note_fanout(msgs)
+            finally:
+                if on_submitted is not None:
+                    on_submitted()
+
+        if state is None:
+            # deletes skip the device entirely; the sequencer keeps
+            # them from overtaking an encode still on the queue
+            self._fan_run(self._fan_ticket(), lambda: fanout([None] * n))
+            return
+        planes = self._prep_planes(state.data)
+        self._encode_then_fanout(
+            planes,
+            lambda coding: fanout(
+                self._chunks_of(planes, coding, self.k, self.m)),
+            self._encode_error_fn(tid, on_submitted, on_error))
+
+    def _encode_error_fn(self, tid, on_submitted, on_error):
+        """Unwind for a failed device encode: nothing was written or
+        sent anywhere, so drop the in-flight op (a later peer-change
+        must not complete it as success), let the PG roll back its
+        projected bookkeeping, and release the admission FIFO; the
+        client's write times out retryable."""
+        def unwind() -> None:
+            self.in_flight.pop(tid, None)
+            try:
+                if on_error is not None:
+                    on_error()
+            finally:
+                if on_submitted is not None:
+                    on_submitted()
+        return unwind
+
+    def apply_sub_write_vec(self, msg, on_commit=None) -> None:
+        """Peer side of MECSubWriteVec: ONE merged transaction covering
+        every local shard this write touches, with each overwritten
+        shard state snapshotted into the entry's rollback records first
+        — same crash atomicity as the per-shard path, at one WAL append
+        and one commit ack per write."""
+        txn = Transaction.from_bytes(msg.txn)
+        if msg.entries:
+            version = msg.entries[-1].version
+            for shard, kind, off, length in msg.rb:
+                if kind:
+                    self.rb_capture(txn, msg.oid, shard, kind, off,
+                                    length, version)
+        self.store.queue_transaction(txn, on_commit=on_commit)
 
     def apply_sub_write(self, msg, on_commit=None) -> None:
         """Shard side of MECSubWrite (handle_sub_write,
@@ -786,73 +989,108 @@ class ECBackend(PGBackend):
                        log_omap: Dict[str, bytes],
                        acting: Sequence[int],
                        on_commit: Callable[[], None],
-                       log_rm: Optional[List[str]] = None) -> None:
+                       log_rm: Optional[List[str]] = None,
+                       on_submitted: Optional[Callable[[], None]] = None,
+                       on_error: Optional[Callable[[], None]] = None
+                       ) -> None:
         """Write merged stripes [s0, s0+len) as per-shard EXTENTS — only
         the touched stripes move (reference three-stage RMW,
         ECBackend.cc:1791 start_rmw / :1892 try_reads_to_commit).
 
         The caller has merged the new bytes into `stripes`, which must
         be contiguous from s0; the merged content feeds the extent
-        cache so the next overlapping RMW skips its read phase.
+        cache so the next overlapping RMW skips its read phase.  Like
+        submit(), the parity encode is async (coalesces with every
+        other write in flight) and each peer gets ONE merged extent
+        transaction for all its shards.
         """
         S = len(stripes)
-        width = self.stripe_width
         buf = b"".join(bytes(stripes[s]) for s in range(s0, s0 + S))
         planes = np.frombuffer(buf, dtype=np.uint8).reshape(
             S, self.k, self.unit).transpose(1, 0, 2)
         planes = np.ascontiguousarray(planes.reshape(self.k, S * self.unit))
-        coding = np.asarray(self.queue.encode(self.codec, planes))
         for s in range(s0, s0 + S):
             self.cache.put(oid, s, bytes(stripes[s]))
 
         n = self.k + self.m
         shard_osds = list(acting[:n]) + [CRUSH_ITEM_NONE] * (n - len(acting))
+        peer_shards = self._peer_map(shard_osds)
         tid = self._new_tid()
-        waiting = {(shard, osd) for shard, osd in enumerate(shard_osds)
-                   if osd != CRUSH_ITEM_NONE and osd >= 0}
-
-        def done() -> None:
-            self._done(tid)
-            on_commit()
-
-        op = InFlightOp(waiting, done)
+        op = InFlightOp(set(peer_shards),
+                        lambda: (self._done(tid), on_commit()))
         self.in_flight[tid] = op
-        ext_off, _ = self.sinfo.chunk_extent(s0, s0 + S)
-        for shard, osd in enumerate(shard_osds):
-            if osd == CRUSH_ITEM_NONE or osd < 0:
-                continue
-            payload = (planes[shard] if shard < self.k
-                       else coding[shard - self.k]).tobytes()
-            t = Transaction()
-            g = GHObject(oid, shard=shard)
-            t.write(self.coll, g, ext_off, payload)
-            # whole-chunk crc can't survive an extent write (see
-            # _hinfo).  _av: partial writes stamp the shard version
-            # like full writes do, so the NEXT RMW base read can
-            # version-check its extents (a stale shard — degraded-
-            # skipped or not-yet-recovered — carries an older stamp
-            # and is excluded instead of corrupting the base)
-            attrs = {"hinfo": _hinfo(b"", size, False)}
-            if entries:
-                attrs["_av"] = _av_stamp(entries[-1].version)
-            t.setattrs(self.coll, g, attrs)
-            if log_omap:
-                t.touch(self.coll, _meta_oid())
-                t.omap_setkeys(self.coll, _meta_oid(), log_omap)
-            if log_rm:
-                t.omap_rmkeys(self.coll, _meta_oid(),
-                              list(log_rm) + self._rb_trim_keys(log_rm))
-            if osd == self.whoami:
-                if entries:
-                    self.rb_capture(t, oid, shard, RB_EXTENT, ext_off,
-                                    len(payload), entries[-1].version)
-                self.store.queue_transaction(
-                    t, on_commit=lambda s=shard, o=osd: op.ack((s, o)))
-            else:
-                msg = m.MECSubWrite(
-                    self.pgid, self.epoch_fn(), shard, t.to_bytes(),
-                    entries, oid=oid, rb_kind=RB_EXTENT, rb_off=ext_off,
-                    rb_len=len(payload),
-                    committed_to=self.committed_fn())
-                msg.tid = tid
-                self.osd_send(osd, msg)
+        ext_off, ext_len = self.sinfo.chunk_extent(s0, s0 + S)
+        version = entries[-1].version if entries else None
+        # minted under the pg lock, NOT in the deferred closure (see
+        # submit: a post-interval-change epoch would evade the peer's
+        # interval_epoch drop-gate)
+        epoch = self.epoch_fn()
+        committed_to = self.committed_fn()
+
+        def fanout(coding: np.ndarray) -> None:
+            try:
+                msgs = 0
+                for osd, shards in sorted(peer_shards.items()):
+                    txn = Transaction()
+                    for i, shard in enumerate(shards):
+                        payload = (planes[shard] if shard < self.k
+                                   else coding[shard - self.k]).tobytes()
+                        g = GHObject(oid, shard=shard)
+                        txn.write(self.coll, g, ext_off, payload)
+                        # whole-chunk crc can't survive an extent write
+                        # (see _hinfo).  _av: partial writes stamp the
+                        # shard version like full writes do, so the
+                        # NEXT RMW base read can version-check its
+                        # extents (a stale shard — degraded-skipped or
+                        # not-yet-recovered — carries an older stamp
+                        # and is excluded instead of corrupting the
+                        # base)
+                        attrs = {"hinfo": _hinfo(b"", size, False)}
+                        if version is not None:
+                            attrs["_av"] = _av_stamp(version)
+                        txn.setattrs(self.coll, g, attrs)
+                        if i == 0:
+                            if log_omap:
+                                txn.touch(self.coll, _meta_oid())
+                                txn.omap_setkeys(self.coll, _meta_oid(),
+                                                 log_omap)
+                            if log_rm:
+                                txn.omap_rmkeys(
+                                    self.coll, _meta_oid(),
+                                    list(log_rm)
+                                    + self._rb_trim_keys(log_rm))
+                    if osd == self.whoami:
+                        if version is not None:
+                            for shard in shards:
+                                self.rb_capture(txn, oid, shard,
+                                                RB_EXTENT, ext_off,
+                                                ext_len, version)
+                        self.store.queue_transaction(
+                            txn, on_commit=lambda o=osd: op.ack(o))
+                    else:
+                        msg = m.MECSubWriteVec(
+                            self.pgid, epoch, oid,
+                            txn.to_bytes(), entries,
+                            rb=[(shard, RB_EXTENT, ext_off, ext_len)
+                                for shard in shards],
+                            committed_to=committed_to)
+                        msg.tid = tid
+                        self.osd_send(osd, msg)
+                        msgs += 1
+                self._note_fanout(msgs)
+            finally:
+                if on_submitted is not None:
+                    on_submitted()
+
+        unwind = self._encode_error_fn(tid, on_submitted, on_error)
+
+        def unwind_with_cache() -> None:
+            # the merged stripes were cached optimistically above, but
+            # the encode failed before anything landed: a later RMW
+            # must not read them as committed content
+            self.cache.invalidate(oid)
+            unwind()
+
+        self._encode_then_fanout(
+            planes, lambda coding: fanout(np.asarray(coding)),
+            unwind_with_cache)

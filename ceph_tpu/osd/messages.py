@@ -215,6 +215,80 @@ class MECSubWrite(_PGMessage):
 
 
 @register
+class MECSubWriteVec(_PGMessage):
+    """Primary -> EC peer: ALL of the peer's shard transactions for one
+    write, merged into a single store transaction (the per-peer
+    aggregation of the pipelined write engine).  On a k=8,m=4 pool over
+    3 OSDs the per-(shard,peer) MECSubWrite fan-out cost ~11 messages
+    and ~11 store transactions per write; this carries one message and
+    ONE merged transaction per peer — one rollback-capture pass, one
+    WAL append, one commit ack.
+
+    `rb` holds one (shard, rb_kind, rb_off, rb_len) descriptor per
+    shard the transaction mutates, so the receiver can snapshot every
+    overwritten shard state into the entry's rollback records inside
+    the SAME transaction (the MECSubWrite v2 discipline, vectorized).
+    `committed_to` piggybacks the primary's roll-forward watermark.
+
+    The scalar MECSubWrite stays registered and applied for
+    mixed-version peers: an old primary's per-shard sub-writes must
+    keep decoding and applying byte-for-byte."""
+
+    TYPE = 48
+    VERSION = 1
+
+    def __init__(self, pgid=(0, 0), epoch=0, oid: str = "",
+                 txn: bytes = b"",
+                 entries: Optional[List[LogEntry]] = None,
+                 rb: Optional[List[Tuple[int, int, int, int]]] = None,
+                 committed_to: Optional[EVersion] = None) -> None:
+        super().__init__(pgid, epoch)
+        self.oid = oid
+        self.txn = txn
+        self.entries = entries or []
+        self.rb = rb or []  # [(shard, rb_kind, rb_off, rb_len), ...]
+        self.committed_to = committed_to or EVersion()
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.string(self.oid).blob(self.txn)
+        e.seq(self.entries, lambda enc, en: en.encode(enc))
+        e.seq(self.rb, lambda enc, r: enc.s32(r[0]).u8(r[1])
+              .u64(r[2]).u64(r[3]))
+        self.committed_to.encode(e)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.oid = d.string()
+        self.txn = d.blob()
+        self.entries = d.seq(LogEntry.decode)
+        self.rb = d.seq(lambda dd: (dd.s32(), dd.u8(), dd.u64(),
+                                    dd.u64()))
+        self.committed_to = EVersion.decode(d)
+
+
+@register
+class MECSubWriteVecReply(_PGMessage):
+    """One commit ack per peer per write (the vec twin of
+    MECSubWriteReply; no shard field — the whole merged transaction
+    committed or nothing did)."""
+
+    TYPE = 49
+
+    def __init__(self, pgid=(0, 0), epoch=0, result: int = 0) -> None:
+        super().__init__(pgid, epoch)
+        self.result = result
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.s32(self.result)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.result = d.s32()
+
+
+@register
 class MECSubWriteReply(_PGMessage):
     TYPE = 15
 

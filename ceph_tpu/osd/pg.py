@@ -15,12 +15,18 @@ The shape kept here:
 - scrub (PG.cc:4839): primary gathers per-shard digests and compares;
   EC shards verify stored HashInfo crcs (ECBackend handle_sub_read)
 
-Writes are strictly ordered per PG by the OSD's sharded queue; reads
-execute on the primary.
+Writes run through a pipelined per-object engine (the reference's
+start_rmw/check_ops in-flight pipeline, ECBackend.cc:2098): each oid
+has an admission FIFO — same-object writes stay strictly ordered, with
+the successor's state read served from the predecessor's projected
+(applied-not-yet-committed) state — while writes to different objects
+in one PG overlap in flight; nothing blocks a workqueue shard waiting
+for shard acks.  Reads execute on the primary.
 """
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -62,6 +68,23 @@ ESTALE = -116
 STATE_PEERING = "peering"
 STATE_ACTIVE = "active"
 STATE_DEGRADED = "active+degraded"
+
+# a client write whose commit never arrives (a live-but-silent shard
+# holder the map never resolves) answers retryable after this long —
+# the async replacement for the old block-with-timeout
+WRITE_TIMEOUT_S = 30.0
+
+
+class _OidPipe:
+    """One object's write-admission FIFO (the obc ordering role): the
+    head write owns the object until its transactions have fanned out
+    (on_submitted); queued successors then read its projected state."""
+
+    __slots__ = ("queue", "busy")
+
+    def __init__(self) -> None:
+        self.queue: "collections.deque" = collections.deque()
+        self.busy = False
 
 
 class PG:
@@ -132,12 +155,34 @@ class PG:
         # roll-forward watermark rides EC sub-writes (divergent-entry
         # rollback must never rewind past an acked write)
         self.backend.committed_fn = lambda: self.info.committed_to
+        self.backend.log = getattr(osd, "_log", self.backend.log)
+        self.backend.perf = getattr(osd, "pg_perf", None)
+        # -- pipelined write engine state -----------------------------
+        # per-object admission FIFOs + the in-flight bookkeeping that
+        # replaced the old block-until-commit wait (leaf lock: taken
+        # under the pg lock, never around it)
+        self._pipe_lock = make_lock("pg.write_pipe")
+        self._oid_pipes: Dict[str, _OidPipe] = {}
+        # reqid -> expiry of writes submitted but not yet committed: a
+        # client resend racing its own in-flight original answers
+        # EAGAIN instead of re-executing (exactly-once); entries expire
+        # so a wedged original can't livelock the resend forever
+        self._inflight_reqids: Dict[str, float] = {}
+        # (deadline, replied-flag, fire) rows for in-flight client
+        # writes, swept by the osd watchdog: a shard that never acks
+        # becomes a retryable EAGAIN instead of silence; replied rows
+        # are pruned each tick so committed writes don't pin payloads
+        self._write_deadlines: List[
+            Tuple[float, List[bool], Callable[[], None]]] = []
         # peering-watchdog backoff state (exponential per PG)
         self._wd_backoff = 0.0
         self._wd_next = 0.0
         # leaf lock for the roll-forward watermark CAS (commit
-        # callbacks race it from shard-ack threads)
+        # callbacks race it from shard-ack threads); _ct_dirty marks a
+        # healthy-path watermark advance whose broadcast was absorbed
+        # into the next sub-write's piggyback (flush_commit_note)
         self._ct_lock = make_lock("pg.committed_to")
+        self._ct_dirty = False
 
     # -- identity ---------------------------------------------------------
     def is_primary(self) -> bool:
@@ -268,7 +313,11 @@ class PG:
             self._do_notify(msg, reply)
             return
         if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_SNAPTRIM:
-            self._do_snaptrim(msg, reply)
+            # snaptrim RMWs the head's SnapSet: it rides the same
+            # per-object admission FIFO as pipelined client writes so
+            # the two can never interleave on one object
+            self._oid_admit(msg.oid,
+                            lambda: self._snaptrim_job(msg, reply))
             return
         if len(msg.ops) == 1 and msg.ops[0].op == t_.OP_SNAPTRIMPG:
             self._do_snaptrim_pg(msg, reply)
@@ -276,9 +325,9 @@ class PG:
         with self.lock:
             writes = any(o.is_write() or self._call_is_write(o)
                          for o in msg.ops)
-        # _do_write manages the lock itself: it must NOT be held while
-        # waiting for shard acks, or an inline replica apply (which
-        # takes it) from a peer waiting on OUR ack deadlocks both
+        # _do_write manages the lock itself: writes pipeline through
+        # the per-object admission FIFO and never hold the lock (or
+        # this workqueue shard) across their commit waits
         if writes:
             self._do_write(msg, reply)
         else:
@@ -372,8 +421,10 @@ class PG:
                    done: Callable[[Optional[ObjectState]], None]) -> None:
         """Fetch current full object state (degraded-aware for EC),
         served from the object-context cache when warm (the reference's
-        object_contexts LRU, PrimaryLogPG::get_object_context): per-PG
-        write ordering makes the cached copy read-your-writes."""
+        object_contexts LRU, PrimaryLogPG::get_object_context):
+        per-object write ordering publishes each write's projected
+        state here BEFORE its successor is admitted, so the cached
+        copy is read-your-writes even with commits still in flight."""
         # the copy happens INSIDE the lru lock; `done` runs without it
         # (it may execute ops and send replies — never under a mutex)
         cached = self._obc.get(oid, copy=lambda s: ObjectState(
@@ -629,6 +680,19 @@ class PG:
             reply_once(m.MOSDOpReply(self.pgid, self.osd.epoch(),
                                      msg.oid, msg.ops, result=EAGAIN))
 
+    def _snaptrim_job(self, msg, reply,
+                      done: Optional[threading.Event] = None) -> None:
+        """Admission-FIFO wrapper for one snaptrim: unlike client
+        writes it holds the object until its commit wait resolves
+        (_do_snaptrim blocks internally) — trim correctness beats
+        pipelining here."""
+        try:
+            self._do_snaptrim(msg, reply)
+        finally:
+            if done is not None:
+                done.set()
+            self._oid_release(msg.oid)
+
     def _do_snaptrim_pg(self, msg, reply) -> None:
         """Trim clones of one snap in this PG, fed by the SnapMapper
         index (the reference snap-trimmer work queue:
@@ -654,7 +718,12 @@ class PG:
                 reqid=f"{getattr(msg, 'reqid', 'snaptrim')}/{oid}",
                 snap_seq=0, snaps=[], snapid=0)
             box: List = []
-            self._do_snaptrim(shim, box.append)
+            ev = threading.Event()
+            # admission-ordered against pipelined client writes; the
+            # job may defer behind an in-flight write, so wait for it
+            self._oid_admit(oid, lambda s=shim: self._snaptrim_job(
+                s, box.append, done=ev))
+            ev.wait(timeout=2 * WRITE_TIMEOUT_S)
             rc = box[0].result if box else EAGAIN
             if rc == 0:
                 trimmed += 1
@@ -803,11 +872,91 @@ class PG:
             return EINVAL
         return 0
 
+    # -- pipelined write admission (per-object ordering) -------------------
+    def _oid_admit(self, oid: str, job: Callable[[], None]) -> None:
+        """Admit a write job into `oid`'s FIFO: runs now when the
+        object is idle, else queues behind the in-flight head.  Jobs
+        must call _oid_release(oid) exactly once, when their submit
+        phase (state read -> exec -> fan-out queued) has finished —
+        NOT at commit: that is what lets same-object writes pipeline
+        while staying strictly ordered."""
+        with self._pipe_lock:
+            pipe = self._oid_pipes.get(oid)
+            if pipe is None:
+                pipe = self._oid_pipes[oid] = _OidPipe()
+            if pipe.busy:
+                pipe.queue.append(job)
+                return
+            pipe.busy = True
+        job()
+
+    def _oid_release(self, oid: str) -> None:
+        """Head write's submit phase done: admit the successor.  It
+        runs on a fresh thread — release can fire under the pg lock
+        (synchronous replicated fan-out) or on the fan-out lane (async
+        EC encode), and the successor both takes the pg lock and may
+        BLOCK for seconds on a remote state read (obc miss), so it
+        must not ride a shared single-worker lane where it would
+        head-of-line-block every other write's fan-out.  The spawn
+        (~0.1 ms) only happens when same-object writes actually
+        overlap."""
+        with self._pipe_lock:
+            pipe = self._oid_pipes.get(oid)
+            if pipe is None:
+                return
+            if not pipe.queue:
+                pipe.busy = False
+                del self._oid_pipes[oid]  # holds only active oids
+                return
+            job = pipe.queue.popleft()
+        threading.Thread(target=job, daemon=True,
+                         name="pg-write-pipe").start()
+
+    def _arm_write_deadline(self, replied: List[bool],
+                            fire: Callable[[], None],
+                            timeout: float = WRITE_TIMEOUT_S) -> None:
+        """`replied` is the write's reply-once flag: the sweep drops
+        rows whose reply already went out (commit or error), so a
+        committed write's closure — which pins the whole MOSDOp and
+        its payload — lives ~one watchdog tick, not the full 30 s."""
+        with self._pipe_lock:
+            self._write_deadlines.append((time.monotonic() + timeout,
+                                          replied, fire))
+
+    def sweep_write_timeouts(self) -> None:
+        """Answer retryably for in-flight writes whose commit never
+        came (a shard never acked and no map change resolved it) —
+        called periodically by the osd watchdog loop.  Also prunes
+        rows already replied (committed) and expired in-flight reqid
+        marks."""
+        now = time.monotonic()
+        due: List[Callable[[], None]] = []
+        with self._pipe_lock:
+            if not self._write_deadlines and not self._inflight_reqids:
+                return
+            keep = []
+            for row in self._write_deadlines:
+                if row[1][0]:
+                    continue  # replied (committed/errored): drop
+                (due if row[0] <= now else keep).append(row)
+            self._write_deadlines = keep
+            stale = [r for r, t in self._inflight_reqids.items()
+                     if t <= now]
+            for r in stale:
+                del self._inflight_reqids[r]
+        for row in due:
+            row[2]()
+
+    def _note_inflight(self, delta: int) -> None:
+        note = getattr(self.osd, "note_write_inflight", None)
+        if note is not None:
+            note(delta)
+
     def _do_write(self, msg, reply):
         self.record_hit(msg.oid)
-        # completed-op replay: a resend of an already-committed write
-        # answers from the log instead of re-executing (exactly-once
-        # even if the previous primary died after commit)
+        # completed-op replay fast path: a resend of an already-
+        # committed write answers from the log without queueing (the
+        # authoritative re-check runs again after admission)
         reqid = getattr(msg, "reqid", "")
         if reqid:
             with self.lock:
@@ -816,20 +965,153 @@ class PG:
                 reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                     msg.ops, result=0, version=done_v))
                 return
-        # partial-stripe EC overwrite fast path: a single ranged write
-        # inside the object moves only the touched stripes (reference
-        # start_rmw, ECBackend.cc:1791) instead of re-encoding the
-        # whole object
-        if (self.is_ec() and len(msg.ops) == 1
-                and msg.ops[0].op == t_.OP_WRITE and msg.ops[0].data
-                and self._try_partial_write(msg, reply)):
-            return
-        # writes run START-TO-COMMIT on the pg's queue shard: the state
-        # read is synchronous and we block on the commit before the next
-        # queued op dispatches, so two writes to one object can never
-        # read the same base state (per-PG ordering, the reference's
-        # strictly-ordered RMW pipeline, ECBackend.cc:2098)
-        state = self._read_state_sync(msg.oid, raw_retry=True)
+        # per-object admission (pipelined write engine): same-object
+        # writes stay strictly ordered — the successor runs only after
+        # the predecessor's transactions fanned out, so its state read
+        # sees the projected (applied-not-yet-committed) state — while
+        # writes to different objects proceed concurrently.  Nothing
+        # blocks this workqueue shard waiting for shard acks anymore.
+        self._oid_admit(msg.oid, lambda: self._execute_write(msg, reply))
+
+    def _execute_write(self, msg, reply):
+        """Head of `msg.oid`'s admission FIFO: state read -> op exec ->
+        submit.  Releases the FIFO when the backend reports the fan-out
+        queued (on_submitted) or on any early-bail reply; the commit
+        callback replies to the client later, off this thread."""
+        released = [False]
+
+        def release() -> None:
+            if released[0]:
+                return
+            released[0] = True
+            self._oid_release(msg.oid)
+
+        reqid = getattr(msg, "reqid", "")
+        req_marked = False
+        submitted = False
+        try:
+            with self.lock:
+                # admission may long postdate do_op's gate (queued
+                # behind an in-flight head): re-check so a queued
+                # write never executes against a stale interval —
+                # both answers are retryable, semantics unchanged
+                if not self.is_primary():
+                    reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                        msg.oid, msg.ops, result=ESTALE))
+                    return
+                if self.state == STATE_PEERING:
+                    reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                        msg.oid, msg.ops, result=EAGAIN))
+                    return
+            if reqid:
+                # replay check, in-flight dup check, and the mark are
+                # ONE atomic step against on_commit's register+unmark
+                # (reading them under different locks left a window —
+                # original commits between the two reads — where a
+                # resend re-executed and an append landed twice)
+                with self._pipe_lock:
+                    done_v = self._reqids.get(reqid)
+                    dup = (done_v is None
+                           and reqid in self._inflight_reqids)
+                    if done_v is None and not dup:
+                        self._inflight_reqids[reqid] = (
+                            time.monotonic() + 2 * WRITE_TIMEOUT_S)
+                        req_marked = True
+                if done_v is not None:
+                    reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                        msg.oid, msg.ops, result=0,
+                                        version=done_v))
+                    return
+                if dup:
+                    # resend racing its own in-flight original: never
+                    # re-execute (exactly-once); by the client's next
+                    # retry the original has committed and the replay
+                    # guard answers
+                    reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                        msg.oid, msg.ops, result=EAGAIN))
+                    return
+            # partial-stripe EC overwrite fast path: a single ranged
+            # write inside the object moves only the touched stripes
+            # (reference start_rmw, ECBackend.cc:1791) instead of
+            # re-encoding the whole object
+            if (self.is_ec() and len(msg.ops) == 1
+                    and msg.ops[0].op == t_.OP_WRITE and msg.ops[0].data
+                    and self._try_partial_write(msg, reply,
+                                                on_submitted=release)):
+                submitted = True
+                return
+            submitted = self._execute_full_write(msg, reply, release)
+        finally:
+            if not submitted:
+                if req_marked:
+                    with self._pipe_lock:
+                        self._inflight_reqids.pop(reqid, None)
+                release()
+
+    def _writefull_fast_state(self, oid: str):
+        """Local-only RMW base for all-WRITEFULL ops on a clean PG:
+        the data is replaced wholesale, so only existence + xattrs +
+        omap matter — and the primary's OWN copy answers those without
+        the read phase (EC: no sub-read round, no decode — every shard
+        object carries the full xattrs/omap; replicated: no 64KiB data
+        read of bytes about to be discarded).  The reference's
+        full-object writes likewise skip the read side of the RMW.
+        Returns a 1-tuple (state-or-None) when the local answer is
+        authoritative, else None (degraded/stale-local: take the
+        degraded-aware read path).  Ordering: runs as the head of the
+        oid's admission FIFO, so the projected-state cache is checked
+        first like any other state read."""
+        from ceph_tpu.osd.backend import _av_stamp
+
+        cached = self._obc.get(oid, copy=lambda s: ObjectState(
+            s.data, dict(s.xattrs), dict(s.omap)))
+        if cached is not None:
+            return (cached,)
+        with self.lock:
+            if self.state != STATE_ACTIVE or oid in self.missing:
+                return None  # degraded: testimony may live elsewhere
+            en = self.log.latest_for(oid)
+            acting = list(self.acting)
+        if en is not None and en.op == t_.LOG_DELETE:
+            return (None,)  # the log's newest word: deleted
+        if not self.is_ec():
+            g = GHObject(oid)
+            if not self.osd.store.exists(self.coll, g):
+                return (None,) if en is None else None
+            return (ObjectState(
+                b"", dict(self.osd.store.getattrs(self.coll, g)),
+                dict(self.osd.store.omap_get(self.coll, g))),)
+        shards = self.backend.local_shards(acting)
+        if not shards:
+            return None
+        attrs, omap = self.backend.shard_meta(oid, shards[0])
+        if not attrs and not omap:
+            if en is not None:
+                # log says live but our shard is gone: let the
+                # degraded-aware read path arbitrate
+                return None
+            return (None,)  # clean PG, no shard, no entry: absent
+        if en is not None and attrs.get("_av") != _av_stamp(en.version):
+            return None  # stale local shard (e.g. mid-recovery)
+        xa = {k: v for k, v in attrs.items()
+              if k not in ("hinfo", "_av")}
+        # data is a placeholder: every op in the message replaces it
+        return (ObjectState(b"", xa, dict(omap)),)
+
+    def _execute_full_write(self, msg, reply, on_submitted) -> bool:
+        """The RMW body: returns True once the write was handed to the
+        backend (on_submitted then owns the FIFO release)."""
+        # the state read is ordered by admission, not by blocking: the
+        # predecessor's projected state is already in the object-
+        # context cache, so same-object writes never read the same base
+        fast = None
+        if (msg.ops
+                and all(op.op == t_.OP_WRITEFULL for op in msg.ops)):
+            fast = self._writefull_fast_state(msg.oid)
+        if fast is not None:
+            state = fast[0]
+        else:
+            state = self._read_state_sync(msg.oid, raw_retry=True)
         supersede = False
         if state is READ_RETRY:
             if (self.is_ec() and msg.ops
@@ -869,8 +1151,7 @@ class PG:
                 # retryable
                 reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
                                     msg.oid, msg.ops, result=EAGAIN))
-                return
-        committed = threading.Event()
+                return False
         # exactly one reply per op, whether commit or timeout wins
         _replied = [False]
         _rlock = make_lock("pg.reply_once")
@@ -917,7 +1198,7 @@ class PG:
             if result < 0:
                 reply_once(m.MOSDOpReply(self.pgid, self.osd.epoch(),
                                          msg.oid, msg.ops, result=result))
-                return
+                return False
             pre = self._snap_pre_txn(msg, state, work)
             commit_state = None if delete else work
             if delete:
@@ -936,20 +1217,21 @@ class PG:
                               "whiteout": b"1"}, {})
                     delete = False
             self._commit_write(msg, commit_state, delete,
-                               reply_once, committed, pre_txn=pre)
+                               reply_once, pre_txn=pre,
+                               on_submitted=on_submitted)
             if supersede:
                 # the full rewrite just queued supersedes the
                 # unrecovered generation — the missing marker (if any)
                 # refers to history this write replaced, and leaving it
                 # would EAGAIN every read of the now-current object
                 self.missing.pop(msg.oid, None)
-        # wait OUTSIDE the lock: inline replica handlers need it
-        if not committed.wait(timeout=30.0):
-            # a shard never acked and no map change resolved it: answer
-            # with a retryable error instead of silence (the reference
-            # requeues; the client's resend discipline retries EAGAIN)
-            reply_once(m.MOSDOpReply(self.pgid, self.osd.epoch(),
-                                     msg.oid, msg.ops, result=EAGAIN))
+        # no commit wait: the commit callback replies; the watchdog
+        # sweep answers retryably if no shard ack ever resolves it
+        # (the reference requeues; the client's resend retries EAGAIN)
+        self._arm_write_deadline(_replied, lambda: reply_once(
+            m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                          msg.ops, result=EAGAIN)))
+        return True
 
     def _exec_write_op(self, op: OSDOp, st: ObjectState,
                        exists: bool) -> Tuple[int, bool]:
@@ -1064,16 +1346,17 @@ class PG:
                         extents[rep.shard] = rep.data
         return be.assemble_range(extents, s0, s1)
 
-    def _try_partial_write(self, msg, reply) -> bool:
+    def _try_partial_write(self, msg, reply, on_submitted=None) -> bool:
         """Returns True when the write was handled as per-shard extent
-        writes of only the touched stripes."""
+        writes of only the touched stripes; `on_submitted` (the
+        admission-FIFO release) then fires once the extent transactions
+        have fanned out."""
         wop = msg.ops[0]
         be: ECBackend = self.backend  # type: ignore[assignment]
         if not be.can_partial(msg.oid, wop.off, len(wop.data)):
             return False
         width = be.stripe_width
         s0, s1 = be.sinfo.stripe_range(wop.off, len(wop.data))
-        committed = threading.Event()
         _replied = [False]
         _rlock = make_lock("pg.reply_once")
 
@@ -1116,27 +1399,34 @@ class PG:
             log_rm = self.log.omap_removals(self.log.trim_to())
 
             def on_commit() -> None:
-                self._note_reqid(entry)
+                # register + unmark atomically (see _commit_write)
+                if entry.reqid:
+                    with self._pipe_lock:
+                        self._note_reqid(entry)
+                        self._inflight_reqids.pop(entry.reqid, None)
                 self._note_committed(version)
+                self._note_inflight(-1)
                 reply_once(m.MOSDOpReply(
                     self.pgid, self.osd.epoch(), msg.oid, msg.ops,
                     result=0, version=version))
-                committed.set()
 
             # WRITE: per-shard extents of the touched stripes only
             self._obc_invalidate(msg.oid)  # extents bypass full state
+            self._note_inflight(1)
             be.submit_partial(msg.oid, s0, stripes, size, [entry],
                               log_omap, self.acting, on_commit,
-                              log_rm=log_rm)
-        if not committed.wait(timeout=30.0):
-            reply_once(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
-                                     msg.ops, result=EAGAIN))
+                              log_rm=log_rm, on_submitted=on_submitted,
+                              on_error=self._write_unwind_fn(
+                                  msg.oid, entry))
+        self._arm_write_deadline(_replied, lambda: reply_once(
+            m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                          msg.ops, result=EAGAIN)))
         return True
 
     def _commit_write(self, msg, state: Optional[ObjectState],
                       delete: bool, reply,
                       committed: Optional[threading.Event] = None,
-                      pre_txn=None) -> None:
+                      pre_txn=None, on_submitted=None) -> None:
         version = self._next_version()
         entry = LogEntry(
             op=t_.LOG_DELETE if delete else t_.LOG_MODIFY,
@@ -1157,9 +1447,16 @@ class PG:
         def on_commit() -> None:
             # replay registration happens at COMMIT, not append: a write
             # that never reached quorum (EAGAIN to client) must not be
-            # answered as done on resend
-            self._note_reqid(entry)
+            # answered as done on resend.  Registration and the
+            # in-flight-mark removal are one atomic step under
+            # _pipe_lock: a resend's dup check must see either the
+            # mark or the registered reqid, never neither
+            if entry.reqid:
+                with self._pipe_lock:
+                    self._note_reqid(entry)
+                    self._inflight_reqids.pop(entry.reqid, None)
             self._note_committed(version)
+            self._note_inflight(-1)
             reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                 msg.ops, result=0, version=version))
             if committed is not None:
@@ -1168,11 +1465,33 @@ class PG:
         kw = {"log_rm": log_rm}
         if pre_txn is not None:
             kw["pre_txn"] = pre_txn
-        # the queued write IS the newest state (per-PG ordering):
+        if on_submitted is not None:
+            kw["on_submitted"] = on_submitted
+        if self.is_ec():
+            kw["on_error"] = self._write_unwind_fn(msg.oid, entry)
+        # the queued write IS the newest state (published BEFORE the
+        # backend submit, so a same-object successor admitted at
+        # on_submitted reads its predecessor's projected state):
         # read-your-writes from the context cache
         self._obc_put(msg.oid, None if delete else state)
+        self._note_inflight(1)
         self.backend.submit(msg.oid, state, [entry], log_omap,
                             self.acting, on_commit, **kw)
+
+    def _write_unwind_fn(self, oid: str, entry: LogEntry):
+        """Unwind for a write whose device encode failed (nothing was
+        stored or sent anywhere): un-publish the projected state and
+        drop the in-flight bookkeeping so the client's retry can
+        re-execute.  The log entry stays, like any write whose shards
+        never ack; readers version-check _av and answer retryably
+        until the retry re-mints the head."""
+        def unwind() -> None:
+            self._obc_invalidate(oid)
+            self._note_inflight(-1)
+            if entry.reqid:
+                with self._pipe_lock:
+                    self._inflight_reqids.pop(entry.reqid, None)
+        return unwind
 
     # -- replica apply ----------------------------------------------------
     # Sub-write acks fire from the STORE's commit callback, not inline:
@@ -1218,6 +1537,29 @@ class PG:
                     # rollback
                     self.info.committed_to = msg.committed_to
 
+    def handle_sub_write_vec(self, msg: m.MECSubWriteVec, conn) -> None:
+        """Peer side of the aggregated sub-write: ONE merged store
+        transaction for every shard this peer holds of the op (one
+        rollback-capture pass, one WAL append), ONE commit ack.  Same
+        interval gating and watermark merge as handle_sub_write."""
+        def _ack() -> None:
+            rep = m.MECSubWriteVecReply(self.pgid, self.osd.epoch(), 0)
+            rep.tid = msg.tid
+            conn.send(rep)
+
+        with self.lock:
+            if msg.epoch < self.interval_epoch:
+                # minted in an OLDER interval: applying it would
+                # overwrite recovered data with the past (see
+                # handle_sub_write) — drop, the primary's interval
+                # change already re-resolved the repop
+                return
+            self.backend.apply_sub_write_vec(msg, on_commit=_ack)
+            self._note_entries(msg.entries)
+            with self._ct_lock:
+                if msg.committed_to > self.info.committed_to:
+                    self.info.committed_to = msg.committed_to
+
     def _note_entries(self, entries: List[LogEntry]) -> None:
         for en in entries:
             if en.version > self.log.head:
@@ -1248,19 +1590,50 @@ class PG:
         (never the pg lock — lockdep's checked mutex is not
         reentrant), because two shard-ack threads racing it bare
         could store out of order and REGRESS the watermark below an
-        already-broadcast note."""
+        already-broadcast note.
+
+        Broadcast policy (pipelined-write-engine cost cut): a HEALTHY
+        full-width commit needs no eager note — every acting shard
+        holds the entry, so the >=k-holders roll-forward rule protects
+        it through any later death pattern (and the no-rollback-while-
+        the-acting-set-has-a-hole rule covers the interim).  Those
+        notes (two extra messages plus two peer-side pg-meta persists
+        PER WRITE at depth 16) are absorbed into the committed_to
+        piggyback on the next sub-write, with the watchdog sweep
+        flushing the idle tail.  A DEGRADED commit — exactly the
+        round-6 trace, acked on as few as k live shards — still
+        broadcasts immediately, before the client reply is enqueued."""
         with self._ct_lock:
             if version <= self.info.committed_to:
                 return
             self.info.committed_to = version
         if not self.is_ec() or self.primary != self.osd.whoami:
             return
-        note = None
+        if self.state == STATE_ACTIVE:
+            with self._ct_lock:
+                self._ct_dirty = True
+            return
+        self._broadcast_commit_note(version)
+
+    def _broadcast_commit_note(self, version: EVersion) -> None:
         for osd_id in self.acting:
             if osd_id in (self.osd.whoami, CRUSH_ITEM_NONE) or osd_id < 0:
                 continue
             note = m.MECCommitNote(self.pgid, self.osd.epoch(), version)
             self.osd.send_to_osd(osd_id, note)
+
+    def flush_commit_note(self) -> None:
+        """Tail flush for absorbed healthy-path watermark advances:
+        called by the osd watchdog tick (and the sweep), so shards
+        persist the newest watermark within ~a second of the last
+        commit even with no further writes to piggyback on."""
+        with self._ct_lock:
+            if not self._ct_dirty:
+                return
+            self._ct_dirty = False
+            version = self.info.committed_to
+        if self.is_ec() and self.primary == self.osd.whoami:
+            self._broadcast_commit_note(version)
 
     def handle_commit_note(self, msg: m.MECCommitNote, conn) -> None:
         """Shard side of the roll-forward watermark: merge and PERSIST

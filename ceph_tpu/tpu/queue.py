@@ -57,6 +57,11 @@ class StripeBatchQueue:
         self.batches = 0       # perf: device dispatches
         self.jobs = 0          # perf: logical encodes
         self.bytes_in = 0      # perf: plane bytes that rode the queue
+        # jobs-per-batch histogram {width: batches}: the direct
+        # evidence of whether concurrent writes actually coalesced
+        # (mean width 1.0 == the pipeline fed the queue one job at a
+        # time and the batching engine idled)
+        self.batch_jobs: Dict[int, int] = {}
 
     def start(self) -> None:
         with self._lock:
@@ -204,6 +209,8 @@ class StripeBatchQueue:
                     off += w
             self.batches += 1
             self.jobs += len(batch)
+            self.batch_jobs[len(batch)] = (
+                self.batch_jobs.get(len(batch), 0) + 1)
             self.bytes_in += sum(j.planes.nbytes for j in batch)
         except BaseException as e:  # noqa: BLE001 — propagate to callers
             for j in batch:

@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""One A/B trial of cluster-IO write IOPS at depth 16 (the PR-4
+pipelined-write-engine acceptance metric).  Imports ceph_tpu from
+PYTHONPATH so the same script measures any checkout; prints JSON.
+Interleave trials A,B,A,B,... from a driver to cancel rig drift."""
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    from ceph_tpu.client.rados import OSDOp
+    from ceph_tpu.osd import types as t_
+    from ceph_tpu.tpu.queue import default_queue
+    from ceph_tpu.vstart import VStartCluster
+
+    depth = 16
+    payload = b"b" * 65536
+    out = {}
+
+    def run(io, n, mk):
+        pend = []
+        t0 = time.perf_counter()
+        for i in range(n):
+            pend.append(io.aio_operate(f"ab_{n}_{i}", mk()))
+            if len(pend) >= depth:
+                pend.pop(0).result(60.0)
+        for p in pend:
+            p.result(60.0)
+        return n / (time.perf_counter() - t0)
+
+    def wf():
+        return [OSDOp(t_.OP_WRITEFULL, data=payload)]
+
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        rep = c.create_pool("ab_rep", size=2)
+        io = c.client().ioctx(rep)
+        run(io, 16, wf)  # warmup: peering, sockets, codec jit
+        out["rep_write_iops"] = round(run(io, 128, wf), 1)
+        ec = c.create_pool("ab_ec", size=3, pool_type="erasure",
+                           ec_profile="k=2 m=1")
+        ioec = c.client().ioctx(ec)
+        run(ioec, 16, wf)
+        dq = default_queue()
+        j0, b0 = dq.jobs, dq.batches
+        out["ec_write_iops"] = round(run(ioec, 96, wf), 1)
+        d_b = dq.batches - b0
+        out["ec_mean_jobs_per_batch"] = round(
+            (dq.jobs - j0) / d_b, 2) if d_b else 0.0
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,8 @@ def test_replicated_write_completes_when_peer_dies():
 
 
 def test_ec_write_completes_when_shard_holder_dies():
+    import threading
+
     from ceph_tpu.ec import codec_from_profile
 
     coll = Collection("2.0_head")
@@ -69,12 +71,78 @@ def test_ec_write_completes_when_shard_holder_dies():
                    lambda osd, msg: sent.append((osd, msg)), lambda: 1,
                    codec)
     done = []
+    done_ev = threading.Event()
+    submitted = threading.Event()
     be.submit("o", ObjectState(b"y" * 64), [], {}, [0, 1, 2],
-              lambda: done.append(1))
+              lambda: (done.append(1), done_ev.set()),
+              on_submitted=submitted.set)
+    assert submitted.wait(10), "async fan-out never queued"
+    assert len(sent) == 2  # one MECSubWriteVec per PEER, not per shard
     assert not done
     be.on_peer_change({0, 1})   # shard 2's holder (osd.2) died
     assert not done
-    # surviving remote shard acks normally
+    # surviving remote peer acks its merged transaction normally; the
+    # local store commit ack (osd 0) rides the commit pipeline
     tid = next(iter(be.in_flight))
-    be.handle_reply(tid, (1, 1))
+    be.handle_reply(tid, 1)
+    assert done_ev.wait(10)
     assert done == [1]
+
+
+def test_ec_subwrites_aggregate_per_peer():
+    """k=4,m=2 over 3 OSDs: the old fan-out shipped one MECSubWrite per
+    (shard, peer) pair — 4 remote messages here; the vec fan-out ships
+    ONE merged transaction per peer carrying both of its shards, and
+    the receiving peer lands both shards (plus both rollback records)
+    in a single store transaction."""
+    import threading
+
+    from ceph_tpu.ec import codec_from_profile
+    from ceph_tpu.osd import messages as om
+    from ceph_tpu.osd.pglog import rollback_prefix
+    from ceph_tpu.osd.types import EVersion, LogEntry
+    from ceph_tpu.store.objectstore import GHObject
+
+    coll = Collection("3.0_head")
+    store = _store_with(coll)
+    peer_store = _store_with(coll)
+    sent = []
+    codec = codec_from_profile("plugin=isa k=4 m=2 technique=reed_sol_van")
+    be = ECBackend((3, 0), coll, store, 0,
+                   lambda osd, msg: sent.append((osd, msg)), lambda: 1,
+                   codec)
+    peer_be = ECBackend((3, 0), coll, peer_store, 1,
+                        lambda osd, msg: None, lambda: 1, codec)
+    entry = LogEntry(op=2, oid="o", version=EVersion(1, 1),
+                     prior_version=EVersion(0, 0))
+    acting = [0, 1, 2, 0, 1, 2]  # osd i holds shards i and i+3
+    done = threading.Event()
+    submitted = threading.Event()
+    be.submit("o", ObjectState(b"z" * 4096), [entry], {}, acting,
+              done.set, on_submitted=submitted.set)
+    assert submitted.wait(10)
+    # one message per remote peer (2), each naming BOTH of its shards
+    assert sorted(osd for osd, _ in sent) == [1, 2]
+    for osd, msg in sent:
+        assert isinstance(msg, om.MECSubWriteVec)
+        assert sorted(s for s, _k, _o, _l in msg.rb) == \
+            [osd, osd + 3]
+    # waiting is per peer: acks from osds 1 and 2 (+ the local commit)
+    tid = next(iter(be.in_flight))
+    # peer applies its merged txn: both shard objects + both rollback
+    # records land from the one transaction
+    vec = next(msg for osd, msg in sent if osd == 1)
+    applied = threading.Event()
+    peer_be.apply_sub_write_vec(vec, on_commit=applied.set)
+    assert applied.wait(10)
+    for shard in (1, 4):
+        assert peer_store.exists(coll, GHObject("o", shard=shard))
+    meta = peer_store.omap_get(coll, GHObject("_pgmeta_"))
+    rb_keys = [k for k in meta
+               if k.startswith(rollback_prefix(entry.version))]
+    assert sorted(rb_keys) == [rollback_prefix(entry.version) + "1",
+                               rollback_prefix(entry.version) + "4"]
+    be.handle_reply(tid, 1)
+    be.handle_reply(tid, 2)
+    assert done.wait(10)  # local (osd 0) ack rides the commit thread
+    assert not be.in_flight

@@ -84,6 +84,7 @@ def test_balancer_large_skewed_map():
     assert rep.moves
 
 
+@pytest.mark.slow  # tier-2: ~1 min compile-heavy sweep (see README test tiers)
 def test_crush_compat_reduces_stddev_via_choose_args_only():
     """crush-compat mode (reference balancer module.py:17,68): the
     COMPAT weight-set alone evens PG counts — no upmap entries, no

@@ -115,6 +115,7 @@ def test_fastcmp_choose_matches_table_choose():
     assert contested > 5
 
 
+@pytest.mark.slow  # tier-2: ~1 min compile-heavy sweep (see README test tiers)
 def test_staged_sweep_exact_vs_full_program():
     flat, steps = _uniform_cluster()
     dev_w = np.full(64, 0x10000, dtype=np.uint32)
@@ -127,6 +128,7 @@ def test_staged_sweep_exact_vs_full_program():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow  # tier-2: ~1 min compile-heavy sweep (see README test tiers)
 def test_staged_sweep_exact_when_fastcmp_disabled():
     """Mixed weights knock out eligibility; the staged sweep must stay
     exact through its table-path stages."""

@@ -51,7 +51,7 @@ def test_partial_overwrite_moves_only_touched_stripes(cluster, client):
     orig_send = be.osd_send
 
     def spy(osd, msg):
-        if isinstance(msg, m.MECSubWrite):
+        if isinstance(msg, (m.MECSubWrite, m.MECSubWriteVec)):
             sent_bytes.append(len(msg.txn))
         orig_send(osd, msg)
 
@@ -138,8 +138,22 @@ class _Harness:
         """Deliver + ack everything pending (in order)."""
         while self.pending:
             osd, msg = self.pending.pop(0)
-            self.backends[osd].apply_sub_write(msg.txn)
-            self.backends[0].handle_reply(msg.tid, (msg.shard, osd))
+            self.backends[osd].apply_sub_write_vec(msg)
+            self.backends[0].handle_reply(msg.tid, osd)
+
+    def submit_full(self, be, data: bytes, entry, done) -> None:
+        """submit() + wait for the async fan-out to queue (the encode
+        completes off-thread now)."""
+        sub = threading.Event()
+        be.submit("o", ObjectState(bytes(data)), [entry], {},
+                  self.acting, done, on_submitted=sub.set)
+        assert sub.wait(10), "fan-out never queued"
+
+    def submit_part(self, be, s0, stripes, size, entry, done) -> None:
+        sub = threading.Event()
+        be.submit_partial("o", s0, stripes, size, [entry], {},
+                          self.acting, done, on_submitted=sub.set)
+        assert sub.wait(10), "fan-out never queued"
 
     def entry(self, v: int) -> LogEntry:
         return LogEntry(op=t_.LOG_MODIFY, oid="o", version=EVersion(1, v),
@@ -153,8 +167,7 @@ def test_extent_cache_pipelines_overlapping_rmw():
     data = bytearray(rng.integers(0, 256, size=16384, dtype=np.uint8))
 
     done1 = threading.Event()
-    be.submit("o", ObjectState(bytes(data)), [h.entry(1)], {}, h.acting,
-              done1.set)
+    h.submit_full(be, bytes(data), h.entry(1), done1.set)
     h.flush()
     assert done1.wait(5)
 
@@ -168,8 +181,7 @@ def test_extent_cache_pipelines_overlapping_rmw():
     stripes[2][:] = patch1
     data[2 * width: 3 * width] = patch1
     done2 = threading.Event()
-    be.submit_partial("o", s0, stripes, len(data), [h.entry(2)], {},
-                      h.acting, done2.set)
+    h.submit_part(be, s0, stripes, len(data), h.entry(2), done2.set)
     assert not done2.is_set(), "must still be waiting on shard acks"
 
     # RMW #2 overlaps stripe 3 WHILE #1 is in flight: its read must hit
@@ -182,8 +194,7 @@ def test_extent_cache_pipelines_overlapping_rmw():
     cached[3][:] = patch2
     data[3 * width: 4 * width] = patch2
     done3 = threading.Event()
-    be.submit_partial("o", 3, cached, len(data), [h.entry(3)], {},
-                      h.acting, done3.set)
+    h.submit_part(be, 3, cached, len(data), h.entry(3), done3.set)
 
     h.flush()
     assert done2.wait(5) and done3.wait(5)
@@ -198,8 +209,7 @@ def test_extent_cache_pipelines_overlapping_rmw():
     assert not missing2
     # ... until a full-object write invalidates it
     done4 = threading.Event()
-    be.submit("o", ObjectState(bytes(data)), [h.entry(4)], {}, h.acting,
-              done4.set)
+    h.submit_full(be, bytes(data), h.entry(4), done4.set)
     h.flush()
     assert done4.wait(5)
     assert be.cache.get("o", 2) is None

@@ -155,7 +155,7 @@ def test_kill_primary_mid_rmw_rolls_back():
         orig_send = pbackend.osd_send
 
         def drop_subwrites(osd_id, msg):
-            if isinstance(msg, m.MECSubWrite):
+            if isinstance(msg, (m.MECSubWrite, m.MECSubWriteVec)):
                 return
             orig_send(osd_id, msg)
 

@@ -5,6 +5,7 @@ crush_do_rule (tests/test_crush_vs_reference.py), so equality here
 transitively pins the device-resident path too."""
 
 import numpy as np
+import pytest
 
 from ceph_tpu.crush import map as cmap
 from ceph_tpu.crush import mapper
@@ -18,6 +19,7 @@ def _cluster(n_osds=64, hosts=8, nrep=3):
     return m.flatten(), steps, nrep
 
 
+@pytest.mark.slow  # tier-2: ~1 min compile-heavy sweep (see README test tiers)
 def test_sweep_device_matches_host_sweep():
     flat, steps, nrep = _cluster()
     dev_w = np.full(64, 0x10000, dtype=np.uint32)
@@ -47,6 +49,7 @@ def test_sweep_device_overflow_flag():
     assert bool(overflow)
 
 
+@pytest.mark.slow  # tier-2: ~1 min compile-heavy sweep (see README test tiers)
 def test_sweep_device_single_chunk_whole_batch():
     flat, steps, nrep = _cluster(n_osds=32, hosts=4)
     dev_w = np.full(32, 0x10000, dtype=np.uint32)

@@ -1,0 +1,199 @@
+"""Pipelined PG write engine: per-object ordering + in-flight overlap.
+
+The write path no longer blocks its workqueue shard from start to
+commit: each object has an admission FIFO (same-object writes strictly
+ordered, successor reads the predecessor's projected state) and writes
+to different objects overlap in flight.  These tests pin the two
+halves of that contract:
+
+- ordering: a concurrent append burst to ONE object must land as the
+  exact concatenation in issue order — if any two writes had read the
+  same base state, a token would vanish;
+- overlap: with every store's commit thread frozen (commit callbacks
+  deferred), a second write still executes and fans out while the
+  first is uncommitted — proven by commit-callback ordering (neither
+  client ack has fired) and the osd.N.pg counters.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.client.rados import OSDOp
+from ceph_tpu.osd import types as t_
+from ceph_tpu.vstart import VStartCluster
+
+TOKENS = [f"<{i:02d}>".encode() for i in range(12)]
+
+
+def _pg_perf(c):
+    """Summed osd.N.pg counters (+ max of the in-flight gauge)."""
+    msgs = ops = jobs = 0
+    hw = 0
+    for svc in c.osds.values():
+        d = svc.pg_perf.dump()
+        msgs += d.get("subwrite_msgs", 0)
+        ops += d.get("subwrite_ops", 0)
+        jobs += d.get("encode_batch_jobs", 0)
+        hw = max(hw, d.get("writes_inflight", 0))
+    return {"msgs": msgs, "ops": ops, "jobs": jobs, "hw": hw}
+
+
+def _append_burst_lands_in_order(io, oid):
+    """Concurrent appends to one object land EXACTLY ONCE each (a lost
+    token = two writes read the same base; a doubled token = a resend
+    re-executed), and in issue order whenever the client never had to
+    resend.  A resent op (objecter 1 s resend ticker / boot-window
+    session replay) may legitimately arrive after its successors —
+    that is client retry semantics, unchanged from the old engine — so
+    strict order is asserted on a burst that needed no resends (retry
+    a fresh object up to 3x to get one)."""
+    for attempt in range(3):
+        o = f"{oid}_{attempt}"
+        pend = [io.aio_operate(o, [OSDOp(t_.OP_APPEND, data=tok)])
+                for tok in TOKENS]
+        for p in pend:
+            rep = p.result(30.0)
+            assert rep.result == 0, f"append failed rc={rep.result}"
+        got = io.read(o)
+        for tok in TOKENS:
+            assert got.count(tok) == 1, (
+                f"token {tok!r} appears {got.count(tok)}x (lost = two "
+                f"writes shared a base; doubled = resend re-executed): "
+                f"{got!r}")
+        if all(p.attempts == 1 for p in pend):
+            assert got == b"".join(TOKENS), (
+                f"append burst reordered with no client resends: "
+                f"{got!r}")
+            return
+    # every attempt saw client resends (loaded box): the exactly-once
+    # checks above still hold; strict ordering is pinned determin-
+    # istically by the frozen-window test below
+
+
+def _settle(c):
+    for svc in c.osds.values():
+        assert svc.wait_pgs_settled(15.0)
+
+
+def test_same_oid_appends_strictly_ordered():
+    """Same-object writes pipeline WITHOUT ever reading the same base
+    or reordering, on both backends (EC exercises the async encode +
+    vec fan-out; replicated the synchronous fan-out).  PGs must be
+    settled first: an append EAGAINed by the peering gate is RESENT by
+    the client behind later appends — legitimate client-retry
+    reordering that would mask what this test pins."""
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        rep_pool = c.create_pool("wp_rep", size=3)
+        ec_pool = c.create_pool("wp_ec", size=3, pool_type="erasure",
+                                ec_profile="k=2 m=1")
+        _settle(c)
+        cl = c.client()
+        _append_burst_lands_in_order(cl.ioctx(rep_pool), "ordered_rep")
+        _append_burst_lands_in_order(cl.ioctx(ec_pool), "ordered_ec")
+        # distinct objects in one pool pipeline too; whole burst intact
+        ioec = cl.ioctx(ec_pool)
+        pend = [ioec.aio_operate(f"multi_{i}",
+                                 [OSDOp(t_.OP_WRITEFULL,
+                                        data=b"m" * 2048)])
+                for i in range(16)]
+        for p in pend:
+            assert p.result(30.0).result == 0
+        assert ioec.read("multi_7") == b"m" * 2048
+
+
+@pytest.fixture
+def frozen_cluster(tmp_path):
+    """3 durable-store OSDs whose commit threads we can freeze: inside
+    the freeze window transactions apply (read-your-writes) but no
+    commit callback — so no client ack — fires."""
+    with VStartCluster(n_mons=1, n_osds=3, data_dir=str(tmp_path),
+                       store_kind="filestore",
+                       conf={"objectstore_wal_sync": True}) as c:
+        yield c
+
+
+def _wait(pred, timeout=10.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_distinct_oids_overlap_in_flight(frozen_cluster):
+    """Commit-callback ordering: write B's fan-out happens while write
+    A is still uncommitted (the old engine dispatched B only after A's
+    commit ack).  Counted via the EC backends' subwrite_ops, which
+    bumps exactly when an op's transactions fan out."""
+    c = frozen_cluster
+    pool = c.create_pool("ovl", size=3, pool_type="erasure",
+                         ec_profile="k=2 m=1")
+    io = c.client().ioctx(pool)
+    # warmup outside the freeze: peering settled, connections up
+    assert io.operate("warm", [OSDOp(t_.OP_WRITEFULL,
+                                     data=b"w" * 512)]).result == 0
+    base = _pg_perf(c)
+    for osd in c.osds.values():
+        osd.store._pipeline.freeze()
+    try:
+        pa = io.aio_operate("ovl_a", [OSDOp(t_.OP_WRITEFULL,
+                                            data=b"a" * 4096)])
+        _wait(lambda: _pg_perf(c)["ops"] - base["ops"] >= 1,
+              what="write A fan-out")
+        assert not pa.event.is_set(), "A acked inside the freeze window"
+        pb = io.aio_operate("ovl_b", [OSDOp(t_.OP_WRITEFULL,
+                                            data=b"b" * 4096)])
+        _wait(lambda: _pg_perf(c)["ops"] - base["ops"] >= 2,
+              what="write B fan-out while A uncommitted")
+        # B fanned out; A's commit callback has still not fired
+        assert not pa.event.is_set() and not pb.event.is_set(), (
+            "a client ack leaked out of the frozen commit window")
+    finally:
+        for osd in c.osds.values():
+            osd.store._pipeline.thaw()
+    assert pa.result(30.0).result == 0
+    assert pb.result(30.0).result == 0
+    assert io.read("ovl_a") == b"a" * 4096
+    assert io.read("ovl_b") == b"b" * 4096
+    after = _pg_perf(c)
+    d_ops = after["ops"] - base["ops"]
+    d_msgs = after["msgs"] - base["msgs"]
+    # per-peer aggregation: k=2,m=1 over 3 osds = 2 remote peers ->
+    # AT MOST (live peers) messages per op, not one per (shard, peer)
+    assert d_ops >= 2
+    assert d_msgs <= 2 * d_ops, (d_msgs, d_ops)
+
+
+def test_same_oid_pipelines_and_reads_projected_state(frozen_cluster):
+    """Two writes to ONE object inside the freeze window: the
+    successor is admitted at the predecessor's fan-out (not commit)
+    and its base state is the predecessor's projected state — the
+    in-flight gauge proves both were in flight at once, the final
+    content proves read-your-writes held."""
+    c = frozen_cluster
+    pool = c.create_pool("proj", size=3, pool_type="erasure",
+                         ec_profile="k=2 m=1")
+    io = c.client().ioctx(pool)
+    assert io.operate("warm2", [OSDOp(t_.OP_WRITEFULL,
+                                      data=b"w" * 512)]).result == 0
+    for osd in c.osds.values():
+        osd.store._pipeline.freeze()
+    try:
+        p1 = io.aio_operate("proj_o", [OSDOp(t_.OP_WRITEFULL,
+                                             data=b"v1" * 256)])
+        p2 = io.aio_operate("proj_o", [OSDOp(t_.OP_APPEND,
+                                             data=b"-tail")])
+        # both submitted while NEITHER committed: high-water >= 2 on
+        # the primary's daemon
+        _wait(lambda: _pg_perf(c)["hw"] >= 2,
+              what="two same-oid writes in flight together")
+        assert not p1.event.is_set() and not p2.event.is_set()
+    finally:
+        for osd in c.osds.values():
+            osd.store._pipeline.thaw()
+    assert p1.result(30.0).result == 0
+    assert p2.result(30.0).result == 0
+    # the append's base was the projected (uncommitted) v1 image
+    assert io.read("proj_o") == b"v1" * 256 + b"-tail"
