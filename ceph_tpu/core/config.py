@@ -107,6 +107,9 @@ def _opts() -> List[Option]:
           "plugin=isa k=8 m=4 technique=reed_sol_van",
           "default EC profile"),
         O("osd_recovery_max_active", int, 3, "concurrent recovery ops"),
+        O("osd_recovery_read_timeout", float, 10.0,
+          "seconds to wait for a recovery window's sub-read replies "
+          "before the legacy fallback / retryable verdict"),
         O("osd_recovery_chunk_size", int, 8 << 20,
           "bytes per recovery push chunk (resumable progress unit)"),
         O("osd_scrub_interval", float, 86400.0, "seconds between scrubs"),

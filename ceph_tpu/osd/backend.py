@@ -875,12 +875,55 @@ class ECBackend(PGBackend):
             return None  # corrupt shard reads as missing -> reconstruct
         return data
 
-    def local_size(self, oid: str) -> Optional[int]:
-        """Logical object size from any local shard's HashInfo."""
+    def read_local_chunk_extent(self, oid: str, shard: int, off: int,
+                                length: int) -> Optional[bytes]:
+        """Extent [off, off+length) of a shard chunk (ranged sub-reads:
+        the RMW old-stripe fetch, vec extent rows).
+
+        On stores with their own at-rest checksums (BlockStore) the
+        extent is read directly: every block the store returns is
+        already crc-verified at rest, so materializing the WHOLE chunk
+        just to re-verify the hinfo crc adds a copy without adding
+        protection for the bytes served.  Other stores keep the
+        whole-chunk read + hinfo crc verification and slice — the
+        semantics are unchanged either way: corrupt data is never
+        served (it reads as missing and is reconstructed from peers).
+        """
+        if not getattr(self.store, "checksums_at_rest", False):
+            data = self.read_local_chunk(oid, shard)
+            return None if data is None else data[off: off + length]
+        g = GHObject(oid, shard=shard)
+        if not self.store.exists(self.coll, g):
+            return None
+        try:
+            # the hinfo attr must still parse (same "no/garbled hinfo
+            # reads as missing" answer as the whole-chunk path)
+            hinfo_decode(self.store.getattr(self.coll, g, "hinfo"))
+        except Exception:
+            return None
+        try:
+            return self.store.read(self.coll, g, off, length)
+        except Exception:
+            # at-rest csum failure (ChecksumError): reads as missing
+            return None
+
+    def local_size(self, oid: str,
+                   want_av: Optional[bytes] = None) -> Optional[int]:
+        """Logical object size from a local shard's HashInfo.  With
+        `want_av`, only a shard carrying that attr-version stamp may
+        answer: a stale local shard (pre-takeover zombie, mid-recovery
+        image) otherwise supplies a stale SIZE that the partial-write
+        path would then re-stamp with the NEW write's _av — laundering
+        the wrong size into a fresh-looking hinfo that meta ranking
+        and recovery trust (the 0x1EC thrash byte-mismatch class:
+        same-_av shards disagreeing on hinfo size)."""
         for shard in range(self.k + self.m):
             g = GHObject(oid, shard=shard)
             if self.store.exists(self.coll, g):
                 try:
+                    if want_av is not None and self.store.getattr(
+                            self.coll, g, "_av") != want_av:
+                        continue
                     size, _, _ = hinfo_decode(
                         self.store.getattr(self.coll, g, "hinfo"))
                     return size
@@ -901,24 +944,11 @@ class ECBackend(PGBackend):
         return (dict(self.store.getattrs(self.coll, g)),
                 dict(self.store.omap_get(self.coll, g)))
 
-    def reconstruct(self, oid: str, avail: Dict[int, bytes],
-                    meta: Optional[Tuple[Dict[str, bytes],
-                                         Dict[str, bytes]]] = None,
-                    ) -> Optional[ObjectState]:
-        """Decode the object from >=k chunk payloads.  `meta` is the
-        (attrs, omap) of ANY shard — supplied by the read path from
-        whichever shard answered (possibly remote), so reconstruction
-        never depends on this OSD holding a healthy local shard."""
-        if not avail:
-            return None
-        n = len(next(iter(avail.values())))
-        arrs = {i: np.frombuffer(c, dtype=np.uint8) for i, c in avail.items()
-                if len(c) == n}
-        if len(arrs) < self.k:
-            return None
-        want = list(range(self.k))
-        data_chunks = self.codec.decode_array(arrs, want, n)
-        planes = np.stack([np.asarray(data_chunks[i]) for i in range(self.k)])
+    def _state_from_planes(self, oid: str, planes: np.ndarray,
+                           avail: Dict[int, bytes],
+                           meta) -> Optional[ObjectState]:
+        """Decoded data planes + shard meta -> the logical object
+        (shared tail of the sync and async reconstruct paths)."""
         if meta is None:
             meta = self.shard_meta(oid, next(iter(avail)))
         attrs, omap = dict(meta[0]), dict(meta[1])
@@ -930,6 +960,94 @@ class ECBackend(PGBackend):
         if size is None:
             return None  # no shard metadata reached us: can't size it
         return ObjectState(self._deinterleave(planes, size), attrs, omap)
+
+    def _decode_arrs(self, avail: Dict[int, bytes]
+                     ) -> Optional[Dict[int, np.ndarray]]:
+        if not avail:
+            return None
+        n = len(next(iter(avail.values())))
+        arrs = {i: np.frombuffer(c, dtype=np.uint8)
+                for i, c in avail.items() if len(c) == n}
+        return arrs if len(arrs) >= self.k else None
+
+    def reconstruct(self, oid: str, avail: Dict[int, bytes],
+                    meta: Optional[Tuple[Dict[str, bytes],
+                                         Dict[str, bytes]]] = None,
+                    ) -> Optional[ObjectState]:
+        """Decode the object from >=k chunk payloads, BLOCKING —
+        scrub/repair/tools path.  `meta` is the (attrs, omap) of ANY
+        shard — supplied by the read path from whichever shard
+        answered (possibly remote), so reconstruction never depends on
+        this OSD holding a healthy local shard.  The data path
+        (degraded client reads, the recovery window) uses
+        reconstruct_async so concurrent decodes coalesce on the
+        StripeBatchQueue."""
+        arrs = self._decode_arrs(avail)
+        if arrs is None:
+            return None
+        n = len(next(iter(arrs.values())))
+        want = list(range(self.k))
+        data_chunks = self.codec.decode_array(arrs, want, n)
+        planes = np.stack([np.asarray(data_chunks[i]) for i in range(self.k)])
+        return self._state_from_planes(oid, planes, avail, meta)
+
+    def _note_decode_job(self) -> None:
+        if self.perf is not None:
+            self.perf.inc("decode_batch_jobs")
+
+    def reconstruct_async(self, oid: str, avail: Dict[int, bytes], meta,
+                          done: Callable[[Optional[ObjectState]], None]
+                          ) -> None:
+        """reconstruct, off the caller's thread: when data shards are
+        missing and the codec exposes a flat recovery matrix, the
+        decode rides StripeBatchQueue.decode_data_async so concurrent
+        degraded reads / recovery reconstructs sharing a survivor
+        signature coalesce into ONE device matmul (the decode twin of
+        the write path's encode_async).  `done(state)` always runs on
+        a fresh thread — neither the device worker (which must get
+        back to coalescing) nor the caller's network/timer thread
+        executes completions that may take the pg lock."""
+        def spawn(fn) -> None:
+            threading.Thread(target=fn, daemon=True,
+                             name="ec-decode-done").start()
+
+        arrs = self._decode_arrs(avail)
+        if arrs is None:
+            spawn(lambda: done(None))
+            return
+        data_ids = list(range(self.k))
+        if all(i in arrs for i in data_ids):
+            # systematic fast path: every data shard answered — no
+            # decode at all, just stack and deinterleave
+            def assemble() -> None:
+                planes = np.stack([arrs[i] for i in data_ids])
+                done(self._state_from_planes(oid, planes, avail, meta))
+
+            spawn(assemble)
+            return
+        if not hasattr(self.codec, "recovery_matrix"):
+            # array codecs (clay) couple bytes across the chunk: no
+            # flat recovery matmul — full decode on a worker thread
+            spawn(lambda: done(self.reconstruct(oid, avail, meta)))
+            return
+        self._note_decode_job()
+        fut = self.queue.decode_data_async(self.codec, arrs)
+
+        def finish(f) -> None:
+            def complete() -> None:
+                try:
+                    data = np.asarray(f.result())
+                except Exception as e:  # noqa: BLE001 — device/codec
+                    self.log(0, f"pg {self.pgid}: decode of {oid} "
+                                f"failed: {e!r}")
+                    done(None)
+                    return
+                planes = np.stack([data[i] for i in data_ids])
+                done(self._state_from_planes(oid, planes, avail, meta))
+
+            spawn(complete)
+
+        fut.add_done_callback(finish)
 
     def object_names(self) -> List[str]:
         return sorted({o.name for o in self.store.collection_list(self.coll)
@@ -951,6 +1069,7 @@ class ECBackend(PGBackend):
                 # batched recovery matmul: concurrent degraded reads
                 # sharing a survivor signature coalesce into one device
                 # dispatch (decode twin of the write-path batching)
+                self._note_decode_job()
                 data = self.queue.decode_data(self.codec, arrs)
                 arrs.update({i: data[i] for i in data_ids})
             else:
@@ -961,13 +1080,15 @@ class ECBackend(PGBackend):
         return planes.reshape(self.k, S, self.unit).transpose(
             1, 0, 2).tobytes()
 
-    def can_partial(self, oid: str, off: int, length: int) -> bool:
+    def can_partial(self, oid: str, off: int, length: int,
+                    want_av: Optional[bytes] = None) -> bool:
         """Partial-stripe fast path precondition: flat codec (array
         codecs couple bytes across the whole chunk), locally known
-        size, and no size change."""
+        size — from a CURRENT-stamped shard when `want_av` is given —
+        and no size change."""
         if self.codec.get_sub_chunk_count() != 1:
             return False
-        size = self.local_size(oid)
+        size = self.local_size(oid, want_av)
         return size is not None and off + length <= size
 
     def read_cached_stripes(self, oid: str, s0: int,

@@ -138,10 +138,30 @@ class OSDService(Dispatcher):
         pgpc.add_u64_counter("encode_batch_jobs",
                              "async encode jobs handed to the "
                              "StripeBatchQueue by the write path")
+        # read/recovery-engine counters (the PR-5 read-side twin)
+        pgpc.add_u64_gauge("recovery_active",
+                           "windowed recovery objects in flight, "
+                           "high-water")
+        pgpc.add_u64_counter("subread_msgs",
+                             "EC sub-read messages sent by the "
+                             "recovery window (one MECSubReadVec per "
+                             "peer per round; legacy fallbacks count "
+                             "per shard)")
+        pgpc.add_u64_counter("subread_ops",
+                             "objects fanned out through recovery "
+                             "window sub-reads")
+        pgpc.add_u64_counter("decode_batch_jobs",
+                             "decode jobs handed to the "
+                             "StripeBatchQueue by degraded reads and "
+                             "recovery reconstructs")
+        pgpc.add_u64_counter("recover_on_read_hits",
+                             "reads of missing objects served by a "
+                             "promoted recovery instead of EAGAIN")
         self.pg_perf = pgpc
         self._wr_inflight = 0
         self._wr_inflight_hw = 0
         self._wr_lock = make_lock("osd.wr_inflight")
+        self._rec_active_hw = 0
         # surface the store's group-commit counters (commit-batch
         # histogram, WAL fsyncs, commit latency) in this context's
         # `perf dump` alongside the daemon's own
@@ -468,6 +488,10 @@ class OSDService(Dispatcher):
             if dead:
                 for w in list(self._waiters.values()):
                     w.fail_peers(dead)
+                # in-flight recovery windows degrade to the surviving
+                # peers immediately (same rationale as the RPC waits)
+                for pg in list(self.pgs.values()):
+                    pg.note_peers_down(dead)
             # pg_num growth splits parents IN PLACE (reference PG::split
             # discipline): with pgp_num unchanged, children fold to the
             # parent's pps (raw_pg_to_pps stable_mods ps by pgp_num), so
@@ -653,6 +677,14 @@ class OSDService(Dispatcher):
             self._wr_inflight_hw = self._wr_inflight
             self.pg_perf.set("writes_inflight", self._wr_inflight_hw)
 
+    def note_recovery_active(self, window: int) -> None:
+        """Record a recovery round's width; the gauge keeps the
+        high-water (direct evidence the pull actually ran windowed)."""
+        with self._wr_lock:
+            if window > self._rec_active_hw:
+                self._rec_active_hw = window
+                self.pg_perf.set("recovery_active", window)
+
     def _peering_watchdog_loop(self) -> None:
         """Re-kick activation for PGs wedged in PEERING (a peer reply
         lost in a kill window, or a stale activation discarded by the
@@ -701,8 +733,17 @@ class OSDService(Dispatcher):
             self._tid += 1
             return self._tid
 
-    def track_reads(self, pgid: PGId, cb: Callable, count: int) -> int:
+    def track_reads(self, pgid: PGId, cb: Callable,
+                    count: Optional[int] = None) -> int:
+        """Register a read-reply callback under a fresh tid.  With
+        `count` the registration self-expires after that many replies;
+        without it the caller owns the lifetime (the recovery window
+        may add legacy-fallback sends mid-flight) and must call
+        untrack_reads."""
         tid = self.new_tid()
+        if count is None:
+            self._read_cbs[tid] = cb
+            return tid
         remaining = [count]
 
         def wrapped(rep) -> None:
@@ -713,6 +754,9 @@ class OSDService(Dispatcher):
 
         self._read_cbs[tid] = wrapped
         return tid
+
+    def untrack_reads(self, tid: int) -> None:
+        self._read_cbs.pop(tid, None)
 
     # -- dispatch ---------------------------------------------------------
     def ms_can_fast_dispatch(self, msg: Message) -> bool:
@@ -758,7 +802,7 @@ class OSDService(Dispatcher):
                        else self._osd_of(msg))
                 pg.backend.handle_reply(msg.tid, who)
             return True
-        if isinstance(msg, m.MECSubReadReply):
+        if isinstance(msg, (m.MECSubReadReply, m.MECSubReadVecReply)):
             cb = self._read_cbs.get(msg.tid)
             if cb is not None:
                 cb(msg)
@@ -874,6 +918,7 @@ class OSDService(Dispatcher):
         # version order on every peer
         if isinstance(msg, (m.MOSDRepOp, m.MECSubWrite,
                             m.MECSubWriteVec, m.MECSubRead,
+                            m.MECSubReadVec,
                             m.MPGQuery, m.MScrub, m.MPGRecoveryProbe,
                             m.MPGRollback, m.MECCommitNote)):
             pg = self.pgs.get(msg.pgid)
@@ -895,6 +940,8 @@ class OSDService(Dispatcher):
                 pg.handle_sub_write_vec(msg, conn)
             elif isinstance(msg, m.MECSubRead):
                 pg.handle_sub_read(msg, conn)
+            elif isinstance(msg, m.MECSubReadVec):
+                pg.handle_sub_read_vec(msg, conn)
             elif isinstance(msg, m.MPGRecoveryProbe):
                 pg.handle_recovery_probe(msg, conn)
             elif isinstance(msg, m.MPGRollback):
@@ -978,6 +1025,14 @@ class OSDService(Dispatcher):
         elif isinstance(msg, m.MECSubRead):
             rep = m.MECSubReadReply(msg.pgid, self.epoch(), msg.shard,
                                     msg.oid, b"", -5, {}, {})  # EIO
+        elif isinstance(msg, m.MECSubReadVec):
+            # every row answers EIO: the sender's per-object gather
+            # bookkeeping needs each (shard, oid) accounted, and a
+            # prompt "nothing here" beats a burned read window
+            rep = m.MECSubReadVecReply(
+                msg.pgid, self.epoch(),
+                [(s, o, b"", -5, {}, {})
+                 for s, o, _off, _len in msg.reads])
         if rep is not None:
             rep.tid = msg.tid
             conn.send(rep)
@@ -1054,8 +1109,18 @@ class OSDService(Dispatcher):
                 out[self._osd_of(rep)] = rep.info
         return out
 
-    def pull_from_peer(self, pg: PG, best_osd: int, since: EVersion) -> None:
-        """Catch this (primary) osd up from a peer with a newer log."""
+    def pull_from_peer(self, pg: PG, best_osd: int, since: EVersion,
+                       defer_recovery: bool = False):
+        """Catch this (primary) osd up from a peer with a newer log.
+
+        With defer_recovery (EC activation), the authoritative log is
+        adopted and the missing set fenced, but the recovery window
+        itself is left to the CALLER — activate() opens the peering
+        gate first and then drains the window, so reads of missing
+        objects park on a promoted recovery (recover-on-read) instead
+        of EAGAINing behind the whole pull.  Returns the {oid: entry}
+        work list in that mode (the caller also owns the
+        persist-after-recovery step); None otherwise."""
         reps = self._rpc([(best_osd,
                            m.MPGQuery(pg.pgid, self.epoch(), since))])
         if not reps or not isinstance(reps[0], m.MPGInfo):
@@ -1122,9 +1187,17 @@ class OSDService(Dispatcher):
                     # set); reads must not trust them
                     pg.missing[oid] = en.version
         if pg.is_ec():
-            # reconstruct my shard(s) from surviving peers
-            for oid, en in latest.items():
-                self._ec_self_recover(pg, oid, en)
+            # reconstruct my shard(s) from surviving peers — windowed:
+            # W objects in flight, ONE vec sub-read per peer per
+            # round, decode coalesced, and each completed object
+            # leaves pg.missing individually (osd/recovery.py)
+            if latest and defer_recovery:
+                # activate() opens the gate, drains the window, and
+                # persists after recovery (the PR-1 discipline, moved
+                # with the recovery it fences)
+                return latest
+            if latest:
+                pg.recovery_engine().recover(latest)
         elif latest:
             pulls = [oid for oid, en in latest.items()
                      if en.op != t_.LOG_DELETE]
@@ -1152,62 +1225,14 @@ class OSDService(Dispatcher):
             pg._persist_meta(pg.log.omap_additions(pg.log.entries))
 
     def _ec_self_recover(self, pg: PG, oid: str, en) -> None:
-        """Rebuild this osd's shard(s) of one object.  The oid is in
-        pg.missing while this runs, so _ec_read_object excludes OUR
-        stale local shards from the reconstruction (mixing a stale
-        shard with fresh peers' shards produced silently wrong bytes);
-        success clears the missing entry, failure leaves it for the
-        next interval's retry (a peer holding fresh shards may return).
-        """
-        from ceph_tpu.osd.backend import ECBackend
-        from ceph_tpu.store.objectstore import GHObject, Transaction
-
-        pg._obc_invalidate(oid)  # local shards rewritten below
-        be: ECBackend = pg.backend  # type: ignore[assignment]
-        my_shards = be.local_shards(pg.acting)
-        if en.op == t_.LOG_DELETE:
-            t = Transaction()
-            for shard in my_shards:
-                t.try_remove(pg.coll, GHObject(oid, shard=shard))
-            self.store.queue_transaction(t)
-            with pg.lock:
-                pg.missing.pop(oid, None)
-            return
-        done = threading.Event()
-        box: List[Optional[object]] = [None]
-
-        def got(state) -> None:
-            box[0] = state
-            done.set()
-
-        pg._ec_read_object(oid, got)
-        done.wait(timeout=30.0)
-        state = box[0]
-        from ceph_tpu.osd.pg import READ_RETRY
-
-        if state is None or state is READ_RETRY:
-            return  # not reconstructable right now: stays missing,
-            # retried (READ_RETRY = holders unresponsive or chunks
-            # version-rejected; both heal)
-        chunks, _ = be._encode_object(state.data)
-        from ceph_tpu.osd.backend import _hinfo
-
-        t = Transaction()
-        for shard in my_shards:
-            g = GHObject(oid, shard=shard)
-            t.truncate(pg.coll, g, 0)
-            t.write(pg.coll, g, 0, chunks[shard])
-            attrs = dict(state.xattrs)
-            attrs["hinfo"] = _hinfo(chunks[shard], len(state.data))
-            attrs["_av"] = pg._av_for(oid)
-            t.setattrs(pg.coll, g, attrs)
-            t.omap_clear(pg.coll, g)
-            if state.omap:
-                t.omap_setkeys(pg.coll, g, state.omap)
-        self.store.queue_transaction(t)
-        with pg.lock:
-            pg.missing.pop(oid, None)
-        self.perf.inc("recovery_pushes")
+        """Rebuild this osd's shard(s) of one object — the
+        single-object entry into the windowed recovery engine
+        (osd/recovery.py), kept for tools and tests.  The oid is in
+        pg.missing while this runs, so the gather excludes OUR stale
+        local shards from the reconstruction; success clears the
+        missing entry, failure leaves it for the next interval's retry
+        (a peer holding fresh shards may return)."""
+        pg.recovery_engine().recover({oid: en})
 
     def list_peer_objects(self, pg: PG, osd_id: int) -> Optional[set]:
         """A peer's object listing (its scrub map's key set); None when

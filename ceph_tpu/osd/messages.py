@@ -336,6 +336,83 @@ class MECSubRead(_PGMessage):
 
 
 @register
+class MECSubReadVec(_PGMessage):
+    """Primary -> EC peer: ALL of this peer's (shard, oid, extent)
+    sub-reads for a recovery window or a multi-op read burst, in ONE
+    message (the read twin of MECSubWriteVec).  A W-object recovery
+    round over a k=4,m=2 pool used to cost one MECSubRead per (shard,
+    object) — ~2W messages per peer; this carries one message per peer
+    per round, and the receiver answers with one reply (and one store
+    pass) covering every row.
+
+    `reads` rows are (shard, oid, off, length); length==0 means the
+    whole chunk.  The scalar MECSubRead stays registered and served
+    for mixed-version peers: an old primary's per-shard sub-reads must
+    keep decoding and answering byte-for-byte."""
+
+    TYPE = 50
+    VERSION = 1
+
+    def __init__(self, pgid=(0, 0), epoch=0,
+                 reads: Optional[List[Tuple[int, str, int, int]]] = None
+                 ) -> None:
+        super().__init__(pgid, epoch)
+        self.reads = reads or []  # [(shard, oid, off, length), ...]
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        e.seq(self.reads, lambda enc, r: enc.s32(r[0]).string(r[1])
+              .u64(r[2]).u64(r[3]))
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.reads = d.seq(lambda dd: (dd.s32(), dd.string(), dd.u64(),
+                                       dd.u64()))
+
+
+@register
+class MECSubReadVecReply(_PGMessage):
+    """One reply per peer per window: every requested chunk/extent with
+    its per-shard meta (attrs/omap ride along like MECSubReadReply, so
+    the primary can reconstruct without any local shard).  Rows answer
+    the request rows in order: (shard, oid, data, result, attrs,
+    omap); a shard this peer can't serve answers its row with EIO
+    instead of going silent (the sender's gather bookkeeping needs
+    every row accounted)."""
+
+    TYPE = 51
+    VERSION = 1
+
+    def __init__(self, pgid=(0, 0), epoch=0,
+                 rows: Optional[List[Tuple]] = None) -> None:
+        super().__init__(pgid, epoch)
+        # [(shard, oid, data, result, attrs, omap), ...]
+        self.rows = rows or []
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+
+        def _row(enc: Encoder, r) -> None:
+            enc.s32(r[0]).string(r[1]).blob(r[2]).s32(r[3])
+            enc.mapping(r[4], lambda ee, k: ee.string(k),
+                        lambda ee, v: ee.blob(v))
+            enc.mapping(r[5], lambda ee, k: ee.string(k),
+                        lambda ee, v: ee.blob(v))
+
+        e.seq(self.rows, _row)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+
+        def _row(dd: Decoder):
+            return (dd.s32(), dd.string(), dd.blob(), dd.s32(),
+                    dd.mapping(lambda x: x.string(), lambda x: x.blob()),
+                    dd.mapping(lambda x: x.string(), lambda x: x.blob()))
+
+        self.rows = d.seq(_row)
+
+
+@register
 class MECSubReadReply(_PGMessage):
     """Chunk payload + the shard's object metadata (attrs/omap ride
     along so the primary can reconstruct without any local shard)."""

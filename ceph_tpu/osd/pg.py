@@ -45,16 +45,17 @@ from ceph_tpu.osd.backend import (
     pg_meta_txn,
 )
 from ceph_tpu.osd.pglog import PGLog
+from ceph_tpu.osd.recovery import READ_RETRY, ChunkGather, ECRecoveryEngine
 from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp, PGId, PGInfo
 from ceph_tpu.store.objectstore import (Collection, GHObject, StoreError,
                                         Transaction)
 
 EPERM, ENOENT, EIO, EAGAIN, EINVAL = -1, -2, -5, -11, -22
-# EC reads that could not assemble k CURRENT chunks before the
-# watchdog fired answer with this sentinel: "retry later", never
-# "doesn't exist" (mixing a prior-interval chunk into a fresh decode
-# produced garbage; claiming ENOENT lost reads of live objects)
-READ_RETRY = object()
+# READ_RETRY (defined in osd/recovery.py, re-exported here): EC reads
+# that could not assemble k CURRENT chunks before the watchdog fired
+# answer with that sentinel — "retry later", never "doesn't exist"
+# (mixing a prior-interval chunk into a fresh decode produced garbage;
+# claiming ENOENT lost reads of live objects)
 
 # sentinel digest in merged scrub maps: the object exists on that osd
 # but its store refused the read (at-rest corruption) — votes "exists"
@@ -183,6 +184,9 @@ class PG:
         # into the next sub-write's piggyback (flush_commit_note)
         self._ct_lock = make_lock("pg.committed_to")
         self._ct_dirty = False
+        # windowed EC recovery engine (osd/recovery.py), created lazily
+        # on the first pull/parked read
+        self._recovery: Optional[ECRecoveryEngine] = None
 
     # -- identity ---------------------------------------------------------
     def is_primary(self) -> bool:
@@ -525,12 +529,59 @@ class PG:
             except Exception:
                 continue
 
+    def recovery_engine(self) -> ECRecoveryEngine:
+        """This PG's windowed recovery engine (EC; lazily created)."""
+        with self.lock:
+            if self._recovery is None:
+                self._recovery = ECRecoveryEngine(self)
+            return self._recovery
+
+    def note_peers_down(self, dead: set) -> None:
+        """Map marked peers down: an in-flight recovery window must
+        degrade to the survivors instead of waiting out its read
+        timeout per object (the daemon calls this alongside failing
+        RPC waiters)."""
+        eng = self._recovery
+        if eng is not None:
+            eng.peer_down(dead)
+
+    def _park_missing_read(self, msg, reply) -> bool:
+        """Recover-on-read (reference PrimaryLogPG::maybe_kick_recovery
+        + the recovery-blocked op waitlist): a read of an object in
+        pg.missing no longer EAGAINs blindly — the object is promoted
+        to the FRONT of the recovery window and the read parks on its
+        recovery completion (bounded wait, then EAGAIN exactly as
+        before), so a hot object's read latency is one recovery round,
+        not the whole pull.  Client-visible ordering is unchanged: the
+        woken read re-executes the normal degraded-aware path."""
+        if not self.is_ec() or not self.is_primary() \
+                or self.state == STATE_PEERING:
+            return False
+
+        def wake(ok: bool, msg=msg, reply=reply) -> None:
+            if not ok:
+                reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                    msg.oid, msg.ops, result=EAGAIN))
+                return
+            perf = getattr(self.osd, "pg_perf", None)
+            if perf is not None:
+                perf.inc("recover_on_read_hits")
+            with self.lock:
+                self._do_read(msg, reply)
+
+        return self.recovery_engine().park_read(msg.oid, wake)
+
     def _do_read(self, msg, reply):
         with self.lock:
             if msg.oid in self.missing:
                 # known-newer object we haven't recovered yet: serving
                 # local state would be STALE, "not found" would be a
-                # lie — retryable, the client waits out recovery
+                # lie.  An EC primary parks the read on a promoted
+                # recovery of exactly this object; otherwise (or when
+                # the object just left pg.missing under our feet)
+                # retryable, the client waits out recovery
+                if self._park_missing_read(msg, reply):
+                    return
                 reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
                                     msg.oid, msg.ops, result=EAGAIN))
                 return
@@ -1353,7 +1404,23 @@ class PG:
         have fanned out."""
         wop = msg.ops[0]
         be: ECBackend = self.backend  # type: ignore[assignment]
-        if not be.can_partial(msg.oid, wop.off, len(wop.data)):
+        # version-checked preconditions (0x1EC thrash byte-mismatch
+        # forensics): a primary whose own shards are stale — oid in
+        # pg.missing, or a local shard carrying an older _av — must
+        # not size the write's hinfo from them.  The stale size would
+        # be re-stamped with the NEW write's _av, and meta ranking,
+        # reads, and recovery all trust a current-stamped hinfo; the
+        # full path reads its base degraded-aware instead.
+        from ceph_tpu.osd.backend import _av_stamp
+
+        with self.lock:
+            if msg.oid in self.missing:
+                return False
+            en = self.log.latest_for(msg.oid)
+        want_av = (_av_stamp(en.version)
+                   if en is not None and en.op != t_.LOG_DELETE
+                   else None)
+        if not be.can_partial(msg.oid, wop.off, len(wop.data), want_av):
             return False
         width = be.stripe_width
         s0, s1 = be.sinfo.stripe_range(wop.off, len(wop.data))
@@ -1385,7 +1452,9 @@ class PG:
             d0, d1 = max(wop.off, base), min(end, base + width)
             stripes[s][d0 - base: d1 - base] = (
                 wop.data[d0 - wop.off: d1 - wop.off])
-        size = be.local_size(msg.oid)
+        size = be.local_size(msg.oid, want_av)
+        if size is None:
+            return False  # current-stamped shard vanished mid-check
         with self.lock:
             version = self._next_version()
             entry = LogEntry(
@@ -1662,11 +1731,15 @@ class PG:
 
     def handle_sub_read(self, msg: m.MECSubRead, conn) -> None:
         assert isinstance(self.backend, ECBackend)
-        data = self.backend.read_local_chunk(msg.oid, msg.shard)
-        if data is not None and msg.length:
-            # ranged sub-read (RMW old-stripe fetch): crc was verified
-            # over the whole chunk above, then the extent is sliced
-            data = data[msg.off: msg.off + msg.length]
+        if msg.length:
+            # ranged sub-read (RMW old-stripe fetch): served without
+            # materializing the whole chunk where the store's own
+            # at-rest checksums cover the extent; elsewhere the
+            # whole-chunk crc verify + slice is unchanged
+            data = self.backend.read_local_chunk_extent(
+                msg.oid, msg.shard, msg.off, msg.length)
+        else:
+            data = self.backend.read_local_chunk(msg.oid, msg.shard)
         attrs, omap = self.backend.shard_meta(msg.oid, msg.shard)
         rep = m.MECSubReadReply(
             self.pgid, self.osd.epoch(), msg.shard, msg.oid,
@@ -1676,183 +1749,97 @@ class PG:
         rep.tid = msg.tid
         conn.send(rep)
 
+    def handle_sub_read_vec(self, msg: m.MECSubReadVec, conn) -> None:
+        """Peer side of the aggregated sub-read: ONE message carries
+        every (oid, shard, extent) this peer serves for a recovery
+        window or read burst; ONE reply answers every row with its
+        chunk + per-shard meta.  Chunk and meta fetches are deduped
+        per (oid, shard) so repeated extents of one chunk cost a
+        single store pass.  Rows this peer can't serve answer EIO
+        instead of going silent — the sender's gather accounting
+        needs every row."""
+        assert isinstance(self.backend, ECBackend)
+        be = self.backend
+        chunks: Dict[Tuple[str, int], Optional[bytes]] = {}
+        metas: Dict[Tuple[str, int], Tuple] = {}
+        rows = []
+        for shard, oid, off, length in msg.reads:
+            key = (oid, shard)
+            if length:
+                data = be.read_local_chunk_extent(oid, shard, off,
+                                                  length)
+            else:
+                if key not in chunks:
+                    chunks[key] = be.read_local_chunk(oid, shard)
+                data = chunks[key]
+            if key not in metas:
+                metas[key] = be.shard_meta(oid, shard)
+            attrs, omap = metas[key]
+            rows.append((shard, oid,
+                         data if data is not None else b"",
+                         0 if data is not None else EIO, attrs, omap))
+        rep = m.MECSubReadVecReply(self.pgid, self.osd.epoch(), rows)
+        rep.tid = msg.tid
+        conn.send(rep)
+
     # -- EC read path (primary) -------------------------------------------
     def _ec_read_object(self, oid: str,
                         done: Callable[[Optional[ObjectState]], None]):
         """Gather >=k chunks and one (attrs, omap) meta, then decode.
 
-        Source PRIORITY matters (found by the EC thrash hunt): a
-        prior-interval holder may hold a STALE shard (and stale attrs
-        — e.g. pre-setxattr), so its answer must never beat the
-        CURRENT acting holder's.  A prior holder's chunk/meta is used
-        only once the current holder for that shard has conclusively
-        failed (error reply, excluded as stale, or a hole)."""
+        The gather discipline lives in recovery.ChunkGather, shared
+        with the windowed recovery engine: source PRIORITY (a
+        prior-interval holder may hold a STALE shard, so its answer
+        must never beat the CURRENT acting holder's), the _av version
+        check (mixed shard generations must never co-decode), and the
+        retryable-vs-absent verdict.  The decode itself routes through
+        backend.reconstruct_async, so concurrent degraded reads
+        sharing a survivor pattern coalesce into one device matmul."""
         be: ECBackend = self.backend  # type: ignore[assignment]
-        n = be.k + be.m
-        acting = list(self.acting[:n]) + [CRUSH_ITEM_NONE] * (
-            n - len(self.acting))
-        cur_avail: Dict[int, bytes] = {}     # from current holders
-        prior_avail: Dict[int, bytes] = {}   # from prior-interval holders
-        cur_meta: List = [None]
-        prior_meta: List = [None]
+        g = ChunkGather(self, oid)
 
-        def _better_meta(box, attrs, omap):
-            """Keep the candidate with the HIGHEST _av stamp: an
-            RMW-recreated shard carries hinfo but no user attrs and no
-            stamp, and must never supply the object's attrs while a
-            properly-stamped shard answers (EC thrash-hunt find)."""
-            cand_av = attrs.get("_av", b"")
-            if box[0] is None or cand_av > box[0][0].get("_av", b""):
-                box[0] = (dict(attrs), dict(omap))
-        # version discipline (the same _av check the RMW base read
-        # uses): when the log still holds this object's newest entry,
-        # every usable chunk must carry that entry's stamp — assembling
-        # MIXED shard versions returns silently wrong bytes for
-        # systematic reads (thrash-hunt divergence: one stale shard
-        # served zeros straight into the result).  Mismatched chunks
-        # count as failed answers, so the RETRYABLE path fires and the
-        # client waits out recovery instead of reading garbage.
-        with self.lock:
-            local_stale = oid in self.missing
-            _en = self.log.latest_for(oid)
-        want_av = None
-        if _en is not None and _en.op != t_.LOG_DELETE:
-            from ceph_tpu.osd.backend import _av_stamp
+        def conclude(timed_out: bool = False) -> None:
+            avail, meta, retry = g.resolve(timed_out)
+            if retry:
+                # a current holder never answered / was down / was
+                # version-rejected: the chunks exist and recovery will
+                # bring them forward — retryable, not gone
+                done(READ_RETRY)
+                return
+            if not avail:
+                done(None)
+                return
+            be.reconstruct_async(oid, avail, meta, done)
 
-            want_av = _av_stamp(_en.version)
-
-        def _av_ok(attrs) -> bool:
-            return want_av is None or attrs.get("_av") == want_av
-        av_reject0 = False  # local chunk version-rejected
-        if not local_stale:
-            for shard in be.local_shards(acting):
-                attrs, omap = be.shard_meta(oid, shard)
-                if not _av_ok(attrs):
-                    av_reject0 = True
-                    continue
-                c = be.read_local_chunk(oid, shard)
-                if c is not None:
-                    cur_avail[shard] = c
-                    _better_meta(cur_meta, attrs, omap)
-        omap_ = self.osd.osdmap
-
-        def _up(o: int) -> bool:
-            return omap_ is None or omap_.is_up(o)
-
-        remote = [(s, o, True) for s, o in enumerate(acting)
-                  if o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
-                  and o not in self.stale_peers  # stale shards can't serve
-                  and _up(o)]
-        # a DOWN current holder can never answer: skipping it (instead
-        # of waiting out the 10s read window for silence) turns reads
-        # of its objects into prompt EAGAINs — but its shard may hold
-        # the freshest extent, so a short read must stay RETRYABLE,
-        # never report absence (down_cur below)
-        down_cur = any(o not in (self.osd.whoami, CRUSH_ITEM_NONE)
-                       and o >= 0 and o not in self.stale_peers
-                       and not _up(o)
-                       for o in acting)
-        # wholesale remap: a freshly-placed member has nothing yet — ask
-        # the prior-interval holder of each shard too (fallback source)
-        prior = list(self.prior_acting[:n])
-        for s in range(min(n, len(prior))):
-            o = prior[s]
-            if (o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
-                    and _up(o) and s not in cur_avail
-                    and (s, o, True) not in remote):
-                remote.append((s, o, False))
-        # outstanding CURRENT-holder requests per shard: a prior
-        # holder's data for s is usable only when this drops to 0
-        pending_cur: Dict[int, int] = {}
-        pending_any: Dict[int, int] = {}
-        holder_of: Dict[Tuple[int, int], bool] = {}
-        for s, o, is_cur in remote:
-            holder_of[(s, o)] = is_cur
-            pending_any[s] = pending_any.get(s, 0) + 1
-            if is_cur:
-                pending_cur[s] = pending_cur.get(s, 0) + 1
-
-        def merged():
-            out = dict(cur_avail)
-            for s, c in prior_avail.items():
-                if s not in out and pending_cur.get(s, 0) <= 0:
-                    out[s] = c
-            return out
-
-        if not remote or len(cur_avail) >= be.k:
-            av = cur_avail
-            if len(av) < be.k and (down_cur or av_reject0):
-                done(READ_RETRY)  # short of k only because holders are
-                return            # down/stale: recovery will serve it
-            done(be.reconstruct(oid, av, cur_meta[0]) if av else None)
+        if not g.remote or len(g.cur_avail) >= be.k:
+            conclude()
             return
         lock = make_lock("pg.ec_read_gather")
         fired = [False]
-        # any chunk version-rejected (local pre-scan or on_reply)
-        av_reject = [av_reject0]
 
         def finish(timed_out: bool = False) -> None:
             with lock:
                 if fired[0]:
                     return
                 fired[0] = True
-                av = merged()
-                meta = cur_meta[0] or prior_meta[0]
-                hung_cur = any(v > 0 for v in pending_cur.values())
             timer.cancel()
-            if len(av) < be.k and ((timed_out and hung_cur)
-                                   or av_reject[0] or down_cur):
-                # a current holder never answered (its shard may exist
-                # and a prior holder's chunk must not substitute —
-                # mixed generations decode to garbage), or chunks were
-                # version-rejected (recovery will bring them forward):
-                # retryable, not gone
-                done(READ_RETRY)
-                return
-            done(be.reconstruct(oid, av, meta) if av else None)
+            conclude(timed_out)
 
         def on_reply(rep: m.MECSubReadReply) -> None:
             with lock:
                 if fired[0]:
                     return
                 src = rep.src.num if rep.src else -1
-                is_cur = holder_of.get((rep.shard, src), False)
-                if (rep.result == 0 and rep.oid == oid
-                        and not _av_ok(rep.attrs)):
-                    # version-mismatched chunk: a failed answer for the
-                    # pending bookkeeping, and the read must end
-                    # RETRYABLE (the shard exists, recovery will bring
-                    # it forward) rather than reporting absence
-                    av_reject[0] = True
-                if (rep.result == 0 and rep.oid == oid
-                        and _av_ok(rep.attrs)):
-                    if is_cur:
-                        cur_avail[rep.shard] = rep.data
-                        if "hinfo" in rep.attrs:
-                            _better_meta(cur_meta, rep.attrs, rep.omap)
-                    else:
-                        prior_avail.setdefault(rep.shard, rep.data)
-                        if "hinfo" in rep.attrs:
-                            _better_meta(prior_meta, rep.attrs,
-                                         rep.omap)
-                if is_cur:
-                    pending_cur[rep.shard] = (
-                        pending_cur.get(rep.shard, 1) - 1)
-                pending_any[rep.shard] = pending_any.get(rep.shard, 1) - 1
-                if pending_any.get(rep.shard, 0) <= 0:
-                    pending_any.pop(rep.shard, None)
-                ready = (not pending_any or len(cur_avail) >= be.k
-                         or (len(merged()) >= be.k
-                             and not any(v > 0
-                                         for v in pending_cur.values())))
+                ready = g.feed(rep.shard, src, rep.result, rep.oid,
+                               rep.data, rep.attrs, rep.omap)
             if ready:
                 finish()
 
         timer = threading.Timer(10.0, lambda: finish(timed_out=True))
         timer.daemon = True
         timer.start()
-        tid = self.osd.track_reads(self.pgid, on_reply, len(remote))
-        for shard, osd, _is_cur in remote:
+        tid = self.osd.track_reads(self.pgid, on_reply, len(g.remote))
+        for shard, osd, _is_cur in g.remote:
             rd = m.MECSubRead(self.pgid, self.osd.epoch(), shard, oid, 0, 0)
             rd.tid = tid
             self.osd.send_to_osd(osd, rd)
@@ -1967,9 +1954,16 @@ class PG:
             for osd_id, info in infos.items():
                 if (info.last_update, -osd_id) > (best.last_update, -best_osd):
                     best_osd, best = osd_id, info
+        deferred = None
         if best_osd != self.osd.whoami:
-            self.osd.pull_from_peer(self, best_osd,
-                                    since=self.info.last_update)
+            # EC: the pull adopts the log and fences pg.missing, but
+            # the recovery window drains AFTER the gate opens below —
+            # reads of missing objects then park on a promoted
+            # recovery (recover-on-read) instead of EAGAINing behind
+            # the whole pull
+            deferred = self.osd.pull_from_peer(
+                self, best_osd, since=self.info.last_update,
+                defer_recovery=self.is_ec())
         with self.lock:
             # anyone behind our (now-authoritative) log serves no reads
             # until pushed forward
@@ -1995,16 +1989,28 @@ class PG:
             self.state = STATE_DEGRADED if degraded else STATE_ACTIVE
             self._wd_backoff = 0.0
             self._wd_next = 0.0
+        if deferred:
+            # gate is open: drain the windowed pull while (degraded)
+            # ops are admitted, then make the adopted log durable —
+            # the persist-after-recovery discipline, moved with the
+            # recovery it fences (a crash mid-window re-peers from the
+            # OLD durable state)
+            self.recovery_engine().recover(deferred)
+            with self.lock:
+                self._persist_meta(self.log.omap_additions(
+                    self.log.entries))
         self._push_laggards(infos)
         # objects still missing from an EARLIER interval (recovery was
         # short of fresh shards then): retry now — a peer holding them
-        # may have returned with this interval
+        # may have returned with this interval.  Windowed like the
+        # pull-time recovery (one vec sub-read per peer per round).
         with self.lock:
             retry = dict(self.missing) if self.is_ec() else {}
-        for oid, ver in retry.items():
-            self.osd._ec_self_recover(
-                self, oid, LogEntry(op=t_.LOG_MODIFY, oid=oid,
-                                    version=ver, prior_version=ver))
+        if retry:
+            self.recovery_engine().recover({
+                oid: LogEntry(op=t_.LOG_MODIFY, oid=oid, version=ver,
+                              prior_version=ver)
+                for oid, ver in retry.items()})
         with self.lock:
             if (tuple(self.acting), self.primary) != interval:
                 return  # interval moved on: the newer activation owns state

@@ -216,6 +216,10 @@ class BitmapAllocator:
 
 
 class BlockStore(ObjectStore):
+    # every read re-verifies the per-block crc32c (ChecksumError on
+    # mismatch): ranged readers need no whole-object re-verify pass
+    checksums_at_rest = True
+
     def __init__(self, path: str, compression: str | None = None,
                  device_blocks: int = 1024, o_sync: bool = False,
                  kv_kind: str = "log") -> None:

@@ -473,6 +473,12 @@ class ObjectStore:
     sync with concurrent writers.  Returns the transaction's WAL/commit
     sequence number."""
 
+    # True on backends whose read path verifies data against at-rest
+    # checksums itself (BlockStore: crc32c per stored block, raises on
+    # mismatch).  Lets consumers serve ranged reads without a
+    # whole-object copy purely to re-verify an application-level crc.
+    checksums_at_rest = False
+
     # -- lifecycle --------------------------------------------------------
     def mkfs(self) -> None:
         raise NotImplementedError

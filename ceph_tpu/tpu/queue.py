@@ -62,6 +62,10 @@ class StripeBatchQueue:
         # (mean width 1.0 == the pipeline fed the queue one job at a
         # time and the batching engine idled)
         self.batch_jobs: Dict[int, int] = {}
+        # decode-only slice of the same evidence: recovery windows and
+        # concurrent degraded reads sharing a survivor signature
+        # should show widths > 1 here
+        self.dec_batch_jobs: Dict[int, int] = {}
 
     def start(self) -> None:
         with self._lock:
@@ -211,6 +215,9 @@ class StripeBatchQueue:
             self.jobs += len(batch)
             self.batch_jobs[len(batch)] = (
                 self.batch_jobs.get(len(batch), 0) + 1)
+            if batch[0].kind == "dec":
+                self.dec_batch_jobs[len(batch)] = (
+                    self.dec_batch_jobs.get(len(batch), 0) + 1)
             self.bytes_in += sum(j.planes.nbytes for j in batch)
         except BaseException as e:  # noqa: BLE001 — propagate to callers
             for j in batch:

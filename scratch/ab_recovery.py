@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""One A/B trial of degraded-EC-PG recovery (the PR-5 acceptance
+metric): a revived primary pulls >= 64 missing objects from one pg;
+reports recovery objects/s, sub-read messages per object per peer,
+mean decode batch width, and the latency of a read issued
+mid-recovery (recover-on-read).  Imports ceph_tpu from PYTHONPATH so
+the same script measures any checkout; prints JSON.  Interleave
+trials A,B,A,B,... from a driver to cancel rig drift."""
+
+import json
+import sys
+import threading
+import time
+
+
+def main() -> None:
+    from ceph_tpu.client.rados import OSDOp
+    from ceph_tpu.osd import types as t_
+    from ceph_tpu.tpu.queue import default_queue
+    from ceph_tpu.vstart import VStartCluster
+
+    out = {}
+    pay = b"r" * 16384
+    n = 96
+    depth = 16
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        pool = c.create_pool("ab_ecr", size=3, pool_type="erasure",
+                             ec_profile="k=2 m=1", pg_num=1)
+        io = c.client().ioctx(pool)
+        io.aio_operate("warm", [OSDOp(t_.OP_WRITEFULL,
+                                      data=pay)]).result(30.0)
+        mm = c.leader().osdmap
+        _u, _up, _acting, prim = mm.pg_to_up_acting((pool, 0))
+        c.kill_osd(prim)
+        c.wait_for(lambda: not c.leader().osdmap.is_up(prim),
+                   what="primary marked down")
+        pend = []
+        for i in range(n):
+            pend.append(io.aio_operate(
+                f"o{i}", [OSDOp(t_.OP_WRITEFULL, data=pay)]))
+            if len(pend) >= depth:
+                pend.pop(0).result(60.0)
+        for p in pend:
+            p.result(60.0)
+        dq = default_queue()
+        dec0 = dict(getattr(dq, "dec_batch_jobs", {}))
+        rp0 = c.osds[prim].perf.dump().get("recovery_pushes", 0)
+        pgp = getattr(c.osds[prim], "pg_perf", None)
+        pg0 = pgp.dump() if pgp is not None else {}
+        t0 = time.perf_counter()
+        c.revive_osd(prim)
+        svc = c.osds[prim]
+
+        # a read racing the pull: old shape answers only once the
+        # whole pull reaches the object; recover-on-read promotes it
+        rd = {}
+
+        def read_mid() -> None:
+            t1 = time.perf_counter()
+            rep = io.aio_operate(
+                f"o{n - 1}", [OSDOp(t_.OP_READ)]).result(120.0)
+            rd["rc"] = rep.result
+            rd["latency_s"] = round(time.perf_counter() - t1, 3)
+
+        th = threading.Thread(target=read_mid, daemon=True)
+        th.start()
+        c.wait_for(lambda: svc.perf.dump().get(
+            "recovery_pushes", 0) - rp0 >= n,
+            timeout=300.0, what="pull of the degraded pg")
+        dt = time.perf_counter() - t0
+        th.join(timeout=120.0)
+        out["missing_objects"] = n
+        out["recovery_elapsed_s"] = round(dt, 3)
+        out["recovery_objects_per_s"] = round(n / dt, 1)
+        out["mid_recovery_read"] = rd
+        d = svc.pg_perf.dump() if hasattr(svc, "pg_perf") else {}
+        ops = d.get("subread_ops", 0) - pg0.get("subread_ops", 0)
+        msgs = d.get("subread_msgs", 0) - pg0.get("subread_msgs", 0)
+        out["subread_msgs_per_object_per_peer"] = (
+            round(msgs / ops / 2, 3) if ops else None)
+        out["recover_on_read_hits"] = (
+            d.get("recover_on_read_hits", 0)
+            - pg0.get("recover_on_read_hits", 0)
+            if "recover_on_read_hits" in d else None)
+        out["recovery_window_hw"] = d.get("recovery_active")
+        dh = getattr(dq, "dec_batch_jobs", {})
+        jobs = (sum(w * b for w, b in dh.items())
+                - sum(w * b for w, b in dec0.items()))
+        batches = sum(dh.values()) - sum(dec0.values())
+        out["mean_decode_jobs_per_batch"] = (
+            round(jobs / batches, 2) if batches else None)
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -198,6 +198,76 @@ def test_rados_model_under_thrash():
         c.shutdown()
 
 
+def _dump_thrash_forensics(c, err, seed):
+    """PR-4 caveat follow-up: the EC thrash model flaked ONCE at seed
+    0x1EC with a byte mismatch and left nothing to analyze.  On any
+    model divergence, capture the failing seed plus a full shard dump
+    (per-osd chunk lengths/crcs/_av stamps, pg state/missing/log
+    heads) into scratch/ BEFORE the cluster is torn down, so the next
+    occurrence is a root-cause session instead of a shrug."""
+    import json
+    import os
+    import time as _time
+
+    from ceph_tpu.core.crc import crc32c
+    from ceph_tpu.osd import types as ot
+    from ceph_tpu.store.objectstore import Collection, GHObject
+
+    report = {"seed": hex(seed), "time": _time.time(), "error": str(err),
+              "osds_up": {i: o.up for i, o in c.osds.items()},
+              "pgs": {}, "object": {}}
+    # the _verify assertions lead with "{oid}: ..."
+    oid = str(err).split(":", 1)[0].strip() or None
+    for i, o in c.osds.items():
+        if not o.up:
+            continue
+        for pgid, pg in o.pgs.items():
+            if pgid[0] != EC_POOL:
+                continue
+            key = f"osd{i}.pg{pgid[0]}.{pgid[1]:x}"
+            try:
+                with pg.lock:
+                    report["pgs"][key] = {
+                        "state": pg.state, "acting": list(pg.acting),
+                        "primary": pg.primary,
+                        "log_head": str(pg.log.head),
+                        "missing": {k: str(v)
+                                    for k, v in pg.missing.items()},
+                        "stale_peers": sorted(pg.stale_peers),
+                    }
+            except Exception as e:  # best-effort forensics
+                report["pgs"][key] = {"error": repr(e)}
+            if not oid:
+                continue
+            coll = Collection(ot.pgid_str(pgid) + "_head")
+            shards = {}
+            for s in range(pg.backend.k + pg.backend.m):
+                g = GHObject(oid, shard=s)
+                try:
+                    if not o.store.exists(coll, g):
+                        continue
+                    data = o.store.read(coll, g)
+                    attrs = o.store.getattrs(coll, g)
+                    shards[s] = {
+                        "len": len(data), "crc": hex(crc32c(data)),
+                        "_av": attrs.get("_av", b"").hex(),
+                        "hinfo": attrs.get("hinfo", b"").hex(),
+                    }
+                except Exception as e:
+                    shards[s] = {"error": repr(e)}
+            if shards:
+                en = pg.log.latest_for(oid)
+                report["object"][key] = {
+                    "shards": shards,
+                    "latest_entry": (None if en is None else
+                                     f"op={en.op} v={en.version}"),
+                }
+    out = os.path.join(os.path.dirname(__file__), "..", "scratch",
+                       f"thrash_ec_forensics_{seed:#x}.json")
+    with open(os.path.abspath(out), "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True, default=str)
+
+
 def test_rados_model_ec_under_thrash():
     """The EC-pool model sequence under OSD thrashing: the hunt that
     drove the round's EC consistency fixes (deletion-push guard,
@@ -229,9 +299,17 @@ def test_rados_model_ec_under_thrash():
     th = threading.Thread(target=thrasher, daemon=True)
     th.start()
     try:
-        ops = _run_model_sequence(cl.rc.ioctx(EC_POOL),
-                                  random.Random(0x1EC),
-                                  rounds=150, oid_space=16)
+        try:
+            ops = _run_model_sequence(cl.rc.ioctx(EC_POOL),
+                                      random.Random(0x1EC),
+                                      rounds=150, oid_space=16)
+        except AssertionError as e:
+            # capture the shard-level evidence while the cluster is
+            # still alive (PR-4's seed byte-mismatch flake left none)
+            stop.set()
+            th.join(timeout=10)
+            _dump_thrash_forensics(c, e, seed=0x1EC)
+            raise
         assert sum(ops.values()) >= 120
     finally:
         stop.set()
