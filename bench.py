@@ -529,15 +529,18 @@ def small_stripe_batched(jax, out):
     batch_cols = sorted(set(shapes))
     out["small_stripe_queue_batch_cols"] = batch_cols[:8]
 
-    # -- 2: end-to-end with the real codec ---------------------------
+    # -- 2: end-to-end through the DEVICE-RESIDENT path --------------
+    # the PR-6 pipeline the write path actually rides: fused
+    # encode+crc batches (encode_crc_async), so the number includes
+    # the on-device per-shard crc32c that replaced the host hinfo crc
     q = StripeBatchQueue()
     # warm with a FULL burst so every power-of-two coalesced batch
     # shape the timed burst can produce is already compiled (an
     # in-region XLA compile costs many tunnel RTTs)
-    for f in [q.encode_async(codec, o) for o in objs]:
+    for f in [q.encode_crc_async(codec, o) for o in objs]:
         f.result()
     t0 = time.perf_counter()
-    for f in [q.encode_async(codec, o) for o in objs]:
+    for f in [q.encode_crc_async(codec, o) for o in objs]:
         f.result()
     dt = time.perf_counter() - t0
     q.stop()
@@ -548,9 +551,19 @@ def small_stripe_batched(jax, out):
     out["small_stripe_4k_batched_gbps"] = round(
         n_objs * 4096 / dt / 1e9, 6)
     out["small_stripe_4k_elapsed_s"] = round(dt, 3)
-    out["small_stripe_host_path"] = True
+    # host_path False = the device-resident pipeline (staged batches,
+    # fused crc, metadata-only crossings) served the burst; a rig
+    # whose crc engine fell back to pure numpy is still host-path no
+    # matter how many batches staged
+    from ceph_tpu.ops.crc32c_device import _HAVE_JAX
+
+    st = q.stats.snapshot()
+    out["small_stripe_host_path"] = (st["staged_batches"] == 0
+                                     or not _HAVE_JAX)
     out["small_stripe_stats"] = {"batches": q.batches, "jobs": q.jobs,
-                                 "bytes_in": q.bytes_in}
+                                 "bytes_in": q.bytes_in,
+                                 "staged_batches": st["staged_batches"],
+                                 "h2d_bytes": st["h2d_bytes"]}
 
     # -- 3: device rate at the queue's recorded batch shapes ---------
     if jax.default_backend() == "cpu":
@@ -732,6 +745,7 @@ def cluster_io(jax, out):
         for svc in c.osds.values():
             svc.reset_write_inflight_hw()
         msgs0, ops0, _ = _pg_perf_totals()
+        dstat0 = dq.stats.snapshot()
         n_ec = 64
         t0 = time.perf_counter()
         pend = []
@@ -783,6 +797,59 @@ def cluster_io(jax, out):
                     "-> active engine; batching/fan-out evidence is "
                     "measured from queue + osd.N.pg counters, not "
                     "assumed",
+        }
+        # device-resident data path evidence (PR 6), counter-derived
+        # so it works on CPU rigs: payload bytes uploaded per payload
+        # byte written, and unsanctioned host materializations per op
+        # (the metadata-only-crossing invariant; the GB/s story rides
+        # the device rows above on TPU rigs)
+        from ceph_tpu.ops.crc32c_device import _HAVE_JAX
+
+        dstat1 = dq.stats.snapshot()
+        d_h2d = dstat1["h2d_bytes"] - dstat0["h2d_bytes"]
+        d_tch = (dstat1["payload_host_touches"]
+                 - dstat0["payload_host_touches"])
+        d_stg = dstat1["staged_batches"] - dstat0["staged_batches"]
+        out["cluster_io_ec"].update({
+            "host_path": d_stg == 0 or not _HAVE_JAX,
+            "staged_batches": d_stg,
+            "h2d_bytes_per_payload_byte": round(
+                d_h2d / float(n_ec * len(payload)), 4),
+            "payload_host_touches_per_op": round(d_tch / n_ec, 4),
+            "pool_occupancy_hw": dstat1["pool_occupancy_hw"],
+        })
+
+        # small-object phase — the PR-6 tentpole's target shape: 4KiB
+        # EC WRITEFULL at the same depth, its own counter window
+        st0 = dq.stats.snapshot()
+        pay4k = b"s" * 4096
+        n_small = 96
+        t0 = time.perf_counter()
+        pend = []
+        for i in range(n_small):
+            pend.append(ioec.aio_operate(
+                f"bsm_{i}", [OSDOp(t_.OP_WRITEFULL, data=pay4k)]))
+            if len(pend) >= depth:
+                pend.pop(0).result(60.0)
+        for p in pend:
+            p.result(60.0)
+        sm_dt = time.perf_counter() - t0
+        assert ioec.read("bsm_0") == pay4k
+        st1 = dq.stats.snapshot()
+        sm_h2d = st1["h2d_bytes"] - st0["h2d_bytes"]
+        sm_stg = st1["staged_batches"] - st0["staged_batches"]
+        out["cluster_io_ec"]["small_4k"] = {
+            "objects": n_small, "object_kib": 4,
+            "elapsed_s": round(sm_dt, 3),
+            "write_iops": round(n_small / sm_dt, 1),
+            "host_path": sm_stg == 0 or not _HAVE_JAX,
+            "staged_batches": sm_stg,
+            "h2d_bytes_per_payload_byte": round(
+                sm_h2d / float(n_small * 4096), 4),
+            "payload_host_touches_per_op": round(
+                (st1["payload_host_touches"]
+                 - st0["payload_host_touches"]) / n_small, 4),
+            "pool_occupancy_hw": st1["pool_occupancy_hw"],
         }
 
         # degraded-PG recovery (read-side twin of the write evidence):

@@ -2,6 +2,7 @@
 
 from ceph_tpu.analysis.checks.blocking import NoBlockingOnLoop
 from ceph_tpu.analysis.checks.codec import CodecSymmetry
+from ceph_tpu.analysis.checks.d2h import NoD2HOnHotPath
 from ceph_tpu.analysis.checks.jax_purity import JaxPurity
 from ceph_tpu.analysis.checks.locks import NamedLocks
 from ceph_tpu.analysis.checks.silent_except import SilentExcept
@@ -14,6 +15,7 @@ ALL_CHECKS = (
     NoSleepPoll(),
     SilentExcept(),
     JaxPurity(),
+    NoD2HOnHotPath(),
 )
 
 CHECKS_BY_NAME = {c.name: c for c in ALL_CHECKS}

@@ -176,12 +176,17 @@ class NoBlockingOnLoop(Check):
                 out.append(Violation(
                     check=self.name, path=fn.mod.file.rel, line=line,
                     scope=fn.local, detail=prim,
-                    message=(f"{prim} can block the event loop: reachable "
-                             f"via {' -> '.join(chain)} (fast-dispatch/"
-                             "loop contract: no store work, no lock "
-                             "waits, no RPCs)"),
+                    message=self._message(prim, chain),
                 ))
         return out
+
+    def _message(self, prim: str, chain: List[str]) -> str:
+        """Violation text hook — subclasses reusing the call-graph
+        machinery (no-d2h-on-hot-path) state their own contract."""
+        return (f"{prim} can block the event loop: reachable "
+                f"via {' -> '.join(chain)} (fast-dispatch/"
+                "loop contract: no store work, no lock "
+                "waits, no RPCs)")
 
     # -- roots ------------------------------------------------------------
     def _find_roots(self, mods: Dict[str, _Module],

@@ -1,0 +1,90 @@
+"""no-d2h-on-hot-path: the device-resident payload contract, enforced.
+
+PR 6's staging pipeline keeps payloads device-resident from messenger
+receive through encode/crc to store apply, with only metadata crossing
+back to host.  The contract dies by a thousand cuts: one convenient
+``np.asarray(...)`` / ``bytes(...)`` on a device buffer inside the
+messenger fast-dispatch path or the StripeBatchQueue worker quietly
+reintroduces the tunnel tax the whole refactor removed (the BENCH_r05
+shape: 276 GB/s on-device, ~0 end-to-end).
+
+This check reuses the PR-3 fast-dispatch call graph (every ``async
+def``, fast-dispatching ``ms_dispatch``, loop-scheduled callbacks) and
+adds ``StripeBatchQueue._worker`` as a root, then flags host-
+materialization primitives reachable from them: ``np.asarray`` /
+``np.array`` / ``jnp.asarray``, ``.tolist()``, ``.tobytes()``, and
+``bytes(...)`` applied to a value.
+
+Accepted legacy debt lives in the baseline like any other check —
+EXCEPT in the new pipeline modules themselves (``tpu/staging.py``,
+``ops/crc32c_device.py``): violations there are never baselineable
+(``--write-baseline`` refuses to record them), so the pipeline's own
+code hard-errors the build.  Sanctioned fetches (the engine's own
+batched d2h, 4-byte metadata digests) annotate the line with
+``# cephlint: disable=no-d2h-on-hot-path — why``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ceph_tpu.analysis.checks.blocking import (
+    NoBlockingOnLoop, _Func, _Module, _body_walk,
+)
+from ceph_tpu.analysis.framework import NEVER_BASELINE_PREFIXES, call_name
+
+# host-materialization call names (module-qualified numpy/jax spellings
+# the repo actually uses)
+_MATERIALIZERS = {"np.asarray", "np.array", "numpy.asarray",
+                  "numpy.array", "jnp.asarray", "jnp.array"}
+_MATERIALIZER_METHODS = {"tolist", "tobytes"}
+
+# the new pipeline modules hard-error: debt here is never accepted
+_HARD_PATHS = ("ceph_tpu/tpu/staging.py", "ceph_tpu/ops/crc32c_device.py")
+
+
+class NoD2HOnHotPath(NoBlockingOnLoop):
+    name = "no-d2h-on-hot-path"
+    description = ("host materialization of device buffers reachable "
+                   "from the messenger fast-dispatch or "
+                   "StripeBatchQueue._worker call graphs")
+    scopes = ("ceph_tpu",)
+
+    # -- roots: fast-dispatch graph + the queue's device worker ----------
+    def _find_roots(self, mods: Dict[str, _Module],
+                    index: Dict[str, _Func]) -> Set[str]:
+        roots = super()._find_roots(mods, index)
+        worker = "ceph_tpu.tpu.queue:StripeBatchQueue._worker"
+        if worker in index:
+            roots.add(worker)
+        return roots
+
+    def _message(self, prim: str, chain: List[str]) -> str:
+        return (f"{prim} materializes a device buffer on host: "
+                f"reachable via {' -> '.join(chain)} (device-resident "
+                "payload contract: only metadata crosses to host on "
+                "the hot path — annotate sanctioned metadata fetches "
+                "with a disable + rationale)")
+
+    # -- primitives: host materializations --------------------------------
+    def _primitives(self, fn: _Func) -> List[Tuple[int, str]]:
+        out: List[Tuple[int, str]] = []
+        for node in _body_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            base = cn.split(".")[-1]
+            if cn in _MATERIALIZERS:
+                out.append((node.lineno, f"{cn}()"))
+            elif cn == "bytes" and node.args:
+                out.append((node.lineno, "bytes()"))
+            elif "." in cn and base in _MATERIALIZER_METHODS:
+                out.append((node.lineno, f"{cn}()"))
+        return out
+
+
+# register the hard-error scope with the baseline writer: pipeline-
+# module debt for this check can never be accepted silently
+for _p in _HARD_PATHS:
+    NEVER_BASELINE_PREFIXES.append((NoD2HOnHotPath.name, _p))

@@ -122,6 +122,17 @@ def _opts() -> List[Option]:
           "stripe-batch queue target columns per device dispatch"),
         O("erasure_code_tile_n", int, 2048, "pallas column tile"),
         O("tpu_stripe_queue_depth", int, 4, "in-flight device batches"),
+        O("tpu_devpath", bool, True,
+          "device-resident small-object data path: stage EC WRITEFULL "
+          "payloads into the pinned pool, fuse crc32c into the encode "
+          "batch, ship DeviceBuf handles end-to-end (off = legacy "
+          "host-bytes path)"),
+        O("tpu_staging_slots", int, 64,
+          "pinned staging pool slots (exhaustion backpressures the "
+          "write path)", runtime=False),
+        O("tpu_staging_slot_kib", int, 128,
+          "pinned staging slot size; larger payloads bypass the pool",
+          runtime=False),
         # -- objectstore ----------------------------------------------------
         O("objectstore", str, "memstore", "backend", enum=("memstore", "filestore")),
         O("objectstore_path", str, "", "data directory for filestore"),

@@ -39,10 +39,12 @@ def _load_native():
             from ceph_tpu import _native as nat
 
             L = nat.lib()
-            # c_char_p: immutable bytes pass zero-copy (no buffer dup)
+            # c_void_p: bytes pass zero-copy (char* at the object's
+            # buffer), and any other buffer-protocol object passes as
+            # its raw address (resolved by _native_arg without a dup)
             argtypes = [
                 ctypes.c_uint32,
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_int64,
             ]
             fn = L.ceph_tpu_crc32c
@@ -59,15 +61,46 @@ def _load_native():
     return _native
 
 
-def crc32c(data: bytes, crc: int = 0) -> int:
-    """Running crc32c; chain by passing the previous value as `crc`."""
+def _native_arg(data):
+    """(arg, nbytes, keepalive) for the native call, WITHOUT copying:
+    bytes ride c_void_p's zero-copy conversion; memoryviews, numpy
+    arrays, and other buffer-protocol objects pass their raw buffer
+    address (a zero-copy np.frombuffer supplies it — the bufferlist
+    discipline: the crc reads the same memory the messenger/store
+    holds).  `keepalive` must stay referenced across the call."""
+    if isinstance(data, bytes):
+        return data, len(data), None
+    if isinstance(data, np.ndarray):
+        arr = np.ascontiguousarray(data.reshape(-1)).view(np.uint8)
+    else:
+        try:
+            arr = np.frombuffer(data, dtype=np.uint8)
+        except (TypeError, ValueError):  # non-contiguous / exotic
+            # cephlint: disable=no-d2h-on-hot-path — cold fallback for
+            # non-contiguous buffers only; every hot-path caller hands
+            # bytes/contiguous views that take the zero-copy branches
+            b = bytes(data)
+            return b, len(b), None
+    return arr.ctypes.data, arr.size, arr
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """Running crc32c; chain by passing the previous value as `crc`.
+    Accepts bytes, bytearray, memoryview, numpy arrays — any
+    buffer-protocol object — with no intermediate copy on either the
+    native or the fallback path."""
     fn = _load_native()
     if fn:
-        if len(data) > _GIL_HOLD_MAX:
+        arg, n, keep = _native_arg(data)
+        if n > _GIL_HOLD_MAX:
             # large buffer (scrub/store sweeps): let other threads run
-            return int(_native_nogil(crc, bytes(data), len(data)))
-        return int(fn(crc, bytes(data), len(data)))
+            r = int(_native_nogil(crc, arg, n))
+        else:
+            r = int(fn(crc, arg, n))
+        del keep  # buffer owner held across the call, released here
+        return r
     c = np.uint32(crc) ^ np.uint32(0xFFFFFFFF)
-    for b in data:
+    for b in memoryview(data) if not isinstance(data, np.ndarray) \
+            else data.reshape(-1):
         c = _TABLE[(c ^ b) & 0xFF] ^ (c >> np.uint32(8))
     return int(c ^ np.uint32(0xFFFFFFFF))

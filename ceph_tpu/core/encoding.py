@@ -65,8 +65,13 @@ class Encoder:
     def boolean(self, v: bool) -> "Encoder":
         return self.u8(1 if v else 0)
 
-    def blob(self, v: bytes) -> "Encoder":
-        """u32-length-prefixed byte string (reference bufferlist encode)."""
+    def blob(self, v) -> "Encoder":
+        """u32-length-prefixed byte string (reference bufferlist
+        encode).  Accepts any bytes-like object zero-copy — and a
+        DeviceBuf payload handle, materialized through its sanctioned
+        (accounted) wire view."""
+        if hasattr(v, "wire_view"):  # DeviceBuf duck-type
+            v = v.wire_view()
         self.u32(len(v))
         self.buf += v
         return self
@@ -180,6 +185,19 @@ class Decoder:
         v = self.buf[self.off : self.off + n]
         self.off += n
         return bytes(v)
+
+    def blob_view(self) -> memoryview:
+        """Zero-copy blob: a memoryview into the frame buffer instead
+        of a materialized bytes copy — the bufferlist discipline for
+        large payload fields (a 64 KiB write body decoded with blob()
+        pays a full copy before the op path even sees it).  The view
+        pins the whole frame buffer; callers that retain it long-term
+        (staging pools) copy out of it exactly once."""
+        n = self.u32()
+        self._need(n)
+        v = memoryview(self.buf)[self.off : self.off + n]
+        self.off += n
+        return v
 
     def string(self) -> str:
         return self.blob().decode("utf-8")

@@ -78,8 +78,10 @@ class Message:
         pass
 
     # -- framing ----------------------------------------------------------
-    def to_bytes(self) -> bytes:
-        e = Encoder()
+    def encode_into(self, e: Encoder) -> None:
+        """Encode into an existing sink — the messenger appends the
+        body straight after its frame header in ONE buffer (no
+        body-then-concat copy per send; see Messenger._frame_of)."""
         e.u16(self.TYPE)
         e.start(self.VERSION, self.COMPAT)
         e.u64(self.seq).u64(self.tid).u8(self.priority).u64(self.ack_seq)
@@ -87,6 +89,10 @@ class Message:
         e.optional(self.src, lambda enc, s: s.encode(enc))
         self.encode_payload(e)
         e.finish()
+
+    def to_bytes(self) -> bytes:
+        e = Encoder()
+        self.encode_into(e)
         return e.bytes()
 
     @staticmethod

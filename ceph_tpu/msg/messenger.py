@@ -27,6 +27,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.core.crc import crc32c
+from ceph_tpu.core.encoding import Encoder
 from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.msg.message import MAck, Message
 
@@ -138,9 +139,7 @@ class Connection:
         msg.sid = self.sid
         if msg.src is None:
             msg.src = self.msgr.entity
-        body = msg.to_bytes()
-        frame = _FRAME.pack(len(body),
-                            crc32c(body) if self.msgr.crc_data else 0) + body
+        frame = self.msgr._frame_of(msg)
         if not self.policy.lossy:
             # lossy sessions never replay, so nothing to retain
             self._unacked.append((msg.seq, frame))
@@ -435,11 +434,7 @@ class Messenger:
                         self._auth_provider(target) or b"")
                 except Exception:
                     announce.auth_blob = b""
-            ab = announce.to_bytes()
-            writer.write(
-                _FRAME.pack(len(ab),
-                            crc32c(ab) if self.crc_data else 0) + ab
-            )
+            writer.write(self._frame_of(announce))
             # lossless-peer: resend everything the peer hasn't acked
             for _, frame in conn._unacked:
                 writer.write(frame)
@@ -761,14 +756,28 @@ class Messenger:
         # sender task drains to the same socket ack_writer points at)
         conn._send_q.put_nowait(self._ack_frame(conn.in_seq))
 
+    def _frame_of(self, msg: Message) -> bytearray:
+        """One-allocation frame assembly: the body encodes directly
+        after a reserved header slot in the SAME buffer (to_bytes +
+        header concat paid two full-payload copies per send), and the
+        frame crc runs over a zero-copy view of it.  Message payloads
+        that are DeviceBuf handles materialize here — the wire is a
+        sanctioned sink, accounted by the handle itself."""
+        e = Encoder()
+        e.raw(b"\0" * _FRAME.size)
+        msg.encode_into(e)
+        buf = e.buf
+        body = memoryview(buf)[_FRAME.size:]
+        _FRAME.pack_into(buf, 0, len(body),
+                         crc32c(body) if self.crc_data else 0)
+        return buf
+
     def _ack_frame(self, ack_seq: int) -> bytes:
         ack = MAck()
         ack.ack_seq = ack_seq
         ack.src = self.entity
         ack.nonce = self.nonce
-        body = ack.to_bytes()
-        return _FRAME.pack(len(body),
-                           crc32c(body) if self.crc_data else 0) + body
+        return self._frame_of(ack)
 
     def _send_ack(self, conn: Connection, ack_writer, ack_seq: int) -> None:
         if ack_writer is None or not ack_seq:

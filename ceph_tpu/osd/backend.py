@@ -43,6 +43,7 @@ from ceph_tpu.osd import messages as m
 from ceph_tpu.osd.types import EVersion, LogEntry, PGId
 from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
 from ceph_tpu.tpu.queue import default_queue
+from ceph_tpu.tpu.staging import DeviceBuf
 
 CRUSH_ITEM_NONE = 0x7FFFFFFF
 
@@ -201,7 +202,8 @@ class PGBackend:
             self._fan_tickets += 1
             return t
 
-    def _encode_then_fanout(self, planes, fanout, on_error) -> None:
+    def _encode_then_fanout(self, planes, fanout, on_error,
+                            fused: bool = False, size: int = 0) -> None:
         """Shared async-encode scaffold: queue the planes, then run
         `fanout(coding)` through the per-PG sequencer on the fan-out
         executor — NOT on the StripeBatchQueue's device worker, which
@@ -210,12 +212,18 @@ class PGBackend:
         every write's fan-out behind the device thread and kept batch
         width pinned near 1).  `on_error` runs if the encode itself
         fails: nothing was fanned out anywhere, so the caller unwinds
-        its bookkeeping (in-flight op, gauge, projected state)."""
+        its bookkeeping (in-flight op, gauge, projected state).
+        `fused=True` rides encode_crc_async (device-resident path):
+        fanout receives `(coding, crcs)` — per-shard crc32c computed
+        in the same device batch as the matmul."""
         ticket = self._fan_ticket()
         if self.perf is not None:
             self.perf.inc("encode_batch_jobs")
         try:
-            fut = self.queue.encode_async(self.codec, planes)
+            fut = (self.queue.encode_crc_async(self.codec, planes,
+                                               size=size)
+                   if fused else
+                   self.queue.encode_async(self.codec, planes))
         except BaseException:
             self._fan_run(ticket, lambda: None)  # never park the line
             raise
@@ -385,9 +393,14 @@ def _av_stamp(v) -> bytes:
     return _struct.pack(">IQ", int(v.epoch), int(v.version))
 
 
-def _hinfo(chunk: bytes, total_size: int, crc_valid: bool = True) -> bytes:
+def _hinfo(chunk: bytes, total_size: int, crc_valid: bool = True,
+           crc: Optional[int] = None) -> bytes:
     """Per-shard HashInfo xattr: (object logical size, chunk crc32c)
     (reference ECUtil::HashInfo, src/osd/ECUtil.h:101-122).
+
+    `crc` supplies a digest already computed — the device path fuses
+    crc32c into the encode batch and hands the 4-byte result here, so
+    building hinfo never pulls payload bytes back to host.
 
     Partial-stripe overwrites cannot maintain the whole-chunk crc
     without re-reading the chunk, so they mark it invalid — scrub then
@@ -395,7 +408,11 @@ def _hinfo(chunk: bytes, total_size: int, crc_valid: bool = True) -> bytes:
     ec_overwrites pools likewise drop the running HashInfo crc and lean
     on store checksums / deep scrub)."""
     e = Encoder()
-    e.u64(total_size).u32(crc32c(chunk) if crc_valid else 0)
+    if not crc_valid:
+        crc = 0
+    elif crc is None:
+        crc = crc32c(chunk)
+    e.u64(total_size).u32(crc)
     e.u8(1 if crc_valid else 0)
     return e.bytes()
 
@@ -522,10 +539,14 @@ class ECBackend(PGBackend):
     def _deinterleave(self, planes: np.ndarray, size: int) -> bytes:
         return self.sinfo.deinterleave(planes, size)
 
-    def _prep_planes(self, data: bytes) -> np.ndarray:
+    def _prep_planes(self, data) -> np.ndarray:
         """Object buffer -> padded uint8 [k, cols] data planes (the
         host-side half of the encode, shared by the sync and async
-        paths)."""
+        paths).  Accepts bytes, memoryview, or a staged DeviceBuf —
+        the interleave reads the staging slot directly (part of the
+        single sanctioned upload, not a crossing)."""
+        if isinstance(data, DeviceBuf):
+            data = data.np1d()
         planes, S = self._interleave(data)
         cols = S * self.unit
         # array codecs (clay) need columns divisible by sub_chunk_count
@@ -553,11 +574,15 @@ class ECBackend(PGBackend):
         return (self._chunks_of(planes, coding, self.k, self.m),
                 planes.shape[1])
 
-    def _shard_txn(self, oid: str, shard: int, chunk: Optional[bytes],
+    def _shard_txn(self, oid: str, shard: int, chunk,
                    state: Optional[ObjectState],
                    log_omap: Dict[str, bytes],
                    log_rm: Optional[List[str]] = None,
-                   av: Optional[bytes] = None) -> Transaction:
+                   av: Optional[bytes] = None,
+                   chunk_crc: Optional[int] = None) -> Transaction:
+        """`chunk` may be bytes or a DeviceBuf handle (device path);
+        `chunk_crc` is the fused on-device crc32c when available, so
+        hinfo never re-reads payload bytes on host."""
         t = Transaction()
         g = GHObject(oid, shard=shard)
         if state is None:
@@ -567,7 +592,8 @@ class ECBackend(PGBackend):
             t.try_remove(self.coll, g)
             t.write(self.coll, g, 0, chunk or b"")
             attrs = dict(state.xattrs)
-            attrs["hinfo"] = _hinfo(chunk or b"", len(state.data))
+            attrs["hinfo"] = _hinfo(chunk or b"", len(state.data),
+                                    crc=chunk_crc)
             if av is not None:
                 # attr-version stamp: RMW extent writes may CREATE an
                 # attr-poor shard on a behind holder (they carry no
@@ -752,7 +778,7 @@ class ECBackend(PGBackend):
         epoch = self.epoch_fn()
         committed_to = self.committed_fn()
 
-        def fanout(chunks: List[Optional[bytes]]) -> None:
+        def fanout(chunks: List, crcs=None) -> None:
             try:
                 msgs = 0
                 for osd, shards in sorted(peer_shards.items()):
@@ -764,7 +790,9 @@ class ECBackend(PGBackend):
                             oid, shard,
                             chunks[shard] if state is not None else None,
                             state, log_omap if i == 0 else {},
-                            log_rm if i == 0 else None, av=av))
+                            log_rm if i == 0 else None, av=av,
+                            chunk_crc=(int(crcs[shard])
+                                       if crcs is not None else None)))
                     if osd == self.whoami:
                         # one rollback-capture pass + one WAL append
                         # for every local shard of this write
@@ -786,6 +814,12 @@ class ECBackend(PGBackend):
                         msgs += 1
                 self._note_fanout(msgs)
             finally:
+                if state is not None and isinstance(state.data, DeviceBuf):
+                    # every host sink (local store apply, wire frames)
+                    # has read the staged slot: return it to the pool.
+                    # The handle's truth is the device planes now —
+                    # late readers (projected-state cache) fetch d2h.
+                    state.data.seal()
                 if on_submitted is not None:
                     on_submitted()
 
@@ -795,13 +829,42 @@ class ECBackend(PGBackend):
             self._fan_run(self._fan_ticket(), lambda: fanout([None] * n))
             return
         planes = self._prep_planes(state.data)
+        if isinstance(state.data, DeviceBuf):
+            # device-resident path: the staged payload's planes ride
+            # ONE coalesced upload; encode AND per-shard crc32c run in
+            # that batch; the fan-out ships DeviceBuf chunk handles so
+            # no intermediate bytes copy ever materializes
+            state.data.attach_planes(planes, self.k, self.unit)
+            self._encode_then_fanout(
+                planes,
+                lambda res: fanout(self._chunks_dev(planes, res[0]),
+                                   crcs=res[1]),
+                self._encode_error_fn(tid, on_submitted, on_error,
+                                      state),
+                fused=True, size=len(state.data))
+            return
         self._encode_then_fanout(
             planes,
             lambda coding: fanout(
                 self._chunks_of(planes, coding, self.k, self.m)),
             self._encode_error_fn(tid, on_submitted, on_error))
 
-    def _encode_error_fn(self, tid, on_submitted, on_error):
+    def _chunks_dev(self, planes: np.ndarray, coding) -> List[DeviceBuf]:
+        """k+m chunk payload HANDLES for the fan-out: data chunks view
+        the staged planes (host-pinned, zero-copy to every sink),
+        coding chunks wrap the device-born parity rows (a sink reading
+        them is the one d2h the write pays — and it is counted)."""
+        stats = self.queue.stats
+        chunks = [DeviceBuf.wrap_host(planes[i], stats)
+                  for i in range(self.k)]
+        coding = np.asarray(coding)  # cephlint: disable=no-d2h-on-hot-path
+        # — zero-copy on CPU backends; the real fetch is accounted at
+        # the chunk handles' wire_view sinks
+        chunks += [DeviceBuf.wrap_device(coding[j], stats)
+                   for j in range(self.m)]
+        return chunks
+
+    def _encode_error_fn(self, tid, on_submitted, on_error, state=None):
         """Unwind for a failed device encode: nothing was written or
         sent anywhere, so drop the in-flight op (a later peer-change
         must not complete it as success), let the PG roll back its
@@ -810,6 +873,8 @@ class ECBackend(PGBackend):
         def unwind() -> None:
             self.in_flight.pop(tid, None)
             try:
+                if state is not None and isinstance(state.data, DeviceBuf):
+                    state.data.seal()  # release the staging slot
                 if on_error is not None:
                     on_error()
             finally:

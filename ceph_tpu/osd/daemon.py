@@ -168,6 +168,24 @@ class OSDService(Dispatcher):
         store_pc = getattr(store, "perf", None)
         if store_pc is not None:
             ctx.perf.register(f"osd.{whoami}.store", store_pc)
+        # device-resident data path counters (h2d/d2h bytes, staged
+        # batches, pool occupancy, payload host touches): a live view
+        # of the process-wide StripeBatchQueue accounting — the pool,
+        # like the queue, is shared by every in-process daemon, so the
+        # "metadata-only host crossing" invariant is measured once and
+        # dumped under each daemon's osd.N.tpu set
+        from ceph_tpu.tpu.queue import default_queue
+
+        _dq = default_queue()
+        ctx.perf.register(
+            f"osd.{whoami}.tpu",
+            _dq.stats.perf_view(f"osd.{whoami}.tpu"))
+        # apply the daemon's staging-pool geometry conf (the pool is
+        # built before any Context exists, env-sized); a busy pool
+        # refuses the resize — first idle daemon boot wins
+        _dq.pool.configure(
+            int(ctx.conf.get("tpu_staging_slot_kib")) << 10,
+            int(ctx.conf.get("tpu_staging_slots")))
 
     # -- lifecycle --------------------------------------------------------
     def init(self) -> None:

@@ -46,6 +46,7 @@ from ceph_tpu.osd.backend import (
 )
 from ceph_tpu.osd.pglog import PGLog
 from ceph_tpu.osd.recovery import READ_RETRY, ChunkGather, ECRecoveryEngine
+from ceph_tpu.tpu.staging import DeviceBuf, devpath_enabled
 from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp, PGId, PGInfo
 from ceph_tpu.store.objectstore import (Collection, GHObject, StoreError,
                                         Transaction)
@@ -871,6 +872,10 @@ class PG:
             op.rval = EINVAL
             return EINVAL, False
         flags, fn = got
+        if isinstance(state.data, DeviceBuf):
+            # cls methods treat data as plain bytes: sanctioned
+            # pull-back, counted (never on the WRITEFULL happy path)
+            state.data = state.data.tobytes()
         ctx = MethodContext(state, exists, writable)
         try:
             op.out_data = fn(ctx, op.data) or b""
@@ -1016,6 +1021,23 @@ class PG:
                 reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                                     msg.ops, result=0, version=done_v))
                 return
+        # device-resident small-object path: an all-WRITEFULL payload
+        # is staged ONCE into the pinned pool owned by the stripe
+        # batch queue (the messenger decoded it as a zero-copy frame
+        # view); from here through encode/crc to store apply it flows
+        # as a DeviceBuf handle and only metadata crosses back to
+        # host.  Pool exhaustion BLOCKS here (workqueue thread, never
+        # the messenger loop) — backpressure, not drops; a timed-out
+        # acquire degrades to the host path.
+        if (self.is_ec() and msg.ops
+                and all(o.op == t_.OP_WRITEFULL for o in msg.ops)
+                and devpath_enabled(self.osd.ctx.conf)):
+            last = msg.ops[-1]  # earlier WRITEFULLs are dead stores
+            if (not isinstance(last.data, DeviceBuf) and last.data is not None
+                    and len(last.data)):
+                staged = DeviceBuf.stage(self.backend.queue.pool, last.data)
+                if staged is not None:
+                    last.data = staged
         # per-object admission (pipelined write engine): same-object
         # writes stay strictly ordered — the successor runs only after
         # the predecessor's transactions fanned out, so its state read
@@ -1097,6 +1119,12 @@ class PG:
                 if req_marked:
                     with self._pipe_lock:
                         self._inflight_reqids.pop(reqid, None)
+                # early bail (ESTALE/EAGAIN/op error): the staged
+                # payload never reached the backend — return its slot
+                # without seal()'s defensive copy (nothing reads it)
+                for o in msg.ops:
+                    if isinstance(o.data, DeviceBuf):
+                        o.data.discard()
                 release()
 
     def _writefull_fast_state(self, oid: str):
@@ -1287,6 +1315,15 @@ class PG:
     def _exec_write_op(self, op: OSDOp, st: ObjectState,
                        exists: bool) -> Tuple[int, bool]:
         o = op.op
+        if o in (t_.OP_WRITE, t_.OP_APPEND, t_.OP_TRUNCATE, t_.OP_ZERO):
+            if isinstance(st.data, DeviceBuf):
+                # read-modify over a device-resident payload: the ONE
+                # sanctioned pull-back, and it is counted — mixed-op
+                # workloads pay it, the pure-WRITEFULL happy path
+                # never reaches here
+                st.data = st.data.tobytes()
+            elif isinstance(st.data, memoryview):
+                st.data = bytes(st.data)  # zero-copy frame view: pin
         if o == t_.OP_CALL:
             return self._exec_call(op, st, exists, writable=True)
         if o == t_.OP_WRITE:
@@ -1297,7 +1334,14 @@ class PG:
             buf[op.off:end] = op.data
             st.data = bytes(buf)
         elif o == t_.OP_WRITEFULL:
-            st.data = op.data
+            if isinstance(op.data, memoryview):
+                # the zero-copy frame view's ONE copy-out: the obc
+                # cache retains this state long-term, and pinning the
+                # whole receive frame (or handing cls methods a
+                # memoryview) is worse than one payload copy
+                st.data = bytes(op.data)
+            else:
+                st.data = op.data  # bytes, or a staged DeviceBuf
         elif o == t_.OP_APPEND:
             st.data = st.data + op.data
         elif o == t_.OP_CREATE:

@@ -175,6 +175,8 @@ class OSDOp:
 
     def encode(self, e: Encoder) -> None:
         e.start(1, 1)
+        # blob() materializes DeviceBuf payloads via their sanctioned
+        # (accounted) wire view
         e.u8(self.op).u64(self.off).u64(self.length).blob(self.data)
         e.string(self.name)
         e.mapping(self.kv, lambda enc, k: enc.string(k),
@@ -189,8 +191,14 @@ class OSDOp:
     @classmethod
     def decode(cls, d: Decoder) -> "OSDOp":
         d.start(1)
+        op, off, length = d.u8(), d.u64(), d.u64()
+        # WRITEFULL bodies decode as zero-copy views into the frame
+        # buffer (the small-object data path's receive side): the op
+        # path stages them into the pinned pool — or the store copies
+        # once at txn build — without an intermediate bytes dup here
+        data = d.blob_view() if op == OP_WRITEFULL else d.blob()
         out = cls(
-            op=d.u8(), off=d.u64(), length=d.u64(), data=d.blob(),
+            op=op, off=off, length=length, data=data,
             name=d.string(),
             kv=d.mapping(lambda dd: dd.string(), lambda dd: dd.blob()),
             keys=d.seq(lambda dd: dd.string()),

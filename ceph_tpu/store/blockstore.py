@@ -595,7 +595,9 @@ class BlockStore(ObjectStore):
             self._write(key, 0, b"", ctx)
             return
         if code == os_.OP_WRITE:
-            self._write(key, op.off, op.data, ctx)
+            # copy=True: blob extents RETAIN the buffer — a view into
+            # a staging slot must not outlive the slot's release
+            self._write(key, op.off, os_.op_payload(op, copy=True), ctx)
             return
         if code == os_.OP_ZERO:
             on = self._onode(key) or Onode()

@@ -54,6 +54,12 @@ def _objkey(cid: Collection, oid: GHObject) -> str:
 _COMP_MAGIC = b"CPRS"  # compressed-file header magic
 
 
+def _has_magic(data) -> bool:
+    """data may be bytes OR a zero-copy buffer view (memoryview/numpy
+    from a DeviceBuf store sink) — startswith without materializing."""
+    return bytes(data[:len(_COMP_MAGIC)]) == _COMP_MAGIC
+
+
 class FileStore(ObjectStore):
     def __init__(self, path: str, wal_sync: bool = False,
                  compression: str | None = None) -> None:
@@ -297,7 +303,7 @@ class FileStore(ObjectStore):
             self._data_write(op.cid, op.oid, 0, b"")
             return
         if code == os_.OP_WRITE:
-            self._data_write(op.cid, op.oid, op.off, op.data)
+            self._data_write(op.cid, op.oid, op.off, os_.op_payload(op))
             return
         if code == os_.OP_ZERO:
             self._data_write(op.cid, op.oid, op.off, b"\0" * op.length)
@@ -426,6 +432,8 @@ class FileStore(ObjectStore):
     def _store_file(self, path: str, data: bytes,
                     try_compress: bool) -> None:
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        if not isinstance(data, (bytes, bytearray)):
+            data = bytes(data)  # compressor/magic paths need bytes
         payload = data
         if self._comp is not None and try_compress and len(data) >= 4096:
             comp = self._comp.compress(data)
@@ -438,7 +446,7 @@ class FileStore(ObjectStore):
                 with open(path, "wb") as f:
                     f.write(payload)
                 return
-        if data.startswith(_COMP_MAGIC):
+        if _has_magic(data):
             # escape raw content that collides with the header magic
             payload = (_COMP_MAGIC + bytes([4]) + b"none"
                        + len(data).to_bytes(8, "little") + data)
@@ -456,7 +464,7 @@ class FileStore(ObjectStore):
         # recovery of a big object must not turn O(n^2))
         if (self._file_compressed(path)
                 or (off == 0 and (self._comp is not None
-                                  or data.startswith(_COMP_MAGIC)))):
+                                  or _has_magic(data)))):
             old = self._load_file(path)
             buf = bytearray(old)
             if len(buf) < off:

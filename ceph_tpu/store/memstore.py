@@ -133,7 +133,10 @@ class MemStore(ObjectStore):
             end = op.off + len(op.data)
             if len(o.data) < end:
                 o.data.extend(b"\0" * (end - len(o.data)))
-            o.data[op.off:end] = op.data
+            # op_payload: device-resident payloads (DeviceBuf) land
+            # here via their one sanctioned store-apply view; the
+            # slice assignment below is the copy into owned memory
+            o.data[op.off:end] = os_.op_payload(op)
             return
         if code == os_.OP_ZERO:
             o = self._obj(op.cid, op.oid, create=True)

@@ -105,6 +105,8 @@ class Op:
         e.u8(self.op)
         self.cid.encode(e)
         e.optional(self.oid, lambda enc, o: o.encode(enc))
+        # blob() materializes DeviceBuf payloads via their sanctioned
+        # (accounted) wire view
         e.u64(self.off).u64(self.length).blob(self.data)
         e.mapping(self.attrs, lambda enc, k: enc.string(k),
                   lambda enc, v: enc.blob(v))
@@ -148,7 +150,15 @@ class Transaction:
     def touch(self, cid: Collection, oid: GHObject) -> None:
         self.ops.append(Op(OP_TOUCH, cid, oid))
 
-    def write(self, cid: Collection, oid: GHObject, off: int, data: bytes) -> None:
+    def write(self, cid: Collection, oid: GHObject, off: int, data) -> None:
+        """`data` may be bytes-like OR a DeviceBuf payload handle: the
+        handle rides the op list un-materialized (bufferlist role) and
+        becomes host bytes only at a sanctioned sink — store apply
+        (`op_payload`) or wire serialization (`Op.encode`)."""
+        if hasattr(data, "wire_view"):  # DeviceBuf: keep the handle
+            self.ops.append(Op(OP_WRITE, cid, oid, off=off,
+                               length=len(data), data=data))
+            return
         self.ops.append(Op(OP_WRITE, cid, oid, off=off, length=len(data),
                            data=bytes(data)))
 
@@ -219,6 +229,20 @@ class Transaction:
     @classmethod
     def from_bytes(cls, data: bytes) -> "Transaction":
         return cls.decode(Decoder(data))
+
+
+def op_payload(op: Op, copy: bool = False):
+    """A write op's payload as a host buffer for the store's apply —
+    THE sanctioned materialization point of a device-resident payload
+    (accounted by the DeviceBuf itself; see ceph_tpu/tpu/staging.py
+    ownership rules).  ``copy=True`` for backends that RETAIN the
+    buffer (blob stores): a view into a staging slot must never
+    outlive the slot's release."""
+    d = op.data
+    if hasattr(d, "wire_view"):
+        v = d.wire_view()
+        return bytes(v) if copy else v
+    return d
 
 
 class ValidationOverlay:

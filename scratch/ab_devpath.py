@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""One A/B trial of SMALL-OBJECT (4KiB) EC write IOPS at depth 16 —
+the PR-6 device-resident data path acceptance metric.  Imports
+ceph_tpu from PYTHONPATH so the same script measures any checkout;
+prints JSON.  Interleave trials A,B,A,B,... from a driver to cancel
+rig drift (the box drifts +/-35%)."""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    from ceph_tpu.client.rados import OSDOp
+    from ceph_tpu.osd import types as t_
+    from ceph_tpu.tpu.queue import default_queue
+    from ceph_tpu.vstart import VStartCluster
+
+    depth = 16
+    payload = b"s" * 4096
+    out = {"devpath_env": os.environ.get("CEPH_TPU_TPU_DEVPATH", "")}
+
+    def run(io, n, mk):
+        pend = []
+        t0 = time.perf_counter()
+        for i in range(n):
+            pend.append(io.aio_operate(f"ab_{n}_{i}", mk()))
+            if len(pend) >= depth:
+                pend.pop(0).result(60.0)
+        for p in pend:
+            p.result(60.0)
+        return n / (time.perf_counter() - t0)
+
+    def wf():
+        return [OSDOp(t_.OP_WRITEFULL, data=payload)]
+
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        ec = c.create_pool("ab_ec", size=3, pool_type="erasure",
+                           ec_profile="k=2 m=1")
+        ioec = c.client().ioctx(ec)
+        run(ioec, 32, wf)  # warmup: peering, sockets, codec+crc jit
+        dq = default_queue()
+        stats = getattr(dq, "stats", None)
+        s0 = stats.snapshot() if stats is not None else {}
+        out["ec4k_write_iops"] = round(run(ioec, 192, wf), 1)
+        if stats is not None:
+            s1 = stats.snapshot()
+            out["staged_batches"] = (s1["staged_batches"]
+                                     - s0["staged_batches"])
+            out["h2d_per_payload"] = round(
+                (s1["h2d_bytes"] - s0["h2d_bytes"]) / (192 * 4096.0), 3)
+            out["host_touches"] = (s1["payload_host_touches"]
+                                   - s0["payload_host_touches"])
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

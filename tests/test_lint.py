@@ -299,6 +299,62 @@ def test_jax_purity_follows_pallas_call_kernel(tmp_path):
     assert len(bad) == 1 and bad[0].detail == "np.dot"
 
 
+def test_d2h_flags_materializers_in_fast_dispatch_graph(tmp_path):
+    code = (
+        "import numpy as np\n"
+        "class D:\n"
+        "    def ms_can_fast_dispatch(self, msg):\n"
+        "        return True\n"
+        "    def ms_dispatch(self, conn, msg):\n"
+        "        self._helper(msg)\n"
+        "        return True\n"
+        "    def _helper(self, msg):\n"
+        "        a = np.asarray(msg.buf)\n"      # flagged: d2h fetch
+        "        b = bytes(msg.buf)\n"           # flagged
+        "        c = msg.buf.tolist()\n"         # flagged
+        "        n = len(msg.buf)\n")            # ok: metadata
+    bad = _lint(tmp_path, code, "no-d2h-on-hot-path")
+    assert [v.line for v in bad] == [9, 10, 11]
+
+
+def test_d2h_follows_stripe_queue_worker(tmp_path):
+    # the queue worker root is resolved by module path: write the
+    # fixture AS ceph_tpu/tpu/queue.py so the root matches
+    code = (
+        "import numpy as np\n"
+        "class StripeBatchQueue:\n"
+        "    def _worker(self):\n"
+        "        self._run_batch([])\n"
+        "    def _run_batch(self, batch):\n"
+        "        return np.asarray(batch)\n")    # flagged via worker
+    bad = _lint(tmp_path, code, "no-d2h-on-hot-path",
+                rel="ceph_tpu/tpu/queue.py")
+    assert [v.line for v in bad] == [6]
+    # a plain class's methods are NOT roots
+    ok = _lint(tmp_path, (
+        "import numpy as np\n"
+        "class Other:\n"
+        "    def _run_batch(self, batch):\n"
+        "        return np.asarray(batch)\n"), "no-d2h-on-hot-path")
+    assert not ok
+
+
+def test_d2h_hard_paths_never_baseline(tmp_path):
+    """Violations in the device-path modules are excluded from
+    --write-baseline output: debt there can never be accepted."""
+    from ceph_tpu.analysis.framework import (Violation,
+                                             violations_to_baseline)
+
+    hard = Violation(check="no-d2h-on-hot-path",
+                     path="ceph_tpu/tpu/staging.py", line=1,
+                     scope="DeviceBuf.x", detail="bytes()", message="m")
+    soft = Violation(check="no-d2h-on-hot-path",
+                     path="ceph_tpu/osd/backend.py", line=1,
+                     scope="ECBackend.x", detail="bytes()", message="m")
+    entries = violations_to_baseline([hard, soft])["entries"]
+    assert soft.key in entries and hard.key not in entries
+
+
 def test_parse_error_is_a_violation(tmp_path):
     p = tmp_path / "broken.py"
     p.write_text("def f(:\n")

@@ -213,8 +213,20 @@ def _dump_thrash_forensics(c, err, seed):
     from ceph_tpu.osd import types as ot
     from ceph_tpu.store.objectstore import Collection, GHObject
 
+    from ceph_tpu.tpu.queue import default_queue
+
+    # staging-pool state rides every forensics dump (PR 6): a
+    # divergence with slots still held or host touches recorded
+    # implicates the device-resident path's buffer lifecycle, one
+    # without them exonerates it
+    _dq = default_queue()
     report = {"seed": hex(seed), "time": _time.time(), "error": str(err),
               "osds_up": {i: o.up for i, o in c.osds.items()},
+              "staging_pool": {
+                  "occupancy": _dq.pool.occupancy,
+                  "slots": _dq.pool.nslots,
+                  "slot_bytes": _dq.pool.slot_bytes,
+                  **_dq.stats.snapshot()},
               "pgs": {}, "object": {}}
     # the _verify assertions lead with "{oid}: ..."
     oid = str(err).split(":", 1)[0].strip() or None

@@ -22,11 +22,19 @@ from __future__ import annotations
 
 import argparse
 import hashlib
+import os
 import random
 import sys
 import threading
 import time
 import traceback
+
+# runnable from anywhere, like cephlint: repo root (ceph_tpu) and
+# tests/ (the model sequence) both on the path
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for _p in (_ROOT, os.path.join(_ROOT, "tests")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
 
 
 def _forensics(c, cl, pool: int, oid: str) -> None:
