@@ -3,6 +3,7 @@
 from ceph_tpu.analysis.checks.blocking import NoBlockingOnLoop
 from ceph_tpu.analysis.checks.codec import CodecSymmetry
 from ceph_tpu.analysis.checks.d2h import NoD2HOnHotPath
+from ceph_tpu.analysis.checks.failpoint_names import FailpointNameRegistry
 from ceph_tpu.analysis.checks.jax_purity import JaxPurity
 from ceph_tpu.analysis.checks.locks import NamedLocks
 from ceph_tpu.analysis.checks.silent_except import SilentExcept
@@ -16,6 +17,7 @@ ALL_CHECKS = (
     SilentExcept(),
     JaxPurity(),
     NoD2HOnHotPath(),
+    FailpointNameRegistry(),
 )
 
 CHECKS_BY_NAME = {c.name: c for c in ALL_CHECKS}

@@ -1,0 +1,100 @@
+"""failpoint-name-registry: failpoint call sites must use names
+declared in `ceph_tpu.core.failpoint.POINTS`.
+
+A failpoint is a CONTRACT between an instrumented site and the test /
+operator arming it by name; a typo'd site is a dead injection point
+that silently never fires (the schedule "passes" by testing nothing),
+and a typo'd arming raises at arm() time only because the same table
+gates it.  This check closes the remaining hole — the call sites.
+Also flagged: non-literal names (a dynamic name evades both the
+registry and every grep), and literal names in arm()/enabled() calls,
+for the same reason.
+
+Baseline-free from day one: failpoints ship with this PR, so there is
+no accepted debt — every violation is a hard error and
+``--write-baseline`` refuses to record them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from ceph_tpu.analysis.framework import (
+    NEVER_BASELINE_PREFIXES, Check, SourceFile, Violation, call_name,
+    enclosing_scope,
+)
+
+# call names whose FIRST string argument is a failpoint name
+_NAME_CALLS = ("failpoint", "enabled", "arm", "disarm", "hits", "fired")
+
+
+def _is_fp_call(node: ast.Call) -> str:
+    """Returns the bare function name when `node` is a failpoint-
+    registry call (failpoint(...), fp.failpoint(...), fpt.arm(...)),
+    else ''."""
+    name = call_name(node)
+    base = name.rsplit(".", 1)[-1]
+    if base not in _NAME_CALLS:
+        return ""
+    if base == "failpoint":
+        # failpoint(...) or <alias>.failpoint(...) — the module is
+        # conventionally imported as fp/fpt/failpoint
+        head = name.rsplit(".", 1)[0] if "." in name else ""
+        if head in ("", "fp", "fpt", "failpoint"):
+            return base
+        return ""
+    # the other names are common words: require the fp/fpt module
+    # alias so Event.wait-style calls don't false-positive
+    if "." not in name:
+        return ""
+    head = name.rsplit(".", 1)[0]
+    return base if head in ("fp", "fpt", "failpoint") else ""
+
+
+class FailpointNameRegistry(Check):
+    name = "failpoint-name-registry"
+    description = ("failpoint()/arm() names must be declared in "
+                   "failpoint.POINTS (typo = dead injection point)")
+    scopes = ("ceph_tpu", "tools")
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        from ceph_tpu.core.failpoint import POINTS
+
+        out: List[Violation] = []
+        for f in files:
+            if f.rel.endswith("core/failpoint.py"):
+                continue  # the registry itself
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                base = _is_fp_call(node)
+                if not base or not node.args:
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    out.append(Violation(
+                        check=self.name, path=f.rel, line=node.lineno,
+                        scope=enclosing_scope(f.tree, node.lineno),
+                        detail=f"{base}(<dynamic>)",
+                        message=(f"{base}() name must be a string "
+                                 "literal — a dynamic name evades the "
+                                 "registry and every grep"),
+                    ))
+                    continue
+                if arg.value not in POINTS:
+                    out.append(Violation(
+                        check=self.name, path=f.rel, line=node.lineno,
+                        scope=enclosing_scope(f.tree, node.lineno),
+                        detail=f"{base}({arg.value!r})",
+                        message=(f"failpoint name {arg.value!r} is not "
+                                 "declared in failpoint.POINTS — a "
+                                 "typo'd site never fires"),
+                    ))
+        return out
+
+
+# failpoint plumbing must stay correct-by-construction: refuse to
+# baseline ANY violation of this check, anywhere
+NEVER_BASELINE_PREFIXES.append((FailpointNameRegistry.name, ""))

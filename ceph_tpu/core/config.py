@@ -66,6 +66,9 @@ def _opts() -> List[Option]:
         O("tracing", bool, False, "record blkin-style trace spans"),
         O("admin_socket", str, "", "admin socket path ('' = disabled)"),
         O("heartbeat_interval", float, 5.0, "internal liveness check period"),
+        O("failpoint_inject", str, "",
+          "arm fault-injection points (core/failpoint.py DSL: "
+          "name=action[:modifier...],... — see failpoint.POINTS)"),
         # -- messenger ------------------------------------------------------
         O("ms_bind_ip", str, "127.0.0.1", "listen address", runtime=False),
         O("ms_connect_timeout", float, 10.0, "dial timeout seconds"),
@@ -93,12 +96,19 @@ def _opts() -> List[Option]:
         O("osd_heartbeat_grace", float, 20.0,
           "seconds without a ping before reporting failure"),
         O("osd_heartbeat_interval", float, 2.0, "osd peer ping period"),
+        O("osd_heartbeat_grace_load_stretch", bool, True,
+          "stretch the heartbeat grace by the host's load factor "
+          "(loadavg per cpu, capped 3x) so a CPU-saturated box does "
+          "not mark live-but-starved peers down (ROUND6 bench note)"),
         # -- osd ------------------------------------------------------------
         O("osd_op_num_shards", int, 4, "sharded op queue shards", runtime=False),
         O("osd_op_queue", str, "wpq",
           "op scheduler: wpq (priority) or mclock (QoS)", runtime=False),
         O("osd_op_complaint_time", float, 30.0,
           "seconds after which an op counts as slow (OpTracker)"),
+        O("osd_client_write_timeout", float, 30.0,
+          "seconds before an in-flight client write whose commit (or "
+          "durable-ack gate) never resolves answers retryable EAGAIN"),
         O("osd_max_write_size", int, 90 << 20, "largest single write"),
         O("osd_pool_default_size", int, 3, "replica count"),
         O("osd_pool_default_min_size", int, 0, "0 = size - size/2"),
@@ -112,6 +122,9 @@ def _opts() -> List[Option]:
           "before the legacy fallback / retryable verdict"),
         O("osd_recovery_chunk_size", int, 8 << 20,
           "bytes per recovery push chunk (resumable progress unit)"),
+        O("osd_recovery_push_timeout", float, 30.0,
+          "seconds to wait for a recovery push's ack before leaving "
+          "the peer stale for this round"),
         O("osd_scrub_interval", float, 86400.0, "seconds between scrubs"),
         O("osd_pg_stats_interval", float, 2.0,
           "seconds between MPGStats reports to the mon"),

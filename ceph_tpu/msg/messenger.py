@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.core.crc import crc32c
 from ceph_tpu.core.encoding import Encoder
+from ceph_tpu.core import failpoint as fp
 from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.msg.message import MAck, Message
 
@@ -408,9 +409,17 @@ class Messenger:
                 continue
             # guard against TCP self-connect: dialing a dead localhost
             # port can land on our own ephemeral source port and
-            # "succeed" against ourselves, wedging reconnect forever
-            if (writer.get_extra_info("sockname")[:2]
-                    == writer.get_extra_info("peername")[:2]):
+            # "succeed" against ourselves, wedging reconnect forever.
+            # A connection that died between connect and here reports
+            # None addresses — treat as a failed dial, not a crash of
+            # the whole outgoing task (thrash-kill window)
+            sockname = writer.get_extra_info("sockname")
+            peername = writer.get_extra_info("peername")
+            if sockname is None or peername is None:
+                writer.close()
+                await asyncio.sleep(self._retry)
+                continue
+            if sockname[:2] == peername[:2]:
                 writer.close()
                 await asyncio.sleep(self._retry)
                 continue
@@ -503,7 +512,11 @@ class Messenger:
     async def _on_accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        peer = writer.get_extra_info("peername")[:2]
+        peername = writer.get_extra_info("peername")
+        if peername is None:  # died between accept and here: fold
+            writer.close()
+            return
+        peer = peername[:2]
         # sessions are bidirectional: replies from dispatchers go back
         # over this same socket (conn.send), so the accepted side pumps
         # a send queue too; if the socket drops, the dialing peer owns
@@ -794,6 +807,15 @@ class Messenger:
         """Byte-budgeted: when ms_dispatch_throttle_bytes of payload are
         in flight to dispatchers, stop reading this socket (TCP then
         backpressures the peer — the reference policy throttle)."""
+        # fault injection: a decoded-but-undispatched frame is exactly
+        # what a kill boundary loses — DROP models that loss without a
+        # kill; the enabled() guard keeps the disarmed path free of
+        # even the ctx packing (hot path: every message crosses here)
+        if fp.enabled("msg.frame.deliver"):
+            if fp.failpoint("msg.frame.deliver",
+                            mtype=type(msg).__name__,
+                            entity=str(self.entity)) is fp.DROP:
+                return
         for d in self._dispatchers:
             if d.ms_can_fast_dispatch(msg):
                 # fast dispatch (reference ms_fast_dispatch): run the

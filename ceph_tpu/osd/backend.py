@@ -38,6 +38,7 @@ import numpy as np
 
 from ceph_tpu.core.crc import crc32c
 from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.core import failpoint as fp
 from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.osd import messages as m
 from ceph_tpu.osd.types import EVersion, LogEntry, PGId
@@ -85,21 +86,30 @@ class ObjectState:
 
 
 class InFlightOp:
-    """One replicated/EC write waiting on shard acks."""
+    """One replicated/EC write waiting on shard acks.
 
-    __slots__ = ("waiting_on", "on_commit", "lock")
+    `acked` / `dropped` record HOW the op completed: a completion with
+    `dropped` non-empty is a DEGRADED commit — some acting member never
+    persisted the entry — and the PG's durable-ack gate must make the
+    committed_to watermark outlive this primary before the client may
+    learn the write happened (the 0xd403 acked-loss class)."""
+
+    __slots__ = ("waiting_on", "on_commit", "lock", "acked", "dropped")
 
     def __init__(self, waiting_on: set, on_commit: Callable[[], None]):
         self.waiting_on = waiting_on
         self.on_commit = on_commit
         self.lock = make_lock("backend.inflight")
+        self.acked: set = set()
+        self.dropped: set = set()
 
     def ack(self, who) -> None:
         fire = False
         with self.lock:
             if who in self.waiting_on:  # a late ack from a peer that
                 self.waiting_on.discard(who)  # drop_missing already
-                fire = not self.waiting_on    # removed must not re-fire
+                self.acked.add(who)           # removed must not re-fire
+                fire = not self.waiting_on
         if fire:
             self.on_commit()
 
@@ -114,9 +124,21 @@ class InFlightOp:
             dead = {w for w in self.waiting_on if not is_alive(w)}
             if dead:
                 self.waiting_on -= dead
+                self.dropped |= dead
                 fire = not self.waiting_on
         if fire:
             self.on_commit()
+
+
+def _fire_commit(cb: Callable, op: InFlightOp) -> None:
+    """Completion trampoline: a callback marked ``wants_acked = True``
+    receives the op's completion evidence (who acked, who was dropped
+    dead) so the PG can gate degraded acks on watermark durability;
+    plain callbacks (tests, tools, replica acks) fire unchanged."""
+    if getattr(cb, "wants_acked", False):
+        cb(acked=set(op.acked), dropped=set(op.dropped))
+    else:
+        cb()
 
 
 class PGBackend:
@@ -176,6 +198,8 @@ class PGBackend:
     def handle_reply(self, tid: int, who) -> None:
         op = self.in_flight.get(tid)
         if op is not None:
+            if fp.enabled("backend.commit.ack"):
+                fp.failpoint("backend.commit.ack", tid=tid, who=who)
             op.ack(who)
 
     def on_peer_change(self, alive: set) -> None:
@@ -338,11 +362,16 @@ class ReplicatedBackend(PGBackend):
         peers = [o for o in acting
                  if o != self.whoami and o != CRUSH_ITEM_NONE and o >= 0]
         tid = self._new_tid()
-        op = InFlightOp(set(peers) | {self.whoami},
-                        lambda: (self._done(tid), on_commit()))
+        op = InFlightOp(set(peers) | {self.whoami}, lambda: None)
+        op.on_commit = lambda: (self._done(tid),
+                                _fire_commit(on_commit, op))
         self.in_flight[tid] = op
         body = txn.to_bytes()
         for peer in peers:
+            if (fp.enabled("backend.subwrite.fanout")
+                    and fp.failpoint("backend.subwrite.fanout",
+                                     peer=peer, oid=oid) is fp.DROP):
+                continue  # modeled kill-boundary loss: never sent
             msg = m.MOSDRepOp(self.pgid, self.epoch_fn(), body, entries)
             msg.tid = tid
             self.osd_send(peer, msg)
@@ -764,8 +793,9 @@ class ECBackend(PGBackend):
         shard_osds = list(acting[:n]) + [CRUSH_ITEM_NONE] * (n - len(acting))
         peer_shards = self._peer_map(shard_osds)
         tid = self._new_tid()
-        op = InFlightOp(set(peer_shards),
-                        lambda: (self._done(tid), on_commit()))
+        op = InFlightOp(set(peer_shards), lambda: None)
+        op.on_commit = lambda: (self._done(tid),
+                                _fire_commit(on_commit, op))
         self.in_flight[tid] = op
         version = entries[-1].version if entries else None
         av = _av_stamp(version) if version is not None else None
@@ -803,6 +833,11 @@ class ECBackend(PGBackend):
                         self.store.queue_transaction(
                             txn, on_commit=lambda o=osd: op.ack(o))
                     else:
+                        if (fp.enabled("backend.subwrite.fanout")
+                                and fp.failpoint(
+                                    "backend.subwrite.fanout",
+                                    peer=osd, oid=oid) is fp.DROP):
+                            continue  # modeled loss: never sent
                         msg = m.MECSubWriteVec(
                             self.pgid, epoch, oid,
                             txn.to_bytes(), entries,
@@ -1202,8 +1237,9 @@ class ECBackend(PGBackend):
         shard_osds = list(acting[:n]) + [CRUSH_ITEM_NONE] * (n - len(acting))
         peer_shards = self._peer_map(shard_osds)
         tid = self._new_tid()
-        op = InFlightOp(set(peer_shards),
-                        lambda: (self._done(tid), on_commit()))
+        op = InFlightOp(set(peer_shards), lambda: None)
+        op.on_commit = lambda: (self._done(tid),
+                                _fire_commit(on_commit, op))
         self.in_flight[tid] = op
         ext_off, ext_len = self.sinfo.chunk_extent(s0, s0 + S)
         version = entries[-1].version if entries else None
@@ -1254,6 +1290,11 @@ class ECBackend(PGBackend):
                         self.store.queue_transaction(
                             txn, on_commit=lambda o=osd: op.ack(o))
                     else:
+                        if (fp.enabled("backend.subwrite.fanout")
+                                and fp.failpoint(
+                                    "backend.subwrite.fanout",
+                                    peer=osd, oid=oid) is fp.DROP):
+                            continue  # modeled loss: never sent
                         msg = m.MECSubWriteVec(
                             self.pgid, epoch, oid,
                             txn.to_bytes(), entries,

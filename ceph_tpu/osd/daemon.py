@@ -124,6 +124,15 @@ class OSDService(Dispatcher):
         pc.add_u64_counter("op_r", "client reads")
         pc.add_time_avg("op_w_latency")
         pc.add_u64_counter("recovery_pushes")
+        # heartbeat-starvation diagnosability (ROUND6 bench note: the
+        # mon marked an OSD down mid-bench on a loaded box, and only
+        # archaeology said why): misses count grace overruns observed
+        # by this sender; marked_down_while_alive counts maps that
+        # declared THIS live daemon down
+        pc.add_u64_counter("heartbeat_misses",
+                           "peer heartbeat grace overruns observed")
+        pc.add_u64_counter("marked_down_while_alive",
+                           "osdmaps that marked this live daemon down")
         self.perf = pc
         # pipelined-write-engine counters (registered once, like the
         # osd.N.store set): shared by every PG of this daemon
@@ -188,7 +197,34 @@ class OSDService(Dispatcher):
             int(ctx.conf.get("tpu_staging_slots")))
 
     # -- lifecycle --------------------------------------------------------
+    def _apply_fault_conf(self) -> None:
+        """Arm the conf-declared fault injection: the failpoint_inject
+        DSL, and filestore_debug_inject_read_err (the reference's
+        orphaned option, now wired through the store's bad-object set
+        + the store.filestore.read failpoint)."""
+        from ceph_tpu.core import failpoint as fpt
+
+        spec = str(self.ctx.conf.get("failpoint_inject") or "")
+        if spec:
+            try:
+                armed = fpt.arm_from_spec(spec)
+                self._log(0, f"failpoints armed from conf: {armed}")
+            except (KeyError, ValueError) as e:
+                self._log(0, f"failpoint_inject rejected: {e}")
+        inject = bool(self.ctx.conf.get("filestore_debug_inject_read_err"))
+        if hasattr(self.store, "debug_read_err_enabled"):
+            self.store.debug_read_err_enabled = inject
+
+        def _observe(name, val) -> None:
+            if (name == "filestore_debug_inject_read_err"
+                    and hasattr(self.store, "debug_read_err_enabled")):
+                self.store.debug_read_err_enabled = bool(val)
+
+        self.ctx.conf.add_observer(
+            ("filestore_debug_inject_read_err",), _observe)
+
     def init(self) -> None:
+        self._apply_fault_conf()
         self.store.mount()
         self.msgr.start()
         self.hb_msgr.start()
@@ -495,6 +531,19 @@ class OSDService(Dispatcher):
         self.osdmap = osdmap
         if addr_book:
             self.addr_book.update(addr_book)
+        if (self.up and 0 <= self.whoami < osdmap.max_osd
+                and not osdmap.is_up(self.whoami)
+                and old is not None and old.is_up(self.whoami)):
+            # up->down transition only: the first map after a revive
+            # legitimately still says down (boot races the mon) and
+            # must not pollute the starvation diagnostic
+            # a loaded box starving heartbeats gets live daemons marked
+            # down (ROUND6 bench note); make it a counter + log line so
+            # the next loaded-box artifact is diagnosable from counters
+            self.perf.inc("marked_down_while_alive")
+            self._log(0, f"osd.{self.whoami} marked DOWN by map epoch "
+                         f"{osdmap.epoch} while alive (heartbeat "
+                         f"starvation?)")
         if old is not None:
             # fail in-flight RPC waits on peers this map marks down:
             # their replies can never come, and burning the full RPC
@@ -792,6 +841,7 @@ class OSDService(Dispatcher):
         # must read those peers' replies.
         return isinstance(msg, (m.MOSDRepOpReply, m.MECSubWriteReply,
                                 m.MECSubWriteVecReply,
+                                m.MECCommitNoteAck,
                                 m.MOSDOp, m.MPGInfo, m.MScrubMap,
                                 m.MPGPushReply, m.MPGRecoveryProbeReply,
                                 m.MWatchNotifyAck))
@@ -819,6 +869,13 @@ class OSDService(Dispatcher):
                        if isinstance(msg, m.MECSubWriteReply)
                        else self._osd_of(msg))
                 pg.backend.handle_reply(msg.tid, who)
+            return True
+        if isinstance(msg, m.MECCommitNoteAck):
+            # durable-ack gate leg: flips gate bookkeeping and may fire
+            # a held client reply (a send) — safe inline on the loop
+            pg = self.pgs.get(msg.pgid)
+            if pg is not None:
+                pg.handle_commit_note_ack(msg)
             return True
         if isinstance(msg, (m.MECSubReadReply, m.MECSubReadVecReply)):
             cb = self._read_cbs.get(msg.tid)
@@ -1056,12 +1113,27 @@ class OSDService(Dispatcher):
             conn.send(rep)
 
     # -- heartbeats -------------------------------------------------------
+    def _load_stretch(self) -> float:
+        """Heartbeat-grace stretch factor under CPU saturation: a
+        loaded box delays ping HANDLING, not just sending — stretching
+        the fuse by loadavg-per-cpu (capped 3x) keeps live-but-starved
+        peers from being reported down (the ROUND6 loaded-bench
+        down-mark).  1.0 when disabled or unmeasurable."""
+        try:
+            if not self.ctx.conf.get("osd_heartbeat_grace_load_stretch"):
+                return 1.0
+            load = os.getloadavg()[0] / max(1, os.cpu_count() or 1)
+        except (OSError, AttributeError, KeyError):
+            return 1.0
+        return min(3.0, max(1.0, load))
+
     def _hb_loop(self, interval: float) -> None:
         grace = self.ctx.conf.get("osd_heartbeat_grace")
         while not self._hb_stop.wait(interval):
             now = time.time()
             hb_addrs = (dict(self.osdmap.osd_hb_addrs)
                         if self.osdmap is not None else {})
+            stretch = self._load_stretch()
             for osd_id, addr in hb_addrs.items():
                 if osd_id == self.whoami or self.osdmap is None or (
                         not self.osdmap.is_up(osd_id)):
@@ -1073,9 +1145,15 @@ class OSDService(Dispatcher):
                 # a longer fuse (3x) before the first reply so startup
                 # churn doesn't trigger spurious reports
                 last = self.hb_stamps.setdefault(osd_id, now)
-                fuse = grace if osd_id in self.hb_replied else 3 * grace
+                fuse = (grace if osd_id in self.hb_replied
+                        else 3 * grace) * stretch
                 if now - last > fuse:
+                    self.perf.inc("heartbeat_misses")
                     if self.on_failure_report:
+                        self._log(1, f"heartbeat: osd.{osd_id} silent "
+                                     f"{now - last:.1f}s > fuse "
+                                     f"{fuse:.1f}s (stretch "
+                                     f"{stretch:.2f}); reporting")
                         self.on_failure_report(osd_id)
 
     def _handle_ping(self, conn: Connection, msg: m.MOSDPing) -> bool:

@@ -834,3 +834,40 @@ class MECCommitNote(_PGMessage):
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.committed_to = EVersion.decode(d)
+
+
+@register
+class MECCommitNoteAck(_PGMessage):
+    """Shard -> primary: the commit-note watermark at `committed_to`
+    is PERSISTED here.  Sent only for notes carrying a tid — the
+    durable-ack gate of a DEGRADED commit, where the client reply must
+    not fire until the watermark can outlive the primary (the 0xd403
+    acked-write-vs-rollback loss class: an acked entry whose watermark
+    lived solely in the dead primary's memory counted < k holders at
+    the next whole-set arbitration and was rewound).  Advisory
+    (tid-less) notes stay fire-and-forget, so mixed-version peers that
+    never ack merely keep the old unprotected window."""
+
+    TYPE = 52
+
+    def __init__(self, pgid=(0, 0), epoch=0,
+                 committed_to: Optional[EVersion] = None,
+                 last_update: Optional[EVersion] = None) -> None:
+        super().__init__(pgid, epoch)
+        self.committed_to = committed_to or EVersion()
+        # the acker's log head: lets a REPLAY gate count how many
+        # members actually HOLD the replayed entry (pg logs are
+        # contiguous, so last_update >= v implies the v entry) — a
+        # resend must never be answered result=0 for a write whose
+        # data never reached k shards
+        self.last_update = last_update or EVersion()
+
+    def encode_payload(self, e: Encoder) -> None:
+        self._enc_head(e)
+        self.committed_to.encode(e)
+        self.last_update.encode(e)
+
+    def decode_payload(self, d: Decoder) -> None:
+        self._dec_head(d)
+        self.committed_to = EVersion.decode(d)
+        self.last_update = EVersion.decode(d)

@@ -32,6 +32,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ceph_tpu.core.crc import crc32c
+from ceph_tpu.core import failpoint as fp
 from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.core.encoding import DecodeError, Decoder, Encoder
 from ceph_tpu.osd import messages as m
@@ -73,8 +74,90 @@ STATE_DEGRADED = "active+degraded"
 
 # a client write whose commit never arrives (a live-but-silent shard
 # holder the map never resolves) answers retryable after this long —
-# the async replacement for the old block-with-timeout
+# the async replacement for the old block-with-timeout (overridable
+# via conf osd_client_write_timeout; tests shrink it)
 WRITE_TIMEOUT_S = 30.0
+
+# process-wide divergent-rollback event ring: the acked-durability
+# oracle (tests/test_rados_model.py) joins a lost granule to the
+# rollback that destroyed it, turning "m2: xattr x1" into a report
+# naming the rewind.  Forensics-only — never read by the data path.
+ROLLBACK_EVENTS: "collections.deque" = collections.deque(maxlen=256)
+
+
+class _NoteGate:
+    """Durable-ack gate of one DEGRADED EC commit: the client reply is
+    held until every surviving acked co-holder has PERSISTED the
+    committed_to watermark (MECCommitNote with tid -> MECCommitNoteAck).
+
+    This is the 0xd403 fix: a degraded write used to ack the client
+    the moment its k-wide commit landed, with the watermark broadcast
+    fire-and-forget — so the primary dying inside that window left the
+    acked entry's watermark nowhere durable, and the next whole-set
+    arbitration counted < k holders and rewound an acknowledged write
+    (xattr loss / byte divergence / missing object, always right after
+    a `rolled back 1 divergent entries` line).  With the gate, a
+    client that holds an ack implies a durable witness beyond the
+    primary.
+
+    Peers that die mid-gate are pruned: if a persisted witness already
+    acked, the gate fires (durability holds); if none did, the gate
+    drops SILENTLY — the deadline sweep answers EAGAIN and the resend
+    re-runs the gate against the live set.  An ack without a witness
+    is exactly the bug."""
+
+    __slots__ = ("waiting", "got", "lus", "complete", "lock",
+                 "expires")
+
+    def __init__(self, waiting: set, complete: Callable[[], None],
+                 expires: float = 0.0):
+        self.waiting = set(waiting)
+        self.got: set = set()
+        self.lus: Dict[int, EVersion] = {}  # acker -> its log head
+        self.complete = complete
+        self.lock = make_lock("pg.note_gate")
+        # monotonic expiry: a gated note lost to a LIVE peer (dropped
+        # frame, wedged dispatch) would otherwise pin this gate — and
+        # the client reply closure with its MOSDOp payload — forever;
+        # the deadline sweep discards expired gates (the client got
+        # its EAGAIN from the write deadline, the resend re-gates)
+        self.expires = expires
+
+    def ack(self, who: int, last_update: Optional[EVersion] = None
+            ) -> None:
+        with self.lock:
+            if who not in self.waiting:
+                return
+            self.waiting.discard(who)
+            self.got.add(who)
+            if last_update is not None:
+                self.lus[who] = last_update
+            fire = not self.waiting
+        if fire:
+            self.complete()
+
+    def holders_at(self, version: EVersion) -> int:
+        """Ackers whose log head reaches `version` (pg logs are
+        contiguous, so last_update >= v implies they hold the v
+        entry) — the replay gate's k-durability evidence."""
+        with self.lock:
+            return sum(1 for lu in self.lus.values() if lu >= version)
+
+    def prune_dead(self, alive: set) -> bool:
+        """Remove peers not in `alive`; returns True when the gate
+        should be discarded WITHOUT firing (no witness persisted)."""
+        with self.lock:
+            dead = {w for w in self.waiting if w not in alive}
+            if not dead:
+                return False
+            self.waiting -= dead
+            if self.waiting:
+                return False
+            fire = bool(self.got)
+        if fire:
+            self.complete()
+            return False
+        return True
 
 
 class _OidPipe:
@@ -185,6 +268,13 @@ class PG:
         # into the next sub-write's piggyback (flush_commit_note)
         self._ct_lock = make_lock("pg.committed_to")
         self._ct_dirty = False
+        # durable-ack bookkeeping: _ct_covered is the newest version
+        # whose watermark provably outlives this primary (full-width
+        # commit, or a completed note gate); replays of reqids above
+        # it re-run the gate before answering result=0.  _note_gates
+        # holds the in-flight gates keyed by note tid.
+        self._ct_covered = EVersion()
+        self._note_gates: Dict[int, _NoteGate] = {}
         # windowed EC recovery engine (osd/recovery.py), created lazily
         # on the first pull/parked read
         self._recovery: Optional[ECRecoveryEngine] = None
@@ -272,6 +362,18 @@ class PG:
         alive = {o for o in acting if o >= 0 and o != CRUSH_ITEM_NONE}
         alive.add(self.osd.whoami)
         self.backend.on_peer_change(alive)
+        # durable-ack gates waiting on dropped peers re-resolve too: a
+        # gate with a persisted witness fires, one with none drops
+        # silently (deadline EAGAIN; the resend re-runs the gate)
+        self._sweep_note_gates(alive)
+
+    def _sweep_note_gates(self, alive: set) -> None:
+        with self._ct_lock:
+            gates = list(self._note_gates.items())
+        for tid, g in gates:
+            if g.prune_dead(alive):
+                with self._ct_lock:
+                    self._note_gates.pop(tid, None)
 
     # -- op execution (primary) -------------------------------------------
     def do_op(self, msg: m.MOSDOp, reply: Callable[[m.MOSDOpReply], None],
@@ -968,13 +1070,21 @@ class PG:
         threading.Thread(target=job, daemon=True,
                          name="pg-write-pipe").start()
 
+    def _write_timeout_s(self) -> float:
+        try:
+            return float(self.osd.ctx.conf.get("osd_client_write_timeout"))
+        except Exception:
+            return WRITE_TIMEOUT_S  # bare-stub osds in unit tests
+
     def _arm_write_deadline(self, replied: List[bool],
                             fire: Callable[[], None],
-                            timeout: float = WRITE_TIMEOUT_S) -> None:
+                            timeout: Optional[float] = None) -> None:
         """`replied` is the write's reply-once flag: the sweep drops
         rows whose reply already went out (commit or error), so a
         committed write's closure — which pins the whole MOSDOp and
         its payload — lives ~one watchdog tick, not the full 30 s."""
+        if timeout is None:
+            timeout = self._write_timeout_s()
         with self._pipe_lock:
             self._write_deadlines.append((time.monotonic() + timeout,
                                           replied, fire))
@@ -986,6 +1096,15 @@ class PG:
         rows already replied (committed) and expired in-flight reqid
         marks."""
         now = time.monotonic()
+        # expired durable-ack gates go too: a gated note lost to a
+        # live peer never resolves, and the gate must not pin its
+        # client-reply closure past the write deadline (the client
+        # already got EAGAIN; its resend re-gates)
+        with self._ct_lock:
+            stale_gates = [t for t, g in self._note_gates.items()
+                           if g.expires and g.expires <= now]
+            for t in stale_gates:
+                del self._note_gates[t]
         due: List[Callable[[], None]] = []
         with self._pipe_lock:
             if not self._write_deadlines and not self._inflight_reqids:
@@ -1008,6 +1127,54 @@ class PG:
         if note is not None:
             note(delta)
 
+    def _replay_reply(self, msg, reply, done_v: EVersion) -> None:
+        """Answer a resend of an already-committed write.  result=0 IS
+        an ack: if this version's durable-ack coverage never completed
+        (the original degraded commit EAGAINed at the gate, or this is
+        a freshly-failed-over primary), the replay must re-run the
+        watermark gate against the live acting peers first — answering
+        from the log alone would re-open the 0xd403 window through the
+        resend door."""
+        def fire() -> None:
+            reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                msg.ops, result=0, version=done_v))
+
+        with self._ct_lock:
+            covered = done_v <= self._ct_covered
+        if covered or not self.is_ec() or self.primary != self.osd.whoami:
+            fire()
+            return
+        omap_ = self.osd.osdmap
+        n = self.backend.k + self.backend.m
+        peers = sorted({o for o in self.acting[:n]
+                        if o >= 0 and o != CRUSH_ITEM_NONE
+                        and o != self.osd.whoami
+                        and (omap_ is None or omap_.is_up(o))})
+        if not peers:
+            fire()
+            return
+        replied = [False]
+        rlock = make_lock("pg.reply_once")
+
+        def fire_once() -> None:
+            with rlock:
+                if replied[0]:
+                    return
+                replied[0] = True
+            fire()
+
+        def timeout_eagain() -> None:
+            with rlock:
+                if replied[0]:
+                    return
+                replied[0] = True
+            reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
+                                msg.ops, result=EAGAIN))
+
+        self._gate_on_notes(done_v, peers, fire_once,
+                            need_holders_at=done_v)
+        self._arm_write_deadline(replied, timeout_eagain)
+
     def _do_write(self, msg, reply):
         self.record_hit(msg.oid)
         # completed-op replay fast path: a resend of an already-
@@ -1018,8 +1185,7 @@ class PG:
             with self.lock:
                 done_v = self._reqids.get(reqid)
             if done_v is not None:
-                reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
-                                    msg.ops, result=0, version=done_v))
+                self._replay_reply(msg, reply, done_v)
                 return
         # device-resident small-object path: an all-WRITEFULL payload
         # is staged ONCE into the pinned pool owned by the stripe
@@ -1088,12 +1254,11 @@ class PG:
                            and reqid in self._inflight_reqids)
                     if done_v is None and not dup:
                         self._inflight_reqids[reqid] = (
-                            time.monotonic() + 2 * WRITE_TIMEOUT_S)
+                            time.monotonic()
+                            + 2 * self._write_timeout_s())
                         req_marked = True
                 if done_v is not None:
-                    reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
-                                        msg.oid, msg.ops, result=0,
-                                        version=done_v))
+                    self._replay_reply(msg, reply, done_v)
                     return
                 if dup:
                     # resend racing its own in-flight original: never
@@ -1208,18 +1373,15 @@ class PG:
                 state, supersede = None, True
                 # WRITEFULL replaces DATA but keeps xattrs/omap —
                 # forking from fully-absent silently wiped them
-                # (model-thrash omap-loss find).  Best effort: carry
-                # the meta of the freshest local shard; its data may
-                # be a stale generation but the newest local stamp is
-                # the best testimony reachable without the dead holder.
-                best = None
-                for shard in self.backend.local_shards(self.acting):
-                    attrs, omap = self.backend.shard_meta(
-                        msg.oid, shard)
-                    if (attrs or omap) and (
-                            best is None or attrs.get("_av", b"")
-                            > best[0].get("_av", b"")):
-                        best = (dict(attrs), dict(omap))
+                # (model-thrash omap-loss find).  Carry the meta with
+                # the freshest _av stamp among LOCAL shards AND the
+                # reachable acting holders: an acked setxattr/omap may
+                # live only on a peer's shard (this primary took over
+                # mid-churn, or a rollback stripped its local copy),
+                # and superseding from local-only testimony laundered
+                # PRE-ACK meta forward under a fresh stamp — the
+                # second 0xd403 loss mechanic.
+                best = self._supersede_meta(msg.oid)
                 if best is not None:
                     xa = {k: v for k, v in best[0].items()
                           if k not in ("hinfo", "_av")}
@@ -1311,6 +1473,37 @@ class PG:
             m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                           msg.ops, result=EAGAIN)))
         return True
+
+    def _supersede_meta(self, oid: str):
+        """Freshest (attrs, omap) testimony reachable for a superseding
+        WRITEFULL's meta carry-forward: local shards first, then one
+        short sub-read round to the live acting peers (cheap 1-byte
+        extents; the meta rides every sub-read reply).  Ranked by
+        ChunkGather's meta discipline — highest _av stamp wins, valid
+        hinfo breaks ties.  Returns None when nobody has anything."""
+        box: List = [None]
+        for shard in self.backend.local_shards(self.acting):
+            attrs, omap = self.backend.shard_meta(oid, shard)
+            if attrs or omap:
+                ChunkGather._better_meta(box, attrs, omap)
+        omap_ = self.osd.osdmap
+        n = self.backend.k + self.backend.m
+        acting = list(self.acting[:n])
+        remote = [
+            (o, m.MECSubRead(self.pgid, self.osd.epoch(), s, oid, 0, 1))
+            for s, o in enumerate(acting)
+            if o not in (self.osd.whoami, CRUSH_ITEM_NONE) and o >= 0
+            and (omap_ is None or omap_.is_up(o))
+        ]
+        if remote:
+            for rep in self.osd.rpc(remote, timeout=5.0):
+                if (isinstance(rep, m.MECSubReadReply)
+                        and rep.oid == oid
+                        and (rep.attrs or rep.omap)):
+                    ChunkGather._better_meta(box, rep.attrs, rep.omap)
+        if box[0] is None:
+            return None
+        return (dict(box[0][0]), dict(box[0][1]))
 
     def _exec_write_op(self, op: OSDOp, st: ObjectState,
                        exists: bool) -> Tuple[int, bool]:
@@ -1511,17 +1704,20 @@ class PG:
             log_omap = self.log.omap_additions([entry])
             log_rm = self.log.omap_removals(self.log.trim_to())
 
-            def on_commit() -> None:
+            def on_commit(acked=None, dropped=None) -> None:
                 # register + unmark atomically (see _commit_write)
                 if entry.reqid:
                     with self._pipe_lock:
                         self._note_reqid(entry)
                         self._inflight_reqids.pop(entry.reqid, None)
-                self._note_committed(version)
                 self._note_inflight(-1)
-                reply_once(m.MOSDOpReply(
-                    self.pgid, self.osd.epoch(), msg.oid, msg.ops,
-                    result=0, version=version))
+                self._durable_ack(
+                    version, acked, dropped,
+                    lambda: reply_once(m.MOSDOpReply(
+                        self.pgid, self.osd.epoch(), msg.oid, msg.ops,
+                        result=0, version=version)))
+
+            on_commit.wants_acked = True
 
             # WRITE: per-shard extents of the touched stripes only
             self._obc_invalidate(msg.oid)  # extents bypass full state
@@ -1557,7 +1753,7 @@ class PG:
         trimmed = self.log.trim_to()
         log_rm = self.log.omap_removals(trimmed)
 
-        def on_commit() -> None:
+        def on_commit(acked=None, dropped=None) -> None:
             # replay registration happens at COMMIT, not append: a write
             # that never reached quorum (EAGAIN to client) must not be
             # answered as done on resend.  Registration and the
@@ -1568,12 +1764,20 @@ class PG:
                 with self._pipe_lock:
                     self._note_reqid(entry)
                     self._inflight_reqids.pop(entry.reqid, None)
-            self._note_committed(version)
             self._note_inflight(-1)
-            reply(m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
-                                msg.ops, result=0, version=version))
-            if committed is not None:
-                committed.set()
+
+            def fire() -> None:
+                reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                    msg.oid, msg.ops, result=0,
+                                    version=version))
+                if committed is not None:
+                    committed.set()
+
+            # degraded EC commits hold the reply until the watermark
+            # is durable beyond this primary (the 0xd403 fix)
+            self._durable_ack(version, acked, dropped, fire)
+
+        on_commit.wants_acked = True
 
         kw = {"log_rm": log_rm}
         if pre_txn is not None:
@@ -1683,52 +1887,151 @@ class PG:
             self.info.last_update = self.log.head
             self.info.last_complete = self.log.head
 
-    def _note_committed(self, version: EVersion) -> None:
-        """Advance the roll-forward watermark: the op at `version` got
-        its LAST shard ack, so every acting shard holds it and
+    def _durable_ack(self, version: EVersion, acked, dropped,
+                     fire: Callable[[], None]) -> None:
+        """Advance the roll-forward watermark and release the client
+        reply — the op at `version` got its last shard ack, so
         divergent-entry rollback must never rewind past it (the
         reference's roll_forward_to).
 
-        EC primaries broadcast the advance to their acting shards
-        IMMEDIATELY (MECCommitNote, sent before the client reply is
-        enqueued) rather than only piggybacking it on the next
-        sub-write: an acked write with no successor, followed by the
-        primary's death, otherwise leaves the watermark solely on the
-        dead primary — and the next peering round, seeing < k
-        reachable holders and no watermark, would roll back an
-        acknowledged write (the round-6 thrash data-loss trace).
+        Called from commit callbacks with and without the pg lock held
+        (some inline on the messenger loop): the watermark check-then-
+        set runs under a dedicated leaf lock, and the pg lock is never
+        taken here.
 
-        Called from commit callbacks with and without the pg lock
-        held: the check-then-set runs under a dedicated leaf lock
-        (never the pg lock — lockdep's checked mutex is not
-        reentrant), because two shard-ack threads racing it bare
-        could store out of order and REGRESS the watermark below an
-        already-broadcast note.
-
-        Broadcast policy (pipelined-write-engine cost cut): a HEALTHY
-        full-width commit needs no eager note — every acting shard
-        holds the entry, so the >=k-holders roll-forward rule protects
-        it through any later death pattern (and the no-rollback-while-
-        the-acting-set-has-a-hole rule covers the interim).  Those
-        notes (two extra messages plus two peer-side pg-meta persists
-        PER WRITE at depth 16) are absorbed into the committed_to
-        piggyback on the next sub-write, with the watchdog sweep
-        flushing the idle tail.  A DEGRADED commit — exactly the
-        round-6 trace, acked on as few as k live shards — still
-        broadcasts immediately, before the client reply is enqueued."""
+        Reply policy — the 0xd403 fix: a HEALTHY full-width commit
+        fires immediately with its broadcast ABSORBED into the next
+        sub-write's committed_to piggyback (the >=k-holders
+        roll-forward rule already protects it through any single death,
+        and eager notes cost two messages + two peer pg-meta persists
+        per write at depth 16).  A DEGRADED commit — some acting member
+        dropped dead mid-write, acked on as few as k shards — must NOT
+        ack the client until the watermark provably outlives this
+        primary: the round-6 loss traces were exactly an acked entry
+        whose watermark lived solely in the dead primary's memory (the
+        old eager broadcast was fire-and-forget, and the 2x-CPU-load
+        window between client ack and note delivery spanned the thrash
+        kill), so the next whole-set arbitration counted < k holders,
+        floored below the entry, and rewound acknowledged state.  The
+        gate sends tid-carrying notes to every surviving acked
+        co-holder and fires only when each has PERSISTED the watermark
+        (MECCommitNoteAck); a commit that never reached k members at
+        all is not EC-durable and is left to the deadline sweep's
+        EAGAIN."""
         with self._ct_lock:
-            if version <= self.info.committed_to:
-                return
-            self.info.committed_to = version
+            if version > self.info.committed_to:
+                self.info.committed_to = version
         if not self.is_ec() or self.primary != self.osd.whoami:
+            fire()
             return
-        if self.state == STATE_ACTIVE:
+        # read without the pg lock: a racing interval change only
+        # widens toward the gated (safe) side
+        n = self.backend.k + self.backend.m
+        slots = list(self.acting[:n])
+        full = (acked is not None and not dropped
+                and len(slots) == n
+                and all(o >= 0 and o != CRUSH_ITEM_NONE for o in slots)
+                and all(o in acked for o in set(slots))
+                and self.state == STATE_ACTIVE)
+        if full:
             with self._ct_lock:
                 self._ct_dirty = True
+                if version > self._ct_covered:
+                    self._ct_covered = version
+            fire()
             return
-        self._broadcast_commit_note(version)
+        members = set(acked or ())
+        if len(members) < self.backend.k:
+            # fewer than k members persisted the entry: not durable at
+            # EC strength — never tell the client it is.  The deadline
+            # sweep answers EAGAIN; the resend re-runs the gate.
+            self.osd._log(1, f"pg {t_.pgid_str(self.pgid)}: commit of "
+                             f"{version} on {sorted(members)} is below "
+                             f"k={self.backend.k}; withholding ack")
+            return
+        peers = sorted(members - {self.osd.whoami})
+        if not peers:
+            # every persisted shard is local: our own durable log IS
+            # the whole testimony — nothing remote to wait for
+            fire()
+            return
+        self._gate_on_notes(version, peers, fire)
+
+    def _gate_on_notes(self, version: EVersion, peers: List[int],
+                       fire: Callable[[], None],
+                       need_holders_at: Optional[EVersion] = None
+                       ) -> None:
+        """Hold `fire` until every peer persists the watermark at
+        `version`.  Note sends + the local meta persist hop to the
+        fan-out lane — this may run inline on the messenger loop.
+
+        `need_holders_at` (the REPLAY gate): additionally require that
+        self plus the ackers whose log heads reach that version make
+        up k members — a commit-path gate's peers acked the sub-write
+        itself so they hold the entry by construction, but a replayed
+        reqid may belong to a write whose data never reached k shards
+        (both peers died mid-write); persisting the watermark alone
+        would answer result=0 for unreconstructable data."""
+        tid = self.osd.new_tid()
+        gate_box: List[_NoteGate] = []
+
+        def complete() -> None:
+            with self._ct_lock:
+                self._note_gates.pop(tid, None)
+            if need_holders_at is not None:
+                held = 1 + gate_box[0].holders_at(need_holders_at)
+                if held < self.backend.k:
+                    # the entry's data is below k shards: not
+                    # EC-durable — stay silent, the deadline sweep
+                    # answers EAGAIN and the object heals via
+                    # recovery or a superseding write first
+                    self.osd._log(
+                        1, f"pg {t_.pgid_str(self.pgid)}: replay of "
+                           f"{need_holders_at} held by {held} < "
+                           f"k={self.backend.k}; withholding ack")
+                    return
+            with self._ct_lock:
+                if version > self._ct_covered:
+                    self._ct_covered = version
+            fp.failpoint("pg.commit.client_reply", version=str(version))
+            fire()
+
+        gate = _NoteGate(set(peers), complete,
+                         expires=time.monotonic()
+                         + 2 * self._write_timeout_s())
+        gate_box.append(gate)
+        with self._ct_lock:
+            self._note_gates[tid] = gate
+
+        def send_notes() -> None:
+            fp.failpoint("pg.commit_note.broadcast",
+                         version=str(version), gated=True)
+            # the primary's own watermark goes durable alongside: a
+            # revived primary then testifies the floor from its info.
+            # Under the pg lock like every other persist site — an
+            # unlocked encode could snapshot a concurrent write's
+            # last_update BEFORE that write's entry reaches the WAL,
+            # and a kill between the two records leaves persisted
+            # info claiming an entry the log can't produce (breaking
+            # the contiguity the holder counts rely on)
+            with self.lock:
+                self._persist_meta()
+            epoch = self.osd.epoch()
+            for osd_id in peers:
+                note = m.MECCommitNote(self.pgid, epoch, version)
+                note.tid = tid
+                self.osd.send_to_osd(osd_id, note)
+
+        from ceph_tpu.osd.backend import _fanout_executor
+
+        _fanout_executor().submit(send_notes)
 
     def _broadcast_commit_note(self, version: EVersion) -> None:
+        """Advisory (tid-less, fire-and-forget) watermark broadcast —
+        the healthy-path tail flush.  Durability-bearing broadcasts go
+        through _gate_on_notes instead."""
+        fp.failpoint("pg.commit_note.broadcast", version=str(version),
+                     gated=False)
         for osd_id in self.acting:
             if osd_id in (self.osd.whoami, CRUSH_ITEM_NONE) or osd_id < 0:
                 continue
@@ -1751,14 +2054,45 @@ class PG:
     def handle_commit_note(self, msg: m.MECCommitNote, conn) -> None:
         """Shard side of the roll-forward watermark: merge and PERSIST
         it (a revived shard must still refuse to rewind acked
-        entries).  No reply — the note is advisory; losing one only
-        defers protection to the piggyback on the next sub-write."""
+        entries).  A tid-less note is advisory (no reply; losing one
+        only defers protection to the next piggyback); a tid-carrying
+        note is one leg of a degraded commit's durable-ack gate — the
+        persist is unconditional (the in-memory watermark may be ahead
+        of the durable one via sub-write piggybacks) and the ack goes
+        back only once it is on stable storage."""
+        if fp.enabled("pg.commit_note.persist") and fp.failpoint(
+                "pg.commit_note.persist", osd=self.osd.whoami,
+                v=str(msg.committed_to)) is fp.DROP:
+            return  # modeled loss: the note dies with its sender
         with self.lock:
             with self._ct_lock:
-                if msg.committed_to <= self.info.committed_to:
-                    return
-                self.info.committed_to = msg.committed_to
+                newer = msg.committed_to > self.info.committed_to
+                if newer:
+                    self.info.committed_to = msg.committed_to
+            if not newer and not msg.tid:
+                return
             self._persist_meta()
+        if not msg.tid:
+            return
+        if fp.enabled("pg.commit_note.ack") and fp.failpoint(
+                "pg.commit_note.ack", osd=self.osd.whoami) is fp.DROP:
+            return
+        rep = m.MECCommitNoteAck(self.pgid, self.osd.epoch(),
+                                 msg.committed_to,
+                                 last_update=self.info.last_update)
+        rep.tid = msg.tid
+        conn.send(rep)
+
+    def handle_commit_note_ack(self, msg: m.MECCommitNoteAck,
+                               conn=None) -> None:
+        """Primary side of the durable-ack gate: one surviving
+        co-holder has the watermark on stable storage (its log head
+        rides along for the replay gate's holder count)."""
+        src = msg.src.num if msg.src else -1
+        with self._ct_lock:
+            gate = self._note_gates.get(msg.tid)
+        if gate is not None and src >= 0:
+            gate.ack(src, getattr(msg, "last_update", None))
 
     # -- reqid replay (exactly-once resends) ------------------------------
     def _note_reqid(self, en: LogEntry) -> None:
@@ -2129,6 +2463,8 @@ class PG:
                 break
         if auth is None or auth >= heads[0]:
             return infos  # nothing divergent / nothing safely rewindable
+        fp.failpoint("pg.resolve_divergent", auth=str(auth),
+                     head=str(heads[0]), committed=str(committed))
         if any(o not in lus for o in acting):
             # an acting member never answered: it may hold (and its ack
             # may have completed) the very entries a rewind would drop
@@ -2183,6 +2519,8 @@ class PG:
                     self.backend.coll, _meta_oid())
             fallback_rm: List[str] = []
             for en in divergent:  # newest first
+                fp.failpoint("pg.rollback.entry", oid=en.oid,
+                             version=str(en.version))
                 if not self.backend.roll_back_entry(en, meta_omap):
                     # no record: local state for this object is suspect
                     # — recovery must re-replicate it
@@ -2197,6 +2535,14 @@ class PG:
                 self.osd.store.queue_transaction(t)
             self._persist_meta()
             self._reindex_reqids()
+            # forensic channel: the acked-durability oracle joins a
+            # lost granule to the rewind that destroyed it
+            ROLLBACK_EVENTS.append({
+                "time": time.time(), "osd": self.osd.whoami,
+                "pg": t_.pgid_str(self.pgid), "target": str(target),
+                "entries": [(en.oid, str(en.version), en.op)
+                            for en in divergent],
+            })
             self.osd._log(1, f"pg {t_.pgid_str(self.pgid)}: rolled back "
                              f"{len(divergent)} divergent entries to "
                              f"{target}")
@@ -2259,10 +2605,18 @@ class PG:
             if ok:
                 self.stale_peers.discard(osd_id)
 
+    def _push_timeout_s(self) -> float:
+        try:
+            return float(
+                self.osd.ctx.conf.get("osd_recovery_push_timeout"))
+        except Exception:
+            return 30.0  # bare-stub osds in unit tests
+
     def push_delete(self, oid: str, to_osd: int) -> bool:
         msg = m.MPGPush(self.pgid, self.osd.epoch(), oid, self.log.head,
                         deleted=True, shard=-1)
-        reps = self.osd.rpc([(to_osd, msg)], timeout=30.0)
+        reps = self.osd.rpc([(to_osd, msg)],
+                            timeout=self._push_timeout_s())
         return any(isinstance(r, m.MPGPushReply) for r in reps)
 
     def push_object(self, oid: str, to_osd: int) -> bool:
@@ -2301,7 +2655,8 @@ class PG:
                     dict(msg.omap) if off == 0 else {},
                     shard=msg.shard, off=off, total=total,
                     more=off + len(part) < total))
-        reps = self.osd.rpc([(to_osd, msg) for msg in msgs], timeout=30.0)
+        reps = self.osd.rpc([(to_osd, msg) for msg in msgs],
+                            timeout=self._push_timeout_s())
         return sum(1 for r in reps
                    if isinstance(r, m.MPGPushReply)) >= len(msgs)
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.core.failpoint import failpoint
 from ceph_tpu.osd.types import EVersion, LogEntry, LOG_DELETE
 
 MAX_LOG_ENTRIES = 3000  # osd_max_pg_log_entries role
@@ -74,6 +75,7 @@ class PGLog:
         divergent = [en for en in self.entries if en.version > target]
         if not divergent:
             return []
+        failpoint("pglog.rewind", target=str(target), n=len(divergent))
         self.entries = [en for en in self.entries
                         if en.version <= target]
         self.head = (self.entries[-1].version if self.entries

@@ -37,6 +37,7 @@ import collections
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from ceph_tpu.core.failpoint import failpoint
 from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.osd import messages as m
 from ceph_tpu.osd import types as t_
@@ -634,19 +635,29 @@ class ECRecoveryEngine:
         # image would launder stale bytes as current
         av = (_av_stamp(av_version) if av_version is not None
               else pg._av_for(oid))
+        # schedulable seam between decode completion and the landing
+        # txn: the window where a superseding write can race the
+        # rebuilt image (the _av fence below is what must hold)
+        failpoint("recovery.store_recovered", oid=oid,
+                  av=str(av_version))
         # sync encode: concurrent window completions coalesce on the
         # StripeBatchQueue exactly like concurrent writes do
         chunks, _ = be._encode_object(state.data)
         t = Transaction()
         for shard in my_shards:
             g = GHObject(oid, shard=shard)
-            t.truncate(pg.coll, g, 0)
+            # REPLACE semantics (handle_push discipline): setattrs
+            # merges, so landing the rebuilt image over a stale shard
+            # object resurrected the stale generation's xattrs — one
+            # shard then carried ghost attrs its peers lacked, and
+            # meta-ranked reads served rewound state as live (the
+            # 0xd403 forensics' shard-attr disagreement)
+            t.try_remove(pg.coll, g)
             t.write(pg.coll, g, 0, chunks[shard])
             attrs = dict(state.xattrs)
             attrs["hinfo"] = _hinfo(chunks[shard], len(state.data))
             attrs["_av"] = av
             t.setattrs(pg.coll, g, attrs)
-            t.omap_clear(pg.coll, g)
             if state.omap:
                 t.omap_setkeys(pg.coll, g, state.omap)
         with pg.lock:

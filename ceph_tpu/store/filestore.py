@@ -20,6 +20,7 @@ import threading
 from typing import Dict, List, Optional
 
 from ceph_tpu.core.crc import crc32c
+from ceph_tpu.core.failpoint import enabled as fp_enabled, failpoint
 from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.core.encoding import Decoder, Encoder
 from ceph_tpu.core.perf import PerfCounters
@@ -65,6 +66,13 @@ class FileStore(ObjectStore):
                  compression: str | None = None) -> None:
         self.path = path
         self.wal_sync = wal_sync
+        # filestore_debug_inject_read_err wiring (reference
+        # 'injectdataerr' admin hook): when the conf enables the
+        # mechanism, reads of objects marked bad raise EIO — and the
+        # generic store.filestore.read failpoint can inject without
+        # any marking at all (match(oid=...) in the arming spec)
+        self.debug_read_err_enabled = False
+        self._read_err_objs: set = set()
         self._kv = LogKV(os.path.join(path, "meta.kv"))
         self._wal_path = os.path.join(path, "wal.log")
         self._wal_fh = None
@@ -496,8 +504,26 @@ class FileStore(ObjectStore):
             return (self._kv.get(P_COLL, cid.name) is not None
                     and self._exists_kv(cid, oid))
 
+    def debug_inject_read_err(self, cid: Collection, oid: GHObject) -> None:
+        """Mark one object bad: its reads raise EIO while the
+        filestore_debug_inject_read_err conf is on."""
+        self._read_err_objs.add((cid.name, oid.name, oid.shard))
+
+    def debug_clear_read_err(self) -> None:
+        self._read_err_objs.clear()
+
     def read(self, cid: Collection, oid: GHObject, off: int = 0,
              length: int = 0) -> bytes:
+        # hot path (every chunk read crosses here): pack no ctx while
+        # disarmed — the enabled() guard is the whole disarmed cost
+        if fp_enabled("store.filestore.read"):
+            failpoint("store.filestore.read", oid=oid.name,
+                      coll=cid.name)
+        if (self.debug_read_err_enabled
+                and (cid.name, oid.name, oid.shard) in self._read_err_objs):
+            raise StoreError(
+                f"EIO (injected): {cid.name}/{oid.name} shard "
+                f"{oid.shard}")
         with self._lock:
             self._check(cid, oid)
             path = self._datafile(cid, oid)

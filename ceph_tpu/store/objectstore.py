@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ceph_tpu.core.encoding import Decoder, Encoder
+from ceph_tpu.core.failpoint import failpoint
 
 
 class StoreError(Exception):
@@ -465,6 +466,9 @@ class CommitPipeline:
                 if self._stopping and (not self._pending or self._frozen):
                     return
                 batch, self._pending = self._pending, []
+            # the WAL-appended-nothing-synced kill window: a schedule
+            # can hold/kill here to model a crash mid-batch
+            failpoint("store.commit_batch.sync", n=len(batch))
             t0 = time.perf_counter()
             try:
                 self._sync_fn()

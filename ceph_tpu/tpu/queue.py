@@ -205,6 +205,11 @@ class StripeBatchQueue:
         return np.asarray(codec.encode_array(stacked))
 
     def _run_batch(self, batch: List[_Job]) -> None:
+        from ceph_tpu.core import failpoint as fp
+
+        if fp.enabled("queue.batch.dispatch"):
+            fp.failpoint("queue.batch.dispatch", jobs=len(batch),
+                         kind=batch[0].kind)
         try:
             if len(batch) == 1 and batch[0].kind == "enc":
                 coding = batch[0].codec.encode_array(batch[0].planes)

@@ -302,6 +302,10 @@ class DeviceBuf:
         staged slot — return it to the pool.  With planes attached the
         handle stays alive device-side; without (early bail), keep a
         host copy so late readers still see the bytes."""
+        from ceph_tpu.core import failpoint as fp
+
+        if fp.enabled("staging.seal"):
+            fp.failpoint("staging.seal", size=self._size)
         with self._lock:
             if self._slot is not None:
                 if self._planes is not None:

@@ -375,3 +375,46 @@ def test_baseline_allows_exact_count_only(tmp_path):
     assert not new_violations(vs, {key: 2})      # both baselined
     over = new_violations(vs, {key: 1})          # one new beyond debt
     assert len(over) == 1 and over[0].line == 3  # newest-looking first
+
+
+def test_failpoint_names_flag_typo_and_dynamic(tmp_path):
+    bad = _lint(tmp_path, (
+        "from ceph_tpu.core import failpoint as fp\n"
+        "def f():\n"
+        "    fp.failpoint('pg.commit.client_repyl')\n"  # typo'd
+    ), "failpoint-name-registry")
+    assert len(bad) == 1 and "typo" in bad[0].message
+
+    dyn = _lint(tmp_path, (
+        "from ceph_tpu.core import failpoint as fp\n"
+        "def f(name):\n"
+        "    fp.failpoint(name)\n"
+    ), "failpoint-name-registry")
+    assert len(dyn) == 1 and "dynamic" in dyn[0].detail
+
+    ok = _lint(tmp_path, (
+        "from ceph_tpu.core import failpoint as fp\n"
+        "def f():\n"
+        "    fp.failpoint('pg.commit.client_reply')\n"
+        "    if fp.enabled('msg.frame.deliver'):\n"
+        "        fp.failpoint('msg.frame.deliver')\n"
+    ), "failpoint-name-registry")
+    assert not ok
+
+    # bare Event.wait()-style calls must not false-positive
+    clean = _lint(tmp_path, (
+        "def f(ev):\n"
+        "    ev.enabled('whatever')\n"
+        "    arm = None\n"
+    ), "failpoint-name-registry")
+    assert not clean
+
+
+def test_failpoint_names_never_baseline(tmp_path):
+    from ceph_tpu.analysis.framework import (Violation,
+                                             violations_to_baseline)
+
+    v = Violation(check="failpoint-name-registry",
+                  path="ceph_tpu/osd/pg.py", line=1,
+                  scope="PG.x", detail="failpoint('typo')", message="m")
+    assert v.key not in violations_to_baseline([v])["entries"]

@@ -32,22 +32,77 @@ def client(cluster):
 
 
 class Model:
-    """The in-memory truth: {oid: {data, xattrs, omap}}."""
+    """The in-memory truth: {oid: {data, xattrs, omap}} — plus the
+    ACKED-MUTATION LOG that powers the durability oracle.  The model
+    only updates after an op returns success, so model state IS acked
+    state; `acked` remembers, per granule (data, one xattr key, one
+    omap key, existence), WHICH op acked it — on divergence the report
+    names the acking op instead of just the symptom."""
 
     def __init__(self) -> None:
         self.objs = {}
+        self.acked = {}   # (oid, kind, name) -> {step, op}
+        self.step = -1
 
     def ensure(self, oid):
         return self.objs.setdefault(
             oid, {"data": b"", "xattrs": {}, "omap": {}})
 
+    def note_ack(self, op: str, oid: str, kind: str,
+                 name: str = "") -> None:
+        self.acked[(oid, kind, name)] = {"step": self.step, "op": op}
 
-def _run_model_sequence(io, rng, rounds, oid_space):
+    def note_removed(self, oid: str) -> None:
+        for key in [k for k in self.acked if k[0] == oid]:
+            del self.acked[key]
+        self.acked[(oid, "removed", "")] = {"step": self.step,
+                                            "op": "remove"}
+
+
+def _rollback_events_for(oid):
+    """Divergent-rollback events touching `oid` (forensic channel in
+    osd/pg.py): the oracle joins a lost granule to the rewind that
+    destroyed it."""
+    from ceph_tpu.osd.pg import ROLLBACK_EVENTS
+
+    return [e for e in list(ROLLBACK_EVENTS)
+            if any(o == oid for o, _v, _op in e["entries"])]
+
+
+def _oracle_detail(model, oid, kind, name=""):
+    """Acked-durability context for one lost granule: the acking op
+    and any rollback events that touched the object."""
+    rec = model.acked.get((oid, kind, name))
+    parts = []
+    if rec is not None:
+        parts.append(f"ACKED at step {rec['step']} by {rec['op']}")
+    else:
+        parts.append("no ack recorded for this granule")
+    try:
+        for e in _rollback_events_for(oid):
+            ents = [f"{o}@{v}" for o, v, _op in e["entries"] if o == oid]
+            parts.append(f"rolled back on osd.{e['osd']} pg {e['pg']} "
+                         f"to {e['target']}: {ents}")
+    except Exception:
+        pass
+    return " [acked-durability oracle: " + "; ".join(parts) + "]"
+
+
+def _run_model_sequence(io, rng, rounds, oid_space, model_box=None):
+    from ceph_tpu.osd.pg import ROLLBACK_EVENTS
+
+    # the rollback ring is process-global and oid namespaces repeat
+    # across runs: stale events from an earlier (clean) run must not
+    # be attributed to this run's failure provenance
+    ROLLBACK_EVENTS.clear()
     model = Model()
+    if model_box is not None:
+        model_box.append(model)  # caller forensics see the acked log
     ops_run = {k: 0 for k in ("write_full", "write", "append",
                               "truncate", "remove", "setxattr",
                               "omap_set", "omap_rm")}
     for step in range(rounds):
+        model.step = step
         oid = f"m{rng.randrange(oid_space)}"
         op = rng.choice(list(ops_run))
         try:
@@ -55,6 +110,7 @@ def _run_model_sequence(io, rng, rounds, oid_space):
                 data = rng.randbytes(rng.randrange(1, 8192))
                 io.write_full(oid, data)
                 model.ensure(oid)["data"] = data
+                model.note_ack(op, oid, "data")
             elif op == "write":
                 ent = model.ensure(oid)
                 off = rng.randrange(0, 4096)
@@ -65,11 +121,13 @@ def _run_model_sequence(io, rng, rounds, oid_space):
                     cur.extend(b"\0" * (off - len(cur)))
                 cur[off:off + len(data)] = data
                 ent["data"] = bytes(cur)
+                model.note_ack(op, oid, "data")
             elif op == "append":
                 ent = model.ensure(oid)
                 data = rng.randbytes(rng.randrange(1, 1024))
                 io.append(oid, data)
                 ent["data"] += data
+                model.note_ack(op, oid, "data")
             elif op == "truncate":
                 ent = model.ensure(oid)
                 size = rng.randrange(0, 4096)
@@ -77,10 +135,12 @@ def _run_model_sequence(io, rng, rounds, oid_space):
                 cur = ent["data"]
                 ent["data"] = (cur[:size] if len(cur) >= size
                                else cur + b"\0" * (size - len(cur)))
+                model.note_ack(op, oid, "data")
             elif op == "remove":
                 if oid in model.objs:
                     io.remove(oid)
                     del model.objs[oid]
+                    model.note_removed(oid)
                 else:
                     with pytest.raises(RadosError):
                         io.remove(oid)
@@ -90,18 +150,22 @@ def _run_model_sequence(io, rng, rounds, oid_space):
                 v = rng.randbytes(16)
                 io.setxattr(oid, k, v)
                 ent["xattrs"][k] = v
+                model.note_ack(op, oid, "xattr", k)
             elif op == "omap_set":
                 ent = model.ensure(oid)
                 kv = {f"k{rng.randrange(8)}": rng.randbytes(12)
                       for _ in range(rng.randrange(1, 4))}
                 io.omap_set(oid, kv)
                 ent["omap"].update(kv)
+                for k in kv:
+                    model.note_ack(op, oid, "omap", k)
             elif op == "omap_rm":
                 ent = model.objs.get(oid)
                 if ent and ent["omap"]:
                     k = rng.choice(sorted(ent["omap"]))
                     io.operate(oid, [t_.OSDOp(t_.OP_OMAP_RM, keys=[k])])
                     del ent["omap"][k]
+                    model.acked.pop((oid, "omap", k), None)
                 else:
                     continue
             ops_run[op] += 1
@@ -117,11 +181,24 @@ def _run_model_sequence(io, rng, rounds, oid_space):
 
 
 def _verify(io, model):
-    """Cluster state must equal the model exactly."""
+    """The acked-durability oracle: cluster state must equal the model
+    exactly — and the model holds ONLY client-acked state, so any
+    divergence is an acked mutation that was rewound.  Every failure
+    message leads with "{oid}: ..." (the forensics hook keys on it)
+    and carries the acking op + any rollback events for the object."""
     listed = set(io.list_objects())
-    assert listed == set(model.objs), (
-        f"object set diverged: extra={listed - set(model.objs)} "
-        f"missing={set(model.objs) - listed}")
+    if listed != set(model.objs):
+        missing = set(model.objs) - listed
+        extra = listed - set(model.objs)
+        detail = ""
+        if missing:
+            oid = sorted(missing)[0]
+            detail = _oracle_detail(model, oid, "data")
+        elif extra:
+            detail = _oracle_detail(model, sorted(extra)[0], "removed")
+        raise AssertionError(
+            f"object set diverged: extra={extra} missing={missing}"
+            f"{detail}")
     for oid, ent in model.objs.items():
         # ALWAYS read: an object the model says is empty must read
         # empty — skipping the read would hide a lost truncate
@@ -132,11 +209,33 @@ def _verify(io, model):
         want = ent["data"]
         # trailing zeros are representation-equivalent (sparse tails)
         assert got.rstrip(b"\0") == want.rstrip(b"\0"), (
-            f"{oid}: data diverged ({len(got)}B vs {len(want)}B)")
+            f"{oid}: data diverged ({len(got)}B vs {len(want)}B)"
+            + _oracle_detail(model, oid, "data"))
+        # ghost checks run even when the model holds NOTHING: an acked
+        # removal of the last xattr/omap key followed by a rollback
+        # resurrecting it is exactly the loss class the oracle exists
+        # for (the model's x0..x3/k0..k7 namespaces keep internal
+        # attrs like snapset out of the comparison)
+        stored = {k: v for k, v in io.getxattrs(oid).items()
+                  if k.startswith("x")}
         for k, v in ent["xattrs"].items():
-            assert io.getxattr(oid, k) == v, f"{oid}: xattr {k}"
-        if ent["omap"]:
-            assert io.omap_get(oid) == ent["omap"], f"{oid}: omap"
+            assert stored.get(k) == v, (
+                f"{oid}: xattr {k}"
+                + _oracle_detail(model, oid, "xattr", k))
+        ghost = set(stored) - set(ent["xattrs"])
+        assert not ghost, (
+            f"{oid}: unacked xattrs resurrected: {sorted(ghost)}"
+            + _oracle_detail(model, oid, "xattr", sorted(ghost)[0]))
+        stored = io.omap_get(oid)
+        for k, v in ent["omap"].items():
+            assert stored.get(k) == v, (
+                f"{oid}: omap {k}"
+                + _oracle_detail(model, oid, "omap", k))
+        ghost = set(stored) - set(ent["omap"])
+        assert not ghost, (
+            f"{oid}: unacked omap keys resurrected: "
+            f"{sorted(ghost)}"
+            + _oracle_detail(model, oid, "omap", sorted(ghost)[0]))
 
 
 def test_rados_model_replicated(cluster, client):
@@ -198,7 +297,7 @@ def test_rados_model_under_thrash():
         c.shutdown()
 
 
-def _dump_thrash_forensics(c, err, seed):
+def _dump_thrash_forensics(c, err, seed, model=None):
     """PR-4 caveat follow-up: the EC thrash model flaked ONCE at seed
     0x1EC with a byte mismatch and left nothing to analyze.  On any
     model divergence, capture the failing seed plus a full shard dump
@@ -230,6 +329,17 @@ def _dump_thrash_forensics(c, err, seed):
               "pgs": {}, "object": {}}
     # the _verify assertions lead with "{oid}: ..."
     oid = str(err).split(":", 1)[0].strip() or None
+    # the acked-mutation log (oracle): which op acked each granule of
+    # the diverged object, plus every divergent-rollback event — the
+    # PR-7 schema addition that turns a symptom into a provenance
+    from ceph_tpu.osd.pg import ROLLBACK_EVENTS
+
+    report["rollback_events"] = list(ROLLBACK_EVENTS)
+    if model is not None and oid:
+        report["acked_mutations"] = {
+            f"{kind}:{name}" if name else kind: rec
+            for (o, kind, name), rec in sorted(model.acked.items())
+            if o == oid}
     for i, o in c.osds.items():
         if not o.up:
             continue
@@ -310,17 +420,21 @@ def test_rados_model_ec_under_thrash():
 
     th = threading.Thread(target=thrasher, daemon=True)
     th.start()
+    model_box = []
     try:
         try:
             ops = _run_model_sequence(cl.rc.ioctx(EC_POOL),
                                       random.Random(0x1EC),
-                                      rounds=150, oid_space=16)
+                                      rounds=150, oid_space=16,
+                                      model_box=model_box)
         except AssertionError as e:
             # capture the shard-level evidence while the cluster is
             # still alive (PR-4's seed byte-mismatch flake left none)
             stop.set()
             th.join(timeout=10)
-            _dump_thrash_forensics(c, e, seed=0x1EC)
+            _dump_thrash_forensics(
+                c, e, seed=0x1EC,
+                model=model_box[0] if model_box else None)
             raise
         assert sum(ops.values()) >= 120
     finally:

@@ -9,6 +9,10 @@ it can be replayed:
 
     thrash_hunt.py --seconds 1800            # sweep until deadline
     thrash_hunt.py --seed 0x24678178 --pool ec --tries 10   # replay
+    thrash_hunt.py --seed 0xd403 --matrix --burn 2   # ROUND6 recipe:
+        # devpath on/off x unloaded/loaded replay grid, loaded cells
+        # run with N CPU-saturation subprocesses; prints the
+        # failures/runs cell table (was a hand-run burn loop)
 
 Failures dump forensics: on data divergence, each acting shard's
 stored chunk digests and attr-version stamps for the object.
@@ -117,6 +121,84 @@ def _timeout_forensics(c, cl, pool: int, errmsg: str) -> None:
         traceback.print_exc()
 
 
+class _Burn:
+    """Deliberate CPU saturation (the ROUND6 loaded-box recipe): N
+    busy-loop SUBPROCESSES pinning the cores for the duration of a
+    run.  Processes, not threads: an in-process spin thread contends
+    the cluster's GIL directly (one trial measured a 150-round replay
+    at 843 s vs ~30 s), which models a pathological embedder, not a
+    loaded box — the original ROUND6 load was a second cluster
+    process + burns."""
+
+    def __init__(self, n: int) -> None:
+        self.n = n
+        self._procs: list = []
+
+    def __enter__(self) -> "_Burn":
+        import subprocess
+
+        for _ in range(self.n):
+            self._procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 "while True:\n x = 0\n for i in range(1000000):\n"
+                 "  x = (x * 1103515245 + 12345) & 0xFFFFFFFF"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import subprocess
+
+        for p in self._procs:
+            p.kill()
+        for p in self._procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # killed; reaping is best-effort
+        self._procs.clear()
+
+
+def run_matrix(seed: int, pool_kind: str, rounds: int, tries: int,
+               burn: int) -> int:
+    """The ROUND6 replay matrix as one command: devpath {on, off} x
+    {unloaded, loaded(burn)} grid, `tries` runs per cell; prints the
+    failures/runs table.  Returns 1 on any failure."""
+    cells = {}
+    prior_env = os.environ.get("CEPH_TPU_TPU_DEVPATH")
+    try:
+        for devpath in ("off", "on"):
+            os.environ["CEPH_TPU_TPU_DEVPATH"] = \
+                "1" if devpath == "on" else "0"
+            for load in ("unloaded", "loaded"):
+                fails = 0
+                print(f"--- cell devpath={devpath} {load} "
+                      f"({tries} tries) ---", flush=True)
+                for _ in range(tries):
+                    if load == "loaded" and burn > 0:
+                        with _Burn(burn):
+                            ok = run_one(seed, pool_kind, rounds)
+                    else:
+                        ok = run_one(seed, pool_kind, rounds)
+                    if not ok:
+                        fails += 1
+                cells[(devpath, load)] = (fails, tries)
+    finally:
+        # restore the caller's own devpath setting (or its absence)
+        if prior_env is None:
+            os.environ.pop("CEPH_TPU_TPU_DEVPATH", None)
+        else:
+            os.environ["CEPH_TPU_TPU_DEVPATH"] = prior_env
+    print(f"\nreplay matrix (seed={seed:#x} pool={pool_kind} "
+          f"rounds={rounds} burn={burn}):", flush=True)
+    print(f"{'':14s}{'unloaded':>10s}{'loaded':>10s}", flush=True)
+    for devpath in ("off", "on"):
+        row = [f"{cells[(devpath, l)][0]}/{cells[(devpath, l)][1]}"
+               for l in ("unloaded", "loaded")]
+        print(f"devpath {devpath:4s}{row[0]:>12s}{row[1]:>10s}",
+              flush=True)
+    return 1 if any(f for f, _t in cells.values()) else 0
+
+
 def run_one(seed: int, pool_kind: str, rounds: int = 200) -> bool:
     sys.path.insert(0, "tests")
     from test_rados_model import _run_model_sequence
@@ -186,29 +268,53 @@ def main(argv=None) -> int:
     p.add_argument("--seed", default=None,
                    help="replay ONE seed instead of sweeping")
     p.add_argument("--pool", choices=("rep", "ec"), default="ec")
-    p.add_argument("--tries", type=int, default=4)
+    p.add_argument("--tries", type=int, default=None,
+                   help="runs per replay (default 4) / per matrix "
+                        "cell (default 6)")
     p.add_argument("--rounds", type=int, default=200)
+    p.add_argument("--burn", type=int, default=None, metavar="N",
+                   help="run with N CPU-saturation subprocesses (the "
+                        "ROUND6 loaded-box recipe; matrix default 2, "
+                        "only the loaded cells burn; 0 = no burn)")
+    p.add_argument("--matrix", action="store_true",
+                   help="devpath on/off x unloaded/loaded replay "
+                        "grid for --seed; prints failures/runs cells")
     args = p.parse_args(argv)
 
-    if args.seed is not None:
-        seed = int(args.seed, 0)
-        fails = sum(not run_one(seed, args.pool, args.rounds)
-                    for _ in range(args.tries))
-        print(f"replay done: {args.tries - fails}/{args.tries} clean",
-              flush=True)
-        return 1 if fails else 0
+    if args.matrix:
+        if args.seed is None:
+            p.error("--matrix needs --seed")
+        return run_matrix(int(args.seed, 0), args.pool, args.rounds,
+                          args.tries if args.tries is not None else 6,
+                          args.burn if args.burn is not None else 2)
 
-    deadline = time.time() + args.seconds
-    master = random.Random()
-    runs = fails = 0
-    while time.time() < deadline:
-        seed = master.randrange(1 << 30)
-        kind = "rep" if runs % 2 == 0 else "ec"
-        if not run_one(seed, kind, args.rounds):
-            fails += 1
-        runs += 1
-    print(f"hunt done: {runs - fails}/{runs} clean", flush=True)
-    return 1 if fails else 0
+    burn = _Burn(args.burn) if args.burn else None
+    if burn is not None:
+        burn.__enter__()
+    try:
+        if args.seed is not None:
+            seed = int(args.seed, 0)
+            tries = args.tries if args.tries is not None else 4
+            fails = sum(not run_one(seed, args.pool, args.rounds)
+                        for _ in range(tries))
+            print(f"replay done: {tries - fails}/{tries} clean",
+                  flush=True)
+            return 1 if fails else 0
+
+        deadline = time.time() + args.seconds
+        master = random.Random()
+        runs = fails = 0
+        while time.time() < deadline:
+            seed = master.randrange(1 << 30)
+            kind = "rep" if runs % 2 == 0 else "ec"
+            if not run_one(seed, kind, args.rounds):
+                fails += 1
+            runs += 1
+        print(f"hunt done: {runs - fails}/{runs} clean", flush=True)
+        return 1 if fails else 0
+    finally:
+        if burn is not None:
+            burn.__exit__()
 
 
 if __name__ == "__main__":
