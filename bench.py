@@ -724,6 +724,31 @@ def cluster_io(jax, out):
                                 ec_profile="k=2 m=1")
         ioec = c.client().ioctx(ec_pool)
         dq = default_queue()
+
+        # latency attribution (PR 8): the per-stage log2 histograms
+        # every tracked op feeds (osd.N.op) plus the queue's own
+        # wait/compute/dispatch split (osd.N.tpuq) — windowed per
+        # phase, so the row shows WHERE a write spends its time, not
+        # just IOPS.  Tracing stays off: the histograms are always fed.
+        from ceph_tpu.core.perf import (hist_delta, hist_summary,
+                                        merge_stage_hists)
+
+        def _stage_hists():
+            # one payload = this process, shaped like a perf dump so
+            # the shared merge (and its tpuq-once rule) applies
+            payload = {f"osd.{osd_id}.op": svc.op_perf.dump()
+                       for osd_id, svc in c.osds.items()}
+            payload["bench.tpuq"] = dq.perf.dump()
+            return merge_stage_hists([payload])
+
+        def _attribution(h0, h1):
+            out_a = {}
+            for nm, after in sorted(h1.items()):
+                d = hist_delta(after, h0.get(nm, {}))
+                if d["count"] > 0:
+                    out_a[nm] = hist_summary(d)
+            return out_a
+
         jobs0, batches0 = dq.jobs, dq.batches
         bytes0 = dq.bytes_in
         hist0 = dict(dq.batch_jobs)
@@ -746,6 +771,7 @@ def cluster_io(jax, out):
             svc.reset_write_inflight_hw()
         msgs0, ops0, _ = _pg_perf_totals()
         dstat0 = dq.stats.snapshot()
+        lat0 = _stage_hists()
         n_ec = 64
         t0 = time.perf_counter()
         pend = []
@@ -776,6 +802,7 @@ def cluster_io(jax, out):
         d_batches = dq.batches - batches0
         msgs1, ops1, infl_hw = _pg_perf_totals()
         d_ops = ops1 - ops0
+        lat_64k = _attribution(lat0, _stage_hists())
         out["cluster_io_ec"] = {
             "object_kib": 64, "objects": n_ec, "profile": "k=2 m=1",
             "write_iops": round(n_ec / ec_wdt, 1),
@@ -793,10 +820,13 @@ def cluster_io(jax, out):
             "batched_payload_fraction": round(frac, 3),
             "tpu_engine_byte_fraction": round(
                 frac if jax.default_backend() != "cpu" else 0.0, 3),
+            "latency_attribution": lat_64k,
             "note": "every EC stripe encode rode the StripeBatchQueue "
                     "-> active engine; batching/fan-out evidence is "
                     "measured from queue + osd.N.pg counters, not "
-                    "assumed",
+                    "assumed; latency_attribution = per-stage p50/p99 "
+                    "us from the osd.N.op/tpuq histograms, this phase's "
+                    "window only, tracing off",
         }
         # device-resident data path evidence (PR 6), counter-derived
         # so it works on CPU rigs: payload bytes uploaded per payload
@@ -822,6 +852,7 @@ def cluster_io(jax, out):
         # small-object phase — the PR-6 tentpole's target shape: 4KiB
         # EC WRITEFULL at the same depth, its own counter window
         st0 = dq.stats.snapshot()
+        lat0_4k = _stage_hists()
         pay4k = b"s" * 4096
         n_small = 96
         t0 = time.perf_counter()
@@ -850,6 +881,7 @@ def cluster_io(jax, out):
                 (st1["payload_host_touches"]
                  - st0["payload_host_touches"]) / n_small, 4),
             "pool_occupancy_hw": st1["pool_occupancy_hw"],
+            "latency_attribution": _attribution(lat0_4k, _stage_hists()),
         }
 
         # degraded-PG recovery (read-side twin of the write evidence):

@@ -8,6 +8,7 @@ from ceph_tpu.analysis.checks.jax_purity import JaxPurity
 from ceph_tpu.analysis.checks.locks import NamedLocks
 from ceph_tpu.analysis.checks.silent_except import SilentExcept
 from ceph_tpu.analysis.checks.sleep_poll import NoSleepPoll
+from ceph_tpu.analysis.checks.span_discipline import SpanDiscipline
 
 ALL_CHECKS = (
     NoBlockingOnLoop(),
@@ -18,6 +19,7 @@ ALL_CHECKS = (
     JaxPurity(),
     NoD2HOnHotPath(),
     FailpointNameRegistry(),
+    SpanDiscipline(),
 )
 
 CHECKS_BY_NAME = {c.name: c for c in ALL_CHECKS}

@@ -49,7 +49,7 @@ class ObjecterOp:
     __slots__ = ("tid", "pool", "oid", "ops", "reqid", "reply", "event",
                  "attempts", "last_send", "retry_at", "target",
                  "on_complete", "timeout_at", "snap_seq", "snaps",
-                 "snapid", "pgid_override")
+                 "snapid", "pgid_override", "span")
 
     def __init__(self, tid: int, pool: int, oid: str, ops: List[OSDOp],
                  reqid: str, timeout: float,
@@ -71,6 +71,7 @@ class ObjecterOp:
         self.snaps: List[int] = []
         self.snapid = 0
         self.pgid_override = None
+        self.span = None  # client root span when tracing is on
 
     # future-like surface
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -179,6 +180,14 @@ class Objecter(Dispatcher):
             # explicit PG targeting (pgls and other per-PG ops; the
             # reference's base_pgid path in Objecter::_calc_target)
             op.pgid_override = pgid
+            tr = getattr(self.ctx, "trace", None)
+            if tr is not None and tr.enabled:
+                # the root of the cross-daemon tree: the context rides
+                # the MOSDOp wire tail, so the primary's do_op span —
+                # and every peer child under it — parents back here
+                op.span = tr.start_span("client.op")
+                op.span.annotate(f"sent pool={pool} oid={oid} "
+                                 f"reqid={op.reqid}")
             self.ops[tid] = op
         self._send_op(op)
         return op
@@ -207,6 +216,8 @@ class Objecter(Dispatcher):
         msg.reqid = op.reqid
         msg.snap_seq, msg.snaps, msg.snapid = (op.snap_seq, op.snaps,
                                                op.snapid)
+        if op.span is not None:
+            msg.set_trace(op.span.context())  # wire-propagated context
         self.msgr.send_message(msg, addr)
 
     # -- watch/notify ------------------------------------------------------
@@ -295,6 +306,9 @@ class Objecter(Dispatcher):
                     op.attempts, 10)
                 return True
             del self.ops[op.tid]
+        if op.span is not None:
+            op.span.annotate(f"reply result={msg.result}")
+            op.span.finish()
         op.reply = msg
         op.event.set()
         if op.on_complete is not None:
@@ -312,6 +326,9 @@ class Objecter(Dispatcher):
                     with self._lock:
                         if self.ops.pop(op.tid, None) is None:
                             continue
+                    if op.span is not None:
+                        op.span.annotate(f"reply result={ETIMEDOUT}")
+                        op.span.finish()
                     op.reply = m.MOSDOpReply(
                         op.target[0], 0, op.oid, op.ops, result=ETIMEDOUT)
                     op.event.set()
@@ -339,6 +356,8 @@ class Objecter(Dispatcher):
             pending = list(self.ops.values())
             self.ops.clear()
         for op in pending:
+            if op.span is not None:
+                op.span.finish()
             op.reply = m.MOSDOpReply(op.target[0], 0, op.oid, op.ops,
                                      result=ETIMEDOUT)
             op.event.set()

@@ -105,7 +105,13 @@ def _opts() -> List[Option]:
         O("osd_op_queue", str, "wpq",
           "op scheduler: wpq (priority) or mclock (QoS)", runtime=False),
         O("osd_op_complaint_time", float, 30.0,
-          "seconds after which an op counts as slow (OpTracker)"),
+          "seconds after which an op counts as slow (OpTracker: drives "
+          "the dump_historic_slow_ops ring admission; runtime-updatable "
+          "so operators can shrink it to catch a live stall)"),
+        O("osd_op_history_size", int, 20,
+          "completed ops kept for dump_historic_ops", runtime=False),
+        O("osd_op_history_slow_size", int, 20,
+          "slow ops kept for dump_historic_slow_ops", runtime=False),
         O("osd_client_write_timeout", float, 30.0,
           "seconds before an in-flight client write whose commit (or "
           "durable-ack gate) never resolves answers retryable EAGAIN"),
@@ -221,9 +227,19 @@ class Config:
 
     def add_observer(
         self, keys: Sequence[str], fn: Callable[[str, Any], None]
-    ) -> None:
-        """fn(name, new_value) fires on apply_changes for watched keys."""
+    ) -> Callable[[str, Any], None]:
+        """fn(name, new_value) fires on apply_changes for watched keys.
+        Returns fn as the handle for remove_observer."""
         self._observers.append((tuple(keys), fn))
+        return fn
+
+    def remove_observer(self, fn: Callable[[str, Any], None]) -> None:
+        """Unhook an observer (by the handle add_observer returned).
+        Daemons that die on a shared long-lived Context must remove
+        their observers, or every kill/revive cycle pins the dead
+        daemon's state for the Context's lifetime."""
+        self._observers = [(k, f) for k, f in self._observers
+                           if f is not fn]
 
     def apply_changes(self) -> None:
         with self._lock:

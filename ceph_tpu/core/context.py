@@ -66,10 +66,16 @@ class Context:
             "healthy": self.heartbeat.is_healthy(),
             "unhealthy_workers": self.heartbeat.unhealthy_workers(),
         }, "thread liveness")
-        a.register("dump_tracing", lambda c: (
-            self.trace.dump(int(c["trace_id"], 16)) if "trace_id" in c
-            else self.trace.recent(int(c.get("count", 100)))),
-            "archived trace spans (blkin role)")
+        def _dump_trace(c):
+            if "trace_id" in c:
+                return self.trace.dump(int(str(c["trace_id"]), 16))
+            return self.trace.recent(int(c.get("count", 100)))
+
+        a.register("dump_tracing", _dump_trace,
+                   "archived trace spans (blkin role)")
+        a.register("dump_trace", _dump_trace,
+                   "spans of one trace: dump_trace trace_id=<hex> "
+                   "(without trace_id: the ring tail)")
         a.start()
         self.admin = a
 

@@ -107,6 +107,99 @@ class PerfCounters:
         return out
 
 
+def hist_quantile(hist: Dict[str, object], q: float) -> float:
+    """Approximate quantile of a dumped TYPE_HIST counter.
+
+    Bucket b of hinc() holds values in [2^(b-1), 2^b) (b=0 holds
+    values < 1), so the true quantile is known to within one power of
+    two; interpolating linearly inside the winning bucket gives a
+    stable point estimate — the same derivation `cephtop`, the mgr
+    merge, and the bench latency-attribution aux all use, so p50/p99
+    agree everywhere they are shown."""
+    count = int(hist.get("count", 0) or 0)
+    buckets = list(hist.get("buckets", []) or [])
+    if count <= 0 or not buckets:
+        return 0.0
+    target = max(1.0, q * count)
+    acc = 0.0
+    for b, n in enumerate(buckets):
+        if not n:
+            continue
+        if acc + n >= target:
+            lo = 0.0 if b == 0 else float(1 << (b - 1))
+            hi = 1.0 if b == 0 else float(1 << b)
+            return lo + (target - acc) / n * (hi - lo)
+        acc += n
+    return float(1 << (len(buckets) - 1))
+
+
+def hist_merge(into: Dict[str, object], val: Dict[str, object]) -> None:
+    """Accumulate one dumped histogram into a merge accumulator
+    ({count, sum, buckets}) — the cluster-wide aggregation primitive
+    shared by the mgr poll and cephtop."""
+    into["count"] = int(into.get("count", 0)) + int(val.get("count", 0))
+    into["sum"] = float(into.get("sum", 0.0)) + float(val.get("sum", 0.0))
+    b = into.setdefault("buckets", [])
+    for i, n in enumerate(val.get("buckets", []) or []):
+        if i < len(b):
+            b[i] += n
+        else:
+            b.append(n)
+
+
+def merge_stage_hists(payloads) -> Dict[str, Dict[str, object]]:
+    """{counter: merged-histogram} over perf-dump payloads — ONE
+    ``{subsys: counters}`` payload per PROCESS.  Only the op/queue
+    stage sets (``*.op`` / ``*.tpuq``) participate, and a payload's
+    ``.tpuq`` sets merge exactly once: every daemon's ``.tpuq`` is a
+    view of that process's ONE StripeBatchQueue, while the ``.op``
+    sets are genuinely per-daemon.  The single home of the merge rules
+    so mgr `ops latency`, cephtop, and the bench attribution aux
+    cannot drift apart."""
+    merged: Dict[str, Dict[str, object]] = {}
+    for dump in payloads:
+        tpuq_done = False
+        for subsys, counters in sorted(dump.items()):
+            is_q = subsys.endswith(".tpuq")
+            if not (subsys.endswith(".op") or is_q):
+                continue
+            if is_q:
+                if tpuq_done:
+                    continue
+                tpuq_done = True
+            for cname, val in counters.items():
+                if isinstance(val, dict) and "buckets" in val:
+                    hist_merge(merged.setdefault(cname, {}), val)
+    return merged
+
+
+def hist_summary(hist: Dict[str, object]) -> Dict[str, object]:
+    """The {count, p50_us, p99_us, mean_us} row every latency surface
+    renders (mgr `ops latency`, cephtop, the bench attribution aux) —
+    ONE implementation so their numbers agree by construction."""
+    count = int(hist.get("count", 0) or 0)
+    return {
+        "count": count,
+        "p50_us": round(hist_quantile(hist, 0.50), 1),
+        "p99_us": round(hist_quantile(hist, 0.99), 1),
+        "mean_us": round(float(hist.get("sum", 0.0)) / count, 1)
+        if count else 0.0,
+    }
+
+
+def hist_delta(after: Dict[str, object],
+               before: Dict[str, object]) -> Dict[str, object]:
+    """after - before of two dumped histograms (bench phase windows)."""
+    ab = list(after.get("buckets", []) or [])
+    bb = list(before.get("buckets", []) or [])
+    bb += [0] * (len(ab) - len(bb))
+    return {
+        "count": int(after.get("count", 0)) - int(before.get("count", 0)),
+        "sum": float(after.get("sum", 0.0)) - float(before.get("sum", 0.0)),
+        "buckets": [a - b for a, b in zip(ab, bb)],
+    }
+
+
 class PerfCountersCollection:
     """All counter sets of one context; admin `perf dump` target."""
 

@@ -30,6 +30,56 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 TraceContext = Tuple[int, int]  # (trace_id, span_id)
 
+# -- stage-name registry -----------------------------------------------------
+#
+# Every stage event recorded on an op timeline (TrackedOp.mark_event)
+# or annotated as a literal stage on a hot-path span must come from
+# this table.  The name IS the contract between the instrumented site,
+# the per-stage latency histogram it feeds (the osd.N.op `lat_*_us`
+# counters — value below; '' = timeline-only), and every dump consumer
+# (dump_historic_slow_ops, the mgr merge, cephtop, thrash forensics).
+# A typo'd site is a dead timeline row that silently never feeds its
+# histogram — cephlint's `span-discipline` check validates literal
+# call-site names against this table (never baselineable, the
+# failpoint-name-registry shape).
+#
+# Primary write-pipeline order (each histogram buckets the latency
+# since the PREVIOUS timeline event, in microseconds):
+#   initiated -> queued_for_pg -> reached_pg -> [staged] -> admitted
+#   -> submitted -> commit -> [ack_gated] -> commit_sent
+STAGES: Dict[str, str] = {
+    # client / generic
+    "sent": "",                # client: op handed to the messenger
+    "initiated": "",           # tracker entry created (messenger receive)
+    # daemon dispatch
+    "queued_for_pg": "lat_recv_us",      # decode -> sharded-queue entry
+    "reached_pg": "lat_queue_us",        # queue wait: a shard picked it up
+    # write pipeline
+    "staged": "lat_staging_us",          # pinned staging-pool acquire
+    "admitted": "lat_admission_us",      # _OidPipe admission FIFO grant
+    "submitted": "lat_encode_fanout_us",  # exec+encode queued+fan-out sent
+    "commit": "lat_commit_wait_us",      # last shard ack arrived
+    "ack_gated": "lat_ack_gate_us",      # durable-ack gate released
+    "commit_sent": "lat_reply_us",       # reply sent to the client
+    # read path
+    "parked": "",              # read parked on recover-on-read
+    "read_sent": "lat_read_us",  # terminal for reads: execute -> reply
+    #   (reads must NOT conclude as commit_sent — that would feed the
+    #   whole read service time into lat_reply_us, which for writes
+    #   measures only reply-send time)
+    # peer-side span stages (cross-daemon children)
+    "sub_write_recv": "",      # peer: MECSubWriteVec dispatched
+    "store_commit": "",        # peer: merged store transaction durable
+    "sub_read_served": "",     # peer: MECSubReadVec rows answered
+    "note_persisted": "",      # peer: commit-note watermark on stable storage
+    # terminal events (history admission; see optracker.TERMINAL_STAGES)
+    "done": "",
+    "eagain": "",              # retryable reply (peering gate, deadline sweep)
+    "aborted": "",             # error reply or dispatch exception
+    "daemon_shutdown": "",     # daemon went down with the op in flight
+    "leaked": "",              # force-finished lifecycle leak (a bug)
+}
+
 
 class Span:
     __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",

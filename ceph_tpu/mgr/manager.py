@@ -200,12 +200,72 @@ class TelemetryModule(MgrModule):
         return 0, self.report()
 
 
+class OpsModule(MgrModule):
+    """Cluster-wide op observability (PR 8): merges every registered
+    daemon's slow-op/in-flight rings and per-stage latency histograms
+    into one surface — the aggregation the reference spreads across
+    `ceph daemon <osd> dump_historic_slow_ops` polling and the mgr's
+    perf queries.  `tools/cephtop.py` renders the same shapes from
+    admin sockets when no mgr is running."""
+
+    name = "ops"
+
+    def _tracked(self):
+        for name, svc in sorted(self.mgr.services.items()):
+            trk = getattr(svc, "op_tracker", None)
+            if trk is not None:
+                yield name, trk
+
+    def _merged(self, method: str) -> dict:
+        ops: List[dict] = []
+        for name, trk in self._tracked():
+            for o in getattr(trk, method)()["ops"]:
+                o["daemon"] = name
+                ops.append(o)
+        ops.sort(key=lambda o: -o.get("age", 0.0))
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_slow_ops(self) -> dict:
+        return self._merged("dump_slow")
+
+    def dump_ops_in_flight(self) -> dict:
+        return self._merged("dump_in_flight")
+
+    def latency(self) -> dict:
+        """Per-stage p50/p99 merged across every daemon's osd.N.op
+        (and the process-wide osd.N.tpuq) histogram sets."""
+        from ceph_tpu.core.perf import hist_summary, merge_stage_hists
+
+        # every registered daemon shares this mgr's process: collapse
+        # the repeated named sets (daemons sharing one Context dump
+        # them all) into ONE payload, then the shared merge applies
+        # its tpuq-exactly-once rule
+        combined: Dict[str, dict] = {}
+        for subs in self.mgr.collect().values():
+            combined.update(subs)
+        return {stage: hist_summary(v)
+                for stage, v in sorted(merge_stage_hists([combined]).items())}
+
+    def handle_command(self, cmd):
+        prefix = cmd.get("prefix", "")
+        if prefix == "ops dump_slow":
+            return 0, self.dump_slow_ops()
+        if prefix == "ops dump_in_flight":
+            return 0, self.dump_ops_in_flight()
+        if prefix == "ops latency":
+            return 0, self.latency()
+        return None
+
+
 class MgrDaemon:
     """The aggregation point: daemons register, modules serve."""
 
     def __init__(self, ctx) -> None:
         self.ctx = ctx
         self.daemons: Dict[str, object] = {}  # name -> Context
+        # name -> daemon service object (OSDService etc): the op
+        # tracker lives on the service, not the shared Context
+        self.services: Dict[str, object] = {}
         self.modules: Dict[str, MgrModule] = {}
         self.osdmap = None  # fed by whoever owns the map (mon/tests)
         self.last_collect = 0.0
@@ -214,18 +274,31 @@ class MgrDaemon:
 
         for m in (StatusModule(self), PrometheusModule(self),
                   CrashModule(self), BalancerModule(self),
-                  DashboardModule(self), TelemetryModule(self)):
+                  DashboardModule(self), TelemetryModule(self),
+                  OpsModule(self)):
             self.modules[m.name] = m
 
-    def register_daemon(self, name: str, ctx) -> None:
+    def register_daemon(self, name: str, ctx, service=None) -> None:
         """The MMgrReport-session role: this daemon's counters become
-        visible to every module."""
+        visible to every module; with `service`, its op tracker joins
+        the cluster-wide slow-op/in-flight merge too."""
         with self._lock:
             self.daemons[name] = ctx
+            if service is not None:
+                self.services[name] = service
+
+    def register_service(self, name: str, service) -> None:
+        """Attach a daemon service's op tracker to the cluster-wide
+        slow-op/in-flight merge WITHOUT re-registering its Context —
+        vstart daemons share one Context (counters dedup by identity)
+        but each service owns a distinct tracker."""
+        with self._lock:
+            self.services[name] = service
 
     def unregister_daemon(self, name: str) -> None:
         with self._lock:
             self.daemons.pop(name, None)
+            self.services.pop(name, None)
 
     def collect(self) -> Dict[str, Dict[str, Dict[str, object]]]:
         """daemon -> subsystem -> counter -> value."""

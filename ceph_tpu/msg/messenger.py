@@ -686,7 +686,13 @@ class Messenger:
         try:
             while True:
                 body = await self._read_one(reader)
+                t_recv = time.monotonic()
                 msg = Message.from_bytes(body)
+                # receive stamp for op-stage attribution: the tracker's
+                # first stage delta (lat_recv_us) then covers frame
+                # decode + dispatch queueing, measured from the moment
+                # the frame's last byte arrived
+                msg._recv_stamp = t_recv
                 await self._process_frame(conn, body, msg, ack_writer)
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
                 asyncio.CancelledError):

@@ -32,6 +32,7 @@ Completion: an op commits when every PEER (not every shard) acked
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -94,7 +95,8 @@ class InFlightOp:
     committed_to watermark outlive this primary before the client may
     learn the write happened (the 0xd403 acked-loss class)."""
 
-    __slots__ = ("waiting_on", "on_commit", "lock", "acked", "dropped")
+    __slots__ = ("waiting_on", "on_commit", "lock", "acked", "dropped",
+                 "sent_at")
 
     def __init__(self, waiting_on: set, on_commit: Callable[[], None]):
         self.waiting_on = waiting_on
@@ -102,6 +104,9 @@ class InFlightOp:
         self.lock = make_lock("backend.inflight")
         self.acked: set = set()
         self.dropped: set = set()
+        # per-peer send stamps (fan-out RTT attribution): filled by
+        # the fan-out just before each peer send
+        self.sent_at: Dict = {}
 
     def ack(self, who) -> None:
         fire = False
@@ -163,10 +168,12 @@ class PGBackend:
         # info.committed_to (rides EC sub-writes so shards learn which
         # entries are beyond divergent rollback)
         self.committed_fn: Callable[[], EVersion] = EVersion
-        # optional perf sink (the daemon's osd.N.pg counter set) and
-        # log hook, both bound by the host PG; no-ops stand alone so
-        # unit tests can drive a bare backend
+        # optional perf sinks (the daemon's osd.N.pg counter set, and
+        # osd.N.op for the per-peer fan-out RTT histogram) and log
+        # hook, all bound by the host PG; no-ops stand alone so unit
+        # tests can drive a bare backend
         self.perf = None
+        self.op_perf = None
         self.log: Callable[[int, str], None] = lambda lvl, msg: None
         # fan-out sequencer: async encodes complete off-thread, and a
         # write that SKIPS the encode (delete) must not overtake one
@@ -200,6 +207,12 @@ class PGBackend:
         if op is not None:
             if fp.enabled("backend.commit.ack"):
                 fp.failpoint("backend.commit.ack", tid=tid, who=who)
+            t0 = op.sent_at.get(who)
+            if t0 is not None and self.op_perf is not None:
+                # per-peer sub-write RTT: send -> commit ack (includes
+                # the peer's store commit batch)
+                self.op_perf.hinc("lat_fanout_rtt_us",
+                                  (time.monotonic() - t0) * 1e6)
             op.ack(who)
 
     def on_peer_change(self, alive: set) -> None:
@@ -351,7 +364,8 @@ class ReplicatedBackend(PGBackend):
         return t
 
     def submit(self, oid, state, entries, log_omap, acting, on_commit,
-               log_rm=None, pre_txn=None, on_submitted=None):
+               log_rm=None, pre_txn=None, on_submitted=None,
+               trace=None):
         txn = self._object_txn(oid, state, log_omap, log_rm)
         if pre_txn is not None:
             # snapshot clone-on-write rides the SAME transaction: the
@@ -374,6 +388,7 @@ class ReplicatedBackend(PGBackend):
                 continue  # modeled kill-boundary loss: never sent
             msg = m.MOSDRepOp(self.pgid, self.epoch_fn(), body, entries)
             msg.tid = tid
+            op.sent_at[peer] = time.monotonic()  # fan-out RTT stamp
             self.osd_send(peer, msg)
         # local apply last: the store raises on real corruption, and
         # the self-ack fires from the store's COMMIT callback (not
@@ -786,7 +801,8 @@ class ECBackend(PGBackend):
             self.perf.inc("subwrite_msgs", msgs)
 
     def submit(self, oid, state, entries, log_omap, acting, on_commit,
-               log_rm=None, on_submitted=None, on_error=None):
+               log_rm=None, on_submitted=None, on_error=None,
+               trace=None):
         # full-object rewrite/delete supersedes any cached stripes
         self.cache.invalidate(oid)
         n = self.k + self.m
@@ -845,6 +861,10 @@ class ECBackend(PGBackend):
                                 for shard in shards],
                             committed_to=committed_to)
                         msg.tid = tid
+                        # the client op's span context rides the wire;
+                        # the peer opens its store-commit child off it
+                        msg.set_trace(trace)
+                        op.sent_at[osd] = time.monotonic()
                         self.osd_send(osd, msg)
                         msgs += 1
                 self._note_fanout(msgs)

@@ -23,6 +23,7 @@ from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
 from ceph_tpu.osd import messages as m
 from ceph_tpu.osd import types as t_
 from ceph_tpu.osd.osdmap import OSDMap, POOL_ERASURE
+from ceph_tpu.osd.pg import EAGAIN as _EAGAIN
 from ceph_tpu.osd.pg import PG
 from ceph_tpu.osd.types import EVersion, PGId, PGInfo
 
@@ -102,12 +103,32 @@ class OSDService(Dispatcher):
 
         self.recovery_reserver = AsyncReserver(
             ctx.conf.get("osd_recovery_max_active"))
+        # per-stage op-latency histograms (osd.N.op): every tracked
+        # op's stage timeline feeds these (optracker mark_event), plus
+        # the direct-fed sites (fan-out RTT, ack gate, recovery rounds,
+        # parked reads) — per-stage p50/p99 from `perf dump`, no
+        # tracing required
+        from ceph_tpu.core import optracker as optk
+
+        op_pc = ctx.perf.create(f"osd.{whoami}.op")
+        optk.declare_op_hists(op_pc)
+        self.op_perf = op_pc
         # in-flight op history + slow-op evidence (reference
         # TrackedOp.h / OpRequest.h, `dump_ops_in_flight`)
-        from ceph_tpu.core.optracker import OpTracker
-
-        self.op_tracker = OpTracker(
-            slow_op_threshold=ctx.conf.get("osd_op_complaint_time"))
+        self.op_tracker = optk.OpTracker(
+            slow_op_threshold=ctx.conf.get("osd_op_complaint_time"),
+            history_size=int(ctx.conf.get("osd_op_history_size")),
+            slow_history_size=int(
+                ctx.conf.get("osd_op_history_slow_size")),
+            perf=op_pc)
+        # the complaint time is runtime-updatable (operators shrink it
+        # to catch a live stall in the slow ring); keep the handle so
+        # shutdown can unhook it — the Context outlives kill/revive
+        # cycles and would otherwise pin every dead tracker
+        self._complaint_obs = ctx.conf.add_observer(
+            ("osd_op_complaint_time",),
+            lambda _n, v: setattr(self.op_tracker, "slow_op_threshold",
+                                  float(v)))
         self.up = False
         self._log = ctx.log.dout("osd")
         # notified whenever a PG's activation pass finishes, so
@@ -189,6 +210,12 @@ class OSDService(Dispatcher):
         ctx.perf.register(
             f"osd.{whoami}.tpu",
             _dq.stats.perf_view(f"osd.{whoami}.tpu"))
+        # the queue's own stage histograms (enqueue wait vs device
+        # compute vs callback dispatch) — process-wide like the queue,
+        # dumped under each daemon's context exactly like osd.N.tpu
+        ctx.perf.register(f"osd.{whoami}.tpuq", _dq.perf)
+        # batch spans (job width / kind) ride this context's tracer
+        _dq.tracer = ctx.trace
         # apply the daemon's staging-pool geometry conf (the pool is
         # built before any Context exists, env-sized); a busy pool
         # refuses the resize — first idle daemon boot wins
@@ -243,6 +270,23 @@ class OSDService(Dispatcher):
                 f"osd.{self.whoami} bench", self._admin_bench,
                 "objectstore write benchmark "
                 "(count=<total bytes> bsize=<block bytes>)")
+            # op-observability surface (reference `ceph daemon <osd>
+            # dump_ops_in_flight` family over TrackedOp): per-daemon
+            # prefixed, since one Context (and one admin socket) may
+            # host several in-process daemons
+            trk = self.op_tracker
+            self.ctx.admin.register(
+                f"osd.{self.whoami} dump_ops_in_flight",
+                lambda c: trk.dump_in_flight(),
+                "in-flight tracked ops with stage timelines")
+            self.ctx.admin.register(
+                f"osd.{self.whoami} dump_historic_ops",
+                lambda c: trk.dump_historic(),
+                "recently completed ops (bounded history)")
+            self.ctx.admin.register(
+                f"osd.{self.whoami} dump_historic_slow_ops",
+                lambda c: trk.dump_slow(),
+                "ops slower than osd_op_complaint_time")
 
     def _admin_bench(self, cmd: dict) -> dict:
         from ceph_tpu.store.objectstore import Collection, GHObject
@@ -481,6 +525,11 @@ class OSDService(Dispatcher):
         self.msgr.shutdown()
         self.hb_msgr.shutdown()
         self.store.umount()
+        # every in-flight tracked op lands in history with a terminal
+        # event; concluded-but-never-unregistered ops are lifecycle
+        # leaks, reported on the optracker.LEAKS sanitizer channel
+        self.op_tracker.drain()
+        self.ctx.conf.remove_observer(self._complaint_obs)
 
     @property
     def addr(self) -> Addr:
@@ -953,10 +1002,17 @@ class OSDService(Dispatcher):
                 conn.send(rep)
                 return True
             tid = msg.tid
+            # op start = the messenger's receive stamp, so the first
+            # stage delta attributes frame decode + dispatch (absent
+            # for locally-forged messages in tests)
             top = self.op_tracker.create_op(
                 f"osd_op({msg.src} tid={tid} {msg.oid} "
-                f"{'+'.join(str(o.op) for o in msg.ops)} pg={msg.pgid})")
+                f"{'+'.join(str(o.op) for o in msg.ops)} pg={msg.pgid})",
+                start=getattr(msg, "_recv_stamp", None))
             top.mark_event("queued_for_pg")
+            # the tracked op rides the message through the PG pipeline
+            # (local attribute, never encoded): every stage marks it
+            msg.trop = top
 
             def run(pg=pg, msg=msg, conn=conn, tid=tid, top=top) -> None:
                 t0 = time.perf_counter()
@@ -966,8 +1022,22 @@ class OSDService(Dispatcher):
                 def reply(rep: m.MOSDOpReply) -> None:
                     rep.tid = tid
                     conn.send(rep)
-                    top.mark_event(f"commit_sent r={rep.result}")
-                    top.finish()
+                    # terminal stage rides finish() so concluding and
+                    # leaving the in-flight table are ONE step: EAGAIN'd
+                    # ops (peering gate, write-deadline sweep) land in
+                    # history like commits — never leak in the table
+                    if rep.result == 0:
+                        # reads get their own terminal stage: the
+                        # commit_sent histogram (lat_reply_us) times
+                        # reply-send for writes, and feeding whole
+                        # read service times into it would corrupt
+                        # the per-stage attribution
+                        top.finish(stage="commit_sent" if is_w
+                                   else "read_sent")
+                    elif rep.result == _EAGAIN:
+                        top.finish(stage="eagain")
+                    else:
+                        top.finish(stage="aborted", detail=f"r={rep.result}")
                     if is_w:
                         self.perf.inc("op_w")
                         self.perf.tinc("op_w_latency",
@@ -975,7 +1045,23 @@ class OSDService(Dispatcher):
                     else:
                         self.perf.inc("op_r")
 
-                pg.do_op(msg, reply, conn=conn)
+                try:
+                    pg.do_op(msg, reply, conn=conn)
+                except Exception as e:
+                    # the op died before any reply path owned it: a
+                    # terminal event + history entry, not an in-flight
+                    # leak (the client's resend retries; finish() is
+                    # idempotent if a reply DID go out first)
+                    self._log(0, f"do_op {msg.oid} failed: {e!r}")
+                    top.finish(stage="aborted", detail=repr(e))
+                    # the wrapped reply() owns finishing the do_op
+                    # span; a raise before any reply would leave it
+                    # unarchived — the primary node of the causal tree
+                    # silently missing (the peer-handler leak class)
+                    sp = getattr(msg, "span", None)
+                    if sp is not None and not sp.end:
+                        sp.annotate(f"exception: {e!r}")
+                        sp.finish()
 
             self.wq.queue(msg.pgid, run,
                           priority=self.ctx.conf.get("osd_client_op_priority"),

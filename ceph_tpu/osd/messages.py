@@ -26,12 +26,38 @@ def _dec_pgid(d: Decoder) -> PGId:
 
 
 class _PGMessage(Message):
-    """Common pgid + map epoch header."""
+    """Common pgid + map epoch header.
+
+    Wire-propagated trace context (the blkin trace/span ids): every PG
+    message CAN carry ``(trace_id, span_id)`` as an optional payload
+    tail — the carriers that actually propagate it (MOSDOp,
+    MECSubWriteVec, MECSubReadVec, MECCommitNote/Ack) call
+    ``_enc_trace``/``_dec_trace`` around their own tails.  The tail is
+    written only when a context is set, so tracing-off encodings (and
+    the committed golden corpus) stay byte-for-byte stable, and a v1
+    blob decodes with the context defaulted to (0, 0)."""
 
     def __init__(self, pgid: PGId = (0, 0), epoch: int = 0) -> None:
         super().__init__()
         self.pgid = pgid
         self.epoch = epoch
+
+    # trace helpers are defined here, but ONLY the carrier messages
+    # own the attributes (set in their __init__ via _init_trace and in
+    # decode via _dec_trace) — a non-carrier must not grow fields its
+    # codec drops (the test_messages_roundtrip contract)
+    def _init_trace(self) -> None:
+        self.trace_id = 0
+        self.span_id = 0
+
+    def set_trace(self, ctx) -> None:
+        """Adopt a (trace_id, span_id) context for the wire (None ok)."""
+        if ctx is not None:
+            self.trace_id, self.span_id = ctx
+
+    def trace_ctx(self):
+        """The carried context, or None when the sender wasn't tracing."""
+        return (self.trace_id, self.span_id) if self.trace_id else None
 
     def _enc_head(self, e: Encoder) -> None:
         _enc_pgid(e, self.pgid)
@@ -40,6 +66,17 @@ class _PGMessage(Message):
     def _dec_head(self, d: Decoder) -> None:
         self.pgid = _dec_pgid(d)
         self.epoch = d.u32()
+
+    def _enc_trace(self, e: Encoder) -> None:
+        if self.trace_id:
+            e.u64(self.trace_id).u64(self.span_id)
+
+    def _dec_trace(self, d: Decoder) -> None:
+        if d.remaining_in_frame():
+            self.trace_id = d.u64()
+            self.span_id = d.u64()
+        else:
+            self.trace_id = self.span_id = 0
 
 
 @register
@@ -62,6 +99,7 @@ class MOSDOp(_PGMessage):
         self.snap_seq = 0
         self.snaps: List[int] = []
         self.snapid = 0
+        self._init_trace()
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
@@ -70,6 +108,9 @@ class MOSDOp(_PGMessage):
         e.string(self.reqid)
         e.u64(self.snap_seq).u64(self.snapid)
         e.seq(self.snaps, lambda enc, s: enc.u64(s))
+        # trace context rides last (written only when tracing set one:
+        # untraced encodings stay byte-identical to the prior format)
+        self._enc_trace(e)
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
@@ -82,6 +123,7 @@ class MOSDOp(_PGMessage):
             self.snaps = d.seq(lambda dd: dd.u64())
         else:
             self.snap_seq, self.snapid, self.snaps = 0, 0, []
+        self._dec_trace(d)
 
 
 @register
@@ -248,6 +290,7 @@ class MECSubWriteVec(_PGMessage):
         self.entries = entries or []
         self.rb = rb or []  # [(shard, rb_kind, rb_off, rb_len), ...]
         self.committed_to = committed_to or EVersion()
+        self._init_trace()
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
@@ -256,6 +299,7 @@ class MECSubWriteVec(_PGMessage):
         e.seq(self.rb, lambda enc, r: enc.s32(r[0]).u8(r[1])
               .u64(r[2]).u64(r[3]))
         self.committed_to.encode(e)
+        self._enc_trace(e)  # inherited from the client op when tracing
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
@@ -265,6 +309,7 @@ class MECSubWriteVec(_PGMessage):
         self.rb = d.seq(lambda dd: (dd.s32(), dd.u8(), dd.u64(),
                                     dd.u64()))
         self.committed_to = EVersion.decode(d)
+        self._dec_trace(d)
 
 
 @register
@@ -358,16 +403,19 @@ class MECSubReadVec(_PGMessage):
                  ) -> None:
         super().__init__(pgid, epoch)
         self.reads = reads or []  # [(shard, oid, off, length), ...]
+        self._init_trace()
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
         e.seq(self.reads, lambda enc, r: enc.s32(r[0]).string(r[1])
               .u64(r[2]).u64(r[3]))
+        self._enc_trace(e)  # recovery-round span context when tracing
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.reads = d.seq(lambda dd: (dd.s32(), dd.string(), dd.u64(),
                                        dd.u64()))
+        self._dec_trace(d)
 
 
 @register
@@ -826,14 +874,17 @@ class MECCommitNote(_PGMessage):
                  committed_to: Optional[EVersion] = None) -> None:
         super().__init__(pgid, epoch)
         self.committed_to = committed_to or EVersion()
+        self._init_trace()
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
         self.committed_to.encode(e)
+        self._enc_trace(e)  # the gated op's span context when tracing
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.committed_to = EVersion.decode(d)
+        self._dec_trace(d)
 
 
 @register
@@ -861,13 +912,16 @@ class MECCommitNoteAck(_PGMessage):
         # resend must never be answered result=0 for a write whose
         # data never reached k shards
         self.last_update = last_update or EVersion()
+        self._init_trace()
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
         self.committed_to.encode(e)
         self.last_update.encode(e)
+        self._enc_trace(e)  # echoed from the note: correlates the ack
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.committed_to = EVersion.decode(d)
         self.last_update = EVersion.decode(d)
+        self._dec_trace(d)

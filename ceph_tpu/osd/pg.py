@@ -242,6 +242,8 @@ class PG:
         self.backend.committed_fn = lambda: self.info.committed_to
         self.backend.log = getattr(osd, "_log", self.backend.log)
         self.backend.perf = getattr(osd, "pg_perf", None)
+        # osd.N.op stage histograms (per-peer fan-out RTT lands there)
+        self.backend.op_perf = getattr(osd, "op_perf", None)
         # -- pipelined write engine state -----------------------------
         # per-object admission FIFOs + the in-flight bookkeeping that
         # replaced the old block-until-commit wait (leaf lock: taken
@@ -376,19 +378,46 @@ class PG:
                     self._note_gates.pop(tid, None)
 
     # -- op execution (primary) -------------------------------------------
+    @staticmethod
+    def _op_stage(msg, stage: str, detail: str = "") -> None:
+        """Mark one pipeline stage on the op's timeline (TrackedOp —
+        feeds the stage's osd.N.op latency histogram) and, when the op
+        is traced, annotate its span.  Stage names are literals from
+        tracing.STAGES (cephlint span-discipline enforces it)."""
+        trop = getattr(msg, "trop", None)
+        if trop is not None:
+            # cephlint: disable=span-discipline — the forwarding
+            # helper itself; callers pass registry literals and the
+            # check validates THEM (the _op_stage arg rule)
+            trop.mark_event(stage, detail)
+        span = getattr(msg, "span", None)
+        if span is not None:
+            span.annotate(f"{stage} {detail}" if detail else stage)
+
     def do_op(self, msg: m.MOSDOp, reply: Callable[[m.MOSDOpReply], None],
               conn=None):
         tr = getattr(self.osd.ctx, "trace", None)
         if tr is not None and tr.enabled:
-            # cross-daemon correlation by reqid (blkin role: every
-            # daemon touching this op derives the same trace id)
+            # cross-daemon causality: prefer the client's wire context
+            # (MOSDOp trace tail) so this span is a CHILD of the
+            # client's root span; untraced clients fall back to the
+            # reqid-derived correlator (blkin role: every daemon
+            # touching the op derives the same trace id)
             from ceph_tpu.core.tracing import trace_id_of
 
-            reqid = getattr(msg, "reqid", "") or f"anon:{msg.tid}"
+            parent = msg.trace_ctx() if hasattr(msg, "trace_ctx") else None
+            if parent is None:
+                reqid = getattr(msg, "reqid", "") or f"anon:{msg.tid}"
+                parent = (trace_id_of(reqid), 0)
             span = tr.start_span(
-                f"pg{t_.pgid_str(self.pgid)}.do_op",
-                parent=(trace_id_of(reqid), 0))
+                f"pg{t_.pgid_str(self.pgid)}.do_op", parent=parent)
             span.annotate(f"oid={msg.oid} ops={[o.op for o in msg.ops]}")
+            # downstream stages annotate it, and the backend fan-out
+            # inherits its context onto the peer messages
+            msg.span = span
+            trop = getattr(msg, "trop", None)
+            if trop is not None:
+                trop.trace_ctx = span.context()
             inner_reply = reply
 
             def reply(rep, _span=span, _inner=inner_reply):  # noqa: F811
@@ -672,7 +701,12 @@ class PG:
             with self.lock:
                 self._do_read(msg, reply)
 
-        return self.recovery_engine().park_read(msg.oid, wake)
+        parked = self.recovery_engine().park_read(msg.oid, wake)
+        if parked:
+            # timeline evidence for slow-op forensics: this read's
+            # latency is a recovery promotion, not pipeline time
+            self._op_stage(msg, "parked", f"oid={msg.oid}")
+        return parked
 
     def _do_read(self, msg, reply):
         with self.lock:
@@ -1204,6 +1238,9 @@ class PG:
                 staged = DeviceBuf.stage(self.backend.queue.pool, last.data)
                 if staged is not None:
                     last.data = staged
+                    # pool-acquire wait is the stage's latency (delta
+                    # since the previous timeline event)
+                    self._op_stage(msg, "staged", f"{len(staged)}B")
         # per-object admission (pipelined write engine): same-object
         # writes stay strictly ordered — the successor runs only after
         # the predecessor's transactions fanned out, so its state read
@@ -1218,11 +1255,18 @@ class PG:
         queued (on_submitted) or on any early-bail reply; the commit
         callback replies to the client later, off this thread."""
         released = [False]
+        # head of the admission FIFO: the delta since the previous
+        # timeline event is the _OidPipe queue wait
+        self._op_stage(msg, "admitted")
 
-        def release() -> None:
+        def release(submitted_ok: bool = True) -> None:
             if released[0]:
                 return
             released[0] = True
+            if submitted_ok:
+                # fan-out queued (state read + exec + encode handed
+                # off): the admission FIFO opens for the successor
+                self._op_stage(msg, "submitted")
             self._oid_release(msg.oid)
 
         reqid = getattr(msg, "reqid", "")
@@ -1290,7 +1334,7 @@ class PG:
                 for o in msg.ops:
                     if isinstance(o.data, DeviceBuf):
                         o.data.discard()
-                release()
+                release(submitted_ok=False)  # early bail: no fan-out
 
     def _writefull_fast_state(self, oid: str):
         """Local-only RMW base for all-WRITEFULL ops on a clean PG:
@@ -1711,11 +1755,13 @@ class PG:
                         self._note_reqid(entry)
                         self._inflight_reqids.pop(entry.reqid, None)
                 self._note_inflight(-1)
+                self._op_stage(msg, "commit")
                 self._durable_ack(
                     version, acked, dropped,
                     lambda: reply_once(m.MOSDOpReply(
                         self.pgid, self.osd.epoch(), msg.oid, msg.ops,
-                        result=0, version=version)))
+                        result=0, version=version)),
+                    msg=msg)
 
             on_commit.wants_acked = True
 
@@ -1765,6 +1811,8 @@ class PG:
                     self._note_reqid(entry)
                     self._inflight_reqids.pop(entry.reqid, None)
             self._note_inflight(-1)
+            self._op_stage(msg, "commit",
+                           f"dropped={sorted(dropped)}" if dropped else "")
 
             def fire() -> None:
                 reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
@@ -1775,7 +1823,7 @@ class PG:
 
             # degraded EC commits hold the reply until the watermark
             # is durable beyond this primary (the 0xd403 fix)
-            self._durable_ack(version, acked, dropped, fire)
+            self._durable_ack(version, acked, dropped, fire, msg=msg)
 
         on_commit.wants_acked = True
 
@@ -1786,6 +1834,11 @@ class PG:
             kw["on_submitted"] = on_submitted
         if self.is_ec():
             kw["on_error"] = self._write_unwind_fn(msg.oid, entry)
+        span = getattr(msg, "span", None)
+        if span is not None:
+            # peer sub-writes inherit this op's span context on the
+            # wire, so each peer's store-commit batch opens a child
+            kw["trace"] = span.context()
         # the queued write IS the newest state (published BEFORE the
         # backend submit, so a same-object successor admitted at
         # on_submitted reads its predecessor's projected state):
@@ -1859,23 +1912,53 @@ class PG:
         transaction for every shard this peer holds of the op (one
         rollback-capture pass, one WAL append), ONE commit ack.  Same
         interval gating and watermark merge as handle_sub_write."""
+        tr = self.osd.ctx.trace
+        span = None
+        if tr.enabled and msg.trace_ctx() is not None:
+            # cross-daemon child: the primary op span's context rode
+            # the wire; this peer's store-commit batch hangs off it
+            span = tr.start_span(f"osd{self.osd.whoami}.sub_write",
+                                 parent=msg.trace_ctx())
+            span.annotate(f"sub_write_recv oid={msg.oid} "
+                          f"shards={[r[0] for r in msg.rb]}")
+
         def _ack() -> None:
             rep = m.MECSubWriteVecReply(self.pgid, self.osd.epoch(), 0)
             rep.tid = msg.tid
             conn.send(rep)
+            if span is not None:
+                # fires from the store's commit thread: the annotation
+                # stamps when THIS peer's merged transaction went
+                # durable (its fsync batch)
+                span.annotate("store_commit")
+                span.finish()
 
-        with self.lock:
-            if msg.epoch < self.interval_epoch:
-                # minted in an OLDER interval: applying it would
-                # overwrite recovered data with the past (see
-                # handle_sub_write) — drop, the primary's interval
-                # change already re-resolved the repop
-                return
-            self.backend.apply_sub_write_vec(msg, on_commit=_ack)
-            self._note_entries(msg.entries)
-            with self._ct_lock:
-                if msg.committed_to > self.info.committed_to:
-                    self.info.committed_to = msg.committed_to
+        try:
+            with self.lock:
+                if msg.epoch < self.interval_epoch:
+                    # minted in an OLDER interval: applying it would
+                    # overwrite recovered data with the past (see
+                    # handle_sub_write) — drop, the primary's interval
+                    # change already re-resolved the repop
+                    if span is not None:
+                        span.annotate(f"dropped: stale interval "
+                                      f"(epoch {msg.epoch} < "
+                                      f"{self.interval_epoch})")
+                        span.finish()
+                    return
+                self.backend.apply_sub_write_vec(msg, on_commit=_ack)
+                self._note_entries(msg.entries)
+                with self._ct_lock:
+                    if msg.committed_to > self.info.committed_to:
+                        self.info.committed_to = msg.committed_to
+        except BaseException as e:
+            # the happy path finishes the span from the store's commit
+            # thread (_ack); a store/apply failure must not leak it —
+            # an unarchived span is a silently missing trace subtree
+            if span is not None:
+                span.annotate(f"exception: {e!r}")
+                span.finish()
+            raise
 
     def _note_entries(self, entries: List[LogEntry]) -> None:
         for en in entries:
@@ -1888,7 +1971,7 @@ class PG:
             self.info.last_complete = self.log.head
 
     def _durable_ack(self, version: EVersion, acked, dropped,
-                     fire: Callable[[], None]) -> None:
+                     fire: Callable[[], None], msg=None) -> None:
         """Advance the roll-forward watermark and release the client
         reply — the op at `version` got its last shard ack, so
         divergent-entry rollback must never rewind past it (the
@@ -1955,12 +2038,36 @@ class PG:
             # the whole testimony — nothing remote to wait for
             fire()
             return
-        self._gate_on_notes(version, peers, fire)
+        # gate-wait attribution: how long the degraded commit's reply
+        # was held for watermark witnesses (lat_ack_gate_us + the op
+        # timeline's ack_gated stage)
+        t_gate = time.monotonic()
+
+        def fire_gated() -> None:
+            trop = getattr(msg, "trop", None) if msg is not None else None
+            if trop is None:
+                # no tracked op to feed the stage delta (forged/test
+                # messages): hinc the gate histogram directly
+                op_perf = getattr(self.osd, "op_perf", None)
+                if op_perf is not None:
+                    op_perf.hinc("lat_ack_gate_us",
+                                 (time.monotonic() - t_gate) * 1e6)
+            if msg is not None:
+                # tracked ops feed lat_ack_gate_us ONCE through the
+                # stage delta (previous timeline event is the commit,
+                # marked just before _durable_ack)
+                self._op_stage(msg, "ack_gated")
+            fire()
+
+        span = getattr(msg, "span", None) if msg is not None else None
+        self._gate_on_notes(version, peers, fire_gated,
+                            trace=None if span is None
+                            else span.context())
 
     def _gate_on_notes(self, version: EVersion, peers: List[int],
                        fire: Callable[[], None],
-                       need_holders_at: Optional[EVersion] = None
-                       ) -> None:
+                       need_holders_at: Optional[EVersion] = None,
+                       trace=None) -> None:
         """Hold `fire` until every peer persists the watermark at
         `version`.  Note sends + the local meta persist hop to the
         fan-out lane — this may run inline on the messenger loop.
@@ -2020,6 +2127,7 @@ class PG:
             for osd_id in peers:
                 note = m.MECCommitNote(self.pgid, epoch, version)
                 note.tid = tid
+                note.set_trace(trace)  # gated op's span context
                 self.osd.send_to_osd(osd_id, note)
 
         from ceph_tpu.osd.backend import _fanout_executor
@@ -2064,24 +2172,38 @@ class PG:
                 "pg.commit_note.persist", osd=self.osd.whoami,
                 v=str(msg.committed_to)) is fp.DROP:
             return  # modeled loss: the note dies with its sender
-        with self.lock:
-            with self._ct_lock:
-                newer = msg.committed_to > self.info.committed_to
-                if newer:
-                    self.info.committed_to = msg.committed_to
-            if not newer and not msg.tid:
+        tr = self.osd.ctx.trace
+        span = None
+        if tr.enabled and msg.trace_ctx() is not None:
+            # gated notes carry the held op's span context: this child
+            # records the witness persist leg of the durable-ack gate
+            span = tr.start_span(f"osd{self.osd.whoami}.commit_note",
+                                 parent=msg.trace_ctx())
+        try:
+            with self.lock:
+                with self._ct_lock:
+                    newer = msg.committed_to > self.info.committed_to
+                    if newer:
+                        self.info.committed_to = msg.committed_to
+                if not newer and not msg.tid:
+                    return
+                self._persist_meta()
+            if span is not None:
+                span.annotate("note_persisted")
+            if not msg.tid:
                 return
-            self._persist_meta()
-        if not msg.tid:
-            return
-        if fp.enabled("pg.commit_note.ack") and fp.failpoint(
-                "pg.commit_note.ack", osd=self.osd.whoami) is fp.DROP:
-            return
-        rep = m.MECCommitNoteAck(self.pgid, self.osd.epoch(),
-                                 msg.committed_to,
-                                 last_update=self.info.last_update)
-        rep.tid = msg.tid
-        conn.send(rep)
+            if fp.enabled("pg.commit_note.ack") and fp.failpoint(
+                    "pg.commit_note.ack", osd=self.osd.whoami) is fp.DROP:
+                return
+            rep = m.MECCommitNoteAck(self.pgid, self.osd.epoch(),
+                                     msg.committed_to,
+                                     last_update=self.info.last_update)
+            rep.tid = msg.tid
+            rep.set_trace(msg.trace_ctx())  # correlate the witness ack
+            conn.send(rep)
+        finally:
+            if span is not None:
+                span.finish()
 
     def handle_commit_note_ack(self, msg: m.MECCommitNoteAck,
                                conn=None) -> None:
@@ -2137,28 +2259,43 @@ class PG:
         instead of going silent — the sender's gather accounting
         needs every row."""
         assert isinstance(self.backend, ECBackend)
-        be = self.backend
-        chunks: Dict[Tuple[str, int], Optional[bytes]] = {}
-        metas: Dict[Tuple[str, int], Tuple] = {}
-        rows = []
-        for shard, oid, off, length in msg.reads:
-            key = (oid, shard)
-            if length:
-                data = be.read_local_chunk_extent(oid, shard, off,
-                                                  length)
-            else:
-                if key not in chunks:
-                    chunks[key] = be.read_local_chunk(oid, shard)
-                data = chunks[key]
-            if key not in metas:
-                metas[key] = be.shard_meta(oid, shard)
-            attrs, omap = metas[key]
-            rows.append((shard, oid,
-                         data if data is not None else b"",
-                         0 if data is not None else EIO, attrs, omap))
-        rep = m.MECSubReadVecReply(self.pgid, self.osd.epoch(), rows)
-        rep.tid = msg.tid
-        conn.send(rep)
+        tr = self.osd.ctx.trace
+        span = None
+        if tr.enabled and msg.trace_ctx() is not None:
+            # child of the sender's recovery-round span: which peer
+            # served which rows, and how long the store pass took
+            span = tr.start_span(f"osd{self.osd.whoami}.sub_read",
+                                 parent=msg.trace_ctx())
+        try:
+            be = self.backend
+            chunks: Dict[Tuple[str, int], Optional[bytes]] = {}
+            metas: Dict[Tuple[str, int], Tuple] = {}
+            rows = []
+            for shard, oid, off, length in msg.reads:
+                key = (oid, shard)
+                if length:
+                    data = be.read_local_chunk_extent(oid, shard, off,
+                                                      length)
+                else:
+                    if key not in chunks:
+                        chunks[key] = be.read_local_chunk(oid, shard)
+                    data = chunks[key]
+                if key not in metas:
+                    metas[key] = be.shard_meta(oid, shard)
+                attrs, omap = metas[key]
+                rows.append((shard, oid,
+                             data if data is not None else b"",
+                             0 if data is not None else EIO, attrs, omap))
+            rep = m.MECSubReadVecReply(self.pgid, self.osd.epoch(), rows)
+            rep.tid = msg.tid
+            conn.send(rep)
+            if span is not None:
+                span.annotate(f"sub_read_served rows={len(rows)}")
+        finally:
+            # a store-pass failure must not leak the span (finish is
+            # idempotent: the happy path's annotate already ran)
+            if span is not None:
+                span.finish()
 
     # -- EC read path (primary) -------------------------------------------
     def _ec_read_object(self, oid: str,

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.core.failpoint import failpoint
@@ -261,6 +262,7 @@ class _Round:
 
     def __init__(self, oids: List[str]) -> None:
         self.oids = oids
+        self.span = None  # recovery-round trace span (when tracing)
         self.lock = make_lock("pg.recovery_round")
         self.gathers: Dict[str, ChunkGather] = {}
         self.unresolved: Set[str] = set(oids)
@@ -325,7 +327,8 @@ class ECRecoveryEngine:
         timer.daemon = True
         kick = False
         with self._cond:
-            self._parked.setdefault(oid, []).append((wake, timer))
+            self._parked.setdefault(oid, []).append(
+                (wake, timer, time.monotonic()))
             rnd = self._round
             inflight = rnd is not None and oid in rnd.unresolved
             if not inflight:
@@ -408,12 +411,31 @@ class ECRecoveryEngine:
                         self._pending_set.discard(oid)
                         batch.append(oid)
                     rnd = self._round = _Round(batch)
+                t_round = time.monotonic()
+                tr = getattr(self.osd.ctx, "trace", None)
+                if tr is not None and tr.enabled:
+                    # one span per window round: the recovery twin of
+                    # the write path's op spans — peer sub-read
+                    # children hang off it via the vec wire context
+                    rnd.span = tr.start_span(
+                        f"pg{t_.pgid_str(self.pg.pgid)}.recovery.round")
+                    rnd.span.annotate(f"window={len(rnd.oids)}")
                 try:
                     self._run_round(rnd)
                 finally:
                     with self._cond:
                         self._round = None
                         self._cond.notify_all()
+                    op_perf = getattr(self.osd, "op_perf", None)
+                    if op_perf is not None:
+                        op_perf.hinc(
+                            "lat_recovery_round_us",
+                            (time.monotonic() - t_round) * 1e6)
+                    if rnd.span is not None:
+                        rnd.span.annotate(
+                            f"concluded={len(rnd.concluded)}"
+                            f"/{len(rnd.oids)}")
+                        rnd.span.finish()
         except BaseException:
             with self._cond:
                 self._drainers -= 1
@@ -557,6 +579,9 @@ class ECRecoveryEngine:
                     pg.pgid, epoch,
                     [(shard, oid, 0, 0) for shard, oid in rows])
                 vec.tid = tid
+                if rnd.span is not None:
+                    # the peer opens its sub_read child off this round
+                    vec.set_trace(rnd.span.context())
                 self.osd.send_to_osd(osd_id, vec)
                 with rnd.lock:
                     rnd.vec_sent.add(osd_id)
@@ -719,16 +744,23 @@ class ECRecoveryEngine:
         # requeued: parked waiters stay parked — their bounded-wait
         # timer still answers EAGAIN if the retry loses too
 
+    def _note_park_wait(self, t0: float) -> None:
+        op_perf = getattr(self.osd, "op_perf", None)
+        if op_perf is not None:
+            op_perf.hinc("lat_parked_read_us",
+                         (time.monotonic() - t0) * 1e6)
+
     def _wake_parked(self, oid: str, ok: bool) -> None:
         with self._cond:
             waiters = self._parked.pop(oid, [])
         if not waiters:
             return
-        for _wake, timer in waiters:
+        for _wake, timer, t0 in waiters:
             timer.cancel()
+            self._note_park_wait(t0)
 
         def fire() -> None:
-            for wake, _timer in waiters:
+            for wake, _timer, _t0 in waiters:
                 try:
                     wake(ok)
                 except Exception as e:  # noqa: BLE001 — one waiter's
@@ -748,10 +780,12 @@ class ECRecoveryEngine:
             kept = [r for r in rows if r[0] is not wake]
             if len(kept) == len(rows):
                 return  # already woken
+            mine = next(r for r in rows if r[0] is wake)
             if kept:
                 self._parked[oid] = kept
             else:
                 self._parked.pop(oid, None)
+        self._note_park_wait(mine[2])
         try:
             wake(False)  # bounded wait elapsed: EAGAIN as before
         except Exception as e:  # noqa: BLE001 — timer thread must survive

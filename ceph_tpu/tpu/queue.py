@@ -16,16 +16,19 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Tuple
 
 import numpy as np
 
+from ceph_tpu.core.perf import PerfCounters
 from ceph_tpu.tpu.staging import DevPathStats, StagingPool
 
 
 class _Job:
-    __slots__ = ("codec", "planes", "future", "kind", "sig", "size")
+    __slots__ = ("codec", "planes", "future", "kind", "sig", "size",
+                 "t_enq")
 
     def __init__(self, codec, planes: np.ndarray, kind: str = "enc",
                  sig: Tuple[int, ...] = (), size: int = 0) -> None:
@@ -36,6 +39,7 @@ class _Job:
         self.size = size or planes.nbytes  # real payload bytes (h2d
         # accounting: stripe-tail zeros are device-side fill, not
         # transferred bytes)
+        self.t_enq = time.monotonic()  # queue-wait attribution
         self.future: Future = Future()
 
 
@@ -78,6 +82,22 @@ class StripeBatchQueue:
         # measured invariant (registered per daemon as osd.N.tpu)
         self.stats = DevPathStats()
         self.pool = StagingPool(stats=self.stats)
+        # stage-latency attribution (PR 8): where an encode's time
+        # goes — waiting in this queue (coalescing window included) vs
+        # the device matmul(+crc) vs handing results back to the
+        # futures.  Process-wide like the queue; each daemon registers
+        # it in its context as osd.N.tpuq
+        self.perf = PerfCounters("tpu.queue")
+        self.perf.add_histogram(
+            "lat_encq_wait_us", "job enqueue -> batch start (us)")
+        self.perf.add_histogram(
+            "lat_device_us", "device compute per coalesced batch (us)")
+        self.perf.add_histogram(
+            "lat_encq_dispatch_us",
+            "batch result fan-out to futures (us)")
+        # batch spans (width/kind per dispatch) ride this tracer when
+        # set AND enabled; bound by daemon init to its context's tracer
+        self.tracer = None
 
     def start(self) -> None:
         with self._lock:
@@ -210,9 +230,17 @@ class StripeBatchQueue:
         if fp.enabled("queue.batch.dispatch"):
             fp.failpoint("queue.batch.dispatch", jobs=len(batch),
                          kind=batch[0].kind)
+        t_start = time.monotonic()
+        for j in batch:
+            # queue wait: enqueue -> batch start; the coalescing
+            # window is included — the op pays it either way
+            self.perf.hinc("lat_encq_wait_us",
+                           (t_start - j.t_enq) * 1e6)
+        t_compute = t_start
         try:
             if len(batch) == 1 and batch[0].kind == "enc":
                 coding = batch[0].codec.encode_array(batch[0].planes)
+                t_compute = time.monotonic()
                 batch[0].future.set_result(np.asarray(coding))
             else:
                 widths = [j.planes.shape[1] for j in batch]
@@ -261,12 +289,14 @@ class StripeBatchQueue:
                         offs.append(o)
                         o += w
                     crcs = crc32c_rows(full, offs, widths)
+                    t_compute = time.monotonic()
                     off = 0
                     for i, (j, w) in enumerate(zip(batch, widths)):
                         j.future.set_result(
                             (coding[:, off:off + w], crcs[i]))
                         off += w
                 else:
+                    t_compute = time.monotonic()
                     off = 0
                     for j, w in zip(batch, widths):
                         j.future.set_result(coding[:, off:off + w])
@@ -287,6 +317,19 @@ class StripeBatchQueue:
                 self.dec_batch_jobs[len(batch)] = (
                     self.dec_batch_jobs.get(len(batch), 0) + 1)
             self.bytes_in += sum(j.planes.nbytes for j in batch)
+            t_done = time.monotonic()
+            self.perf.hinc("lat_device_us",
+                           (t_compute - t_start) * 1e6)
+            self.perf.hinc("lat_encq_dispatch_us",
+                           (t_done - t_compute) * 1e6)
+            tr = self.tracer
+            if tr is not None and tr.enabled:
+                # batch span record: job width is THE coalescing
+                # evidence per dispatch (tracepoint, not a span — a
+                # batch serves many unrelated ops)
+                tr.event("tpu", "batch", jobs=len(batch),
+                         kind=batch[0].kind,
+                         cols=sum(j.planes.shape[1] for j in batch))
         except BaseException as e:  # noqa: BLE001 — propagate to callers
             for j in batch:
                 if not j.future.done():

@@ -127,6 +127,10 @@ class VStartCluster:
             label = "cluster" if dctx is self.ctx else name
             seen[id(dctx)] = label
             mgr.register_daemon(label, dctx)
+        # op trackers are per-SERVICE even when contexts are shared:
+        # every OSD joins the ops-module slow-op/in-flight merge
+        for i, svc in self.osds.items():
+            mgr.register_service(f"osd.{i}", svc)
         mgr.osdmap = self.leader().osdmap
         if dashboard:
             mgr.modules["dashboard"].serve(
@@ -300,6 +304,12 @@ class VStartCluster:
         svc.boot(self.monmap, keyring=self.keyring)
         svc.start_heartbeats()
         self.osds[i] = svc
+        # the revived daemon owns a FRESH op tracker: repoint the mgr
+        # ops-module merge at it, or the cluster-wide slow-op/in-flight
+        # surface keeps serving the dead service's frozen rings
+        mgr = getattr(self, "mgr", None)
+        if mgr is not None:
+            mgr.register_service(f"osd.{i}", svc)
 
     def shutdown(self) -> None:
         self._stop_evt.set()

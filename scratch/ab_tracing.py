@@ -1,0 +1,50 @@
+#!/usr/bin/env python3
+"""One A/B trial of EC write IOPS (64KiB and 4KiB, depth 16) — the
+PR-8 op-observability overhead acceptance metric.  Imports ceph_tpu
+from PYTHONPATH so the same script measures any checkout (A = clean
+pre-PR worktree, B = this tree with tracing at its default OFF, the
+stage histograms always fed); prints JSON.  Interleave trials
+A,B,A,B,... from a driver to cancel rig drift (the box drifts
++/-35%)."""
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    from ceph_tpu.client.rados import OSDOp
+    from ceph_tpu.osd import types as t_
+    from ceph_tpu.vstart import VStartCluster
+
+    depth = 16
+
+    def run(io, n, payload, tag):
+        def wf():
+            return [OSDOp(t_.OP_WRITEFULL, data=payload)]
+        pend = []
+        t0 = time.perf_counter()
+        for i in range(n):
+            pend.append(io.aio_operate(f"ab_{tag}_{i}", wf()))
+            if len(pend) >= depth:
+                pend.pop(0).result(60.0)
+        for p in pend:
+            p.result(60.0)
+        return n / (time.perf_counter() - t0)
+
+    out = {}
+    with VStartCluster(n_mons=1, n_osds=3) as c:
+        ec = c.create_pool("ab_ec", size=3, pool_type="erasure",
+                           ec_profile="k=2 m=1")
+        ioec = c.client().ioctx(ec)
+        run(ioec, 32, b"w" * 4096, "warm")  # peering, sockets, jit
+        out["ec64k_write_iops"] = round(
+            run(ioec, 64, b"b" * 65536, "64k"), 1)
+        out["ec4k_write_iops"] = round(
+            run(ioec, 192, b"s" * 4096, "4k"), 1)
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

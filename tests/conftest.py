@@ -45,6 +45,7 @@ if _LOCKDEP:
     lockdep.enable(True)
 os.environ.setdefault("CEPH_TPU_LOOP_STALL_MS", "1000")
 
+from ceph_tpu.core import optracker as _optracker
 from ceph_tpu.msg import messenger as _messenger
 
 
@@ -53,6 +54,7 @@ def _sanitizers():
     if _LOCKDEP:
         lockdep.enable(True)  # re-assert: a test may have toggled it
     _messenger.LOOP_STALLS.clear()
+    _optracker.LEAKS.clear()
     yield
     stalls, _messenger.LOOP_STALLS[:] = (list(_messenger.LOOP_STALLS), [])
     if float(os.environ.get("CEPH_TPU_LOOP_STALL_MS", "0") or 0) > 0:
@@ -60,3 +62,12 @@ def _sanitizers():
             "fast-dispatched handler(s) blocked the messenger event loop "
             "(no store work, no lock waits, no RPCs inline on the loop): "
             + "; ".join(f"{e}:{t} {s * 1e3:.0f}ms" for e, t, s in stalls))
+    # TrackedOp lifecycle sanitizer: a daemon that shut down holding an
+    # op whose reply went out but that never left the in-flight table
+    # has a lifecycle leak (the loop-stall shape: evidence collected by
+    # the machinery, asserted per test)
+    leaks, _optracker.LEAKS[:] = (list(_optracker.LEAKS), [])
+    assert not leaks, (
+        "TrackedOp lifecycle leak(s) — replied ops must be finish()ed "
+        "into history, not left in the in-flight table: "
+        + "; ".join(leaks))

@@ -410,6 +410,78 @@ def test_failpoint_names_flag_typo_and_dynamic(tmp_path):
     assert not clean
 
 
+def test_span_discipline_unfinished_span(tmp_path):
+    bad = _lint(tmp_path, (
+        "def f(tr):\n"
+        "    s = tr.start_span('x')\n"
+        "    s.annotate('commit')\n"  # never finished
+    ), "span-discipline")
+    assert any("finish" in v.message for v in bad)
+
+    # a bare call nothing can ever finish
+    bare = _lint(tmp_path, (
+        "def f(tr):\n"
+        "    tr.start_span('x')\n"
+    ), "span-discipline")
+    assert any(v.detail == "start_span-unfinished" for v in bare)
+
+    ok = _lint(tmp_path, (
+        "def f(tr):\n"
+        "    with tr.start_span('x') as s:\n"
+        "        s.annotate('commit')\n"
+        "def g(tr):\n"
+        "    s = tr.start_span('y')\n"
+        "    def cb():\n"
+        "        s.finish()\n"  # closure finish counts
+        "    return cb\n"
+        "def h(tr, op):\n"
+        "    op.span = tr.start_span('z')\n"
+        "def h2(op):\n"
+        "    op.span.finish()\n"  # sibling-method finish (module-wide)
+    ), "span-discipline")
+    assert not [v for v in ok if v.detail == "start_span-unfinished"]
+
+
+def test_span_discipline_stage_registry(tmp_path):
+    bad = _lint(tmp_path, (
+        "def f(top):\n"
+        "    top.mark_event('comit_sent')\n"  # typo'd stage
+    ), "span-discipline")
+    assert len(bad) == 1 and "not declared" in bad[0].message
+
+    dyn = _lint(tmp_path, (
+        "def f(top, name):\n"
+        "    top.mark_event(name)\n"
+    ), "span-discipline")
+    assert len(dyn) == 1 and "<dynamic>" in dyn[0].detail
+
+    # literal annotate must be a stage; f-string detail is free-form
+    lit = _lint(tmp_path, (
+        "def f(span, r):\n"
+        "    span.annotate('not_a_stage')\n"
+        "    span.annotate(f'reply result={r}')\n"
+    ), "span-discipline")
+    assert len(lit) == 1 and "not_a_stage" in lit[0].detail
+
+    ok = _lint(tmp_path, (
+        "def f(top, self, msg):\n"
+        "    top.mark_event('commit_sent')\n"
+        "    self._op_stage(msg, 'admitted')\n"
+    ), "span-discipline")
+    assert not ok
+
+
+def test_span_discipline_never_baseline(tmp_path):
+    from ceph_tpu.analysis.framework import (Violation,
+                                             violations_to_baseline)
+
+    v = Violation(check="span-discipline",
+                  path="ceph_tpu/osd/pg.py", line=1,
+                  scope="PG.x", detail="start_span-unfinished",
+                  message="m")
+    assert v.key not in violations_to_baseline([v])["entries"]
+
+
 def test_failpoint_names_never_baseline(tmp_path):
     from ceph_tpu.analysis.framework import (Violation,
                                              violations_to_baseline)

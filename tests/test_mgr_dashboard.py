@@ -75,6 +75,26 @@ def test_404_and_command(cluster):
     assert rc == 0 and out["running"] and str(cluster._dash_port) in out["url"]
 
 
+def test_ops_module_sees_vstart_services(cluster):
+    """start_mgr wires every OSD SERVICE into the ops-module merge
+    (trackers are per-service even when daemons share one Context) —
+    the cluster-wide dump surface must not be test-fixture-only."""
+    mgr = cluster.mgr
+    assert len(mgr.services) == 3, sorted(mgr.services)
+    rc, hist = mgr.handle_command({"prefix": "ops dump_in_flight"})
+    assert rc == 0 and "ops" in hist
+    # the fixture's write concluded through every tracker -> history
+    assert sum(t.op_tracker.ops_tracked
+               for t in mgr.services.values()) >= 1
+    rc, lat = mgr.handle_command({"prefix": "ops latency"})
+    assert rc == 0 and lat.get("lat_op_us", {}).get("count", 0) >= 1
+    # kill/revive repoints the merge at the revived service's FRESH
+    # tracker — not the dead daemon's frozen rings
+    cluster.kill_osd(2)
+    cluster.revive_osd(2)
+    assert mgr.services["osd.2"] is cluster.osds[2]
+
+
 def test_df_command_and_telemetry(cluster):
     rc, out = cluster.command({"prefix": "df"})
     assert rc == 0

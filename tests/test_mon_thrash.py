@@ -51,6 +51,22 @@ def cluster():
     c.shutdown()
 
 
+def _leader_rank(cluster):
+    """Wait out any in-flight election and return the leader's rank.
+
+    A bare next(... if m.state == "leader") races the re-election a
+    just-restarted mon's probe can trigger after quorum was already
+    observed once (StopIteration under full-suite load)."""
+    found = []
+
+    def _poll():
+        found[:] = [m.rank for m in cluster.mons if m.state == "leader"]
+        return bool(found)
+
+    cluster.wait_for(_poll, msg="leader elected")
+    return found[0]
+
+
 def _restart_mon(cluster, rank):
     """Kill + re-create one mon rank over the SAME kv store (the
     durable restart path: paxos promises and committed state must
@@ -73,8 +89,7 @@ def test_mon_thrash_under_io(cluster):
         write = 0
         for round_no in range(3):
             # thrash: bounce a PEON, then the LEADER
-            leader_rank = next(m.rank for m in cluster.mons
-                               if m.state == "leader")
+            leader_rank = _leader_rank(cluster)
             peon_rank = next(m.rank for m in cluster.mons
                              if m.rank != leader_rank)
             for victim in (peon_rank, leader_rank):

@@ -50,8 +50,8 @@ def build_map() -> OSDMap:
 class MiniCluster:
     """N OSDService instances over memstores + one shared map."""
 
-    def __init__(self, store_factory=None) -> None:
-        self.ctx = Context("osd.cluster")
+    def __init__(self, store_factory=None, overrides=None) -> None:
+        self.ctx = Context("osd.cluster", overrides)
         self.osdmap = build_map()
         self.osds = {}
         self.watchers = []  # clients notified on every map refresh
@@ -104,6 +104,7 @@ class MiniCluster:
         for o in self.osds.values():
             if o.up:
                 o.shutdown()
+        self.ctx.shutdown()  # stops the admin socket when one was up
 
     def primary_of(self, pool: int, oid: str):
         pgid = self.osdmap.object_to_pg(pool, oid)

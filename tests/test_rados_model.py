@@ -335,6 +335,22 @@ def _dump_thrash_forensics(c, err, seed, model=None):
     from ceph_tpu.osd.pg import ROLLBACK_EVENTS
 
     report["rollback_events"] = list(ROLLBACK_EVENTS)
+    # op-observability evidence (PR 8): every OSD's slow-op ring and
+    # in-flight op timelines ride the dump — a divergence now shows
+    # WHERE the implicated ops spent their time (stage events), not
+    # just what state they left behind.  Down OSDs included: a killed
+    # daemon's drained history is exactly the kill-window testimony.
+    report["slow_ops"] = {}
+    report["ops_in_flight"] = {}
+    for i, o in c.osds.items():
+        trk = getattr(o, "op_tracker", None)
+        if trk is None:
+            continue
+        try:
+            report["slow_ops"][f"osd{i}"] = trk.dump_slow()
+            report["ops_in_flight"][f"osd{i}"] = trk.dump_in_flight()
+        except Exception as e:  # best-effort forensics
+            report["slow_ops"][f"osd{i}"] = {"error": repr(e)}
     if model is not None and oid:
         report["acked_mutations"] = {
             f"{kind}:{name}" if name else kind: rec

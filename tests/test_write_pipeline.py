@@ -132,8 +132,15 @@ def test_distinct_oids_overlap_in_flight(frozen_cluster):
                          ec_profile="k=2 m=1")
     io = c.client().ioctx(pool)
     # warmup outside the freeze: peering settled, connections up
-    assert io.operate("warm", [OSDOp(t_.OP_WRITEFULL,
-                                     data=b"w" * 512)]).result == 0
+    # (generous timeout + one retry: the first op on a fresh pool races
+    # PG activation, and under full-suite load 30s has proven too tight)
+    try:
+        rep = io.operate("warm", [OSDOp(t_.OP_WRITEFULL,
+                                        data=b"w" * 512)], timeout=60.0)
+    except TimeoutError:
+        rep = io.operate("warm", [OSDOp(t_.OP_WRITEFULL,
+                                        data=b"w" * 512)], timeout=60.0)
+    assert rep.result == 0
     base = _pg_perf(c)
     for osd in c.osds.values():
         osd.store._pipeline.freeze()

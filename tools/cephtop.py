@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""cephtop — cluster-wide per-stage op-latency breakdown.
+
+Polls daemon admin sockets for `perf dump` (the osd.N.op per-stage
+histograms + the osd.N.tpuq queue-stage set) and the per-daemon
+`osd.N dump_historic_slow_ops` rings, merges them, and renders where
+a write spends its time — the live answer to "where does the tunnel
+tax land per op" that PRs 2-7 could only estimate from benchmarks.
+
+    python tools/cephtop.py --socket /run/a.sock [--socket /run/b.sock]
+    python tools/cephtop.py --socket /run/a.sock --slow   # slow-op rings
+    python tools/cephtop.py --socket /run/a.sock --json
+
+Stage rows are the `lat_*_us` histograms (see tracing.STAGES for the
+pipeline order); p50/p99 are log2-bucket interpolations, identical to
+the mgr `ops latency` merge and the bench latency-attribution aux.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Iterable, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.core.admin_socket import admin_command  # noqa: E402
+from ceph_tpu.core.perf import hist_summary, merge_stage_hists  # noqa: E402
+
+# render order follows the write pipeline; anything else (reads,
+# recovery, queue stages) appends alphabetically after
+_STAGE_ORDER = [
+    "lat_recv_us", "lat_queue_us", "lat_staging_us", "lat_admission_us",
+    "lat_encode_fanout_us", "lat_encq_wait_us", "lat_device_us",
+    "lat_encq_dispatch_us", "lat_fanout_rtt_us", "lat_commit_wait_us",
+    "lat_ack_gate_us", "lat_reply_us", "lat_op_us",
+]
+
+
+def merge_op_hists(perf_dumps: Iterable[Dict]) -> Dict[str, dict]:
+    """One socket = one process = one payload; the merge rules
+    (op/tpuq filter, tpuq-exactly-once per process) live in
+    core.perf.merge_stage_hists, shared with the mgr and bench."""
+    return merge_stage_hists(perf_dumps)
+
+
+def breakdown(merged: Dict[str, dict]) -> List[dict]:
+    rows = []
+    ordered = [s for s in _STAGE_ORDER if s in merged]
+    ordered += sorted(s for s in merged if s not in _STAGE_ORDER)
+    for stage in ordered:
+        row = hist_summary(merged[stage])
+        if not row["count"]:
+            continue
+        row["stage"] = stage
+        rows.append(row)
+    return rows
+
+
+def render(rows: List[dict]) -> str:
+    if not rows:
+        return "no stage histograms yet (no tracked ops?)"
+    widths = (max(len(r["stage"]) for r in rows), 10, 12, 12, 12)
+    head = (f"{'stage':<{widths[0]}} {'count':>{widths[1]}} "
+            f"{'p50_us':>{widths[2]}} {'p99_us':>{widths[3]}} "
+            f"{'mean_us':>{widths[4]}}")
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        lines.append(
+            f"{r['stage']:<{widths[0]}} {r['count']:>{widths[1]}} "
+            f"{r['p50_us']:>{widths[2]}} {r['p99_us']:>{widths[3]}} "
+            f"{r['mean_us']:>{widths[4]}}")
+    return "\n".join(lines)
+
+
+def _slow_ops(socket_paths: List[str]) -> List[dict]:
+    """Merged slow-op rings: daemon dump commands are discovered from
+    each socket's `help` listing (per-daemon prefixed commands)."""
+    out: List[dict] = []
+    for path in socket_paths:
+        try:
+            cmds = admin_command(path, "help")
+        except OSError:
+            continue
+        for prefix in sorted(cmds):
+            if not prefix.endswith(" dump_historic_slow_ops"):
+                continue
+            daemon = prefix.rsplit(" ", 1)[0]
+            try:
+                d = admin_command(path, prefix)
+            except OSError:
+                continue
+            for o in d.get("ops", []):
+                o["daemon"] = daemon
+                out.append(o)
+    out.sort(key=lambda o: -o.get("age", 0.0))
+    return out
+
+
+def render_slow(ops: List[dict]) -> str:
+    if not ops:
+        return "slow-op rings are empty"
+    lines = []
+    for o in ops:
+        lines.append(f"{o.get('daemon', '?')}  age={o.get('age')}s  "
+                     f"{o.get('description', '')}")
+        for ev in o.get("events", []):
+            lines.append(f"    {ev.get('t'):>10.6f}  {ev.get('event')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cephtop", description=__doc__)
+    p.add_argument("--socket", action="append", default=[],
+                   help="daemon admin socket path (repeatable)")
+    p.add_argument("--slow", action="store_true",
+                   help="dump the merged slow-op rings instead")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    if not args.socket:
+        print("cephtop: at least one --socket required", file=sys.stderr)
+        return 2
+
+    if args.slow:
+        ops = _slow_ops(args.socket)
+        print(json.dumps({"num_ops": len(ops), "ops": ops}, indent=1)
+              if args.as_json else render_slow(ops))
+        return 0
+
+    dumps = []
+    for path in args.socket:
+        try:
+            dumps.append(admin_command(path, "perf dump"))
+        except OSError as e:
+            print(f"cephtop: {path}: {e}", file=sys.stderr)
+    rows = breakdown(merge_op_hists(dumps))
+    print(json.dumps(rows, indent=1) if args.as_json else render(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
