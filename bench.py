@@ -682,7 +682,12 @@ def cluster_io(jax, out):
     from ceph_tpu.osd import types as t_
     from ceph_tpu.client.rados import OSDOp
 
-    with VStartCluster(n_mons=1, n_osds=3) as c:
+    # fast stats reporting so the recovery phase's telemetry digest
+    # (degraded ratio, recovery rate, progress ETA) is observable at
+    # bench timescales; rate window sized to the recovery duration
+    with VStartCluster(n_mons=1, n_osds=3,
+                       conf={"osd_pg_stats_interval": 0.5,
+                             "mon_stats_rate_window": 15.0}) as c:
         rep_pool = c.create_pool("bench_rep", size=2)
         io = c.client().ioctx(rep_pool)
         payload = b"b" * 65536
@@ -916,16 +921,67 @@ def cluster_io(jax, out):
         # (one ctx): measure deltas, not absolutes
         rp0 = c.osds[r_prim].perf.dump().get("recovery_pushes", 0)
         pg0 = c.osds[r_prim].pg_perf.dump()
+        # telemetry digest capture (ISSUE 9): the degraded debt must
+        # be VISIBLE in the mon digest before recovery starts, and the
+        # recovery phase samples rate + progress ETA against the
+        # measured completion
+        mgr = c.start_mgr()
+        tel = {"degraded_ratio_peak": 0.0, "recovery_rate_peak": 0.0,
+               "eta_first_s": None, "eta_error_ratio": None}
+        eta_first = []  # (monotonic stamp, eta_s, event started)
+
+        def _digest():
+            return c.leader().pgmap.digest()
+
+        c.wait_for(lambda: _digest()["degraded_objects"] > 0,
+                   timeout=30.0, what="degraded debt in the digest")
         t0 = time.perf_counter()
         c.revive_osd(r_prim)
         svc = c.osds[r_prim]
 
+        def _sample_telemetry() -> None:
+            d = _digest()
+            tel["degraded_ratio_peak"] = max(
+                tel["degraded_ratio_peak"], d["degraded_ratio"])
+            tel["recovery_rate_peak"] = max(
+                tel["recovery_rate_peak"],
+                d["io"]["recovery_objects_per_s"])
+            _code, prog = mgr.handle_command({"prefix": "progress"})
+            if not eta_first:
+                for ev in prog["events"]:
+                    if ev["pgid"] == f"{rec_pool}.0" and \
+                            ev["eta_s"] is not None:
+                        eta_first.append((time.monotonic(),
+                                          ev["eta_s"], ev["started"]))
+                        break
+
         def _pulled() -> bool:
+            _sample_telemetry()
             return svc.perf.dump().get(
                 "recovery_pushes", 0) - rp0 >= n_rec
         c.wait_for(_pulled, timeout=120.0,
                    what="windowed pull of the degraded pg")
         rec_dt = time.perf_counter() - t0
+        # drain the last stats reports so the rate ring and the
+        # progress completion both see the finished recovery
+        rec_deadline = time.time() + 8.0
+        rec_done = None
+        while time.time() < rec_deadline:
+            _sample_telemetry()
+            _code, prog = mgr.handle_command({"prefix": "progress"})
+            rec_done = next(
+                (ev for ev in prog["completed"]
+                 if ev["pgid"] == f"{rec_pool}.0"), None)
+            if rec_done is not None and tel["recovery_rate_peak"] > 0:
+                break
+            time.sleep(0.3)
+        if eta_first and rec_done is not None:
+            stamp, eta0, started = eta_first[0]
+            actual = (started + rec_done["duration_s"]) - stamp
+            tel["eta_first_s"] = eta0
+            if actual > 0:
+                tel["eta_error_ratio"] = round(
+                    abs(eta0 - actual) / actual, 3)
         pgd = svc.pg_perf.dump()
         sr_msgs = pgd.get("subread_msgs", 0) - pg0.get("subread_msgs", 0)
         sr_ops = pgd.get("subread_ops", 0) - pg0.get("subread_ops", 0)
@@ -952,6 +1008,14 @@ def cluster_io(jax, out):
             "decode_batch_jobs_hist": dec_hist,
             "mean_decode_jobs_per_batch": round(
                 dec_jobs / dec_batches, 2) if dec_batches else 0.0,
+            "telemetry": {
+                **tel,
+                "note": "mon PGMap digest during the phase: peak "
+                        "degraded ratio + recovery objects/s from the "
+                        "rate ring, first progress-event ETA vs the "
+                        "event's measured duration (None = recovery "
+                        "outran the stats cadence)",
+            },
             "note": "revived primary pulls a 1-pg degraded EC pool "
                     "through the windowed recovery engine; includes "
                     "boot+peering latency (same in any A/B arm)",

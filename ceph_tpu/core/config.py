@@ -93,6 +93,18 @@ def _opts() -> List[Option]:
           "distinct failure reporters required to mark an osd down"),
         O("mon_osd_adjust_heartbeat_grace", bool, True,
           "scale grace by reporter history"),
+        O("mon_pg_stats_stale_s", float, 30.0,
+          "seconds after which an OSD's MPGStats report stops feeding "
+          "PG health checks; a LIVE osd whose reports go stale past "
+          "this raises MON_STALE_PG_REPORTS instead of silently "
+          "vanishing from the digest"),
+        O("mon_pg_stuck_threshold", float, 300.0,
+          "seconds a PG may sit in a non-active state before the "
+          "PG_STUCK health check fires (stuck-since stamps come from "
+          "the PGMap's state-transition tracking)"),
+        O("mon_stats_rate_window", float, 10.0,
+          "window (seconds) over which the PGMap digest derives "
+          "client IOPS/BW and recovery rates from report deltas"),
         O("osd_heartbeat_grace", float, 20.0,
           "seconds without a ping before reporting failure"),
         O("osd_heartbeat_interval", float, 2.0, "osd peer ping period"),
@@ -112,6 +124,10 @@ def _opts() -> List[Option]:
           "completed ops kept for dump_historic_ops", runtime=False),
         O("osd_op_history_slow_size", int, 20,
           "slow ops kept for dump_historic_slow_ops", runtime=False),
+        O("osd_slow_op_report_window", float, 30.0,
+          "seconds a completed slow op keeps counting toward the "
+          "slow-op depth reported to the mon (MPGStats); the SLOW_OPS "
+          "health check clears once the ring entries age past this"),
         O("osd_client_write_timeout", float, 30.0,
           "seconds before an in-flight client write whose commit (or "
           "durable-ack gate) never resolves answers retryable EAGAIN"),

@@ -218,6 +218,22 @@ class OpTracker:
         with self._lock:
             return len(self._in_flight)
 
+    def slow_depth(self, window_s: float = 30.0) -> int:
+        """Live slow-op pressure for the mon's SLOW_OPS health check:
+        in-flight ops already past the complaint threshold, plus slow
+        ring entries whose completion is younger than ``window_s`` —
+        so the check fires while a stall is fresh and CLEARS once the
+        ring evidence ages out (the entries stay dumpable; only the
+        health signal decays)."""
+        now = time.monotonic()
+        with self._lock:
+            live = sum(1 for op in self._in_flight.values()
+                       if op.age >= self.slow_op_threshold)
+            recent = sum(1 for op in self._slow
+                         if op.done_at is not None
+                         and now - op.done_at < window_s)
+        return live + recent
+
     # -- dumps (admin socket payloads) --------------------------------
     def dump_in_flight(self) -> Dict[str, Any]:
         with self._lock:

@@ -9,8 +9,10 @@ over the admin socket (`perf dump`).  Daemons push these to the mgr
 
 from __future__ import annotations
 
+import collections
 import math
 import threading
+import time
 from typing import Dict, List, Optional
 
 TYPE_U64 = "u64"          # monotonically increasing counter
@@ -83,6 +85,17 @@ class PerfCounters:
             c.sum += value
 
     # -- output -----------------------------------------------------------
+    def value(self, name: str, default: int = 0) -> int:
+        """One scalar counter/gauge, without serializing the whole set
+        (dump() walks every counter incl. histogram bucket lists — too
+        heavy for per-tick single-value reads like the stats report's
+        heartbeat_misses)."""
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None or c.type not in (TYPE_U64, TYPE_GAUGE):
+                return default
+            return c.value
+
     def dump(self) -> Dict[str, object]:
         out: Dict[str, object] = {}
         with self._lock:
@@ -105,6 +118,87 @@ class PerfCounters:
                         "buckets": c.buckets[: top + 1],
                     }
         return out
+
+
+class SnapshotRing:
+    """Bounded ring of (stamp, {key: cumulative value}) snapshots with
+    windowed rate derivation — the shared primitive behind the
+    windowed "per-second" numbers this repo shows (the mon PGMap's
+    client IOPS/BW and recovery objects/s, the StripeBatchQueue's
+    device-busy fraction).  The mgr ProgressModule's ETA rate is NOT
+    ring-derived: it is a cumulative since-event-start average, the
+    smoother input its monotone clamp wants.
+
+    Values pushed are CUMULATIVE counters; ``rate()`` differences the
+    newest sample against the oldest sample inside the window, so a
+    lost intermediate sample costs resolution, never correctness.
+    One implementation so the mon digest, the progress ETAs, and the
+    bench telemetry aux derive rates identically."""
+
+    def __init__(self, capacity: int = 128) -> None:
+        from ceph_tpu.core.lockdep import make_lock
+
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = make_lock("perf.snapring")
+
+    def push(self, values: Dict[str, float],
+             stamp: Optional[float] = None) -> None:
+        if stamp is None:
+            stamp = time.monotonic()
+        with self._lock:
+            self._ring.append((stamp, dict(values)))
+
+    def latest(self, key: str, default: float = 0.0) -> float:
+        with self._lock:
+            if not self._ring:
+                return default
+            return float(self._ring[-1][1].get(key, default))
+
+    def _endpoints(self, window_s: float, now: Optional[float]):
+        """Window endpoints (t0, v0, t1, v1) shared by rate()/delta();
+        None when fewer than two samples span the window (no invented
+        numbers) or — with `now` supplied — when the NEWEST sample
+        already fell out of the window: a feed that stopped pushing
+        (every reporter died) must decay to zero, not serve its last
+        value forever."""
+        with self._lock:
+            samples = list(self._ring)
+        if len(samples) < 2:
+            return None
+        t1, v1 = samples[-1]
+        if now is None:
+            now = t1
+        if now - t1 > window_s:
+            return None
+        t0, v0 = samples[0]
+        for t, v in samples:
+            if now - t <= window_s:
+                t0, v0 = t, v
+                break
+        if t1 <= t0:
+            return None
+        return t0, v0, t1, v1
+
+    def rate(self, key: str, window_s: float = 10.0,
+             now: Optional[float] = None) -> float:
+        """(newest - oldest-in-window) / elapsed, per second."""
+        ep = self._endpoints(window_s, now)
+        if ep is None:
+            return 0.0
+        t0, v0, t1, v1 = ep
+        return (float(v1.get(key, 0.0)) - float(v0.get(key, 0.0))) \
+            / (t1 - t0)
+
+    def delta(self, key: str, window_s: float = 10.0,
+              now: Optional[float] = None) -> float:
+        """Windowed increase of a cumulative counter (identical sample
+        selection and decay semantics to rate(), minus the time
+        division)."""
+        ep = self._endpoints(window_s, now)
+        if ep is None:
+            return 0.0
+        _t0, v0, _t1, v1 = ep
+        return float(v1.get(key, 0.0)) - float(v0.get(key, 0.0))
 
 
 def hist_quantile(hist: Dict[str, object], q: float) -> float:

@@ -51,14 +51,58 @@ class PrometheusModule(MgrModule):
 
     name = "prometheus"
 
+    def _export_cluster(self, lines: List[str]) -> None:
+        """Cluster-level gauges (health, pg states, per-pool df, io
+        rates) when the mgr is wired to a mon's health/PGMap feeds —
+        the reference prometheus module's ceph_health_status /
+        ceph_pg_* / ceph_pool_* family."""
+        mgr = self.mgr
+        if mgr.health_fn is not None:
+            status, checks = mgr.health_fn()
+            rank = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
+            lines.append("# TYPE ceph_health_status gauge")
+            lines.append(f"ceph_health_status {rank.get(status, 2)}")
+            if checks:
+                lines.append("# TYPE ceph_health_check gauge")
+                for name, c in sorted(checks.items()):
+                    lines.append(
+                        f'ceph_health_check{{check="{name}",'
+                        f'severity="{c.get("severity", "")}"}} 1')
+        if mgr.pgmap_digest_fn is None:
+            return
+        digest = mgr.pgmap_digest_fn()
+        lines.append("# TYPE ceph_pg_state gauge")
+        for state, n in sorted(digest["pg_states"].items()):
+            lines.append(f'ceph_pg_state{{state="{state}"}} {n}')
+        lines.append(f'ceph_pg_state{{state="total"}} '
+                     f'{digest["num_pgs"]}')
+        for key in ("degraded_objects", "misplaced_objects",
+                    "unfound_objects", "used_bytes", "total_bytes"):
+            metric = f"ceph_cluster_{key}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {digest[key]}")
+        lines.append("# TYPE ceph_cluster_io_rate gauge")
+        for key, v in sorted(digest["io"].items()):
+            lines.append(f'ceph_cluster_io_rate{{kind="{key}"}} {v}')
+        for metric, field in (("ceph_pool_objects", "objects"),
+                              ("ceph_pool_stored_bytes", "bytes"),
+                              ("ceph_pool_degraded_objects", "degraded")):
+            lines.append(f"# TYPE {metric} gauge")
+            for pool, row in sorted(digest["pools"].items()):
+                lines.append(f'{metric}{{pool="{pool}"}} {row[field]}')
+
     def export(self) -> str:
         metrics = self.mgr.collect()
         lines: List[str] = []
+        self._export_cluster(lines)
         seen_help = set()
         for daemon, subsystems in sorted(metrics.items()):
             for subsys, counters in sorted(subsystems.items()):
                 for cname, val in sorted(counters.items()):
-                    metric = f"ceph_{subsys}_{cname}".replace("-", "_")
+                    # exposition metric names admit [a-zA-Z0-9_:] only:
+                    # subsystem dots (osd.0.op) flatten to underscores
+                    metric = f"ceph_{subsys}_{cname}".replace(
+                        "-", "_").replace(".", "_")
                     label = f'{{daemon="{daemon}"}}'
                     if isinstance(val, dict):
                         if "avgcount" in val:
@@ -72,12 +116,23 @@ class PrometheusModule(MgrModule):
                             if metric not in seen_help:
                                 lines.append(f"# TYPE {metric} histogram")
                                 seen_help.add(metric)
+                            # perf histograms are log2-bucketed in
+                            # MICROSECONDS for the lat_* families:
+                            # bucket i holds values < 2^i us, so its
+                            # cumulative upper bound le IS 2^i (us)
                             acc = 0
                             for i, b in enumerate(val["buckets"]):
                                 acc += b
                                 lines.append(
                                     f'{metric}_bucket{{daemon="{daemon}",'
                                     f'le="{1 << i}"}} {acc}')
+                            # the exposition format REQUIRES a
+                            # terminal le="+Inf" bucket equal to
+                            # _count; scrapers reject a histogram
+                            # that stops at the last finite bucket
+                            lines.append(
+                                f'{metric}_bucket{{daemon="{daemon}",'
+                                f'le="+Inf"}} {val["count"]}')
                             lines.append(
                                 f"{metric}_count{label} {val['count']}")
                             lines.append(f"{metric}_sum{label} {val['sum']}")
@@ -200,6 +255,88 @@ class TelemetryModule(MgrModule):
         return 0, self.report()
 
 
+class ProgressModule(MgrModule):
+    """Per-PG recovery/backfill progress events with rate-derived ETAs
+    (the reference mgr progress module role, src/pybind/mgr/progress).
+
+    An event opens when a primary-reported PG shows degraded object
+    copies, tracks the recovered count against the event's high-water
+    baseline, and derives its ETA from the CUMULATIVE recovery rate
+    since the event started (remaining / rate).  The published ETA is
+    clamped monotonically non-increasing — a convergence-from-above
+    estimator: early samples over a small recovered count undershoot
+    the rate (overshoot the ETA), and as recovery proceeds the
+    estimate tightens toward the true completion time, so the dashboard
+    never promises a finish and then pushes it later.  Completed
+    events keep their measured duration (the bench aux's ETA-error
+    ground truth)."""
+
+    name = "progress"
+    KEEP_COMPLETED = 32
+
+    def __init__(self, mgr: "MgrDaemon") -> None:
+        super().__init__(mgr)
+        from ceph_tpu.core.lockdep import make_lock
+
+        self._lock = make_lock("mgr.progress")
+        self.events: Dict[str, dict] = {}
+        self.completed: List[dict] = []
+        self._now = time.monotonic  # injectable clock (deterministic tests)
+
+    def refresh(self) -> None:
+        """Fold the current PGMap rows into the event set; called on
+        every `progress` command (polling cadence = refresh cadence)
+        and by whoever drives the mgr's poll loop."""
+        rows_fn = self.mgr.pg_rows_fn
+        if rows_fn is None:
+            return
+        now = self._now()
+        degraded_now: Dict[str, int] = {}
+        for row in rows_fn():
+            if row["primary"] and row["degraded"] > 0:
+                degraded_now[row["pgid"]] = row["degraded"]
+        with self._lock:
+            for pgid, cur in sorted(degraded_now.items()):
+                ev_id = f"recovery-{pgid}"
+                ev = self.events.get(ev_id)
+                if ev is None:
+                    ev = self.events[ev_id] = {
+                        "id": ev_id, "pgid": pgid,
+                        "message": f"Recovering pg {pgid}",
+                        "started": now, "baseline": cur,
+                        "progress": 0.0, "eta_s": None,
+                    }
+                ev["baseline"] = max(ev["baseline"], cur)
+                recovered = ev["baseline"] - cur
+                ev["progress"] = round(recovered / ev["baseline"], 4)
+                elapsed = now - ev["started"]
+                if recovered > 0 and elapsed > 0:
+                    rate = recovered / elapsed
+                    eta = cur / rate
+                    prev = ev["eta_s"]
+                    ev["eta_s"] = round(
+                        eta if prev is None else min(prev, eta), 2)
+            for ev_id in [e for e in self.events
+                          if self.events[e]["pgid"] not in degraded_now]:
+                ev = self.events.pop(ev_id)
+                ev["progress"] = 1.0
+                ev["duration_s"] = round(now - ev["started"], 2)
+                ev["eta_s"] = 0.0
+                self.completed.append(ev)
+                del self.completed[:-self.KEEP_COMPLETED]
+
+    def handle_command(self, cmd):
+        if cmd.get("prefix") != "progress":
+            return None
+        self.refresh()
+        with self._lock:
+            return 0, {
+                "events": [dict(e) for _, e in sorted(
+                    self.events.items())],
+                "completed": [dict(e) for e in self.completed],
+            }
+
+
 class OpsModule(MgrModule):
     """Cluster-wide op observability (PR 8): merges every registered
     daemon's slow-op/in-flight rings and per-stage latency histograms
@@ -268,6 +405,14 @@ class MgrDaemon:
         self.services: Dict[str, object] = {}
         self.modules: Dict[str, MgrModule] = {}
         self.osdmap = None  # fed by whoever owns the map (mon/tests)
+        # mon telemetry feeds (wired by vstart/tests to the live
+        # leader): health_fn() -> (status, checks);
+        # pgmap_digest_fn() -> the PGMap digest; pg_rows_fn() -> rich
+        # per-PG rows.  The MgrStatMonitor inversion: instead of the
+        # mon pushing stats to the mgr, the in-process mgr pulls them.
+        self.health_fn: Optional[Callable] = None
+        self.pgmap_digest_fn: Optional[Callable] = None
+        self.pg_rows_fn: Optional[Callable] = None
         self.last_collect = 0.0
         self._lock = threading.Lock()
         from ceph_tpu.mgr.dashboard import DashboardModule
@@ -275,7 +420,7 @@ class MgrDaemon:
         for m in (StatusModule(self), PrometheusModule(self),
                   CrashModule(self), BalancerModule(self),
                   DashboardModule(self), TelemetryModule(self),
-                  OpsModule(self)):
+                  OpsModule(self), ProgressModule(self)):
             self.modules[m.name] = m
 
     def register_daemon(self, name: str, ctx, service=None) -> None:

@@ -200,9 +200,20 @@ class MonClient(Dispatcher):
                                    self.monmap.addrs[rank])
 
     def send_pg_stats(self, osd_id: int, epoch: int, pgs: list,
-                      used_bytes: int = 0, total_bytes: int = 0) -> None:
-        """MPGStats feed (every mon keeps a transient mgr-style copy)."""
+                      used_bytes: int = 0, total_bytes: int = 0,
+                      slow_ops: int = 0,
+                      heartbeat_misses: int = 0) -> None:
+        """MPGStats feed (every mon keeps a transient mgr-style copy).
+
+        ``pgs`` may be rich PGStat rows (osd/types.py) or legacy
+        7-tuples; rich rows also populate the legacy field so old
+        consumers keep reading the thin shape."""
+        stats = [p for p in pgs if hasattr(p, "as_legacy")]
+        legacy = [p.as_legacy() if hasattr(p, "as_legacy") else p
+                  for p in pgs]
         for rank in self.monmap.live_ranks():
             self.msgr.send_message(
-                mm.MPGStats(osd_id, epoch, pgs, used_bytes, total_bytes),
+                mm.MPGStats(osd_id, epoch, legacy, used_bytes,
+                            total_bytes, stats=stats, slow_ops=slow_ops,
+                            heartbeat_misses=heartbeat_misses),
                 self.monmap.addrs[rank])

@@ -276,16 +276,24 @@ class MPGStats(Message):
 
     def __init__(self, osd: int = -1, epoch: int = 0,
                  pgs: Optional[list] = None, used_bytes: int = 0,
-                 total_bytes: int = 0) -> None:
+                 total_bytes: int = 0, stats: Optional[list] = None,
+                 slow_ops: int = 0, heartbeat_misses: int = 0) -> None:
         super().__init__()
         self.osd = osd
         self.epoch = epoch
         # [(pool, ps, state, num_objects, last_update_epoch,
-        #   last_update_version, is_primary)]
+        #   last_update_version, is_primary)] — the legacy thin rows,
+        # still carried so pre-PGStat consumers keep working
         self.pgs = pgs or []
         # store fullness (ObjectStore::statfs — the nearfull/full feed)
         self.used_bytes = used_bytes
         self.total_bytes = total_bytes
+        # v2 tail: rich PGStat rows (osd/types.py) + daemon health
+        # signals — slow-ring depth (SLOW_OPS) and the cumulative
+        # heartbeat-miss counter (OSD_SLOW_HEARTBEAT)
+        self.stats = stats or []
+        self.slow_ops = slow_ops
+        self.heartbeat_misses = heartbeat_misses
 
     def encode_payload(self, e: Encoder) -> None:
         e.s32(self.osd).u32(self.epoch)
@@ -293,8 +301,12 @@ class MPGStats(Message):
             en.s64(p[0]), en.u32(p[1]), en.string(p[2]), en.u64(p[3]),
             en.u32(p[4]), en.u64(p[5]), en.u8(1 if p[6] else 0)))
         e.u64(self.used_bytes).u64(self.total_bytes)
+        e.seq(self.stats, lambda en, s: s.encode(en))
+        e.u32(self.slow_ops).u64(self.heartbeat_misses)
 
     def decode_payload(self, d: Decoder) -> None:
+        from ceph_tpu.osd.types import PGStat
+
         self.osd = d.s32()
         self.epoch = d.u32()
         self.pgs = d.seq(lambda dd: (
@@ -302,6 +314,11 @@ class MPGStats(Message):
             dd.u64(), bool(dd.u8())))
         self.used_bytes = d.u64()
         self.total_bytes = d.u64()
+        # v2 tail (absent in pre-telemetry blobs)
+        if d.remaining_in_frame():
+            self.stats = d.seq(lambda dd: PGStat.decode(dd))
+            self.slow_ops = d.u32()
+            self.heartbeat_misses = d.u64()
 
 
 @register

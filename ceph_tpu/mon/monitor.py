@@ -139,6 +139,24 @@ class Monitor(Dispatcher):
         # reference: the MPGStats feed behind `ceph pg dump`)
         self.pg_stats: Dict[int, Tuple[float, list]] = {}
         self.osd_fullness: Dict[int, Tuple[int, int]] = {}
+        # the PGMap digest (reference PGMap/MgrStatMonitor role):
+        # aggregates the rich PGStat rows into per-pool df, pg-state
+        # counts, degraded totals, and rate-derived io numbers —
+        # transient like pg_stats, re-learned from the next reports
+        from ceph_tpu.mon.pgmap import PGMapService
+
+        def _pool_size(pid: int) -> Optional[int]:
+            m = self.osdmap
+            p = m.pools.get(pid) if m is not None else None
+            return p.size if p is not None else None
+
+        def _osd_up(osd: int) -> bool:
+            m = self.osdmap
+            return bool(m is not None and 0 <= osd < m.max_osd
+                        and m.is_up(osd))
+
+        self.pgmap = PGMapService(ctx.conf, pool_size_fn=_pool_size,
+                                  osd_up_fn=_osd_up)
         self.failure_reports: Dict[int, Dict[int, float]] = {}
         self.down_stamp: Dict[int, float] = {}
         self.subscribers: Dict[Addr, int] = {}  # addr -> last epoch sent
@@ -171,7 +189,21 @@ class Monitor(Dispatcher):
         self._tick_thread = threading.Thread(
             target=self._tick_loop, daemon=True, name=f"mon{self.rank}-tick")
         self._tick_thread.start()
+        if self.ctx.admin is not None:
+            # cluster pane for tools/cephtop.py --cluster: the `ceph
+            # -s` digest + health over the admin socket, per-rank
+            # prefixed like the per-daemon osd.N commands
+            self.ctx.admin.register(
+                f"mon.{self.rank} status", self._admin_status,
+                "health + PGMap digest (the `ceph -s` payload)")
         self.start_election()
+
+    def _admin_status(self, cmd: dict) -> dict:
+        status, checks = self.services["health"].gather()
+        return {"health": status,
+                "checks": {k: v.get("summary", "") for k, v in
+                           sorted(checks.items())},
+                "digest": self.pgmap.digest()}
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -790,6 +822,12 @@ class Monitor(Dispatcher):
                 for r in self._peers():
                     self._send_mon(r, msg)
                 self._osd_tick()
+                try:
+                    # health transition edges -> cluster log (leader
+                    # only: peons would double-log through paxos)
+                    self.services["health"].tick()
+                except Exception as e:
+                    self._log(1, f"health tick failed: {e!r}")
             elif state == STATE_PEON:
                 if time.monotonic() - self._last_lease > 2 * lease:
                     self._log(1, f"mon.{self.rank}: leader lease expired")
@@ -956,21 +994,27 @@ class Monitor(Dispatcher):
     def _do_command(self, cmd: dict) -> Tuple[int, dict]:
         prefix = cmd.get("prefix", "")
         if prefix == "status":
+            # `ceph -s`: map summary + health + the PGMap digest
+            # (pg states, degraded totals, client/recovery io rates)
+            digest = self.pgmap.digest()
+            status, _checks = self.services["health"].gather()
             with self.lock:
                 m = self.osdmap
                 n_up = int(m.osd_state_up.sum()) if m is not None else 0
-                pg_states: Dict[str, int] = {}
-                for _osd, (_stamp, pgs) in self.pg_stats.items():
-                    for (_pool, _ps, state, _n, _e, _v, prim) in pgs:
-                        if prim:
-                            pg_states[state] = pg_states.get(state, 0) + 1
                 return 0, {
+                    "health": status,
                     "quorum_leader": self.leader,
                     "election_epoch": self.election_epoch,
                     "osdmap_epoch": m.epoch if m else 0,
                     "num_osds": m.max_osd if m else 0,
                     "num_up_osds": n_up,
-                    "pg_states": pg_states,
+                    "pg_states": digest["pg_states"],
+                    "num_pgs": digest["num_pgs"],
+                    "degraded_objects": digest["degraded_objects"],
+                    "degraded_ratio": digest["degraded_ratio"],
+                    "misplaced_objects": digest["misplaced_objects"],
+                    "unfound_objects": digest["unfound_objects"],
+                    "io": digest["io"],
                     "pools": {p.name or str(pid): pid
                               for pid, p in (m.pools if m else {}).items()},
                 }
@@ -1042,21 +1086,26 @@ class Monitor(Dispatcher):
                         if total else 0.0})
                 return 0, {"nodes": rows}
         if prefix == "df":
-            # cluster + per-pool usage (the `ceph df` surface,
-            # reference OSDMonitor 'df' via the pg stats feed)
+            # cluster + per-pool usage (the `ceph df` surface) from
+            # the PGMap digest: objects AND stored bytes per pool,
+            # degraded/unfound carried so `df` shows damage too
+            digest = self.pgmap.digest()
             with self.lock:
                 used = sum(u for u, _ in self.osd_fullness.values())
                 total = sum(t for _, t in self.osd_fullness.values())
-                per_pool: Dict[int, int] = {}
-                for osd, (stamp, pgs) in self.pg_stats.items():
-                    for (pool, ps, state, n, lu_e, lu_v, prim) in pgs:
-                        if prim:
-                            per_pool[pool] = per_pool.get(pool, 0) + n
                 pools = []
                 if self.osdmap is not None:
                     for pid, p in sorted(self.osdmap.pools.items()):
+                        row = digest["pools"].get(
+                            pid, {"objects": 0, "bytes": 0,
+                                  "degraded": 0, "misplaced": 0,
+                                  "unfound": 0, "pgs": 0})
                         pools.append({"name": p.name, "id": pid,
-                                      "objects": per_pool.get(pid, 0)})
+                                      "objects": row["objects"],
+                                      "stored_bytes": row["bytes"],
+                                      "degraded": row["degraded"],
+                                      "unfound": row["unfound"],
+                                      "pgs": row["pgs"]})
                 return 0, {"total_bytes": total, "used_bytes": used,
                            "avail_bytes": max(0, total - used),
                            "pools": pools}
@@ -1082,20 +1131,10 @@ class Monitor(Dispatcher):
                 om.MPGCommand((pool_id, ps), 0, action), tuple(addr))
             return 0, {"instructed": f"osd.{primary}", "action": action}
         if prefix == "pg dump":
-            with self.lock:
-                # primary-reported rows win; replicas fill gaps
-                rows: Dict[Tuple[int, int], dict] = {}
-                for osd, (stamp, pgs) in self.pg_stats.items():
-                    for (pool, ps, state, n, lu_e, lu_v, prim) in pgs:
-                        key = (pool, ps)
-                        if prim or key not in rows:
-                            rows[key] = {
-                                "pgid": f"{pool}.{ps}", "state": state,
-                                "num_objects": n,
-                                "last_update": [lu_e, lu_v],
-                                "reported_by": osd, "primary": prim}
-                return 0, {"num_pg_stats": len(rows),
-                           "pg_stats": [rows[k] for k in sorted(rows)]}
+            # rich rows straight off the PGMap (primary-reported rows
+            # win; replicas fill gaps — the ingest rule)
+            rows = self.pgmap.pg_rows()
+            return 0, {"num_pg_stats": len(rows), "pg_stats": rows}
         if prefix == "osd pool set":
             var, val = cmd["var"], int(cmd["val"])
             if var not in ("pg_num", "pgp_num", "size", "min_size"):
@@ -1222,6 +1261,21 @@ class Monitor(Dispatcher):
                 self.pg_stats[msg.osd] = (time.time(), msg.pgs)
                 self.osd_fullness[msg.osd] = (msg.used_bytes,
                                               msg.total_bytes)
+            stats = msg.stats
+            if not stats and msg.pgs:
+                # legacy thin report (a pre-telemetry daemon): rows
+                # synthesize with zeroed io/degraded fields so the
+                # digest still counts its pg states
+                from ceph_tpu.osd.types import EVersion, PGStat
+
+                stats = [PGStat(pgid=(p[0], p[1]), state=p[2],
+                                primary=p[6], num_objects=p[3],
+                                last_update=EVersion(p[4], p[5]))
+                         for p in msg.pgs]
+            self.pgmap.ingest(msg.osd, msg.epoch, stats,
+                              msg.used_bytes, msg.total_bytes,
+                              slow_ops=msg.slow_ops,
+                              heartbeat_misses=msg.heartbeat_misses)
             return True
         if isinstance(msg, mm.MOSDFailure):
             self._handle_failure(msg)

@@ -204,6 +204,11 @@ class HealthMonitor(PaxosService):
     def __init__(self, mon) -> None:
         super().__init__(mon)
         self.muted: Dict[str, bool] = {}
+        # transition tracking (tick(), leader-side): previous overall
+        # status + live check set, so HEALTH_OK <-> WARN <-> ERR edges
+        # and check appear/clear events land in the cluster log
+        self._last_status = "HEALTH_OK"
+        self._last_checks: set = set()
 
     def load(self) -> None:
         raw = self.kv.get("svc_health", "muted")
@@ -245,33 +250,97 @@ class HealthMonitor(PaxosService):
                     "summary": f"{len(out)} osds out",
                     "detail": [f"osd.{i} is out" for i in out],
                 }
-        # PG states from the transient MPGStats feed (primary-reported)
-        import time as _time
-
+        # PG states from the PGMap digest (primary-reported rows;
+        # stale reports — conf mon_pg_stats_stale_s, not a hardcoded
+        # cutoff — are EXCLUDED here and surfaced as their own check
+        # below instead of silently vanishing)
+        pgmap = self.mon.pgmap
+        digest = pgmap.digest()
         degraded, peering = [], []
-        now = _time.time()
-        for osd, (stamp, pgs) in self.mon.pg_stats.items():
-            if now - stamp > 30.0:
-                continue  # stale report
-            for (pool, ps, state, _n, _e, _v, prim) in pgs:
-                if not prim:
-                    continue
-                if "degraded" in state:
-                    degraded.append(f"{pool}.{ps}")
-                elif state == "peering":
-                    peering.append(f"{pool}.{ps}")
-        if degraded:
+        # fresh_only: the detail must name the same staleness-filtered
+        # PG set the digest summaries count — a dead reporter's stale
+        # rows belong to MON_STALE_PG_REPORTS, not these lists
+        for row in pgmap.pg_rows(fresh_only=True):
+            if not row["primary"]:
+                continue
+            if "degraded" in row["state"]:
+                degraded.append(f"{row['pgid']} ({row['degraded']} "
+                                f"objects degraded)")
+            elif row["state"] == "peering":
+                peering.append(row["pgid"])
+        n_deg_pgs = sum(n for s, n in digest["pg_states"].items()
+                        if "degraded" in s)
+        if n_deg_pgs:
             checks["PG_DEGRADED"] = {
                 "severity": "HEALTH_WARN",
-                "summary": f"{len(degraded)} pgs degraded",
+                "summary": f"{n_deg_pgs} pgs degraded",
                 "detail": sorted(degraded)[:10],
             }
-        if peering:
+        if digest["pg_states"].get("peering"):
             checks["PG_PEERING"] = {
                 "severity": "HEALTH_WARN",
-                "summary": f"{len(peering)} pgs peering",
+                "summary": f"{digest['pg_states']['peering']} pgs peering",
                 "detail": sorted(peering)[:10],
             }
+        if digest["degraded_objects"]:
+            pct = digest["degraded_ratio"] * 100.0
+            checks["OBJECT_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{digest['degraded_objects']}/"
+                           f"{digest['total_copies']} object copies "
+                           f"degraded ({pct:.1f}%)",
+                "detail": [f"recovery rate "
+                           f"{digest['io']['recovery_objects_per_s']} "
+                           f"objects/s"],
+            }
+        if digest["unfound_objects"]:
+            checks["OBJECT_UNFOUND"] = {
+                "severity": "HEALTH_ERR",
+                "summary": f"{digest['unfound_objects']} objects "
+                           f"unfound (no live source)",
+                "detail": [],
+            }
+        stuck = pgmap.stuck_pgs()
+        if stuck:
+            checks["PG_STUCK"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(stuck)} pgs stuck in non-active "
+                           f"states",
+                "detail": [f"pg {r['pgid']} stuck {r['state']} for "
+                           f"{r['stuck_for_s']}s" for r in stuck[:10]],
+            }
+        if digest["slow_ops"]:
+            n_slow = sum(digest["slow_ops"].values())
+            checks["SLOW_OPS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{n_slow} slow ops on "
+                           f"{len(digest['slow_ops'])} daemons",
+                "detail": [f"osd.{osd}: {n} slow ops"
+                           for osd, n in sorted(
+                               digest["slow_ops"].items())],
+            }
+        slow_hb = pgmap.slow_heartbeat_osds()
+        if slow_hb:
+            checks["OSD_SLOW_HEARTBEAT"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(slow_hb)} osds observing heartbeat "
+                           f"grace overruns",
+                "detail": [f"osd.{o} reported fresh heartbeat misses"
+                           for o in slow_hb],
+            }
+        if m is not None:
+            live = [i for i in range(m.max_osd)
+                    if bool(m.osd_state_up[i])]
+            stale_reps = pgmap.stale_osds(live)
+            if stale_reps:
+                checks["MON_STALE_PG_REPORTS"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": f"{len(stale_reps)} up osds have stale "
+                               f"pg stats (degraded pgs may be "
+                               f"invisible)",
+                    "detail": [f"osd.{o}: last report {age}s ago"
+                               for o, age in stale_reps],
+                }
         # store fullness (reference OSDMap full/nearfull flags)
         nearfull, full = [], []
         for osd, (used, total) in self.mon.osd_fullness.items():
@@ -305,11 +374,53 @@ class HealthMonitor(PaxosService):
                 status = c["severity"]
         return status, checks
 
+    def tick(self) -> None:
+        """Leader-side transition detector (called from the mon tick):
+        HEALTH_OK <-> WARN <-> ERR edges and individual check
+        appear/clear events land in the LogMonitor cluster log, so
+        `log last` reconstructs the health history of an incident —
+        muted checks don't log (that is what mute is for)."""
+        status, checks = self.gather()
+        live = {k for k in checks if k not in self.muted}
+        logm = self.mon.services.get("logm")
+        if logm is None:
+            return
+        if status != self._last_status:
+            changed = sorted((live ^ self._last_checks) & live)
+            why = ""
+            if changed:
+                why = " (" + "; ".join(
+                    f"{k}: {checks[k]['summary']}" for k in changed) + ")"
+            logm.log(f"mon.{self.mon.rank}",
+                     f"cluster health {self._last_status} -> "
+                     f"{status}{why}",
+                     level="warn" if status != "HEALTH_OK" else "info")
+        for k in sorted(live - self._last_checks):
+            logm.log(f"mon.{self.mon.rank}",
+                     f"health check {k} raised: "
+                     f"{checks[k]['summary']}", level="warn")
+        for k in sorted(self._last_checks - live):
+            logm.log(f"mon.{self.mon.rank}",
+                     f"health check {k} cleared", level="info")
+        self._last_status = status
+        self._last_checks = live
+
     def command(self, cmd: dict) -> Optional[Tuple[int, dict]]:
         prefix = cmd.get("prefix", "")
         if prefix == "health":
             status, checks = self.gather()
             return 0, {"status": status, "checks": checks,
+                       "muted": sorted(self.muted)}
+        if prefix == "health detail":
+            # every check with full detail; muted checks stay LISTED
+            # (flagged) but never count toward the overall status
+            status, checks = self.gather()
+            out = {}
+            for k, v in sorted(checks.items()):
+                row = dict(v)
+                row["muted"] = k in self.muted
+                out[k] = row
+            return 0, {"status": status, "checks": out,
                        "muted": sorted(self.muted)}
         if prefix == "health mute":
             self.propose({"op": "mute", "check": cmd["check"]})

@@ -80,6 +80,13 @@ class OSDService(Dispatcher):
         self.pgs: Dict[PGId, PG] = {}
         # pool_id -> epoch of its most recent pg_num split (stale-op gate)
         self._pool_split_epoch: Dict[int, int] = {}
+        # previous cumulative per-PG io counters: pg_stats() reports
+        # windowed deltas (PGStat cl_*/rec_*) against these
+        self._pg_io_prev: Dict[PGId, Dict[str, int]] = {}
+        # pg_stats() object/byte scan cache keyed on (last_update,
+        # len(missing)): the per-object store.stat walk only re-runs
+        # for PGs whose contents actually moved since the last tick
+        self._pg_stat_cache: Dict[PGId, tuple] = {}
         self.msgr = Messenger(ctx, EntityName("osd", whoami))
         self.msgr.add_dispatcher(self)
         # dedicated heartbeat endpoint (reference hb_front/back
@@ -207,6 +214,7 @@ class OSDService(Dispatcher):
         from ceph_tpu.tpu.queue import default_queue
 
         _dq = default_queue()
+        self._dq = _dq
         ctx.perf.register(
             f"osd.{whoami}.tpu",
             _dq.stats.perf_view(f"osd.{whoami}.tpu"))
@@ -413,9 +421,18 @@ class OSDService(Dispatcher):
                             used, total = self.store.statfs()
                         except Exception:
                             used, total = 0, 0
+                        # refresh the device-visibility gauges on the
+                        # same cadence the mon sees (queue depth,
+                        # busy fraction, staging occupancy)
+                        self._dq.sample()
                         self.monc.send_pg_stats(
                             self.whoami, self.epoch(), self.pg_stats(),
-                            used, total)
+                            used, total,
+                            slow_ops=self.op_tracker.slow_depth(
+                                self.ctx.conf.get(
+                                    "osd_slow_op_report_window")),
+                            heartbeat_misses=self.perf.value(
+                                "heartbeat_misses"))
                     except Exception as e:
                         # mon unreachable mid-election: next tick
                         # retries; losing one stats beat is harmless
@@ -723,16 +740,81 @@ class OSDService(Dispatcher):
                 pg._obc_invalidate()
 
     def pg_stats(self) -> list:
-        """This osd's per-PG stat rows (the MPGStats payload)."""
+        """This osd's per-PG PGStat rows (the MPGStats payload): the
+        PGMap digest's raw material.  Degraded/misplaced/unfound are
+        derived from pg.missing + acting-set holes against the current
+        map; the cl_*/rec_* fields are windowed deltas of the per-PG
+        cumulative io counters since this daemon's previous report."""
+        from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
+
         out = []
+        omap = self.osdmap
         for pgid, pg in list(self.pgs.items()):
-            try:
-                n = len(pg.backend.object_names())
-            except Exception:
-                n = 0
-            lu = pg.info.last_update
-            out.append((pgid[0], pgid[1], pg.state, n,
-                        lu.epoch, lu.version, pg.is_primary()))
+            # the O(objects) store walk is version-gated: last_update
+            # moves on every client write and len(missing) on every
+            # recovered object, so an unchanged key means unchanged
+            # contents (a replica's push-landed bytes lag one report at
+            # worst) and the boot-loop thread pays nothing per tick on
+            # a populated-but-idle store
+            scan_key = (pg.info.last_update.epoch,
+                        pg.info.last_update.version, len(pg.missing))
+            cached = self._pg_stat_cache.get(pgid)
+            if cached is not None and cached[0] == scan_key:
+                _key, n, nbytes = cached
+            else:
+                try:
+                    n = len(pg.backend.object_names())
+                except Exception:
+                    n = 0
+                nbytes = 0
+                try:
+                    for g in self.store.collection_list(pg.coll):
+                        if g.name != "_pgmeta_":
+                            nbytes += self.store.stat(pg.coll, g)
+                except Exception:
+                    nbytes = 0
+                self._pg_stat_cache[pgid] = (scan_key, n, nbytes)
+            want = getattr(pg.pool, "size", len(pg.acting)) or 0
+            live, up_set = [], set()
+            if omap is not None:
+                live = [o for o in pg.acting
+                        if o != CRUSH_ITEM_NONE and 0 <= o < omap.max_osd
+                        and omap.is_up(o)]
+                try:
+                    up, _up_p, _a, _ap = omap.pg_to_up_acting(pgid)
+                    up_set = {o for o in up if o != CRUSH_ITEM_NONE}
+                except Exception:
+                    up_set = set()
+            holes = max(0, want - len(live))
+            # degraded counts missing COPIES, and the rows are kept
+            # DISJOINT so the mon can sum them across every reporter:
+            # only the primary counts acting-set holes (one copy of
+            # every object per dead member), while every row counts its
+            # OWN not-yet-recovered objects — after a revive the debt
+            # lives in the recovering replica's pg.missing, where the
+            # primary's row reads holes=0 and would go blind
+            degraded = len(pg.missing)
+            if pg.is_primary():
+                degraded += n * holes
+            misplaced = n * len([o for o in live
+                                 if up_set and o not in up_set])
+            io = pg.iostat_snapshot()
+            prev = self._pg_io_prev.get(pgid, {})
+            delta = {k: io[k] - prev.get(k, 0) for k in io}
+            self._pg_io_prev[pgid] = io
+            out.append(t_.PGStat(
+                pgid=pgid, state=pg.state, primary=pg.is_primary(),
+                num_objects=n, num_bytes=nbytes,
+                log_size=len(pg.log.entries),
+                degraded=degraded, misplaced=misplaced,
+                unfound=len(pg.unfound),
+                last_update=pg.info.last_update,
+                cl_wr_ops=delta["cl_wr_ops"],
+                cl_wr_bytes=delta["cl_wr_bytes"],
+                cl_rd_ops=delta["cl_rd_ops"],
+                cl_rd_bytes=delta["cl_rd_bytes"],
+                rec_ops=delta["rec_ops"],
+                rec_bytes=delta["rec_bytes"]))
         return out
 
     def activate_pgs(self, wait_s: float = 0.0) -> None:
@@ -1044,6 +1126,16 @@ class OSDService(Dispatcher):
                                        time.perf_counter() - t0)
                     else:
                         self.perf.inc("op_r")
+                    if rep.result == 0:
+                        # per-PG io accounting (the PGStat feed):
+                        # len() on a DeviceBuf/frame-view payload is
+                        # metadata, not a host materialization
+                        if is_w:
+                            nb = sum(len(o.data) or o.length
+                                     for o in msg.ops if o.is_write())
+                        else:
+                            nb = sum(len(o.out_data) for o in rep.ops)
+                        pg.note_client_io(is_w, nb)
 
                 try:
                     pg.do_op(msg, reply, conn=conn)

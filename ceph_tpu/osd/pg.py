@@ -280,6 +280,20 @@ class PG:
         # windowed EC recovery engine (osd/recovery.py), created lazily
         # on the first pull/parked read
         self._recovery: Optional[ECRecoveryEngine] = None
+        # per-PG cumulative io accounting (the PGStat telemetry feed):
+        # client read/write ops+bytes from the reply path, recovered
+        # objects+bytes from the recovery engine / push handler.  A
+        # leaf lock of its own — reply closures and recovery commit
+        # threads race it and must never wait behind the pg lock.
+        self._iostat_lock = make_lock("pg.iostat")
+        self._iostat = {"cl_wr_ops": 0, "cl_wr_bytes": 0,
+                        "cl_rd_ops": 0, "cl_rd_bytes": 0,
+                        "rec_ops": 0, "rec_bytes": 0}
+        # objects recovery proved sourceless (every reachable holder
+        # answered "no chunk" and no holder is unaccounted-for): the
+        # PGStat unfound count.  Entries clear when a later round
+        # recovers the object or a delete supersedes it.
+        self.unfound: set = set()
 
     # -- identity ---------------------------------------------------------
     def is_primary(self) -> bool:
@@ -287,6 +301,29 @@ class PG:
 
     def is_ec(self) -> bool:
         return isinstance(self.backend, ECBackend)
+
+    # -- telemetry accounting ---------------------------------------------
+    def note_client_io(self, is_write: bool, nbytes: int) -> None:
+        """Reply-path hook: one completed client op's size lands in
+        the cumulative per-PG counters the PGStat report differences."""
+        with self._iostat_lock:
+            if is_write:
+                self._iostat["cl_wr_ops"] += 1
+                self._iostat["cl_wr_bytes"] += nbytes
+            else:
+                self._iostat["cl_rd_ops"] += 1
+                self._iostat["cl_rd_bytes"] += nbytes
+
+    def note_recovery_io(self, objects: int, nbytes: int) -> None:
+        """Recovery landing hook (windowed engine commits, incoming
+        pushes): feeds the digest's recovery objects/s and B/s."""
+        with self._iostat_lock:
+            self._iostat["rec_ops"] += objects
+            self._iostat["rec_bytes"] += nbytes
+
+    def iostat_snapshot(self) -> Dict[str, int]:
+        with self._iostat_lock:
+            return dict(self._iostat)
 
     # -- lifecycle --------------------------------------------------------
     def create_onstore(self) -> None:
@@ -1508,8 +1545,12 @@ class PG:
                 # the full rewrite just queued supersedes the
                 # unrecovered generation — the missing marker (if any)
                 # refers to history this write replaced, and leaving it
-                # would EAGAIN every read of the now-current object
+                # would EAGAIN every read of the now-current object;
+                # the unfound verdict dies with it (every clear path
+                # checks missing first, so a stale entry would report
+                # OBJECT_UNFOUND HEALTH_ERR forever)
                 self.missing.pop(msg.oid, None)
+                self.unfound.discard(msg.oid)
         # no commit wait: the commit callback replies; the watchdog
         # sweep answers retryably if no shard ack ever resolves it
         # (the reference requeues; the client's resend retries EAGAIN)
@@ -2949,7 +2990,11 @@ class PG:
                     self.info.last_update = msg.version
                     self.info.last_complete = msg.version
                 self.missing.pop(msg.oid, None)
+                self.unfound.discard(msg.oid)
                 self._persist_meta()
+            if not msg.deleted:
+                self.note_recovery_io(0 if msg.more else 1,
+                                      len(msg.data))
         rep = m.MPGPushReply(self.pgid, self.osd.epoch(), msg.oid, 0)
         rep.tid = msg.tid
         conn.send(rep)

@@ -617,7 +617,12 @@ class ECRecoveryEngine:
         if not avail:
             # nothing anywhere and no holder unaccounted-for: there is
             # no data to rebuild — leave the missing marker for the
-            # log's word (a delete adopted later clears it)
+            # log's word (a delete adopted later clears it), and count
+            # the object UNFOUND for the PGStat feed until a source
+            # returns or the delete lands
+            with self.pg.lock:
+                if oid in self.pg.missing:
+                    self.pg.unfound.add(oid)
             self._oid_resolved(rnd, oid, ok=False)
             return
         self.pg.backend.reconstruct_async(
@@ -701,7 +706,9 @@ class ECRecoveryEngine:
                 return
             self.osd.store.queue_transaction(t)
             pg.missing.pop(oid, None)
+            pg.unfound.discard(oid)
         self.osd.perf.inc("recovery_pushes")
+        pg.note_recovery_io(1, len(state.data))
 
     def _apply_delete(self, oid: str) -> None:
         from ceph_tpu.osd.backend import ECBackend
@@ -716,6 +723,7 @@ class ECRecoveryEngine:
         self.osd.store.queue_transaction(t)
         with pg.lock:
             pg.missing.pop(oid, None)
+            pg.unfound.discard(oid)
         # a parked read re-runs and reads the deletion honestly
         self._wake_parked(oid, ok=True)
 

@@ -125,6 +125,80 @@ class PGInfo:
         return out
 
 
+@dataclass
+class PGStat:
+    """One PG's stat row in the osd -> mon MPGStats feed (reference
+    pg_stat_t, src/osd/osd_types.h): the PGMap digest's unit of
+    aggregation.  Versioned codec so later fields ride as gated tails
+    the way PGInfo v2 does.
+
+    ``cl_*``/``rec_*`` are WINDOWED deltas since this osd's previous
+    report (the reporting daemon differences its cumulative per-PG
+    counters), so the mon's snapshot-ring can rate-derive client
+    IOPS/BW and recovery objects/s without daemon clock coupling."""
+
+    pgid: PGId = (0, 0)
+    state: str = ""
+    primary: bool = False
+    num_objects: int = 0
+    num_bytes: int = 0        # locally stored bytes (shard bytes for EC)
+    log_size: int = 0
+    degraded: int = 0         # object copies missing from the acting set
+    misplaced: int = 0        # copies on osds the up set doesn't want
+    unfound: int = 0          # objects with no live source anywhere
+    last_update: EVersion = field(default_factory=EVersion)
+    cl_wr_ops: int = 0        # client writes since the last report
+    cl_wr_bytes: int = 0
+    cl_rd_ops: int = 0
+    cl_rd_bytes: int = 0
+    rec_ops: int = 0          # objects recovered since the last report
+    rec_bytes: int = 0
+
+    def encode(self, e: Encoder) -> None:
+        e.start(1, 1)
+        e.s64(self.pgid[0]).u32(self.pgid[1])
+        e.string(self.state)
+        e.u8(1 if self.primary else 0)
+        e.u64(self.num_objects).u64(self.num_bytes).u64(self.log_size)
+        e.u64(self.degraded).u64(self.misplaced).u64(self.unfound)
+        self.last_update.encode(e)
+        e.u64(self.cl_wr_ops).u64(self.cl_wr_bytes)
+        e.u64(self.cl_rd_ops).u64(self.cl_rd_bytes)
+        e.u64(self.rec_ops).u64(self.rec_bytes)
+        e.finish()
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "PGStat":
+        d.start(1)
+        out = cls(
+            pgid=(d.s64(), d.u32()),
+            state=d.string(),
+            primary=bool(d.u8()),
+            num_objects=d.u64(),
+            num_bytes=d.u64(),
+            log_size=d.u64(),
+            degraded=d.u64(),
+            misplaced=d.u64(),
+            unfound=d.u64(),
+            last_update=EVersion.decode(d),
+            cl_wr_ops=d.u64(),
+            cl_wr_bytes=d.u64(),
+            cl_rd_ops=d.u64(),
+            cl_rd_bytes=d.u64(),
+            rec_ops=d.u64(),
+            rec_bytes=d.u64(),
+        )
+        d.end()
+        return out
+
+    def as_legacy(self) -> tuple:
+        """The thin 7-tuple older MPGStats consumers read (pool, ps,
+        state, num_objects, lu_epoch, lu_version, primary)."""
+        return (self.pgid[0], self.pgid[1], self.state, self.num_objects,
+                self.last_update.epoch, self.last_update.version,
+                self.primary)
+
+
 # -- client op model --------------------------------------------------------
 
 OP_READ = 1

@@ -95,9 +95,36 @@ class StripeBatchQueue:
         self.perf.add_histogram(
             "lat_encq_dispatch_us",
             "batch result fan-out to futures (us)")
+        # device-visibility gauges (the "as fast as the hardware
+        # allows" dashboard numbers): sampled by the owning daemon's
+        # stats tick via sample() into the same snapshot-ring
+        # machinery the mon PGMap uses for cluster rates
+        self.perf.add_u64_gauge(
+            "queue_depth", "jobs waiting in the stripe batch queue")
+        self.perf.add_u64_gauge(
+            "device_busy_pct",
+            "device compute wall-fraction over the sample window (%)")
+        self.perf.add_u64_gauge(
+            "staging_slots_used", "pinned staging pool slots in use")
+        self.device_time_s = 0.0  # cumulative device compute seconds
+        from ceph_tpu.core.perf import SnapshotRing
+
+        self._gauge_ring = SnapshotRing(capacity=32)
         # batch spans (width/kind per dispatch) ride this tracer when
         # set AND enabled; bound by daemon init to its context's tracer
         self.tracer = None
+
+    def sample(self, window_s: float = 10.0) -> None:
+        """Refresh the device-visibility gauges: called off the data
+        path (the OSD stats tick, the bench) so `perf dump` and the
+        Prometheus export show live queue depth, staging occupancy,
+        and the device-busy fraction derived from the cumulative
+        compute-time counter over the ring window."""
+        self._gauge_ring.push({"device_s": self.device_time_s})
+        busy = self._gauge_ring.rate("device_s", window_s)
+        self.perf.set("device_busy_pct", int(round(min(1.0, busy) * 100)))
+        self.perf.set("queue_depth", self._q.qsize())
+        self.perf.set("staging_slots_used", self.pool.occupancy)
 
     def start(self) -> None:
         with self._lock:
@@ -318,6 +345,7 @@ class StripeBatchQueue:
                     self.dec_batch_jobs.get(len(batch), 0) + 1)
             self.bytes_in += sum(j.planes.nbytes for j in batch)
             t_done = time.monotonic()
+            self.device_time_s += t_compute - t_start
             self.perf.hinc("lat_device_us",
                            (t_compute - t_start) * 1e6)
             self.perf.hinc("lat_encq_dispatch_us",

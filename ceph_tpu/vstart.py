@@ -132,6 +132,18 @@ class VStartCluster:
         for i, svc in self.osds.items():
             mgr.register_service(f"osd.{i}", svc)
         mgr.osdmap = self.leader().osdmap
+        # cluster telemetry feeds resolve the CURRENT leader per call:
+        # an election mid-session must not leave the mgr reading a
+        # deposed mon's frozen pgmap
+        mgr.health_fn = \
+            lambda: self.leader().services["health"].gather()
+        mgr.pgmap_digest_fn = lambda: self.leader().pgmap.digest()
+        # fresh_only: the progress module must see the same
+        # staleness-filtered view health uses, or a dead reporter's
+        # frozen degraded row keeps a recovery event (and its ETA)
+        # alive forever after health has already cleared
+        mgr.pg_rows_fn = \
+            lambda: self.leader().pgmap.pg_rows(fresh_only=True)
         if dashboard:
             mgr.modules["dashboard"].serve(
                 port=dashboard_port, mon_command=self.command)

@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Interleaved A/B: does stats-report-interval telemetry cost IOPS?
+
+A = telemetry effectively OFF (osd_pg_stats_interval=3600: no MPGStats
+    reports, no PGStat assembly, no digest feed)
+B = aggressive telemetry (osd_pg_stats_interval=0.25: rich PGStat rows
+    with per-object store stats + slow-ring depth 4x/s per OSD)
+
+Each trial boots a fresh 1x3 vstart, warms, measures EC k=2,m=1
+WRITEFULL IOPS at depth 16 (64KiB and 4KiB), tears down.  Trials
+interleave A,B,A,B,... to cancel rig drift; the verdict is the
+PAIRWISE median of B/A ratios, judged against the box's documented
++/-35% drift envelope (ROADMAP tier-1 runtime note) — re-measure the
+baseline on the same box before blaming a diff.
+
+    JAX_PLATFORMS=cpu python scratch/ab_telemetry.py [n_pairs]
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def trial(conf_extra, tag):
+    from ceph_tpu.client.rados import OSDOp
+    from ceph_tpu.osd import types as t_
+    from ceph_tpu.vstart import VStartCluster
+
+    depth = 16
+
+    def run(io, n, payload, sub):
+        pend = []
+        t0 = time.perf_counter()
+        for i in range(n):
+            pend.append(io.aio_operate(
+                f"ab_{tag}_{sub}_{i}",
+                [OSDOp(t_.OP_WRITEFULL, data=payload)]))
+            if len(pend) >= depth:
+                pend.pop(0).result(60.0)
+        for p in pend:
+            p.result(60.0)
+        return n / (time.perf_counter() - t0)
+
+    with VStartCluster(n_mons=1, n_osds=3, conf=conf_extra) as c:
+        ec = c.create_pool("ab_ec", size=3, pool_type="erasure",
+                           ec_profile="k=2 m=1")
+        ioec = c.client().ioctx(ec)
+        run(ioec, 32, b"w" * 4096, "warm")  # peering, sockets, jit
+        return {
+            "ec64k_write_iops": round(
+                run(ioec, 64, b"b" * 65536, "64k"), 1),
+            "ec4k_write_iops": round(
+                run(ioec, 192, b"s" * 4096, "4k"), 1),
+        }
+
+
+def main() -> None:
+    n_pairs = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    a_conf = {"osd_pg_stats_interval": 3600.0}
+    b_conf = {"osd_pg_stats_interval": 0.25}
+    # discarded process-wide warmup: the FIRST cluster pays every XLA
+    # compile (both payload shapes), which otherwise lands entirely in
+    # pair 0's A arm and fabricates a B/A skew
+    warm = trial(a_conf, "warmup")
+    print(json.dumps({"warmup_discarded": warm}), flush=True)
+    pairs = []
+    for i in range(n_pairs):
+        a = trial(a_conf, f"a{i}")
+        b = trial(b_conf, f"b{i}")
+        pairs.append({"a": a, "b": b})
+        print(json.dumps({"pair": i, "a": a, "b": b}), flush=True)
+    verdict = {}
+    for key in ("ec64k_write_iops", "ec4k_write_iops"):
+        ratios = [p["b"][key] / p["a"][key] for p in pairs
+                  if p["a"][key] > 0]
+        verdict[key] = {
+            "pairwise_ratios_b_over_a": [round(r, 3) for r in ratios],
+            "median": round(statistics.median(ratios), 3),
+            "parity_within_35pct_drift": bool(
+                0.65 <= statistics.median(ratios) <= 1.35),
+        }
+    print(json.dumps({"verdict": verdict}, indent=1), flush=True)
+
+
+if __name__ == "__main__":
+    main()

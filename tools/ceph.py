@@ -61,12 +61,26 @@ def _parse(tokens):
         return {"prefix": "osd tree"}
     if t[0] == "df":
         return {"prefix": "df"}
-    if t[0] == "status":
+    if t[0] in ("status", "-s"):
         return {"prefix": "status"}
     if t[0] == "health":
         if len(t) > 1 and t[1] in ("mute", "unmute"):
             return {"prefix": f"health {t[1]}", "check": t[2]}
+        if len(t) > 1 and t[1] == "detail":
+            return {"prefix": "health detail"}
         return {"prefix": "health"}
+    if t[0] == "progress":
+        return {"prefix": "progress"}
+    if t[:2] == ["prometheus", "export"]:
+        return {"prefix": "prometheus export"}
+    if t[:2] == ["ops", "dump_slow"]:
+        return {"prefix": "ops dump_slow"}
+    if t[:2] == ["ops", "dump_in_flight"]:
+        return {"prefix": "ops dump_in_flight"}
+    if t[:2] == ["ops", "latency"]:
+        return {"prefix": "ops latency"}
+    if t[:2] == ["mgr", "status"]:
+        return {"prefix": "mgr status"}
     if t[0] == "config":
         if t[1] == "set":
             return {"prefix": "config set", "who": t[2], "name": t[3],
@@ -138,8 +152,14 @@ def main(argv=None) -> int:
     p.add_argument("--data-dir", default=None)
     p.add_argument("--cephx", action="store_true")
     p.add_argument("--script", default="")
+    # the classic `ceph -s` spelling: argparse would otherwise reject
+    # it as an unknown flag before the command tokens are seen
+    p.add_argument("-s", dest="status_alias", action="store_true",
+                   help="alias for the status command")
     p.add_argument("command", nargs="*")
     args = p.parse_args(argv)
+    if args.status_alias and not args.command and not args.script:
+        args.command = ["status"]
 
     from ceph_tpu.vstart import VStartCluster
 
@@ -149,10 +169,18 @@ def main(argv=None) -> int:
     if not scripts or not scripts[0]:
         p.error("no command given")
 
+    # mgr-module commands (the `ceph progress` / `ceph prometheus`
+    # surface): routed to an in-process mgr started on demand — the
+    # reference forwards these mon->mgr; here the CLI owns the hop
+    MGR_PREFIXES = {"progress", "prometheus export", "mgr status",
+                    "ops dump_slow", "ops dump_in_flight",
+                    "ops latency"}
+
     rc = 0
     with VStartCluster(n_mons=n_mons, n_osds=n_osds,
                        data_dir=args.data_dir,
                        keyring=args.cephx) as cluster:
+        mgr = None
         for line in scripts:
             tokens = shlex.split(line)
             if tokens[:2] == ["osd", "tree"]:
@@ -163,8 +191,17 @@ def main(argv=None) -> int:
             except (ValueError, IndexError) as e:
                 print(str(e), file=sys.stderr)
                 return 22
-            code, out = cluster.command(cmd)
-            print(json.dumps({"rc": code, **out}, indent=1, default=str))
+            if cmd["prefix"] in MGR_PREFIXES:
+                if mgr is None:
+                    mgr = cluster.start_mgr()
+                code, out = mgr.handle_command(cmd)
+            else:
+                code, out = cluster.command(cmd)
+            if cmd["prefix"] == "prometheus export" and code == 0:
+                print(out.get("body", ""))
+            else:
+                print(json.dumps({"rc": code, **out}, indent=1,
+                                 default=str))
             if code != 0:
                 rc = abs(code)
     return rc

@@ -111,17 +111,74 @@ def render_slow(ops: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def _cluster_status(socket_paths: List[str]) -> dict:
+    """The first answering mon's health + PGMap digest (the `mon.N
+    status` admin command registered by every monitor)."""
+    for path in socket_paths:
+        try:
+            cmds = admin_command(path, "help")
+        except OSError:
+            continue
+        for prefix in sorted(cmds):
+            if not prefix.endswith(" status") or \
+                    not prefix.startswith("mon."):
+                continue
+            try:
+                return admin_command(path, prefix)
+            except OSError:
+                continue
+    return {}
+
+
+def render_cluster(st: dict) -> str:
+    if not st:
+        return "no mon status admin command answered"
+    d = st.get("digest", {})
+    lines = [f"health: {st.get('health', '?')}"]
+    for name, summary in sorted(st.get("checks", {}).items()):
+        lines.append(f"    {name}: {summary}")
+    states = " ".join(f"{s}={n}"
+                      for s, n in sorted(d.get("pg_states", {}).items()))
+    lines.append(f"pgs: {d.get('num_pgs', 0)} ({states})")
+    lines.append(f"objects: {d.get('objects', 0)}  "
+                 f"stored: {d.get('bytes', 0)} B  "
+                 f"degraded: {d.get('degraded_objects', 0)}  "
+                 f"misplaced: {d.get('misplaced_objects', 0)}  "
+                 f"unfound: {d.get('unfound_objects', 0)}")
+    io = d.get("io", {})
+    lines.append(
+        f"client: {io.get('client_read_ops_per_s', 0)} rd op/s, "
+        f"{io.get('client_write_ops_per_s', 0)} wr op/s, "
+        f"{io.get('client_write_bytes_per_s', 0)} wr B/s")
+    lines.append(
+        f"recovery: {io.get('recovery_objects_per_s', 0)} objects/s, "
+        f"{io.get('recovery_bytes_per_s', 0)} B/s")
+    if d.get("slow_ops"):
+        lines.append("slow ops: " + ", ".join(
+            f"osd.{o}={n}" for o, n in sorted(d["slow_ops"].items())))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="cephtop", description=__doc__)
     p.add_argument("--socket", action="append", default=[],
                    help="daemon admin socket path (repeatable)")
     p.add_argument("--slow", action="store_true",
                    help="dump the merged slow-op rings instead")
+    p.add_argument("--cluster", action="store_true",
+                   help="cluster pane: mon health + PGMap digest "
+                        "(pg states, degraded totals, io rates)")
     p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
     if not args.socket:
         print("cephtop: at least one --socket required", file=sys.stderr)
         return 2
+
+    if args.cluster:
+        st = _cluster_status(args.socket)
+        print(json.dumps(st, indent=1) if args.as_json
+              else render_cluster(st))
+        return 0
 
     if args.slow:
         ops = _slow_ops(args.socket)
