@@ -704,11 +704,36 @@ def cluster_io(jax, out):
                 p.result(30.0)
             return time.perf_counter() - t0
 
+        # compile attribution (PR 10): every phase splits its wall
+        # into XLA-compile seconds (device-watcher measured) vs
+        # steady-state seconds — the end of the "discard the warmup
+        # trial by hand" guesswork in scratch/ab_*.py.  A measured
+        # phase whose compile count is nonzero was warmup-skewed and
+        # says so in the artifact.  ONE implementation for every row.
+        from ceph_tpu.tpu.devwatch import watch as _dwatch
+
+        def _xla0():
+            return _dwatch().compile_totals(), time.perf_counter()
+
+        def _xla_delta(w0):
+            d0, t0 = w0
+            d1 = _dwatch().compile_totals()
+            elapsed = time.perf_counter() - t0
+            comp_s = round(
+                d1["compile_seconds"] - d0["compile_seconds"], 4)
+            return {
+                "compiles": int(d1["compiles"] - d0["compiles"]),
+                "compile_s": comp_s,
+                "steady_s": round(max(0.0, elapsed - comp_s), 4),
+            }
+
+        rep_xla = _xla0()
         wdt = run(lambda: [OSDOp(t_.OP_WRITEFULL, data=payload)])
         rdt = run(lambda: [OSDOp(t_.OP_READ, off=0,
                                  length=len(payload))])
         assert io.read("bench_0") == payload
         out["cluster_io"] = {
+            "compile": _xla_delta(rep_xla),
             "object_kib": 64, "objects": n_objs, "depth": depth,
             "write_iops": round(n_objs / wdt, 1),
             "write_mbps": round(n_objs * 65536 / wdt / 1e6, 1),
@@ -772,11 +797,41 @@ def cluster_io(jax, out):
         # per-phase high-water: the replicated bench above already
         # drove the gauge to ~depth; re-arm so the EC row's overlap
         # evidence is its own
+        # EC warm-until-dry: burst the SAME shape as the measured
+        # phase until a whole round compiles nothing (coalesced batch
+        # widths vary round to round, so one burst is not enough —
+        # measured: a single 24-write warmup still left a 0.57s
+        # compile inside the 64KiB window).  The compile cost lands in
+        # the warmup's own aux instead of skewing IOPS.
+        # rounds are the MEASURED phase's length: coalesced batch
+        # widths (the crc kernel's pow2 row buckets) depend on queue
+        # pressure, so a short warm burst misses buckets a full-length
+        # run reaches (measured: 16-write rounds left one 0.88s
+        # compile inside the 96-write 4KiB window)
+        def _warm_until_steady(io_, pay, tag, rounds=4, n=16):
+            w0 = _xla0()
+            for r in range(rounds):
+                r0 = _xla0()
+                pend = []
+                for i in range(n):
+                    pend.append(io_.aio_operate(
+                        f"{tag}{r}_{i}",
+                        [OSDOp(t_.OP_WRITEFULL, data=pay)]))
+                    if len(pend) >= depth:
+                        pend.pop(0).result(60.0)
+                for p in pend:
+                    p.result(60.0)
+                if _xla_delta(r0)["compiles"] == 0:
+                    break
+            return _xla_delta(w0)
+
+        warm_compile = _warm_until_steady(ioec, payload, "becw", n=64)
         for svc in c.osds.values():
             svc.reset_write_inflight_hw()
         msgs0, ops0, _ = _pg_perf_totals()
         dstat0 = dq.stats.snapshot()
         lat0 = _stage_hists()
+        xla0 = _xla0()
         n_ec = 64
         t0 = time.perf_counter()
         pend = []
@@ -826,6 +881,8 @@ def cluster_io(jax, out):
             "tpu_engine_byte_fraction": round(
                 frac if jax.default_backend() != "cpu" else 0.0, 3),
             "latency_attribution": lat_64k,
+            "compile": _xla_delta(xla0),
+            "warmup_compile": warm_compile,
             "note": "every EC stripe encode rode the StripeBatchQueue "
                     "-> active engine; batching/fan-out evidence is "
                     "measured from queue + osd.N.pg counters, not "
@@ -856,9 +913,11 @@ def cluster_io(jax, out):
 
         # small-object phase — the PR-6 tentpole's target shape: 4KiB
         # EC WRITEFULL at the same depth, its own counter window
+        pay4k = b"s" * 4096
+        warm_4k = _warm_until_steady(ioec, pay4k, "bsmw", n=96)
         st0 = dq.stats.snapshot()
         lat0_4k = _stage_hists()
-        pay4k = b"s" * 4096
+        xla0_4k = _xla0()
         n_small = 96
         t0 = time.perf_counter()
         pend = []
@@ -887,6 +946,8 @@ def cluster_io(jax, out):
                  - st0["payload_host_touches"]) / n_small, 4),
             "pool_occupancy_hw": st1["pool_occupancy_hw"],
             "latency_attribution": _attribution(lat0_4k, _stage_hists()),
+            "compile": _xla_delta(xla0_4k),
+            "warmup_compile": warm_4k,
         }
 
         # degraded-PG recovery (read-side twin of the write evidence):
@@ -935,6 +996,7 @@ def cluster_io(jax, out):
 
         c.wait_for(lambda: _digest()["degraded_objects"] > 0,
                    timeout=30.0, what="degraded debt in the digest")
+        xla0_rec = _xla0()
         t0 = time.perf_counter()
         c.revive_osd(r_prim)
         svc = c.osds[r_prim]
@@ -1008,6 +1070,7 @@ def cluster_io(jax, out):
             "decode_batch_jobs_hist": dec_hist,
             "mean_decode_jobs_per_batch": round(
                 dec_jobs / dec_batches, 2) if dec_batches else 0.0,
+            "compile": _xla_delta(xla0_rec),
             "telemetry": {
                 **tel,
                 "note": "mon PGMap digest during the phase: peak "

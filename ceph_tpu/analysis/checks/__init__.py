@@ -9,6 +9,7 @@ from ceph_tpu.analysis.checks.locks import NamedLocks
 from ceph_tpu.analysis.checks.silent_except import SilentExcept
 from ceph_tpu.analysis.checks.sleep_poll import NoSleepPoll
 from ceph_tpu.analysis.checks.span_discipline import SpanDiscipline
+from ceph_tpu.analysis.checks.unwatched_jit import NoUnwatchedJit
 
 ALL_CHECKS = (
     NoBlockingOnLoop(),
@@ -20,6 +21,7 @@ ALL_CHECKS = (
     NoD2HOnHotPath(),
     FailpointNameRegistry(),
     SpanDiscipline(),
+    NoUnwatchedJit(),
 )
 
 CHECKS_BY_NAME = {c.name: c for c in ALL_CHECKS}

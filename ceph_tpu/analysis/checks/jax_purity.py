@@ -22,7 +22,13 @@ from ceph_tpu.analysis.framework import (
 )
 
 _TRACE_ENTRY = {"jax.jit", "jit", "pallas_call", "pl.pallas_call",
-                "jax.pmap", "pmap", "jax.vmap", "checkify.checkify"}
+                "jax.pmap", "pmap", "jax.vmap", "checkify.checkify",
+                # the devwatch wrappers (the ONLY sanctioned jit/pallas
+                # spellings per no-unwatched-jit) trace their first
+                # argument exactly like the raw entry points
+                "instrumented_jit", "devwatch.instrumented_jit",
+                "instrumented_pallas_call",
+                "devwatch.instrumented_pallas_call"}
 _IMPURE_ROOTS = {"np", "numpy", "time", "random"}
 _F64 = {"np.float64", "numpy.float64", "jnp.float64"}
 

@@ -1,0 +1,92 @@
+"""no-unwatched-jit: every jit/pallas entry point goes through devwatch.
+
+PR 10's device-runtime watcher only sees what flows through its
+``instrumented_jit`` / ``instrumented_pallas_call`` wrappers
+(``ceph_tpu/tpu/devwatch.py``).  One convenient ``jax.jit(...)``
+anywhere else re-opens the observability hole the watcher closed:
+that kernel's compiles are invisible to the ``osd.N.xla`` perf set,
+the recompile-storm detector, the steady-state guard, the op-level
+``compile_wait`` blame, and the crash flight recorder — the exact
+blindness that cost the PR 3 CRUSH-sweep recompile hunt and PR 9's
+discarded warmup trial.
+
+Flagged anywhere in ``ceph_tpu/`` outside devwatch itself:
+
+- any ``jax.jit`` attribute reference (call, decorator,
+  ``functools.partial(jax.jit, ...)`` argument, alias assignment —
+  the ATTRIBUTE is the violation, so aliasing cannot hide it);
+- any ``*.pallas_call`` attribute reference (``pl.pallas_call``,
+  ``pltpu.pallas_call``, fully-qualified spellings);
+- ``from jax import jit`` / ``from jax.experimental.pallas import
+  pallas_call`` style imports of the raw entry points.
+
+Never baselineable (the failpoint-name-registry / span-discipline
+shape): ``--write-baseline`` refuses to record these, so a direct
+jit can never ship as accepted debt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from ceph_tpu.analysis.framework import (
+    Check, NEVER_BASELINE_PREFIXES, SourceFile, Violation, dotted,
+    enclosing_scope,
+)
+
+# the raw entry points, by dotted attribute spelling
+_JIT_ATTRS = {"jax.jit"}
+_PALLAS_TAIL = "pallas_call"
+
+# the one module allowed to touch the raw entry points
+_EXEMPT = ("ceph_tpu/tpu/devwatch.py",)
+
+
+class NoUnwatchedJit(Check):
+    name = "no-unwatched-jit"
+    description = ("direct jax.jit / pl.pallas_call outside "
+                   "tpu/devwatch.py: compiles invisible to the "
+                   "device-runtime watcher")
+    scopes = ("ceph_tpu",)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        out: List[Violation] = []
+        for f in files:
+            if f.rel in _EXEMPT:
+                continue
+            for node in ast.walk(f.tree):
+                detail = None
+                if isinstance(node, ast.Attribute):
+                    dn = dotted(node)
+                    if dn in _JIT_ATTRS:
+                        detail = dn
+                    elif node.attr == _PALLAS_TAIL and dn:
+                        detail = dn
+                elif isinstance(node, ast.ImportFrom):
+                    mod = node.module or ""
+                    if mod == "jax" or mod.startswith("jax."):
+                        for alias in node.names:
+                            if alias.name in ("jit", _PALLAS_TAIL):
+                                detail = f"from {mod} import {alias.name}"
+                                break
+                if detail is None:
+                    continue
+                out.append(Violation(
+                    check=self.name, path=f.rel, line=node.lineno,
+                    scope=enclosing_scope(f.tree, node.lineno),
+                    detail=detail,
+                    message=(
+                        f"{detail}: raw jit/pallas entry point outside "
+                        "tpu/devwatch.py — this kernel's compiles are "
+                        "invisible to the device watcher (osd.N.xla, "
+                        "storm detection, compile_wait blame, crash "
+                        "flight recorder); use devwatch."
+                        "instrumented_jit / instrumented_pallas_call "
+                        "with a family= tag"),
+                ))
+        return out
+
+
+# a direct jit is never accepted debt, anywhere in the tree
+NEVER_BASELINE_PREFIXES.append((NoUnwatchedJit.name, "ceph_tpu/"))

@@ -168,6 +168,17 @@ def _opts() -> List[Option]:
         O("tpu_staging_slot_kib", int, 128,
           "pinned staging slot size; larger payloads bypass the pool",
           runtime=False),
+        O("tpu_recompile_storm_window", float, 60.0,
+          "sliding window (seconds) over which the device watcher "
+          "counts distinct compile signatures per kernel family for "
+          "recompile-storm detection"),
+        O("tpu_recompile_storm_min_sigs", int, 8,
+          "distinct compile signatures of ONE kernel family inside "
+          "the storm window that raise the RECOMPILE_STORM "
+          "cluster-log WARN (naming the family and the churning "
+          "shape dimension); default calibrated so a pow2-padded "
+          "cold start (~5 bounded shapes/family, ROUND10 measured) "
+          "stays quiet while an unpadded dimension trips in seconds"),
         # -- objectstore ----------------------------------------------------
         O("objectstore", str, "memstore", "backend", enum=("memstore", "filestore")),
         O("objectstore_path", str, "", "data directory for filestore"),

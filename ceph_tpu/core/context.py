@@ -76,6 +76,18 @@ class Context:
         a.register("dump_trace", _dump_trace,
                    "spans of one trace: dump_trace trace_id=<hex> "
                    "(without trace_id: the ring tail)")
+
+        def _device_dump(c):
+            # process-wide like the StripeBatchQueue: one device
+            # runtime per process, one compile table
+            from ceph_tpu.tpu.devwatch import watch
+
+            return watch().dump()
+
+        a.register("device compile dump", _device_dump,
+                   "per-kernel-family XLA compile table: compiles, "
+                   "wall seconds, distinct shape signatures, cache "
+                   "hits, recent storms and events")
         a.start()
         self.admin = a
 

@@ -54,6 +54,8 @@ EXTRA_HISTS: Dict[str, str] = {
     "lat_recovery_round_us": "one windowed recovery round, send -> settled",
     "lat_parked_read_us": "recover-on-read park -> wake",
     "lat_op_us": "tracked op total: receive -> terminal event",
+    "lat_compile_wait_us": "op encode wait overlapped by a live XLA "
+                           "compile (devwatch blame)",
 }
 
 
@@ -91,11 +93,19 @@ class TrackedOp:
         # done_at) atomic against straggler marks
         self._mu = make_lock("optracker.op")
 
-    def mark_event(self, stage: str, detail: str = "") -> "TrackedOp":
+    def mark_event(self, stage: str, detail: str = "",
+                   annotation: bool = False) -> "TrackedOp":
+        """annotation=True records the event on the timeline WITHOUT
+        advancing the since-previous-event baseline: out-of-band
+        observations (e.g. compile_wait blame from the device worker)
+        must not shift the adjacent pipeline stages' histogram
+        deltas."""
         with self._mu:
-            return self._mark_locked(stage, detail)
+            return self._mark_locked(stage, detail,
+                                     annotation=annotation)
 
-    def _mark_locked(self, stage: str, detail: str = "") -> "TrackedOp":
+    def _mark_locked(self, stage: str, detail: str = "",
+                     annotation: bool = False) -> "TrackedOp":
         if self.done_at is not None:
             # the op already concluded into history (e.g. the deadline
             # sweep answered EAGAIN): a straggler commit firing later
@@ -104,6 +114,8 @@ class TrackedOp:
             return self
         now = time.monotonic()
         self.events.append((now - self.start, stage, detail))
+        if annotation:
+            return self
         hist = STAGES.get(stage, "")
         perf = self.tracker.perf
         if hist and perf is not None:

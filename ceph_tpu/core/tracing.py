@@ -61,6 +61,12 @@ STAGES: Dict[str, str] = {
     "commit": "lat_commit_wait_us",      # last shard ack arrived
     "ack_gated": "lat_ack_gate_us",      # durable-ack gate released
     "commit_sent": "lat_reply_us",       # reply sent to the client
+    # device runtime (PR 10): annotation, not a pipeline stage — the
+    # overlap duration feeds lat_compile_wait_us DIRECTLY (an
+    # EXTRA_HISTS entry), because the blame is "how long a live XLA
+    # compile overlapped this op's encode wait", not a
+    # since-previous-event delta
+    "compile_wait": "",        # encode batch stalled behind a live compile
     # read path
     "parked": "",              # read parked on recover-on-read
     "read_sent": "lat_read_us",  # terminal for reads: execute -> reply

@@ -50,6 +50,7 @@ global flip advisory), and no 64-bit ops for XLA to emulate.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -57,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ceph_tpu.crush import hashes, ln
+from ceph_tpu.tpu.devwatch import instrumented_jit
 from ceph_tpu.crush.map import (
     ALG_LIST,
     ALG_STRAW,
@@ -1300,7 +1302,8 @@ def compile_rule(
             return result, clean
         return result
 
-    mapped = jax.jit(jax.vmap(one_x, in_axes=(0, None)))
+    mapped = instrumented_jit(jax.vmap(one_x, in_axes=(0, None)),
+                              family="crush_mapper")
 
     def run(xs, dev_weights):
         return mapped(
@@ -1455,7 +1458,7 @@ def sweep_device(
                            one_shot=True, budget=MID_BUDGET)
         slow = compile_rule(flat, steps, result_max, choose_args)
 
-        @jax.jit
+        @functools.partial(instrumented_jit, family="crush_mapper")
         def run(xs2, w):
             def body(overflow, sub):
                 res, clean = fast(sub, w)

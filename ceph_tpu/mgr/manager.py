@@ -91,10 +91,24 @@ class PrometheusModule(MgrModule):
             for pool, row in sorted(digest["pools"].items()):
                 lines.append(f'{metric}{{pool="{pool}"}} {row[field]}')
 
+    def _export_devwatch(self, lines: List[str]) -> None:
+        """Family-labeled device-runtime metrics (ceph_xla_*): compile
+        counts/seconds, distinct shapes, cache hits, and per-family
+        execute-time histograms with the mandatory le=\"+Inf\"
+        terminal bucket — the PR 10 device-observability surface.
+        Process-wide (one device runtime per process), so the watcher
+        exports itself rather than riding a daemon label."""
+        try:
+            from ceph_tpu.tpu.devwatch import watch
+        except ImportError:  # pragma: no cover — stripped install
+            return
+        watch().export_prometheus(lines)
+
     def export(self) -> str:
         metrics = self.mgr.collect()
         lines: List[str] = []
         self._export_cluster(lines)
+        self._export_devwatch(lines)
         seen_help = set()
         for daemon, subsystems in sorted(metrics.items()):
             for subsys, counters in sorted(subsystems.items()):
@@ -177,6 +191,23 @@ class CrashModule(MgrModule):
                     return 0, r
             return -2, {"error": f"no crash {cmd['id']!r}"}
         return None
+
+
+class DeviceModule(MgrModule):
+    """`device compile dump`: the process-wide XLA compile table
+    (per-kernel-family compiles / wall seconds / distinct shape
+    signatures / cache hits, recent recompile storms, the event-ring
+    tail) — the mgr face of ceph_tpu.tpu.devwatch, mirroring the
+    per-daemon admin-socket command of the same name."""
+
+    name = "device"
+
+    def handle_command(self, cmd):
+        if cmd.get("prefix") != "device compile dump":
+            return None
+        from ceph_tpu.tpu.devwatch import watch
+
+        return 0, watch().dump()
 
 
 class BalancerModule(MgrModule):
@@ -420,7 +451,8 @@ class MgrDaemon:
         for m in (StatusModule(self), PrometheusModule(self),
                   CrashModule(self), BalancerModule(self),
                   DashboardModule(self), TelemetryModule(self),
-                  OpsModule(self), ProgressModule(self)):
+                  OpsModule(self), ProgressModule(self),
+                  DeviceModule(self)):
             self.modules[m.name] = m
 
     def register_daemon(self, name: str, ctx, service=None) -> None:

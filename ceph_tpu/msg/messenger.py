@@ -191,6 +191,11 @@ class Messenger:
         self._dispatchers: List[Dispatcher] = []
         self._conns: Dict[Addr, Connection] = {}
         self._loop = asyncio.new_event_loop()
+        # event-loop deaths leave a crash report in every installed
+        # CrashArchive (before this, only daemon THREAD deaths did)
+        from ceph_tpu.core.crash import install_loop_handler
+
+        install_loop_handler(self._loop)
         self._thread = threading.Thread(
             target=self._loop.run_forever, name=f"msgr-{entity}", daemon=True
         )

@@ -24,7 +24,10 @@ threshold.  With it, the same kernels measure 180-290 GB/s.
 
 from __future__ import annotations
 
+import functools
 import time
+
+from ceph_tpu.tpu.devwatch import instrumented_jit
 
 
 LANES = 128
@@ -44,7 +47,7 @@ def gen_planes(k: int, T: int, interleaved: bool = False):
 
     shape = (T, k, LANES) if interleaved else (k, T, LANES)
 
-    @jax.jit
+    @functools.partial(instrumented_jit, family="benchloop")
     def g():
         return mix_jnp(lax.iota(jnp.uint32, k * T * LANES).reshape(shape))
 
@@ -68,7 +71,7 @@ def seeded_loop_runner(enc, out_shape, iters: int):
     import jax.numpy as jnp
     from jax import lax
 
-    @jax.jit
+    @functools.partial(instrumented_jit, family="benchloop")
     def run(w3):
         def body(i, acc):
             s = jnp.full((1,), i, jnp.uint32)
@@ -107,7 +110,7 @@ def sum_digest_runner(enc, iters: int):
     import jax.numpy as jnp
     from jax import lax
 
-    @jax.jit
+    @functools.partial(instrumented_jit, family="benchloop")
     def run(w3):
         def body(i, acc):
             s = jnp.full((1,), i, jnp.uint32)

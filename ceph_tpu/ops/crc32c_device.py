@@ -34,6 +34,8 @@ import functools
 
 import numpy as np
 
+from ceph_tpu.tpu.devwatch import instrumented_jit
+
 _POLY = np.uint32(0x82F63B78)
 
 
@@ -109,7 +111,7 @@ if _HAVE_JAX:
             c = lax.fori_loop(0, 8, tail_step, c)
             return c ^ jnp.uint32(0xFFFFFFFF)
 
-        return jax.jit(kernel)
+        return instrumented_jit(kernel, family="crc32c_device")
 
 
 def _rows_numpy(rows: np.ndarray, lens, inits) -> np.ndarray:

@@ -35,6 +35,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ceph_tpu.tpu.devwatch import (instrumented_jit,
+                                   instrumented_pallas_call)
+
 LANES = 128
 DEFAULT_TILE = 512  # sublane rows per grid step: (k, 512, 128) u32 = 2 MiB for k=8
 
@@ -116,8 +119,8 @@ def _compiled(matrix_bytes: bytes, shape: Tuple[int, int], tile: int,
     def run(words3: jax.Array, seed: jax.Array) -> jax.Array:
         kk, T, L = words3.shape
         assert kk == k and L == LANES and T % tile == 0, (kk, T, L)
-        return pl.pallas_call(
-            kernel,
+        return instrumented_pallas_call(
+            kernel, family="gf256_pallas",
             out_shape=jax.ShapeDtypeStruct((R, T, LANES), jnp.uint32),
             grid=(T // tile,),
             in_specs=[
@@ -133,8 +136,9 @@ def _compiled(matrix_bytes: bytes, shape: Tuple[int, int], tile: int,
             interpret=interpret,
         )(seed, words3)
 
-    return (jax.jit(run, donate_argnums=(0,)) if alias
-            else jax.jit(run))
+    return (instrumented_jit(run, family="gf256_pallas",
+                             donate_argnums=(0,)) if alias
+            else instrumented_jit(run, family="gf256_pallas"))
 
 
 def encode_planes(matrix: np.ndarray, words3, seed=None, *,
@@ -213,12 +217,12 @@ def _compiled_interleaved(matrix_bytes: bytes, shape: Tuple[int, int],
     R, k = shape
     kernel = _make_kernel_interleaved(matrix, mul_shift)
 
-    @jax.jit
+    @functools.partial(instrumented_jit, family="gf256_pallas")
     def run(words3: jax.Array, seed: jax.Array) -> jax.Array:
         T, kk, L = words3.shape
         assert kk == k and L == LANES and T % tile == 0, (T, kk, L)
-        return pl.pallas_call(
-            kernel,
+        return instrumented_pallas_call(
+            kernel, family="gf256_pallas",
             out_shape=jax.ShapeDtypeStruct((T, R, LANES), jnp.uint32),
             grid=(T // tile,),
             in_specs=[

@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ceph_tpu.tpu.devwatch import instrumented_jit
+
 # set when this rig's compiler rejects the Pallas kernel (remote-compile
 # failure): the process then routes every encode via the XLA graph path
 _pallas_broken = False
@@ -124,8 +126,9 @@ def _compiled(matrix: np.ndarray, donate: bool = False) -> Callable:
         # batch instead of two.  Only for callers handing over a fresh
         # per-batch buffer (the StripeBatchQueue pipeline) — a donated
         # buffer cannot be reused by the caller afterwards.
-        fn = (jax.jit(run, donate_argnums=(0,)) if donate
-              else jax.jit(run))
+        fn = (instrumented_jit(run, family="gf256_swar",
+                               donate_argnums=(0,)) if donate
+              else instrumented_jit(run, family="gf256_swar"))
         _cache[key] = fn
     return fn
 
@@ -136,7 +139,8 @@ def _compiled_words(matrix: np.ndarray) -> Callable:
     key = (matrix.tobytes(), matrix.shape, "words")
     fn = _cache.get(key)
     if fn is None:
-        fn = _cache[key] = jax.jit(_build_network(matrix))
+        fn = _cache[key] = instrumented_jit(
+            _build_network(matrix), family="gf256_swar")
     return fn
 
 

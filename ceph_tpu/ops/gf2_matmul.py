@@ -31,6 +31,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from ceph_tpu.tpu.devwatch import (instrumented_jit,
+                                   instrumented_pallas_call)
+
 try:  # pallas TPU backend (absent on CPU-only test runs)
     from jax.experimental.pallas import tpu as pltpu
 except Exception:  # pragma: no cover
@@ -105,7 +108,8 @@ def _gf2_kernel(mbits_ref, x_ref, out_ref):
     out_ref[:] = packed.astype(jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("tile_n",))
+@functools.partial(instrumented_jit, family="gf2_matmul",
+                   static_argnames=("tile_n",))
 def gf2_matmul_bytes_pallas(
     mbits: jax.Array, x: jax.Array, tile_n: int = 2048
 ) -> jax.Array:
@@ -115,8 +119,8 @@ def gf2_matmul_bytes_pallas(
     assert k8 == 8 * k and r8 % 8 == 0
     assert n % tile_n == 0, "pad n to a tile_n multiple"
     grid = (n // tile_n,)
-    return pl.pallas_call(
-        _gf2_kernel,
+    return instrumented_pallas_call(
+        _gf2_kernel, family="gf2_matmul",
         out_shape=jax.ShapeDtypeStruct((r8 // 8, n), jnp.uint8),
         grid=grid,
         in_specs=[
@@ -142,7 +146,7 @@ def gf2_matmul_bytes(mbits: jax.Array, x: jax.Array, *, tile_n: int = 2048):
     return _ref_jit(mbits, x)
 
 
-_ref_jit = jax.jit(gf2_matmul_bytes_ref)
+_ref_jit = instrumented_jit(gf2_matmul_bytes_ref, family="gf2_matmul")
 
 
 def prepare_bitmatrix(matrix: np.ndarray, w: int = 8) -> np.ndarray:

@@ -240,7 +240,8 @@ class PGBackend:
             return t
 
     def _encode_then_fanout(self, planes, fanout, on_error,
-                            fused: bool = False, size: int = 0) -> None:
+                            fused: bool = False, size: int = 0,
+                            trop=None) -> None:
         """Shared async-encode scaffold: queue the planes, then run
         `fanout(coding)` through the per-PG sequencer on the fan-out
         executor — NOT on the StripeBatchQueue's device worker, which
@@ -257,10 +258,13 @@ class PGBackend:
         if self.perf is not None:
             self.perf.inc("encode_batch_jobs")
         try:
+            # trop rides the job so the queue can blame a live XLA
+            # compile for this op's wait (compile_wait annotation)
             fut = (self.queue.encode_crc_async(self.codec, planes,
-                                               size=size)
+                                               size=size, trop=trop)
                    if fused else
-                   self.queue.encode_async(self.codec, planes))
+                   self.queue.encode_async(self.codec, planes,
+                                           trop=trop))
         except BaseException:
             self._fan_run(ticket, lambda: None)  # never park the line
             raise
@@ -365,7 +369,7 @@ class ReplicatedBackend(PGBackend):
 
     def submit(self, oid, state, entries, log_omap, acting, on_commit,
                log_rm=None, pre_txn=None, on_submitted=None,
-               trace=None):
+               trace=None, trop=None):
         txn = self._object_txn(oid, state, log_omap, log_rm)
         if pre_txn is not None:
             # snapshot clone-on-write rides the SAME transaction: the
@@ -802,7 +806,7 @@ class ECBackend(PGBackend):
 
     def submit(self, oid, state, entries, log_omap, acting, on_commit,
                log_rm=None, on_submitted=None, on_error=None,
-               trace=None):
+               trace=None, trop=None):
         # full-object rewrite/delete supersedes any cached stripes
         self.cache.invalidate(oid)
         n = self.k + self.m
@@ -896,13 +900,14 @@ class ECBackend(PGBackend):
                                    crcs=res[1]),
                 self._encode_error_fn(tid, on_submitted, on_error,
                                       state),
-                fused=True, size=len(state.data))
+                fused=True, size=len(state.data), trop=trop)
             return
         self._encode_then_fanout(
             planes,
             lambda coding: fanout(
                 self._chunks_of(planes, coding, self.k, self.m)),
-            self._encode_error_fn(tid, on_submitted, on_error))
+            self._encode_error_fn(tid, on_submitted, on_error),
+            trop=trop)
 
     def _chunks_dev(self, planes: np.ndarray, coding) -> List[DeviceBuf]:
         """k+m chunk payload HANDLES for the fan-out: data chunks view
@@ -1232,8 +1237,8 @@ class ECBackend(PGBackend):
                        on_commit: Callable[[], None],
                        log_rm: Optional[List[str]] = None,
                        on_submitted: Optional[Callable[[], None]] = None,
-                       on_error: Optional[Callable[[], None]] = None
-                       ) -> None:
+                       on_error: Optional[Callable[[], None]] = None,
+                       trop=None) -> None:
         """Write merged stripes [s0, s0+len) as per-shard EXTENTS — only
         the touched stripes move (reference three-stage RMW,
         ECBackend.cc:1791 start_rmw / :1892 try_reads_to_commit).
@@ -1340,4 +1345,4 @@ class ECBackend(PGBackend):
 
         self._encode_then_fanout(
             planes, lambda coding: fanout(np.asarray(coding)),
-            unwind_with_cache)
+            unwind_with_cache, trop=trop)

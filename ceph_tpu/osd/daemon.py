@@ -230,6 +230,30 @@ class OSDService(Dispatcher):
         _dq.pool.configure(
             int(ctx.conf.get("tpu_staging_slot_kib")) << 10,
             int(ctx.conf.get("tpu_staging_slots")))
+        # device-runtime watcher (PR 10): XLA compile/dispatch
+        # attribution — process-wide like the queue, registered per
+        # daemon as osd.N.xla exactly like osd.N.tpuq; the flight
+        # recorder rides this context's gather ring (subsys tpu) and
+        # storm WARNs its cluster-log channel
+        from ceph_tpu.tpu.devwatch import watch as _dw_watch
+
+        _dw = _dw_watch()
+        self._devwatch = _dw
+        ctx.perf.register(f"osd.{whoami}.xla", _dw.perf)
+        _dw.attach_log(ctx.log)
+        _dw.configure(
+            window_s=float(ctx.conf.get("tpu_recompile_storm_window")),
+            min_sigs=int(ctx.conf.get("tpu_recompile_storm_min_sigs")))
+
+        def _dw_conf(name, val, _dw=_dw) -> None:
+            if name == "tpu_recompile_storm_window":
+                _dw.configure(window_s=float(val))
+            elif name == "tpu_recompile_storm_min_sigs":
+                _dw.configure(min_sigs=int(val))
+
+        self._devwatch_observer = ctx.conf.add_observer(
+            ("tpu_recompile_storm_window",
+             "tpu_recompile_storm_min_sigs"), _dw_conf)
 
     # -- lifecycle --------------------------------------------------------
     def _apply_fault_conf(self) -> None:
@@ -547,6 +571,7 @@ class OSDService(Dispatcher):
         # leaks, reported on the optracker.LEAKS sanitizer channel
         self.op_tracker.drain()
         self.ctx.conf.remove_observer(self._complaint_obs)
+        self.ctx.conf.remove_observer(self._devwatch_observer)
 
     @property
     def addr(self) -> Addr:

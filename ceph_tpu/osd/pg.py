@@ -1813,7 +1813,8 @@ class PG:
                               log_omap, self.acting, on_commit,
                               log_rm=log_rm, on_submitted=on_submitted,
                               on_error=self._write_unwind_fn(
-                                  msg.oid, entry))
+                                  msg.oid, entry),
+                              trop=getattr(msg, "trop", None))
         self._arm_write_deadline(_replied, lambda: reply_once(
             m.MOSDOpReply(self.pgid, self.osd.epoch(), msg.oid,
                           msg.ops, result=EAGAIN)))
@@ -1880,6 +1881,10 @@ class PG:
             # peer sub-writes inherit this op's span context on the
             # wire, so each peer's store-commit batch opens a child
             kw["trace"] = span.context()
+        # the tracked op rides to the encode queue so a live XLA
+        # compile overlapping the batch gets blamed on ITS timeline
+        # (compile_wait annotation + lat_compile_wait_us)
+        kw["trop"] = getattr(msg, "trop", None)
         # the queued write IS the newest state (published BEFORE the
         # backend submit, so a same-object successor admitted at
         # on_submitted reads its predecessor's projected state):

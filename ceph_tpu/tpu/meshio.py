@@ -32,6 +32,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ceph_tpu.tpu.devwatch import instrumented_jit
+
 
 def _shard_map():
     import jax
@@ -141,7 +143,7 @@ class MeshCompute:
                 in_specs=P(None, "stripe"),
                 out_specs=P(None, "stripe"),
             )
-            prog = jax.jit(sm)
+            prog = instrumented_jit(sm, family="meshio")
             self._progs[key] = prog
         if isinstance(x, np.ndarray):
             xp, n = self._pad_cols(np.ascontiguousarray(x, dtype=np.uint8))
@@ -190,7 +192,7 @@ class MeshCompute:
                 in_specs=P(None, "stripe"),
                 out_specs=P(None, "stripe"),
             )
-            prog = jax.jit(sm)
+            prog = instrumented_jit(sm, family="meshio")
             self._progs[key] = prog
         if isinstance(survivors, np.ndarray):
             sp, n = self._pad_cols(
@@ -231,7 +233,7 @@ class MeshCompute:
                 in_specs=P(None, "stripe"),
                 out_specs=P(),
             )
-            prog = jax.jit(sm)
+            prog = instrumented_jit(sm, family="meshio")
             self._progs[key] = prog
         pp, _n = self._pad_cols(
             np.ascontiguousarray(planes, dtype=np.uint8))

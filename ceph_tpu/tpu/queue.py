@@ -23,15 +23,17 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ceph_tpu.core.perf import PerfCounters
+from ceph_tpu.tpu import devwatch
 from ceph_tpu.tpu.staging import DevPathStats, StagingPool
 
 
 class _Job:
     __slots__ = ("codec", "planes", "future", "kind", "sig", "size",
-                 "t_enq")
+                 "t_enq", "trop")
 
     def __init__(self, codec, planes: np.ndarray, kind: str = "enc",
-                 sig: Tuple[int, ...] = (), size: int = 0) -> None:
+                 sig: Tuple[int, ...] = (), size: int = 0,
+                 trop=None) -> None:
         self.codec = codec
         self.planes = planes
         self.kind = kind      # "enc" | "encp" (fused crc) | "dec"
@@ -40,6 +42,10 @@ class _Job:
         # accounting: stripe-tail zeros are device-side fill, not
         # transferred bytes)
         self.t_enq = time.monotonic()  # queue-wait attribution
+        # the client op riding this job (TrackedOp), for op-level
+        # compile blame: a batch whose wait window overlapped a live
+        # XLA compile annotates the op with compile_wait
+        self.trop = trop
         self.future: Future = Future()
 
 
@@ -113,6 +119,21 @@ class StripeBatchQueue:
         # batch spans (width/kind per dispatch) ride this tracer when
         # set AND enabled; bound by daemon init to its context's tracer
         self.tracer = None
+        # the batch the device worker is executing RIGHT NOW (kind,
+        # jobs, shapes, start stamp) — the crash flight recorder's
+        # "what was the device doing when we died" evidence; None when
+        # the worker is idle/coalescing
+        self._inflight_info: "Dict | None" = None
+
+    def inflight_batch(self) -> "Dict | None":
+        """Snapshot of the batch currently on the device worker (for
+        CrashArchive's device section); None when idle."""
+        info = self._inflight_info
+        if info is None:
+            return None
+        out = dict(info)
+        out["age_s"] = round(time.monotonic() - out.pop("t0"), 3)
+        return out
 
     def sample(self, window_s: float = 10.0) -> None:
         """Refresh the device-visibility gauges: called off the data
@@ -143,10 +164,12 @@ class StripeBatchQueue:
             self._started = False
 
     # -- API --------------------------------------------------------------
-    def encode_async(self, codec, planes: np.ndarray) -> Future:
+    def encode_async(self, codec, planes: np.ndarray,
+                     trop=None) -> Future:
         """planes: uint8 [k, n] -> Future of coding planes [m, n]."""
         self.start()
-        job = _Job(codec, np.ascontiguousarray(planes, dtype=np.uint8))
+        job = _Job(codec, np.ascontiguousarray(planes, dtype=np.uint8),
+                   trop=trop)
         self._q.put(job)
         return job.future
 
@@ -154,7 +177,7 @@ class StripeBatchQueue:
         return self.encode_async(codec, planes).result()
 
     def encode_crc_async(self, codec, planes: np.ndarray,
-                         size: int = 0) -> Future:
+                         size: int = 0, trop=None) -> Future:
         """Fused encode + per-shard crc32c: planes uint8 [k, n] ->
         Future of (coding [m, n], crcs u32 [k+m]).
 
@@ -165,12 +188,13 @@ class StripeBatchQueue:
         forcing a d2h fetch (or host re-read) of payload bytes."""
         self.start()
         job = _Job(codec, np.ascontiguousarray(planes, dtype=np.uint8),
-                   kind="encp", size=size)
+                   kind="encp", size=size, trop=trop)
         self._q.put(job)
         return job.future
 
     def decode_data_async(self, codec,
-                          available: "Dict[int, np.ndarray]") -> Future:
+                          available: "Dict[int, np.ndarray]",
+                          trop=None) -> Future:
         """Survivor planes {shard: [n]} -> Future of data planes [k, n].
 
         The decode twin of encode_async: jobs sharing a survivor
@@ -183,7 +207,7 @@ class StripeBatchQueue:
         stacked = np.ascontiguousarray(
             np.stack([np.asarray(available[i], dtype=np.uint8)
                       for i in sig]))
-        job = _Job(codec, stacked, kind="dec", sig=sig)
+        job = _Job(codec, stacked, kind="dec", sig=sig, trop=trop)
         self._q.put(job)
         return job.future
 
@@ -252,6 +276,21 @@ class StripeBatchQueue:
         return np.asarray(codec.encode_array(stacked))
 
     def _run_batch(self, batch: List[_Job]) -> None:
+        # publish the in-flight batch BEFORE any dispatch work (incl.
+        # the failpoint: a barrier'd/stalled dispatch must show up in
+        # the crash device section with its shapes); cleared by the
+        # worker loop right after this call returns
+        shapes = [list(j.planes.shape) for j in batch]
+        self._inflight_info = {
+            "kind": batch[0].kind, "jobs": len(batch),
+            "shapes": shapes, "t0": time.monotonic()}
+        try:
+            self._dispatch_batch(batch, shapes)
+        finally:
+            self._inflight_info = None
+
+    def _dispatch_batch(self, batch: List[_Job],
+                        shapes: List[List[int]]) -> None:
         from ceph_tpu.core import failpoint as fp
 
         if fp.enabled("queue.batch.dispatch"):
@@ -350,6 +389,37 @@ class StripeBatchQueue:
                            (t_compute - t_start) * 1e6)
             self.perf.hinc("lat_encq_dispatch_us",
                            (t_done - t_compute) * 1e6)
+            # device-runtime flight recorder + op-level compile blame:
+            # a job whose [enqueue, compute-done] window overlapped a
+            # live XLA compile was stalled BY that compile (one device
+            # worker, one compiler) — annotate the op so slow-op
+            # forensics can tell compile stalls from queue depth
+            dw = devwatch.watch()
+            dw.note_batch(batch[0].kind, len(batch), shapes,
+                          t_compute - t_start)
+            # compile-blame fast path: in steady state no compile is
+            # live and none ended after the oldest job enqueued, so
+            # the whole per-job overlap scan (span-ring walk under the
+            # devwatch lock) is skipped
+            if dw.compile_activity_since(
+                    min(j.t_enq for j in batch)):
+                for j in batch:
+                    if j.trop is None:
+                        continue
+                    wait = dw.compile_overlap_s(j.t_enq, t_compute)
+                    if wait <= 0:
+                        continue
+                    # annotation: timeline evidence only — it must
+                    # NOT advance the stage-delta baseline (the
+                    # adjacent commit/fanout histograms would read
+                    # from the blame stamp instead of their stage)
+                    j.trop.mark_event("compile_wait",
+                                      f"{wait * 1e3:.1f}ms",
+                                      annotation=True)
+                    trk = getattr(j.trop, "tracker", None)
+                    if trk is not None and trk.perf is not None:
+                        trk.perf.hinc("lat_compile_wait_us",
+                                      wait * 1e6)
             tr = self.tracer
             if tr is not None and tr.enabled:
                 # batch span record: job width is THE coalescing

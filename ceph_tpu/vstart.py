@@ -131,6 +131,20 @@ class VStartCluster:
         # every OSD joins the ops-module slow-op/in-flight merge
         for i, svc in self.osds.items():
             mgr.register_service(f"osd.{i}", svc)
+        # durable clusters get a crash spool the CrashModule serves
+        # (`ceph crash ls` / `crash info`): unhandled daemon-thread /
+        # main-thread / event-loop deaths archive here with the
+        # device section (queue depth, in-flight batch, last compiles)
+        if self.data_dir is not None:
+            import os as _os
+
+            from ceph_tpu.core.crash import CrashArchive
+
+            arch = CrashArchive(_os.path.join(self.data_dir, "crash"),
+                                entity="cluster", log=self.ctx.log)
+            arch.install()
+            mgr.modules["crash"].add_archive(arch)
+            self._crash_archive = arch
         mgr.osdmap = self.leader().osdmap
         # cluster telemetry feeds resolve the CURRENT leader per call:
         # an election mid-session must not leave the mgr reading a
@@ -325,6 +339,9 @@ class VStartCluster:
 
     def shutdown(self) -> None:
         self._stop_evt.set()
+        arch = getattr(self, "_crash_archive", None)
+        if arch is not None:
+            arch.uninstall()  # global hooks must not outlive the cluster
         mgr = getattr(self, "mgr", None)
         if mgr is not None:
             try:

@@ -46,28 +46,54 @@ def trial(conf_extra, tag):
             p.result(60.0)
         return n / (time.perf_counter() - t0)
 
+    from ceph_tpu.tpu.devwatch import watch
+
     with VStartCluster(n_mons=1, n_osds=3, conf=conf_extra) as c:
         ec = c.create_pool("ab_ec", size=3, pool_type="erasure",
                            ec_profile="k=2 m=1")
         ioec = c.client().ioctx(ec)
-        run(ioec, 32, b"w" * 4096, "warm")  # peering, sockets, jit
-        return {
+        # warm BOTH payload shapes UNTIL DRY: coalesced batch widths
+        # (the crc kernel's pow2 row buckets) depend on queue
+        # pressure, so rounds match the measured lengths and repeat
+        # until a whole round compiles nothing (the PR 10 devwatch
+        # discipline: no discarded trials — the steady windows PROVE
+        # they were steady)
+        for pay, n, sub in ((b"w" * 4096, 192, "warm4k"),
+                            (b"W" * 65536, 64, "warm64")):
+            for r in range(4):
+                w0 = watch().compile_totals()
+                run(ioec, n, pay, f"{sub}{r}")
+                if watch().compile_totals()["compiles"] \
+                        == w0["compiles"]:
+                    break
+        x0 = watch().compile_totals()
+        out = {
             "ec64k_write_iops": round(
                 run(ioec, 64, b"b" * 65536, "64k"), 1),
             "ec4k_write_iops": round(
                 run(ioec, 192, b"s" * 4096, "4k"), 1),
         }
+        x1 = watch().compile_totals()
+        out["steady_compiles"] = int(x1["compiles"] - x0["compiles"])
+        out["steady_compile_s"] = round(
+            x1["compile_seconds"] - x0["compile_seconds"], 4)
+        # fail LOUDLY: a compile inside the measured window means the
+        # trial was warmup-skewed and its IOPS are not comparable
+        assert out["steady_compiles"] == 0, (
+            f"steady-state window compiled "
+            f"{out['steady_compiles']}x ({out['steady_compile_s']}s) "
+            f"— widen the warmup, do not hand-discard trials")
+        return out
 
 
 def main() -> None:
     n_pairs = int(sys.argv[1]) if len(sys.argv) > 1 else 4
     a_conf = {"osd_pg_stats_interval": 3600.0}
     b_conf = {"osd_pg_stats_interval": 0.25}
-    # discarded process-wide warmup: the FIRST cluster pays every XLA
-    # compile (both payload shapes), which otherwise lands entirely in
-    # pair 0's A arm and fabricates a B/A skew
-    warm = trial(a_conf, "warmup")
-    print(json.dumps({"warmup_discarded": warm}), flush=True)
+    # no hand-discarded warmup trial anymore (PR 10): every trial
+    # warms both payload shapes in-cluster and ASSERTS its measured
+    # windows compiled nothing (steady_compiles == 0 via devwatch) —
+    # the pair-0 "XLA-compile skew" class is now detected, not dodged
     pairs = []
     for i in range(n_pairs):
         a = trial(a_conf, f"a{i}")

@@ -47,6 +47,7 @@ os.environ.setdefault("CEPH_TPU_LOOP_STALL_MS", "1000")
 
 from ceph_tpu.core import optracker as _optracker
 from ceph_tpu.msg import messenger as _messenger
+from ceph_tpu.tpu import devwatch as _devwatch
 
 
 @pytest.fixture(autouse=True)
@@ -55,6 +56,7 @@ def _sanitizers():
         lockdep.enable(True)  # re-assert: a test may have toggled it
     _messenger.LOOP_STALLS.clear()
     _optracker.LEAKS.clear()
+    _devwatch.GUARD_VIOLATIONS.clear()
     yield
     stalls, _messenger.LOOP_STALLS[:] = (list(_messenger.LOOP_STALLS), [])
     if float(os.environ.get("CEPH_TPU_LOOP_STALL_MS", "0") or 0) > 0:
@@ -71,3 +73,12 @@ def _sanitizers():
         "TrackedOp lifecycle leak(s) — replied ops must be finish()ed "
         "into history, not left in the in-flight table: "
         + "; ".join(leaks))
+    # devwatch steady-state guard (the lockdep shape: machinery armed
+    # for the whole suite, violations recorded only inside explicitly
+    # declared steady-state sections): a test whose steady section
+    # compiled a fresh XLA shape has a warmup/padding bug
+    guard, _devwatch.GUARD_VIOLATIONS[:] = (
+        list(_devwatch.GUARD_VIOLATIONS), [])
+    assert not guard, (
+        "XLA compile(s) inside a declared steady-state section: "
+        + "; ".join(guard))

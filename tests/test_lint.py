@@ -482,6 +482,64 @@ def test_span_discipline_never_baseline(tmp_path):
     assert v.key not in violations_to_baseline([v])["entries"]
 
 
+def test_no_unwatched_jit_flags_every_raw_spelling(tmp_path):
+    code = (
+        "import functools\n"
+        "import jax\n"
+        "from jax.experimental import pallas as pl\n"
+        "f = jax.jit(lambda x: x)\n"                    # call
+        "@jax.jit\n"                                    # decorator
+        "def g(x):\n"
+        "    return x\n"
+        "h = functools.partial(jax.jit, static_argnames=('n',))\n"
+        "def k(kern):\n"
+        "    return pl.pallas_call(kern, out_shape=None)\n")
+    bad = _lint(tmp_path, code, "no-unwatched-jit")
+    assert [v.line for v in bad] == [4, 5, 8, 10]
+    # importing the raw entry point by name is flagged too
+    imp = _lint(tmp_path, (
+        "from jax import jit\n"
+        "from jax.experimental.pallas import pallas_call\n"),
+        "no-unwatched-jit")
+    assert [v.line for v in imp] == [1, 2]
+    # the devwatch wrappers are the sanctioned spelling
+    ok = _lint(tmp_path, (
+        "from ceph_tpu.tpu.devwatch import instrumented_jit\n"
+        "f = instrumented_jit(lambda x: x, family='fam')\n"),
+        "no-unwatched-jit")
+    assert not ok
+    # devwatch itself is exempt (it owns the raw entry points)
+    exempt = _lint(tmp_path, (
+        "import jax\n"
+        "f = jax.jit(lambda x: x)\n"), "no-unwatched-jit",
+        rel="ceph_tpu/tpu/devwatch.py")
+    assert not exempt
+
+
+def test_no_unwatched_jit_never_baseline(tmp_path):
+    from ceph_tpu.analysis.framework import (Violation,
+                                             violations_to_baseline)
+
+    v = Violation(check="no-unwatched-jit",
+                  path="ceph_tpu/ops/newkernel.py", line=1,
+                  scope="f", detail="jax.jit", message="m")
+    assert v.key not in violations_to_baseline([v])["entries"]
+
+
+def test_jax_purity_follows_instrumented_jit(tmp_path):
+    """The devwatch wrappers are trace entry points for purity
+    analysis too — converting jax.jit -> instrumented_jit must not
+    blind the jax-purity check."""
+    code = (
+        "import numpy as np\n"
+        "from ceph_tpu.tpu.devwatch import instrumented_jit\n"
+        "def kernel(x):\n"
+        "    return np.sum(x)\n"               # flagged: np in traced fn
+        "f = instrumented_jit(kernel, family='fam')\n")
+    bad = _lint(tmp_path, code, "jax-purity")
+    assert len(bad) == 1 and bad[0].detail == "np.sum"
+
+
 def test_failpoint_names_never_baseline(tmp_path):
     from ceph_tpu.analysis.framework import (Violation,
                                              violations_to_baseline)

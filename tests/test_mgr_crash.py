@@ -101,6 +101,77 @@ def test_crash_hook_captures_thread_death(tmp_path):
     assert "thread-die" in info["exception"]
 
 
+def test_crash_sys_excepthook_captures_main_thread_death(tmp_path):
+    """Satellite fix: only threading.excepthook was hooked, so a
+    MAIN-thread death left no crash report.  install() now hooks
+    sys.excepthook too (chained: the previous hook still runs)."""
+    import sys
+
+    arch = CrashArchive(str(tmp_path / "crash"), entity="osd.4")
+    prev_called = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: prev_called.append(a)
+    try:
+        arch.install()
+        try:
+            raise KeyError("main-thread-die")
+        except KeyError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        arch.uninstall()
+        sys.excepthook = prev
+    crashes = arch.ls()
+    assert len(crashes) == 1
+    assert "main-thread-die" in arch.info(
+        crashes[0]["crash_id"])["exception"]
+    assert prev_called  # the chained previous hook still ran
+
+
+def test_crash_asyncio_loop_death_leaves_report(tmp_path):
+    """An exception escaping an event-loop callback is archived via
+    the loop exception handler (messengers wire their loops through
+    install_loop_handler at construction)."""
+    import asyncio
+
+    from ceph_tpu.core.crash import install_loop_handler
+
+    arch = CrashArchive(str(tmp_path / "crash"), entity="osd.5")
+    arch.install()
+    loop = asyncio.new_event_loop()
+    install_loop_handler(loop)
+    try:
+        async def die():
+            raise ValueError("loop-task-die")
+
+        async def driver():
+            asyncio.ensure_future(die())  # never awaited: escapes
+            await asyncio.sleep(0.05)
+
+        loop.run_until_complete(driver())
+    finally:
+        arch.uninstall()
+        loop.close()
+    crashes = arch.ls()
+    assert len(crashes) == 1
+    assert "loop-task-die" in arch.info(
+        crashes[0]["crash_id"])["exception"]
+
+
+def test_crash_report_has_device_section_by_default(tmp_path):
+    """record() captures the device-runtime state (queue depth,
+    staging, last compiles) without any explicit wiring — a wedged
+    device worker leaves a diagnosable corpse."""
+    arch = CrashArchive(str(tmp_path / "crash"), entity="osd.6")
+    try:
+        raise RuntimeError("boom-with-device")
+    except RuntimeError as e:
+        cid = arch.record(e)
+    info = arch.info(cid)
+    dev = info["device"]
+    assert "queue_depth" in dev
+    assert "last_compiles" in dev and "live_compiles" in dev
+
+
 def test_crash_prune(tmp_path):
     arch = CrashArchive(str(tmp_path / "crash"))
     for i in range(5):

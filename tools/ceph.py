@@ -71,6 +71,13 @@ def _parse(tokens):
         return {"prefix": "health"}
     if t[0] == "progress":
         return {"prefix": "progress"}
+    if t[0] == "crash":
+        if t[1] == "ls":
+            return {"prefix": "crash ls"}
+        if t[1] == "info":
+            return {"prefix": "crash info", "id": t[2]}
+    if t[:3] == ["device", "compile", "dump"]:
+        return {"prefix": "device compile dump"}
     if t[:2] == ["prometheus", "export"]:
         return {"prefix": "prometheus export"}
     if t[:2] == ["ops", "dump_slow"]:
@@ -174,7 +181,8 @@ def main(argv=None) -> int:
     # reference forwards these mon->mgr; here the CLI owns the hop
     MGR_PREFIXES = {"progress", "prometheus export", "mgr status",
                     "ops dump_slow", "ops dump_in_flight",
-                    "ops latency"}
+                    "ops latency", "crash ls", "crash info",
+                    "device compile dump"}
 
     rc = 0
     with VStartCluster(n_mons=n_mons, n_osds=n_osds,

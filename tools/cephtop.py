@@ -111,6 +111,46 @@ def render_slow(ops: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def _device_dump(socket_paths: List[str]) -> dict:
+    """The first answering socket's `device compile dump` (the watcher
+    is process-wide, so any daemon socket of the process serves the
+    same table)."""
+    for path in socket_paths:
+        try:
+            return admin_command(path, "device compile dump")
+        except OSError:
+            continue
+    return {}
+
+
+def render_device(d: dict) -> str:
+    fams = d.get("families", {})
+    if not fams:
+        return "no device compile events yet"
+    head = (f"{'family':<16} {'compiles':>9} {'compile_s':>10} "
+            f"{'shapes':>7} {'hits':>9} {'traces':>7}")
+    lines = [head, "-" * len(head)]
+    for name, f in sorted(fams.items()):
+        lines.append(
+            f"{name:<16} {f['compiles']:>9} {f['compile_s']:>10.3f} "
+            f"{f['distinct_signatures']:>7} {f['cache_hits']:>9} "
+            f"{f['traces']:>7}")
+    tot = d.get("totals", {})
+    lines.append(
+        f"total: {tot.get('compiles', 0)} compiles, "
+        f"{tot.get('compile_seconds', 0.0)}s compiling, "
+        f"{tot.get('distinct_shapes', 0)} distinct shapes, "
+        f"{tot.get('cache_hits', 0)} cache hits")
+    for s in d.get("storms", []):
+        lines.append(
+            f"STORM: {s['family']} x{s['distinct_signatures']} sigs "
+            f"in {s['window_s']}s, churning {s['churning']}")
+    for lc in d.get("live_compiles", []):
+        lines.append(f"LIVE: {lc['family']} compiling for "
+                     f"{lc['age_s']}s")
+    return "\n".join(lines)
+
+
 def _cluster_status(socket_paths: List[str]) -> dict:
     """The first answering mon's health + PGMap digest (the `mon.N
     status` admin command registered by every monitor)."""
@@ -168,11 +208,20 @@ def main(argv=None) -> int:
     p.add_argument("--cluster", action="store_true",
                    help="cluster pane: mon health + PGMap digest "
                         "(pg states, degraded totals, io rates)")
+    p.add_argument("--device", action="store_true",
+                   help="device pane: per-kernel-family XLA compile "
+                        "table (compiles, wall, shapes, hits, storms)")
     p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
     if not args.socket:
         print("cephtop: at least one --socket required", file=sys.stderr)
         return 2
+
+    if args.device:
+        d = _device_dump(args.socket)
+        print(json.dumps(d, indent=1) if args.as_json
+              else render_device(d))
+        return 0
 
     if args.cluster:
         st = _cluster_status(args.socket)

@@ -135,6 +135,15 @@ def main():
 
     guarded("crush_1m_mplacements_per_s", crush_rate)
 
+    # the per-family compile table (PR 10): how much of this run's
+    # wall went to XLA compiles, per kernel family — the artifact
+    # carries its own warmup-skew evidence instead of guesswork
+    from ceph_tpu.tpu.devwatch import watch
+
+    out["xla_compile"] = {
+        fam: watch().family_stats(fam)
+        for fam in sorted(watch().dump()["families"])}
+
     print(flush())
     return 0
 
