@@ -6,6 +6,7 @@ from ceph_tpu.analysis.checks.d2h import NoD2HOnHotPath
 from ceph_tpu.analysis.checks.failpoint_names import FailpointNameRegistry
 from ceph_tpu.analysis.checks.jax_purity import JaxPurity
 from ceph_tpu.analysis.checks.locks import NamedLocks
+from ceph_tpu.analysis.checks.qos_classes import QosClassRegistry
 from ceph_tpu.analysis.checks.silent_except import SilentExcept
 from ceph_tpu.analysis.checks.sleep_poll import NoSleepPoll
 from ceph_tpu.analysis.checks.span_discipline import SpanDiscipline
@@ -20,6 +21,7 @@ ALL_CHECKS = (
     JaxPurity(),
     NoD2HOnHotPath(),
     FailpointNameRegistry(),
+    QosClassRegistry(),
     SpanDiscipline(),
     NoUnwatchedJit(),
 )

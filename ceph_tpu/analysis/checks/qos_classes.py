@@ -1,0 +1,67 @@
+"""qos-class-registry: QoS class names at enqueue sites must be
+declared in the profile registry (``ceph_tpu.osd.qos.KNOWN_QOS_CLASSES``).
+
+A ``qos_class=`` literal is a CONTRACT with the dmClock profile table:
+a typo'd name silently rides the ``best_effort`` triple — the
+reservation/limit the site meant to claim never applies, and nothing
+fails (the scheduler is work-conserving, so the ops still flow and the
+fairness regression only shows under saturation).  This is the
+failpoint-name-registry shape applied to scheduler classes: literal
+names are validated against the one table; dynamic values are the
+sanctioned ``classify_op`` resolver path and pass.
+
+Baseline-free from day one: the registry ships with this PR, so there
+is no accepted debt — every violation is a hard error and
+``--write-baseline`` refuses to record them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from ceph_tpu.analysis.framework import (
+    NEVER_BASELINE_PREFIXES, Check, SourceFile, Violation,
+    enclosing_scope,
+)
+
+
+class QosClassRegistry(Check):
+    name = "qos-class-registry"
+    description = ("qos_class= literals at enqueue sites must exist in "
+                   "qos.KNOWN_QOS_CLASSES (typo = silent best_effort)")
+    scopes = ("ceph_tpu", "tools")
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        from ceph_tpu.osd.qos import KNOWN_QOS_CLASSES
+
+        out: List[Violation] = []
+        for f in files:
+            if f.rel.endswith("osd/qos.py"):
+                continue  # the registry itself
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "qos_class":
+                        continue
+                    v = kw.value
+                    if not (isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)):
+                        continue  # dynamic = the classify_op path
+                    if v.value not in KNOWN_QOS_CLASSES:
+                        out.append(Violation(
+                            check=self.name, path=f.rel,
+                            line=node.lineno,
+                            scope=enclosing_scope(f.tree, node.lineno),
+                            detail=f"qos_class={v.value!r}",
+                            message=(f"QoS class {v.value!r} is not in "
+                                     "qos.KNOWN_QOS_CLASSES — a typo'd "
+                                     "class silently rides best_effort"),
+                        ))
+        return out
+
+
+# scheduler-class plumbing must stay correct-by-construction: refuse
+# to baseline ANY violation of this check, anywhere
+NEVER_BASELINE_PREFIXES.append((QosClassRegistry.name, ""))

@@ -114,8 +114,39 @@ def _opts() -> List[Option]:
           "not mark live-but-starved peers down (ROUND6 bench note)"),
         # -- osd ------------------------------------------------------------
         O("osd_op_num_shards", int, 4, "sharded op queue shards", runtime=False),
-        O("osd_op_queue", str, "wpq",
-          "op scheduler: wpq (priority) or mclock (QoS)", runtime=False),
+        O("osd_op_queue", str, "mclock",
+          "op scheduler: mclock (dmClock QoS, default) or fifo "
+          "(priority heap; wpq is the legacy spelling)",
+          enum=("mclock", "fifo", "wpq"), runtime=False),
+        O("osd_qos_profiles", str, "",
+          "QoS profile overrides (osd/qos.py DSL): "
+          "'<target>=<r>:<w>:<l>;...' where target is a base class "
+          "(client, recovery, scrub, snaptrim, ...), tenant:<entity>, "
+          "or pool:<id>; runtime-updatable (qos set retunes through "
+          "the conf observer)"),
+        O("osd_qos_client_rate_window", float, 5.0,
+          "window (seconds) over which the QoS scheduler derives the "
+          "client-IOPS pressure signal for the recovery feedback "
+          "controller"),
+        O("osd_recovery_feedback", bool, True,
+          "close the recovery-vs-client loop: widen the recovery "
+          "window when client IOPS are idle, clamp it under client "
+          "pressure (off = the fixed osd_recovery_max_active window)"),
+        O("osd_recovery_idle_client_iops", float, 2.0,
+          "client ops/s below which clients count as idle and the "
+          "recovery window widens"),
+        O("osd_recovery_busy_client_iops", float, 50.0,
+          "client ops/s at which the recovery window clamps to half"),
+        O("osd_recovery_feedback_widen", int, 4,
+          "multiplier applied to osd_recovery_max_active while "
+          "clients are idle", minval=1),
+        O("osd_client_message_cap", int, 256,
+          "per-client-connection in-flight op cap at the messenger "
+          "(0 = uncapped); an abusive tenant queues at ITS socket, "
+          "not in the shared workqueue (reference Throttle role)"),
+        O("osd_client_message_size_cap", int, 64 << 20,
+          "per-client-connection in-flight payload-byte cap at the "
+          "messenger (0 = uncapped)"),
         O("osd_op_complaint_time", float, 30.0,
           "seconds after which an op counts as slow (OpTracker: drives "
           "the dump_historic_slow_ops ring admission; runtime-updatable "

@@ -45,14 +45,20 @@ TraceContext = Tuple[int, int]  # (trace_id, span_id)
 #
 # Primary write-pipeline order (each histogram buckets the latency
 # since the PREVIOUS timeline event, in microseconds):
-#   initiated -> queued_for_pg -> reached_pg -> [staged] -> admitted
-#   -> submitted -> commit -> [ack_gated] -> commit_sent
+#   initiated -> queued_for_pg -> qos_admitted -> reached_pg ->
+#   [staged] -> admitted -> submitted -> commit -> [ack_gated]
+#   -> commit_sent
 STAGES: Dict[str, str] = {
     # client / generic
     "sent": "",                # client: op handed to the messenger
     "initiated": "",           # tracker entry created (messenger receive)
     # daemon dispatch
     "queued_for_pg": "lat_recv_us",      # decode -> sharded-queue entry
+    # QoS admission (PR 13): the dmClock (or fifo A/B) scheduler
+    # granted this op a workqueue slot — the delta since
+    # queued_for_pg is the scheduler wait, the per-tenant fairness
+    # number; reached_pg then measures only the dispatch residual
+    "qos_admitted": "lat_qos_wait_us",
     "reached_pg": "lat_queue_us",        # queue wait: a shard picked it up
     # write pipeline
     "staged": "lat_staging_us",          # pinned staging-pool acquire

@@ -4,9 +4,23 @@ Reference: ThreadPool/WorkQueue (src/common/WorkQueue.h:28,266) and the
 OSD's sharded op queue (src/osd/OSD.cc:2030 op_shardedwq, OSDShard at
 :2065): items hash to a shard by ordering token (pg id), each shard is
 a thread draining a priority queue, so per-PG ordering is preserved
-while PGs run in parallel.  mClock/WPQ scheduling reduces here to a
-(priority, seq) heap per shard — QoS class weights can be layered on
-the priority without changing the structure.
+while PGs run in parallel.
+
+Two schedulers drain a shard (conf ``osd_op_queue``):
+
+- ``mclock`` (default): a dmClock reservation/weight/limit queue per
+  shard.  With a ``qos`` scheduler attached (osd/qos.py) the shard
+  queues come from it — tenant-resolved classes, cost-aware tags,
+  conf-driven profiles; standalone, a bare MClockQueue over the
+  reference class defaults.
+- ``fifo`` (alias ``wpq``): the legacy (priority, seq) heap — the A/B
+  arm QoS measurements compare against.
+
+``queue()`` accepts an ``on_admit(cls, phase, wait_s)`` callback fired
+on the worker the moment the item is dequeued, BEFORE it runs: the
+daemon marks the op's ``qos_admitted`` stage and feeds the per-class
+wait histograms from it, under either scheduler (the fifo arm reports
+phase ``fifo`` so A/B p99s come from the same stage histograms).
 """
 
 from __future__ import annotations
@@ -14,6 +28,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from typing import Any, Callable, Hashable, List, Optional, Tuple
 
 
@@ -37,17 +52,22 @@ class ShardedWorkQueue:
         process: Callable[[Any], None],
         on_error: Optional[Callable[[Any, BaseException], None]] = None,
         scheduler: str = "wpq",
+        qos=None,
     ) -> None:
         self.name = name
         self.process = process
         self.on_error = on_error
         self.scheduler = scheduler
+        self.qos = qos
         if scheduler == "mclock":
-            from ceph_tpu.osd.mclock import MClockQueue
+            if qos is not None:
+                self._mclock: Optional[List] = [
+                    qos.make_shard_queue() for _ in range(num_shards)
+                ]
+            else:
+                from ceph_tpu.osd.mclock import MClockQueue
 
-            self._mclock: Optional[List] = [
-                MClockQueue() for _ in range(num_shards)
-            ]
+                self._mclock = [MClockQueue() for _ in range(num_shards)]
         else:
             self._mclock = None
         self._shards: List[List[Tuple[int, int, Any]]] = [
@@ -70,22 +90,26 @@ class ShardedWorkQueue:
             t.start()
 
     def queue(self, token: Hashable, item: Any, priority: int = 63,
-              qos_class: Optional[str] = None) -> None:
+              qos_class: Optional[str] = None, qos_cost: float = 1.0,
+              on_admit: Optional[Callable[[str, str, float], None]] = None
+              ) -> None:
         """Higher priority dispatches first; same token stays ordered.
         Under the mclock scheduler, `qos_class` (or the priority
-        mapping) selects the dmClock reservation/weight/limit class."""
+        mapping) selects the dmClock class and `qos_cost` advances its
+        tags (payload-byte charging).  `on_admit` fires at dequeue."""
         if self._stop:
             raise RuntimeError(f"work queue {self.name} is stopped")
         shard = hash(token) % len(self._shards)
+        cls = qos_class or _prio_to_class(priority)
+        entry = (item, on_admit, time.monotonic(), cls)
         with self._drain_cond:
             self._inflight += 1
         with self._conds[shard]:
             if self._mclock is not None:
-                self._mclock[shard].enqueue(
-                    qos_class or _prio_to_class(priority), item)
+                self._mclock[shard].enqueue(cls, entry, cost=qos_cost)
             else:
                 heapq.heappush(
-                    self._shards[shard], (-priority, next(self._seq), item)
+                    self._shards[shard], (-priority, next(self._seq), entry)
                 )
             self._conds[shard].notify()
 
@@ -99,12 +123,23 @@ class ShardedWorkQueue:
                     cond.wait_for(lambda: len(mq) or self._stop)
                     if self._stop and not len(mq):
                         return
-                    _, item = mq.dequeue()
+                    cls, entry = mq.dequeue()
+                    phase = mq.last_phase
                 else:
                     cond.wait_for(lambda: q or self._stop)
                     if self._stop and not q:
                         return
-                    _, _, item = heapq.heappop(q)
+                    _, _, entry = heapq.heappop(q)
+                    cls, phase = entry[3], "fifo"
+            item, on_admit, t0, _cls = entry
+            if on_admit is not None:
+                try:
+                    on_admit(cls, phase, time.monotonic() - t0)
+                # cephlint: disable=silent-except — QoS accounting is
+                # advisory; a broken callback must never stop the item
+                # itself from dispatching
+                except Exception:
+                    pass
             try:
                 self.process(item)
             except BaseException as e:  # noqa: BLE001 — worker must survive

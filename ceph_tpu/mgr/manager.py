@@ -91,6 +91,49 @@ class PrometheusModule(MgrModule):
             for pool, row in sorted(digest["pools"].items()):
                 lines.append(f'{metric}{{pool="{pool}"}} {row[field]}')
 
+    def _export_qos(self, lines: List[str]) -> None:
+        """ceph_qos_* gauges from every registered daemon's QoS
+        scheduler (PR 13): per-class queue depth + admitted totals,
+        dequeue-phase counters, recovery feedback window, and the
+        per-connection edge-throttle stall count."""
+        rows = []
+        for name, svc in sorted(self.mgr.services.items()):
+            qos = getattr(svc, "qos", None)
+            if qos is None:
+                continue
+            msgr = getattr(svc, "msgr", None)
+            rows.append((name, qos.status(
+                msgr_perf=getattr(msgr, "perf", None))))
+        if not rows:
+            return
+        lines.append("# TYPE ceph_qos_queue_depth gauge")
+        lines.append("# TYPE ceph_qos_admitted_total counter")
+        for name, st in rows:
+            for cls, row in sorted(st["classes"].items()):
+                lines.append(
+                    f'ceph_qos_queue_depth{{daemon="{name}",'
+                    f'class="{cls}"}} {row.get("depth", 0)}')
+                if "admitted" in row:
+                    lines.append(
+                        f'ceph_qos_admitted_total{{daemon="{name}",'
+                        f'class="{cls}"}} {row["admitted"]}')
+        lines.append("# TYPE ceph_qos_dequeue_total counter")
+        for name, st in rows:
+            for phase, n in sorted(st["dequeue_phases"].items()):
+                lines.append(
+                    f'ceph_qos_dequeue_total{{daemon="{name}",'
+                    f'phase="{phase}"}} {n}')
+        lines.append("# TYPE ceph_qos_recovery_window gauge")
+        lines.append("# TYPE ceph_qos_throttle_stalls counter")
+        for name, st in rows:
+            lines.append(
+                f'ceph_qos_recovery_window{{daemon="{name}"}} '
+                f'{st["recovery"]["effective_window"]}')
+            thr = st.get("throttle") or {}
+            lines.append(
+                f'ceph_qos_throttle_stalls{{daemon="{name}"}} '
+                f'{thr.get("stalls", 0)}')
+
     def _export_devwatch(self, lines: List[str]) -> None:
         """Family-labeled device-runtime metrics (ceph_xla_*): compile
         counts/seconds, distinct shapes, cache hits, and per-family
@@ -108,6 +151,7 @@ class PrometheusModule(MgrModule):
         metrics = self.mgr.collect()
         lines: List[str] = []
         self._export_cluster(lines)
+        self._export_qos(lines)
         self._export_devwatch(lines)
         seen_help = set()
         for daemon, subsystems in sorted(metrics.items()):
@@ -368,6 +412,64 @@ class ProgressModule(MgrModule):
             }
 
 
+class QosModule(MgrModule):
+    """Cluster-wide QoS surface (PR 13): `qos status` merges every
+    registered OSD's scheduler evidence; `qos set <target> <r> <w> <l>`
+    retunes at runtime THROUGH the conf observer — the new triple is
+    folded into each daemon context's ``osd_qos_profiles`` value, whose
+    observer reloads the live schedulers, so the conf stays the single
+    durable source of truth (the ConfigMonitor discipline)."""
+
+    name = "qos"
+
+    def _qos_services(self):
+        for name, svc in sorted(self.mgr.services.items()):
+            qos = getattr(svc, "qos", None)
+            if qos is not None:
+                yield name, svc, qos
+
+    def status(self) -> dict:
+        out = {}
+        for name, svc, qos in self._qos_services():
+            msgr = getattr(svc, "msgr", None)
+            out[name] = qos.status(
+                msgr_perf=getattr(msgr, "perf", None))
+        return {"daemons": out}
+
+    def set_qos(self, target: str, reservation: float, weight: float,
+                limit: float) -> dict:
+        from ceph_tpu.osd.qos import merge_profile_spec
+
+        applied = []
+        seen = set()
+        for name, svc, _qos in self._qos_services():
+            conf = svc.ctx.conf
+            if id(conf) in seen:
+                continue  # vstart daemons share one Context/conf
+            seen.add(id(conf))
+            spec = merge_profile_spec(
+                str(conf.get("osd_qos_profiles") or ""),
+                target, reservation, weight, limit)
+            conf.set_val("osd_qos_profiles", spec)
+            applied.append(name)
+        return {"target": target,
+                "reservation": reservation, "weight": weight,
+                "limit": limit, "applied_via": applied}
+
+    def handle_command(self, cmd):
+        prefix = cmd.get("prefix", "")
+        if prefix == "qos status":
+            return 0, self.status()
+        if prefix == "qos set":
+            try:
+                return 0, self.set_qos(
+                    str(cmd["class"]), float(cmd["reservation"]),
+                    float(cmd["weight"]), float(cmd["limit"]))
+            except (KeyError, ValueError) as e:
+                return -22, {"error": f"qos set: {e}"}
+        return None
+
+
 class OpsModule(MgrModule):
     """Cluster-wide op observability (PR 8): merges every registered
     daemon's slow-op/in-flight rings and per-stage latency histograms
@@ -452,7 +554,7 @@ class MgrDaemon:
                   CrashModule(self), BalancerModule(self),
                   DashboardModule(self), TelemetryModule(self),
                   OpsModule(self), ProgressModule(self),
-                  DeviceModule(self)):
+                  DeviceModule(self), QosModule(self)):
             self.modules[m.name] = m
 
     def register_daemon(self, name: str, ctx, service=None) -> None:

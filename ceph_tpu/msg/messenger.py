@@ -107,6 +107,13 @@ class Connection:
         self.peer_addr = addr
         self.policy = policy or Policy.lossless_peer()
         self.sid = random.getrandbits(63) | 1  # this session's seq space
+        # per-connection dispatch-gate state (set_dispatch_gate): in-
+        # flight ops/bytes granted to this peer's session, and the
+        # loop-owned event gate waiters park on.  Counters mutate ONLY
+        # on the event loop (releases hop via call_soon_threadsafe).
+        self._gate_ops = 0
+        self._gate_bytes = 0
+        self._gate_evt: Optional[asyncio.Event] = None
         self.out_seq = 0
         self.in_seq = 0
         self.acked = 0
@@ -261,6 +268,9 @@ class Messenger:
             self._stall_s = float(stall_ms or 0) / 1000.0
         except ValueError:
             self._stall_s = 0.0
+        # per-connection dispatch gate (set_dispatch_gate): the
+        # reference client-messenger Throttle pair — None = disabled
+        self._gate = None
         self.perf = None
         if ctx is not None:
             pc = ctx.perf.create(f"msgr.{entity}")
@@ -273,6 +283,12 @@ class Messenger:
             pc.add_u64_counter("loop_stalls",
                                "fast-dispatch handlers that blocked the "
                                "event loop past ms_loop_stall_ms")
+            pc.add_u64_counter("throttle_stall",
+                               "dispatch-gate waits: a peer connection "
+                               "stopped reading because its in-flight "
+                               "op/byte cap was full")
+            pc.add_histogram("throttle_stall_us",
+                             "dispatch-gate wait durations (us)")
             self.perf = pc
 
     def set_policy(self, peer_type: str, policy: Policy) -> None:
@@ -292,6 +308,80 @@ class Messenger:
             self._auth_provider = provider
         if verifier is not None:
             self._auth_verifier = verifier
+
+    # -- per-connection dispatch gate (edge backpressure) -----------------
+    def set_dispatch_gate(self, cost_fn, msg_cap: int,
+                          size_cap: int) -> None:
+        """Per-connection in-flight op/byte throttle (the reference
+        client-messenger Throttle pair, osd_client_message_cap /
+        _size_cap).  ``cost_fn(msg) -> payload bytes`` for messages
+        subject to the gate, ``None`` for exempt ones.  While a
+        connection is over either cap, ITS frame reader awaits — the
+        socket stops being read and TCP backpressures the abusive
+        peer; every other connection keeps flowing.  The grant rides
+        the message as ``msg._gate_release`` (idempotent, thread-safe)
+        and the daemon's reply path releases it.  Re-call to retune
+        the caps at runtime (conf observer)."""
+        self._gate = (cost_fn, int(msg_cap), int(size_cap))
+
+    def _gate_over(self, conn: Connection, nbytes: int, cap: int,
+                   szcap: int) -> bool:
+        if cap > 0 and conn._gate_ops >= cap:
+            return True
+        # an oversized single message through an idle gate still
+        # passes (the Throttle one-oversized-request discipline)
+        return (szcap > 0 and conn._gate_bytes > 0
+                and conn._gate_bytes + nbytes > szcap)
+
+    async def _gate_acquire(self, conn: Connection, nbytes: int) -> bool:
+        """Take one op + `nbytes` of gate budget on `conn`; True when
+        the acquire had to stall (throttle_stall evidence)."""
+        stalled = False
+        t0 = None
+        while True:
+            gate = self._gate
+            if gate is None:
+                break
+            _fn, cap, szcap = gate
+            if not self._gate_over(conn, nbytes, cap, szcap):
+                break
+            if not stalled:
+                stalled = True
+                t0 = time.perf_counter()
+                if self.perf is not None:
+                    self.perf.inc("throttle_stall")
+            if conn._gate_evt is None:
+                conn._gate_evt = asyncio.Event()
+            conn._gate_evt.clear()
+            await conn._gate_evt.wait()
+        conn._gate_ops += 1
+        conn._gate_bytes += nbytes
+        if stalled and self.perf is not None:
+            self.perf.hinc("throttle_stall_us",
+                           (time.perf_counter() - t0) * 1e6)
+        return stalled
+
+    def _gate_release_fn(self, conn: Connection, nbytes: int):
+        """Idempotent, thread-safe release of one gate grant."""
+        done = [False]
+
+        def release() -> None:
+            if done[0]:
+                return
+            done[0] = True
+
+            def on_loop() -> None:
+                conn._gate_ops = max(0, conn._gate_ops - 1)
+                conn._gate_bytes = max(0, conn._gate_bytes - nbytes)
+                if conn._gate_evt is not None:
+                    conn._gate_evt.set()
+
+            try:
+                self._loop.call_soon_threadsafe(on_loop)
+            except RuntimeError:
+                pass  # loop already closed (messenger shutdown)
+
+        return release
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -827,6 +917,33 @@ class Messenger:
                             mtype=type(msg).__name__,
                             entity=str(self.entity)) is fp.DROP:
                 return
+        # edge backpressure: gate-subject messages take a per-
+        # connection in-flight grant BEFORE dispatch; while this peer
+        # is over its cap, only ITS reader awaits here (TCP then
+        # backpressures the peer's socket).  The grant is released by
+        # the daemon's reply path via msg._gate_release, or below on a
+        # dispatch failure (the frame will be replayed and re-gated).
+        release = None
+        gate = self._gate
+        if gate is not None:
+            nbytes = None
+            try:
+                nbytes = gate[0](msg)
+            except Exception:
+                nbytes = None
+            if nbytes is not None:
+                await self._gate_acquire(conn, int(nbytes))
+                release = self._gate_release_fn(conn, int(nbytes))
+                msg._gate_release = release
+        try:
+            await self._dispatch_inner(conn, msg, size)
+        except BaseException:
+            if release is not None:
+                release()
+            raise
+
+    async def _dispatch_inner(self, conn: Connection, msg: Message,
+                              size: int) -> None:
         for d in self._dispatchers:
             if d.ms_can_fast_dispatch(msg):
                 # fast dispatch (reference ms_fast_dispatch): run the

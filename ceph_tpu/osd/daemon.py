@@ -100,10 +100,33 @@ class OSDService(Dispatcher):
         self._waiters: Dict[int, _Waiter] = {}
         self._read_cbs: Dict[int, Callable] = {}
         self._notify_cbs: Dict[int, Callable] = {}
+        # QoS admission subsystem (osd/qos.py): the dmClock scheduler
+        # in command of this daemon's op path — tenant-resolved
+        # classes, cost-aware tags, recovery feedback, the osd.N.qos
+        # evidence set.  The fifo mode keeps the scheduler object (it
+        # still classifies, accounts, and drives recovery feedback);
+        # only the shard queues differ.
+        from ceph_tpu.osd.qos import QosScheduler
+
+        qos_pc = ctx.perf.create(f"osd.{whoami}.qos")
+        self.qos = QosScheduler(ctx.conf, perf=qos_pc)
+        self._qos_observer = ctx.conf.add_observer(
+            ("osd_qos_profiles",),
+            lambda _n, v: self.qos.reload(str(v)))
+        sched = str(ctx.conf.get("osd_op_queue"))
         self.wq = ShardedWorkQueue(
             f"osd{whoami}-op", ctx.conf.get("osd_op_num_shards"),
             process=lambda item: item(),
-            scheduler=ctx.conf.get("osd_op_queue"))
+            scheduler="mclock" if sched == "mclock" else "wpq",
+            qos=self.qos)
+        # edge backpressure (reference osd_client_message_cap /
+        # _size_cap Throttles): per-connection in-flight caps on
+        # client ops at the messenger, so an abusive tenant queues at
+        # its own socket; grants release on the reply path below
+        self._arm_client_gate()
+        self._gate_observer = ctx.conf.add_observer(
+            ("osd_client_message_cap", "osd_client_message_size_cap"),
+            lambda _n, _v: self._arm_client_gate())
         # recovery slot throttle (reference AsyncReserver.h /
         # osd_recovery_max_active): bounds concurrent object pushes
         from ceph_tpu.core.reserver import AsyncReserver
@@ -255,6 +278,34 @@ class OSDService(Dispatcher):
             ("tpu_recompile_storm_window",
              "tpu_recompile_storm_min_sigs"), _dw_conf)
 
+    # -- QoS plumbing -----------------------------------------------------
+    def _arm_client_gate(self) -> None:
+        """(Re)install the messenger's per-connection client-op gate
+        from the current conf caps (conf observer re-arms on retune)."""
+        def cost(msg) -> Optional[int]:
+            if not isinstance(msg, m.MOSDOp):
+                return None
+            src = msg.src
+            if src is None or src.kind != "client":
+                return None
+            nb = 0
+            for o in msg.ops:
+                if o.is_write() and o.data is not None:
+                    nb += len(o.data) or o.length
+            return nb
+
+        self.msgr.set_dispatch_gate(
+            cost, int(self.ctx.conf.get("osd_client_message_cap")),
+            int(self.ctx.conf.get("osd_client_message_size_cap")))
+
+    @staticmethod
+    def _gate_done(msg) -> None:
+        """Release a gated op's per-connection grant (idempotent; a
+        message that never took one is a no-op)."""
+        rel = getattr(msg, "_gate_release", None)
+        if rel is not None:
+            rel()
+
     # -- lifecycle --------------------------------------------------------
     def _apply_fault_conf(self) -> None:
         """Arm the conf-declared fault injection: the failpoint_inject
@@ -319,6 +370,14 @@ class OSDService(Dispatcher):
                 f"osd.{self.whoami} dump_historic_slow_ops",
                 lambda c: trk.dump_slow(),
                 "ops slower than osd_op_complaint_time")
+            # QoS evidence surface (PR 13): per-class admission
+            # counters/waits, dequeue phases, recovery feedback state,
+            # messenger throttle stalls — the cephtop --qos feed
+            self.ctx.admin.register(
+                f"osd.{self.whoami} qos status",
+                lambda c: self.qos.status(msgr_perf=self.msgr.perf),
+                "dmClock admission state: classes, phases, recovery "
+                "feedback, edge-throttle stalls")
 
     def _admin_bench(self, cmd: dict) -> dict:
         from ceph_tpu.store.objectstore import Collection, GHObject
@@ -572,6 +631,8 @@ class OSDService(Dispatcher):
         self.op_tracker.drain()
         self.ctx.conf.remove_observer(self._complaint_obs)
         self.ctx.conf.remove_observer(self._devwatch_observer)
+        self.ctx.conf.remove_observer(self._qos_observer)
+        self.ctx.conf.remove_observer(self._gate_observer)
 
     @property
     def addr(self) -> Addr:
@@ -1094,6 +1155,7 @@ class OSDService(Dispatcher):
                                     msg.ops, result=-116)  # ESTALE
                 rep.tid = msg.tid
                 conn.send(rep)
+                self._gate_done(msg)
                 return True
             pg = self.pgs.get(msg.pgid)
             if pg is None:
@@ -1107,6 +1169,7 @@ class OSDService(Dispatcher):
                                     msg.ops, result=-116)  # ESTALE
                 rep.tid = msg.tid
                 conn.send(rep)
+                self._gate_done(msg)
                 return True
             tid = msg.tid
             # op start = the messenger's receive stamp, so the first
@@ -1129,6 +1192,10 @@ class OSDService(Dispatcher):
                 def reply(rep: m.MOSDOpReply) -> None:
                     rep.tid = tid
                     conn.send(rep)
+                    # the reply releases this op's per-connection gate
+                    # grant: in-flight = receive -> reply, exactly the
+                    # reference Throttle window
+                    self._gate_done(msg)
                     # terminal stage rides finish() so concluding and
                     # leaving the in-flight table are ONE step: EAGAIN'd
                     # ops (peering gate, write-deadline sweep) land in
@@ -1171,6 +1238,7 @@ class OSDService(Dispatcher):
                     # idempotent if a reply DID go out first)
                     self._log(0, f"do_op {msg.oid} failed: {e!r}")
                     top.finish(stage="aborted", detail=repr(e))
+                    self._gate_done(msg)  # no reply will release it
                     # the wrapped reply() owns finishing the do_op
                     # span; a raise before any reply would leave it
                     # unarchived — the primary node of the causal tree
@@ -1180,9 +1248,21 @@ class OSDService(Dispatcher):
                         sp.annotate(f"exception: {e!r}")
                         sp.finish()
 
+            # scheduled admission: op class AND tenant decide the
+            # dmClock class, payload bytes the tag cost — QoS orders
+            # admission ACROSS objects; the _OidPipe per-object FIFO
+            # downstream keeps same-object order untouched
+            qcls, qcost = self.qos.classify_op(msg)
+            self.qos.note_admit(qcls, qcost)
+
+            def on_admit(cls_, phase, wait_s, top=top) -> None:
+                top.mark_event("qos_admitted", f"{cls_}/{phase}")
+                self.qos.note_dequeue(cls_, phase, wait_s)
+
             self.wq.queue(msg.pgid, run,
                           priority=self.ctx.conf.get("osd_client_op_priority"),
-                          qos_class="client")
+                          qos_class=qcls, qos_cost=qcost,
+                          on_admit=on_admit)
             return True
         if isinstance(msg, m.MWatchNotifyAck):
             cb = self._notify_cbs.get(msg.notify_id)
@@ -1266,10 +1346,14 @@ class OSDService(Dispatcher):
                     done.tid = msg.tid
                     conn.send(done)  # completion marker for the puller
 
+            # recovery traffic is a first-class tenant of the same
+            # scheduler: it queues under the recovery class triple
+            self.qos.note_admit("recovery")
             self.wq.queue(msg.pgid, run,
                           priority=self.ctx.conf.get(
                               "osd_recovery_op_priority"),
-                          qos_class="recovery")
+                          qos_class="recovery",
+                          on_admit=self.qos.note_dequeue)
             return True
         return False
 

@@ -937,7 +937,17 @@ class PG:
         batch = int(msg.ops[0].length) or 16
         oids = self.snap_objects(snapid)
         trimmed, failed, stale = 0, 0, 0
+        # snaptrim is a QoS tenant: each trimmed object charges the
+        # snaptrim class's token bucket and the sweep paces itself to
+        # the class limit (bounded per object, so the shard is never
+        # held longer than batch x the cap)
+        qos = getattr(self.osd, "qos", None)
+        pacer = threading.Event()
         for oid in oids[:batch]:
+            if qos is not None:
+                pause = min(0.1, qos.background_pause("snaptrim"))
+                if pause > 0:
+                    pacer.wait(pause)
             shim = SimpleNamespace(
                 oid=oid, ops=[OSDOp(t_.OP_SNAPTRIM, off=snapid)],
                 reqid=f"{getattr(msg, 'reqid', 'snaptrim')}/{oid}",

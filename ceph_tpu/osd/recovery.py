@@ -403,13 +403,22 @@ class ECRecoveryEngine:
                         # _pending until the bounded-wait EAGAIN)
                         self._drainers -= 1
                         return
-                    w = max(1, int(self.osd.ctx.conf.get(
+                    # recovery is a QoS tenant: the round width comes
+                    # from the feedback controller — widened while
+                    # clients are idle, clamped under client pressure,
+                    # the conf window when no scheduler is wired
+                    base = max(1, int(self.osd.ctx.conf.get(
                         "osd_recovery_max_active")))
+                    qos = getattr(self.osd, "qos", None)
+                    w = (qos.recovery_window(base)
+                         if qos is not None else base)
                     batch: List[str] = []
                     while self._pending and len(batch) < w:
                         oid = self._pending.popleft()
                         self._pending_set.discard(oid)
                         batch.append(oid)
+                    if qos is not None:
+                        qos.note_recovery_grant(len(batch))
                     rnd = self._round = _Round(batch)
                 t_round = time.monotonic()
                 tr = getattr(self.osd.ctx, "trace", None)

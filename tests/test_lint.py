@@ -540,6 +540,33 @@ def test_jax_purity_follows_instrumented_jit(tmp_path):
     assert len(bad) == 1 and bad[0].detail == "np.sum"
 
 
+def test_qos_class_registry_flags_typo(tmp_path):
+    bad = _lint(tmp_path, (
+        "def f(wq, pgid, run):\n"
+        "    wq.queue(pgid, run, qos_class='recvery')\n"  # typo'd
+    ), "qos-class-registry")
+    assert len(bad) == 1 and "best_effort" in bad[0].message
+
+    ok = _lint(tmp_path, (
+        "def f(wq, pgid, run, qcls):\n"
+        "    wq.queue(pgid, run, qos_class='recovery')\n"
+        "    wq.queue(pgid, run, qos_class='snaptrim')\n"
+        "    wq.queue(pgid, run, qos_class=qcls)\n"  # classify_op path
+    ), "qos-class-registry")
+    assert not ok
+
+
+def test_qos_class_registry_never_baseline(tmp_path):
+    from ceph_tpu.analysis.framework import (Violation,
+                                             violations_to_baseline)
+
+    v = Violation(check="qos-class-registry",
+                  path="ceph_tpu/osd/daemon.py", line=1,
+                  scope="OSDService.x", detail="qos_class='typo'",
+                  message="m")
+    assert v.key not in violations_to_baseline([v])["entries"]
+
+
 def test_failpoint_names_never_baseline(tmp_path):
     from ceph_tpu.analysis.framework import (Violation,
                                              violations_to_baseline)

@@ -59,6 +59,12 @@ def test_health_transitions_recovery_eta_and_cluster_log():
     conf = dict(FAST_CONF)
     conf["osd_recovery_max_active"] = 1  # stretch recovery so the
     # ETA estimator gets several samples mid-flight
+    # ... and keep the window AT 1: the PR 13 feedback controller
+    # widens it 4x the moment the client load stops (clients idle),
+    # which drains the debt inside ~2 poll intervals and leaves the
+    # sampler nothing mid-flight — this test measures ETA telemetry,
+    # not the controller (test_qos_tracking owns that)
+    conf["osd_recovery_feedback"] = False
     with VStartCluster(n_mons=1, n_osds=3, conf=conf) as c:
         pool = c.create_pool("telec", size=3, pool_type="erasure",
                              ec_profile="k=2 m=1", pg_num=4)

@@ -86,6 +86,13 @@ def _parse(tokens):
         return {"prefix": "ops dump_in_flight"}
     if t[:2] == ["ops", "latency"]:
         return {"prefix": "ops latency"}
+    if t[:2] == ["qos", "status"]:
+        return {"prefix": "qos status"}
+    if t[:2] == ["qos", "set"]:
+        # qos set <class|tenant:<entity>|pool:<id>> <r> <w> <l>
+        return {"prefix": "qos set", "class": t[2],
+                "reservation": float(t[3]), "weight": float(t[4]),
+                "limit": float(t[5])}
     if t[:2] == ["mgr", "status"]:
         return {"prefix": "mgr status"}
     if t[0] == "config":
@@ -182,7 +189,7 @@ def main(argv=None) -> int:
     MGR_PREFIXES = {"progress", "prometheus export", "mgr status",
                     "ops dump_slow", "ops dump_in_flight",
                     "ops latency", "crash ls", "crash info",
-                    "device compile dump"}
+                    "device compile dump", "qos status", "qos set"}
 
     rc = 0
     with VStartCluster(n_mons=n_mons, n_osds=n_osds,

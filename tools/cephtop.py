@@ -151,6 +151,61 @@ def render_device(d: dict) -> str:
     return "\n".join(lines)
 
 
+def _qos_status(socket_paths: List[str]) -> dict:
+    """Merged `osd.N qos status` payloads, discovered from each
+    socket's `help` listing (per-daemon prefixed commands)."""
+    out: Dict[str, dict] = {}
+    for path in socket_paths:
+        try:
+            cmds = admin_command(path, "help")
+        except OSError:
+            continue
+        for prefix in sorted(cmds):
+            if not prefix.endswith(" qos status"):
+                continue
+            daemon = prefix.rsplit(" ", 2)[0]
+            try:
+                out[daemon] = admin_command(path, prefix)
+            except OSError:
+                continue
+    return out
+
+
+def render_qos(st: Dict[str, dict]) -> str:
+    if not st:
+        return "no qos status admin command answered"
+    lines: List[str] = []
+    for daemon, d in sorted(st.items()):
+        lines.append(f"{daemon}  scheduler={d.get('scheduler', '?')}")
+        head = (f"  {'class':<28} {'res':>7} {'wgt':>7} {'lim':>7} "
+                f"{'depth':>6} {'admitted':>9} {'p99_wait_us':>12}")
+        lines.append(head)
+        lines.append("  " + "-" * (len(head) - 2))
+        for cls, row in sorted(d.get("classes", {}).items()):
+            wait = row.get("wait_us") or {}
+            lines.append(
+                f"  {cls:<28} {row.get('reservation', '-'):>7} "
+                f"{row.get('weight', '-'):>7} {row.get('limit', '-'):>7} "
+                f"{row.get('depth', 0):>6} {row.get('admitted', 0):>9} "
+                f"{wait.get('p99_us', '-'):>12}")
+        ph = d.get("dequeue_phases", {})
+        lines.append("  phases: " + " ".join(
+            f"{p}={n}" for p, n in sorted(ph.items())))
+        rec = d.get("recovery", {})
+        lines.append(
+            f"  recovery: state={rec.get('state')} "
+            f"window={rec.get('effective_window')} "
+            f"client_iops={rec.get('client_iops')} "
+            f"widened={rec.get('widened')} clamped={rec.get('clamped')}")
+        thr = d.get("throttle") or {}
+        if thr:
+            lines.append(
+                f"  throttle: cap={thr.get('message_cap')} "
+                f"size_cap={thr.get('size_cap')} "
+                f"stalls={thr.get('stalls')}")
+    return "\n".join(lines)
+
+
 def _cluster_status(socket_paths: List[str]) -> dict:
     """The first answering mon's health + PGMap digest (the `mon.N
     status` admin command registered by every monitor)."""
@@ -211,11 +266,21 @@ def main(argv=None) -> int:
     p.add_argument("--device", action="store_true",
                    help="device pane: per-kernel-family XLA compile "
                         "table (compiles, wall, shapes, hits, storms)")
+    p.add_argument("--qos", action="store_true",
+                   help="qos pane: per-class dmClock admission state "
+                        "(triples, depths, waits, phases, recovery "
+                        "feedback, edge-throttle stalls)")
     p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
     if not args.socket:
         print("cephtop: at least one --socket required", file=sys.stderr)
         return 2
+
+    if args.qos:
+        st = _qos_status(args.socket)
+        print(json.dumps(st, indent=1) if args.as_json
+              else render_qos(st))
+        return 0
 
     if args.device:
         d = _device_dump(args.socket)
