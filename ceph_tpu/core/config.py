@@ -105,6 +105,10 @@ def _opts() -> List[Option]:
         O("mon_stats_rate_window", float, 10.0,
           "window (seconds) over which the PGMap digest derives "
           "client IOPS/BW and recovery rates from report deltas"),
+        O("mon_warn_not_deep_scrubbed_s", float, 0.0,
+          "raise PG_NOT_DEEP_SCRUBBED for primary PGs whose last deep "
+          "scrub is older than this many seconds (0 = check disabled; "
+          "a PG never deep-scrubbed counts as infinitely old)"),
         O("osd_heartbeat_grace", float, 20.0,
           "seconds without a ping before reporting failure"),
         O("osd_heartbeat_interval", float, 2.0, "osd peer ping period"),
@@ -179,6 +183,27 @@ def _opts() -> List[Option]:
           "seconds to wait for a recovery push's ack before leaving "
           "the peer stale for this round"),
         O("osd_scrub_interval", float, 86400.0, "seconds between scrubs"),
+        O("osd_deep_scrub_interval", float, 604800.0,
+          "seconds between DEEP scrubs of one PG: the scheduler runs a "
+          "byte-reading deep scrub when a PG's last deep scrub is older "
+          "than this (a never-deep-scrubbed PG deep-scrubs first)"),
+        O("osd_scrub_chunk_max", int, 16,
+          "objects per deep-scrub chunk: the engine verifies (and "
+          "persists its resume cursor) one chunk at a time, yielding "
+          "to client io between chunks", minval=1),
+        O("osd_scrub_auto_repair", bool, False,
+          "repair inconsistencies found by deep scrub automatically "
+          "(EC consensus rebuild with replace semantics), bounded by "
+          "osd_scrub_auto_repair_num_errors"),
+        O("osd_scrub_auto_repair_num_errors", int, 5,
+          "auto-repair only when deep scrub found at most this many "
+          "inconsistent objects (mass damage wants an operator)"),
+        O("osd_scrub_busy_client_iops", float, 50.0,
+          "client ops/s at which a running deep scrub preempts "
+          "between chunks (waits for the pressure to drain)"),
+        O("osd_scrub_preempt_max_wait", float, 5.0,
+          "longest a preempted deep scrub waits for client pressure "
+          "to drain before taking its next chunk anyway"),
         O("osd_pg_stats_interval", float, 2.0,
           "seconds between MPGStats reports to the mon"),
         O("osd_client_op_priority", int, 63, "client op priority"),
@@ -216,6 +241,11 @@ def _opts() -> List[Option]:
         O("objectstore_wal_sync", bool, False, "fsync the WAL per txn"),
         O("filestore_debug_inject_read_err", bool, False,
           "fault injection: EIO on reads marked bad"),
+        O("store_debug_inject_data_err", bool, False,
+          "fault injection: reads of objects marked via "
+          "debug_inject_data_err serve seeded bit-flipped bytes "
+          "(silent corruption — the store itself never notices; a "
+          "rewrite of the object clears its mark)"),
         # -- client ---------------------------------------------------------
         O("objecter_timeout", float, 30.0, "op resend timeout"),
         O("objecter_inflight_ops", int, 1024, "op throttle"),

@@ -20,8 +20,10 @@ Usage at an instrumented site::
             return                                         # disarmed
 
 Sites that honor the ``DROP`` verdict model *message/record loss* (the
-operation silently does not happen); all other actions are effects the
-point raises/blocks on directly.
+operation silently does not happen); the two ``store.corrupt_*`` sites
+honor ``CORRUPT`` (the store serves seeded bit-flipped bytes — silent
+at-rest corruption); all other actions are effects the point
+raises/blocks on directly.
 
 Arming::
 
@@ -35,7 +37,7 @@ or declaratively (env ``CEPH_TPU_FAILPOINTS`` / conf
 
     name=action[:modifier[:modifier...]]
     actions:    sleep(ms) | error[(ExcName)] | kill | drop |
-                barrier(token)
+                corrupt | barrier(token)
     modifiers:  once | count(n) | prob(p) | match(key=substr)
 
 ``prob`` draws from a per-point RNG seeded by ``(seed(), name)``, so a
@@ -112,10 +114,42 @@ POINTS: Dict[str, str] = {
     "store.filestore.read":
         "FileStore.read entry (error(EIO) is the "
         "filestore_debug_inject_read_err hook)",
+    # -- silent corruption (every store's read boundary, objectstore.py)
+    "store.corrupt_chunk":
+        "any store's read() return — CORRUPT verdict bit-flips the "
+        "served bytes (seeded silent at-rest corruption; scope with "
+        "match(oid=/coll=/shard=) so only the targeted shards rot)",
+    "store.corrupt_xattr":
+        "any store's getattr() return — CORRUPT verdict bit-flips the "
+        "served attr value (silent metadata corruption)",
+    # -- scrub engine (osd/scrub.py)
+    "scrub.chunk":
+        "scrub engine, before each deep-scrub chunk is verified (the "
+        "kill/preempt/resume seam: a barrier here parks the scrub "
+        "with its cursor persisted)",
 }
 
 DROP = object()          # verdict: the call site skips the operation
 DROP_ACTION = "drop"     # arm(name, DROP_ACTION) => hits return DROP
+# verdict: the call site serves CORRUPTED bytes — only the two
+# store.corrupt_* points honor it, via corrupt_bytes() below
+CORRUPT = object()
+CORRUPT_ACTION = "corrupt"
+
+
+def corrupt_bytes(data, key: str) -> bytes:
+    """Deterministic seeded bit-flips for the CORRUPT verdict: flip
+    positions come from (seed(), key) — one bit per 512 bytes, at
+    least one — so a chaos seed fully determines WHERE the rot lands
+    and a replay reproduces the same damage byte for byte."""
+    if not data:
+        return bytes(data)
+    rng = random.Random(f"{_seed}:corrupt:{key}")
+    buf = bytearray(data)
+    for _ in range(max(1, len(buf) // 512)):
+        i = rng.randrange(len(buf))
+        buf[i] ^= 1 << rng.randrange(8)
+    return bytes(buf)
 
 
 class FailpointError(RuntimeError):
@@ -331,6 +365,8 @@ class _Point:
             disarm(self.name, _only_if_is=self)
         if self.action == DROP_ACTION:
             return DROP
+        if self.action == CORRUPT_ACTION:
+            return CORRUPT
         ctx = dict(ctx)
         ctx["_name"] = self.name
         self.action(ctx)
@@ -385,7 +421,8 @@ def arm(name: str, action, *, once: bool = False,
     if name not in POINTS:
         raise KeyError(f"failpoint {name!r} is not declared in "
                        f"failpoint.POINTS")
-    if isinstance(action, str) and action != DROP_ACTION:
+    if isinstance(action, str) and action not in (DROP_ACTION,
+                                                  CORRUPT_ACTION):
         action = _parse_action(action)
     if once:
         count = 1
@@ -466,6 +503,8 @@ def _parse_action(spec: str):
         return kill()
     if kind == "drop":
         return DROP_ACTION
+    if kind == "corrupt":
+        return CORRUPT_ACTION
     if kind == "barrier":
         if not arg:
             raise ValueError("failpoint: barrier needs a token")
@@ -501,8 +540,9 @@ def arm_from_spec(spec: str) -> List[str]:
                 kw.setdefault("match", {})[k.strip()] = v.strip()
             else:
                 raise ValueError(f"failpoint: unknown modifier {mk!r}")
-        arm(name, DROP_ACTION if action.strip() == "drop"
-            else _parse_action(action), **kw)
+        act = action.strip()
+        arm(name, act if act in (DROP_ACTION, CORRUPT_ACTION)
+            else _parse_action(act), **kw)
         armed.append(name)
     return armed
 

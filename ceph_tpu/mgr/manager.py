@@ -367,10 +367,39 @@ class ProgressModule(MgrModule):
             return
         now = self._now()
         degraded_now: Dict[str, int] = {}
+        damaged_now: Dict[str, int] = {}
         for row in rows_fn():
             if row["primary"] and row["degraded"] > 0:
                 degraded_now[row["pgid"]] = row["degraded"]
+            if row["primary"] and row.get("scrub_errors", 0) > 0:
+                # scrub found damage repair hasn't cleared: a repair
+                # event tracks the PG until its report reads clean
+                # (auto-repair or operator `pg repair`/deep-scrub)
+                damaged_now[row["pgid"]] = row["scrub_errors"]
         with self._lock:
+            for pgid, cur in sorted(damaged_now.items()):
+                ev_id = f"repair-{pgid}"
+                ev = self.events.get(ev_id)
+                if ev is None:
+                    ev = self.events[ev_id] = {
+                        "id": ev_id, "pgid": pgid,
+                        "message": f"Repairing pg {pgid} "
+                                   f"({cur} scrub errors)",
+                        "started": now, "baseline": cur,
+                        "progress": 0.0, "eta_s": None,
+                    }
+                ev["baseline"] = max(ev["baseline"], cur)
+                ev["progress"] = round(
+                    (ev["baseline"] - cur) / ev["baseline"], 4)
+            for ev_id in [e for e in self.events
+                          if e.startswith("repair-")
+                          and self.events[e]["pgid"] not in damaged_now]:
+                ev = self.events.pop(ev_id)
+                ev["progress"] = 1.0
+                ev["duration_s"] = round(now - ev["started"], 2)
+                ev["eta_s"] = 0.0
+                self.completed.append(ev)
+                del self.completed[:-self.KEEP_COMPLETED]
             for pgid, cur in sorted(degraded_now.items()):
                 ev_id = f"recovery-{pgid}"
                 ev = self.events.get(ev_id)
@@ -392,7 +421,8 @@ class ProgressModule(MgrModule):
                     ev["eta_s"] = round(
                         eta if prev is None else min(prev, eta), 2)
             for ev_id in [e for e in self.events
-                          if self.events[e]["pgid"] not in degraded_now]:
+                          if e.startswith("recovery-")
+                          and self.events[e]["pgid"] not in degraded_now]:
                 ev = self.events.pop(ev_id)
                 ev["progress"] = 1.0
                 ev["duration_s"] = round(now - ev["started"], 2)

@@ -1125,7 +1125,13 @@ class Monitor(Dispatcher):
                 addr = self.osdmap.osd_addrs.get(primary)
             if primary < 0 or not addr:
                 return -11, {"error": "pg has no live primary"}
-            action = "repair" if prefix == "pg repair" else "scrub"
+            # distinct actions for all THREE prefixes: `pg deep-scrub`
+            # used to collapse to a shallow scrub here (the only
+            # byte-reading verification an operator could reach was a
+            # full repair) — the primary now receives the deep action
+            # and runs the chunked byte-verifying scrub
+            action = {"pg repair": "repair",
+                      "pg deep-scrub": "deep-scrub"}.get(prefix, "scrub")
             from ceph_tpu.osd import messages as om
             self.msgr.send_message(
                 om.MPGCommand((pool_id, ps), 0, action), tuple(addr))

@@ -154,11 +154,16 @@ class PGMapService:
             pg_states: Dict[str, int] = {}
             pools: Dict[int, dict] = {}
             tot = {"objects": 0, "bytes": 0, "degraded": 0,
-                   "misplaced": 0, "unfound": 0, "log_entries": 0}
+                   "misplaced": 0, "unfound": 0, "log_entries": 0,
+                   "scrub_errors": 0}
+            damaged_pgs = 0
             for row in rows:
                 s: PGStat = row["stat"]
                 if not s.primary:
                     continue
+                if s.scrub_errors:
+                    tot["scrub_errors"] += s.scrub_errors
+                    damaged_pgs += 1
                 pg_states[s.state] = pg_states.get(s.state, 0) + 1
                 pool = pools.setdefault(
                     s.pgid[0], {"objects": 0, "bytes": 0, "degraded": 0,
@@ -226,6 +231,10 @@ class PGMapService:
                 min(1.0, tot["degraded"] / (copies or 1)), 4),
             "misplaced_objects": tot["misplaced"],
             "unfound_objects": tot["unfound"],
+            # scrub damage attribution (primary rows): inconsistent
+            # objects the latest scrubs left unrepaired -> PG_DAMAGED
+            "scrub_errors": tot["scrub_errors"],
+            "damaged_pgs": damaged_pgs,
             "used_bytes": used,
             "total_bytes": total,
             "slow_ops": slow,
@@ -291,7 +300,41 @@ class PGMapService:
                     "reported_by": row["reported_by"],
                     "primary": s.primary,
                     "state_since": row["state_since"],
+                    "scrub_errors": s.scrub_errors,
+                    "last_scrub": s.last_scrub,
+                    "last_deep_scrub": s.last_deep_scrub,
                 })
+            return out
+
+    def not_deep_scrubbed(self, warn_age_s: Optional[float] = None
+                          ) -> List[dict]:
+        """Primary PGs whose last deep scrub is older than the warn
+        age (never-deep-scrubbed stamps read as infinitely old).
+        Empty when the check is disabled (warn age <= 0, the conf
+        default) — always-on deep scrub is the OSD scheduler's job;
+        this is the mon-side evidence it actually ran."""
+        if warn_age_s is None:
+            warn_age_s = float(self.conf.get(
+                "mon_warn_not_deep_scrubbed_s"))
+        if warn_age_s <= 0:
+            return []
+        now = self._now()
+        stale_s = float(self.conf.get("mon_pg_stats_stale_s"))
+        with self._lock:
+            out = []
+            for pgid in sorted(self.pg):
+                row = self.pg[pgid]
+                s: PGStat = row["stat"]
+                if not s.primary or now - row["stamp"] > stale_s:
+                    continue
+                if now - s.last_deep_scrub >= warn_age_s:
+                    out.append({
+                        "pgid": f"{pgid[0]}.{pgid[1]}",
+                        "last_deep_scrub": s.last_deep_scrub,
+                        "age_s": round(
+                            now - s.last_deep_scrub, 1)
+                        if s.last_deep_scrub else None,
+                    })
             return out
 
     def stuck_pgs(self, threshold_s: Optional[float] = None) -> List[dict]:

@@ -256,13 +256,16 @@ class HealthMonitor(PaxosService):
         # below instead of silently vanishing)
         pgmap = self.mon.pgmap
         digest = pgmap.digest()
-        degraded, peering = [], []
+        degraded, peering, damaged = [], [], []
         # fresh_only: the detail must name the same staleness-filtered
         # PG set the digest summaries count — a dead reporter's stale
         # rows belong to MON_STALE_PG_REPORTS, not these lists
         for row in pgmap.pg_rows(fresh_only=True):
             if not row["primary"]:
                 continue
+            if row.get("scrub_errors"):
+                damaged.append(f"{row['pgid']} ({row['scrub_errors']} "
+                               f"scrub errors)")
             if "degraded" in row["state"]:
                 degraded.append(f"{row['pgid']} ({row['degraded']} "
                                 f"objects degraded)")
@@ -299,6 +302,27 @@ class HealthMonitor(PaxosService):
                 "summary": f"{digest['unfound_objects']} objects "
                            f"unfound (no live source)",
                 "detail": [],
+            }
+        if digest.get("scrub_errors"):
+            # scrub found damage repair has not cleared: possible data
+            # corruption (the reference's PG_DAMAGED / OSD_SCRUB_ERRORS)
+            checks["PG_DAMAGED"] = {
+                "severity": "HEALTH_ERR",
+                "summary": f"{digest['scrub_errors']} scrub errors on "
+                           f"{digest['damaged_pgs']} pgs — possible "
+                           f"data damage",
+                "detail": sorted(damaged)[:10],
+            }
+        not_deep = pgmap.not_deep_scrubbed()
+        if not_deep:
+            checks["PG_NOT_DEEP_SCRUBBED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(not_deep)} pgs not deep-scrubbed "
+                           f"in time",
+                "detail": [
+                    f"pg {r['pgid']} last deep-scrubbed "
+                    + (f"{r['age_s']}s ago" if r["age_s"] is not None
+                       else "never") for r in not_deep[:10]],
             }
         stuck = pgmap.stuck_pgs()
         if stuck:

@@ -218,6 +218,27 @@ class OSDService(Dispatcher):
                              "reads of missing objects served by a "
                              "promoted recovery instead of EAGAIN")
         self.pg_perf = pgpc
+        # scrub-engine evidence (osd.N.scrub): chunk/object throughput,
+        # damage found vs repaired, preemption + resume counts — the
+        # dump_scrubs/bench scrub-aux feed (decode batch width comes
+        # from the shared queue's dec_batch_jobs histogram)
+        scpc = ctx.perf.create(f"osd.{whoami}.scrub")
+        scpc.add_u64_counter("chunks", "deep-scrub chunks verified")
+        scpc.add_u64_counter("objects", "objects scrub-verified")
+        scpc.add_u64_counter("errors_found",
+                             "inconsistent objects found by scrub")
+        scpc.add_u64_counter("errors_repaired",
+                             "inconsistent objects auto-repaired")
+        scpc.add_u64_counter("preemptions",
+                             "chunk boundaries where client pressure "
+                             "preempted a running scrub")
+        scpc.add_u64_counter("resumes",
+                             "deep scrubs resumed from a persisted "
+                             "cursor (kill/interval-change mid-scrub)")
+        scpc.add_u64_counter("deep_done", "completed deep scrub passes")
+        scpc.add_u64_counter("shallow_done",
+                             "completed shallow scrub passes")
+        self.scrub_perf = scpc
         self._wr_inflight = 0
         self._wr_inflight_hw = 0
         self._wr_lock = make_lock("osd.wr_inflight")
@@ -324,14 +345,21 @@ class OSDService(Dispatcher):
         inject = bool(self.ctx.conf.get("filestore_debug_inject_read_err"))
         if hasattr(self.store, "debug_read_err_enabled"):
             self.store.debug_read_err_enabled = inject
+        # silent-corruption twin of the read-err hook: reads of marked
+        # objects serve bit-flipped bytes instead of raising
+        self.store.debug_data_err_enabled = bool(
+            self.ctx.conf.get("store_debug_inject_data_err"))
 
         def _observe(name, val) -> None:
             if (name == "filestore_debug_inject_read_err"
                     and hasattr(self.store, "debug_read_err_enabled")):
                 self.store.debug_read_err_enabled = bool(val)
+            elif name == "store_debug_inject_data_err":
+                self.store.debug_data_err_enabled = bool(val)
 
         self.ctx.conf.add_observer(
-            ("filestore_debug_inject_read_err",), _observe)
+            ("filestore_debug_inject_read_err",
+             "store_debug_inject_data_err"), _observe)
 
     def init(self) -> None:
         self._apply_fault_conf()
@@ -378,6 +406,13 @@ class OSDService(Dispatcher):
                 lambda c: self.qos.status(msgr_perf=self.msgr.perf),
                 "dmClock admission state: classes, phases, recovery "
                 "feedback, edge-throttle stalls")
+            # scrub observability (PR 15): per-PG scrub state — mode,
+            # resume cursor, stamps, error counts, preemptions
+            self.ctx.admin.register(
+                f"osd.{self.whoami} dump_scrubs",
+                lambda c: self.dump_scrubs(),
+                "per-PG scrub state: running/mode/cursor, "
+                "last_scrub/last_deep_scrub stamps, scrub_errors")
 
     def _admin_bench(self, cmd: dict) -> dict:
         from ceph_tpu.store.objectstore import Collection, GHObject
@@ -558,10 +593,15 @@ class OSDService(Dispatcher):
 
     def start_scrub_scheduler(self,
                               interval: Optional[float] = None) -> None:
-        """Background periodic scrub (reference OSD::sched_scrub +
-        osd_scrub_min/max_interval): round-robins this osd's primary
-        PGs, scrubbing the one whose last scrub is oldest once per
-        interval; inconsistencies go to the cluster log hook."""
+        """Always-on background scrub (reference OSD::sched_scrub +
+        osd_scrub_min/max_interval + osd_deep_scrub_interval):
+        round-robins this osd's primary PGs, scrubbing the one whose
+        last scrub is oldest once per interval.  A PG whose last DEEP
+        scrub is older than osd_deep_scrub_interval (incl. never) runs
+        the byte-verifying deep pass through the ScrubEngine — with
+        auto-repair per conf — otherwise the cheap metadata-only
+        shallow pass; inconsistencies go to the cluster log and the
+        PGStat scrub_errors feed (PG_DAMAGED)."""
         iv = (interval if interval is not None
               else self.ctx.conf.get("osd_scrub_interval"))
         if self._scrub_thread is not None and self._scrub_thread.is_alive():
@@ -592,18 +632,17 @@ class OSDService(Dispatcher):
                 if pg is None:
                     continue
                 self._scrub_stamps[due] = now
+                deep_iv = float(self.ctx.conf.get(
+                    "osd_deep_scrub_interval"))
+                deep = now - pg.last_deep_scrub >= deep_iv
+                if not pg.maintenance_guard.acquire(blocking=False):
+                    continue  # operator scrub/repair mid-flight
                 try:
-                    errors = pg.scrub()
+                    pg.scrub_engine().run(deep=deep)
                 except Exception as e:
                     self._log(0, f"scheduled scrub {due} failed: {e}")
-                    continue
-                if errors:
-                    self.ctx.log.cluster(
-                        "ERR", f"pg {due} scrub: {len(errors)} "
-                               f"inconsistent objects: "
-                               f"{sorted(errors)[:5]}")
-                else:
-                    self._log(2, f"scheduled scrub {due}: clean")
+                finally:
+                    pg.maintenance_guard.release()
 
         self._scrub_thread = threading.Thread(
             target=_loop, daemon=True, name=f"osd{self.whoami}-scrub")
@@ -615,6 +654,12 @@ class OSDService(Dispatcher):
         if monc is not None:
             monc.close()  # wake command retries before the msgr dies
         self.note_pg_settled()  # unblock settle waiters promptly
+        # wake any scrub pacing wait; the engine persists its cursor
+        # per chunk, so the revived daemon RESUMES instead of restarting
+        for pg in list(self.pgs.values()):
+            eng = pg._scrub_engine
+            if eng is not None:
+                eng.abort()
         self._hb_stop.set()
         if self._hb_thread:
             self._hb_thread.join(timeout=5)
@@ -900,8 +945,30 @@ class OSDService(Dispatcher):
                 cl_rd_ops=delta["cl_rd_ops"],
                 cl_rd_bytes=delta["cl_rd_bytes"],
                 rec_ops=delta["rec_ops"],
-                rec_bytes=delta["rec_bytes"]))
+                rec_bytes=delta["rec_bytes"],
+                last_scrub=pg.last_scrub,
+                last_deep_scrub=pg.last_deep_scrub,
+                scrub_errors=pg.scrub_errors))
         return out
+
+    def dump_scrubs(self) -> dict:
+        """Per-PG scrub state (`ceph daemon osd.N dump_scrubs`): every
+        PG reports its stamps/errors; PGs whose engine was never
+        instantiated report an idle row."""
+        rows = []
+        for pgid, pg in sorted(self.pgs.items()):
+            eng = pg._scrub_engine
+            if eng is not None:
+                rows.append(eng.dump())
+            else:
+                rows.append({"pgid": t_.pgid_str(pgid),
+                             "running": False, "deep": False,
+                             "cursor": "",
+                             "last_scrub": pg.last_scrub,
+                             "last_deep_scrub": pg.last_deep_scrub,
+                             "scrub_errors": pg.scrub_errors,
+                             "preemptions": 0, "last_run_errors": 0})
+        return {"scrubs": rows}
 
     def activate_pgs(self, wait_s: float = 0.0) -> None:
         # async per-PG: one blocked peer RPC must not serialize every
@@ -1133,8 +1200,13 @@ class OSDService(Dispatcher):
                 try:
                     if action == "repair":
                         pg.repair()
+                    elif action == "deep-scrub":
+                        # the DISTINCT deep action (the mon used to
+                        # collapse `pg deep-scrub` to a shallow scrub):
+                        # byte-reading chunked verification
+                        pg.scrub_engine().run(deep=True)
                     else:
-                        pg.scrub()
+                        pg.scrub_engine().run(deep=False)
                 except Exception as e:
                     self._log(1, f"pg {pg.pgid} {action} failed: {e!r}")
                 finally:
@@ -1309,7 +1381,8 @@ class OSDService(Dispatcher):
             elif isinstance(msg, m.MPGQuery):
                 pg.handle_query(msg, conn)
             elif isinstance(msg, m.MScrub):
-                digests, unreadable = pg.local_scrub_map()
+                digests, unreadable = pg.local_scrub_map(
+                    deep=getattr(msg, "deep", True))
                 # objects this osd KNOWS exist but has not recovered
                 # (pg.missing) are exists-but-unservable: advertising
                 # them keeps a backfill consumer from treating our
@@ -1626,14 +1699,20 @@ class OSDService(Dispatcher):
             return set(reps[0].digests) | set(reps[0].unreadable)
         return None
 
-    def collect_scrub_maps(self, pg: PG) -> Dict[int, Dict[str, int]]:
+    def collect_scrub_maps(self, pg: PG, deep: bool = True,
+                           rpc_timeout: Optional[float] = None
+                           ) -> Dict[int, Dict[str, int]]:
         """{osd: {oid: digest}} with store-unreadable objects merged in
-        as SCRUB_UNREADABLE sentinels (exists, but never authoritative)."""
+        as SCRUB_UNREADABLE sentinels (exists, but never authoritative).
+        deep=False asks every member for the METADATA-ONLY map (no
+        data bytes read anywhere — the shallow scrub compare);
+        `rpc_timeout` bounds the one parallel map-fetch round (the
+        scrub engine shrinks it — it may hold the pg lock)."""
         from ceph_tpu.osd.pg import SCRUB_UNREADABLE
 
         peers = [o for o in set(pg.acting)
                  if o not in (self.whoami, 0x7FFFFFFF) and o >= 0]
-        digests, unreadable = pg.local_scrub_map()
+        digests, unreadable = pg.local_scrub_map(deep=deep)
         # symmetric with the MScrub handler: our own known-but-
         # unrecovered objects vote exists-but-unservable exactly like a
         # peer's would
@@ -1646,8 +1725,11 @@ class OSDService(Dispatcher):
         digests.update({o: SCRUB_UNREADABLE for o in unreadable})
         out = {self.whoami: digests}
         if peers:
-            reps = self._rpc([(p, m.MScrub(pg.pgid, self.epoch()))
-                              for p in peers])
+            reps = self._rpc([(p, m.MScrub(pg.pgid, self.epoch(),
+                                           deep=deep))
+                              for p in peers],
+                             timeout=rpc_timeout if rpc_timeout
+                             else 10.0)
             for rep in reps:
                 if isinstance(rep, m.MScrubMap):
                     dm = dict(rep.digests)
@@ -1657,13 +1739,15 @@ class OSDService(Dispatcher):
         return out
 
     def fetch_remote_chunk_full(self, pg: PG, osd_id: int, shard: int,
-                                oid: str):
+                                oid: str,
+                                timeout: Optional[float] = None):
         """(data, attrs, omap) of a remote shard, or None — the shard's
         metadata rides the read reply so scrub/repair never depend on
         the primary holding a local shard (reference handle_sub_read
         returns attrs, ECBackend.cc:955)."""
         reps = self._rpc([(osd_id, m.MECSubRead(pg.pgid, self.epoch(),
-                                                shard, oid, 0, 0))])
+                                                shard, oid, 0, 0))],
+                         timeout=timeout if timeout else 10.0)
         for rep in reps:
             if isinstance(rep, m.MECSubReadReply) and rep.result == 0:
                 return rep.data, dict(rep.attrs), dict(rep.omap)

@@ -712,15 +712,31 @@ class MPGPull(_PGMessage):
 @register
 class MScrub(_PGMessage):
     """Primary -> replica: send your scrub map (build_scrub_map_chunk
-    role, src/osd/PG.cc:4662)."""
+    role, src/osd/PG.cc:4662).
+
+    ``deep`` rides as a remaining_in_frame-gated tail (v1 blobs carry
+    no flag and decode deep=True — the only map older primaries ever
+    asked for was the byte-reading one): deep maps digest object DATA
+    + metadata; shallow maps digest metadata only (size, attr-version,
+    user attrs, omap — no data read), so silent data rot passes a
+    shallow scrub and is caught by the deep one."""
 
     TYPE = 24
 
+    def __init__(self, pgid=(0, 0), epoch=0, deep: bool = True) -> None:
+        super().__init__(pgid, epoch)
+        self.deep = deep
+
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
+        e.u8(1 if self.deep else 0)
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
+        if d.remaining_in_frame():
+            self.deep = bool(d.u8())
+        else:
+            self.deep = True
 
 
 @register

@@ -294,6 +294,14 @@ class PG:
         # PGStat unfound count.  Entries clear when a later round
         # recovers the object or a delete supersedes it.
         self.unfound: set = set()
+        # scrub attribution (the PGStat v2 tail feeding PG_DAMAGED /
+        # PG_NOT_DEEP_SCRUBBED): wall stamps of the last completed
+        # scrub passes + the unrepaired inconsistency count of the
+        # latest one.  Persisted in the pg meta by the ScrubEngine.
+        self.last_scrub = 0.0
+        self.last_deep_scrub = 0.0
+        self.scrub_errors = 0
+        self._scrub_engine = None
 
     # -- identity ---------------------------------------------------------
     def is_primary(self) -> bool:
@@ -344,11 +352,25 @@ class PG:
                 # rebuilds it, but a decode regression must be seen
                 self.osd._log(1, f"pg {self.pgid}: pgmeta info "
                                  f"unreadable: {e!r}")
-            self.log = PGLog.from_omap(self.osd.store.omap_get(self.coll, g))
+            om = self.osd.store.omap_get(self.coll, g)
+            self.log = PGLog.from_omap(om)
             if self.log.head > self.info.last_update:
                 # data+log landed but info didn't: log wins (replay)
                 self.info.last_update = self.log.head
             self._reindex_reqids()
+            # scrub stamps/errors survive daemon restarts (the
+            # PG_DAMAGED check must not clear because a daemon bounced)
+            from ceph_tpu.osd import scrub as _scrub
+
+            blob = om.get(_scrub.STAMPS_KEY)
+            if blob:
+                try:
+                    (self.last_scrub, self.last_deep_scrub,
+                     self.scrub_errors) = _scrub.decode_stamps(blob)
+                except DecodeError:
+                    # torn stamp blob: the next scrub rewrites it
+                    self.osd._log(1, f"pg {self.pgid}: scrub stamps "
+                                     f"unreadable, resetting")
 
     def _persist_meta(self, extra_omap: Optional[Dict[str, bytes]] = None):
         e = Encoder()
@@ -704,6 +726,16 @@ class PG:
             if self._recovery is None:
                 self._recovery = ECRecoveryEngine(self)
             return self._recovery
+
+    def scrub_engine(self):
+        """This PG's chunked scrub engine (osd/scrub.py; lazily
+        created — the recovery-engine shape)."""
+        from ceph_tpu.osd.scrub import ScrubEngine
+
+        with self.lock:
+            if self._scrub_engine is None:
+                self._scrub_engine = ScrubEngine(self)
+            return self._scrub_engine
 
     def note_peers_down(self, dead: set) -> None:
         """Map marked peers down: an in-flight recovery window must
@@ -3070,19 +3102,29 @@ class PG:
                     for o, d in sorted(digests.items())
                 ]
 
-    def _ec_gather(self, oid: str):
+    def _ec_gather(self, oid: str, rpc_timeout: Optional[float] = None):
         """(avail chunks, per-shard (attrs, omap) metas, lost shards)
         across the acting set; remote shard metadata rides the read
         replies, so nothing here depends on the primary holding a
-        local shard."""
+        local shard.  `rpc_timeout` bounds each remote fetch (the
+        scrub engine shrinks it: a gather under the pg lock must not
+        pin client writes for a dead peer's full RPC window)."""
         be: ECBackend = self.backend  # type: ignore[assignment]
         n = be.k + be.m
         acting = list(self.acting[:n])
         avail: Dict[int, bytes] = {}
         metas: Dict[int, Tuple[Dict[str, bytes], Dict[str, bytes]]] = {}
         lost: List[int] = []
+        omap_ = self.osd.osdmap
         for shard, osd_id in enumerate(acting):
             if osd_id in (CRUSH_ITEM_NONE, -1):
+                continue
+            if (osd_id != self.osd.whoami and omap_ is not None
+                    and not omap_.is_up(osd_id)):
+                # a down holder can never answer: count the shard lost
+                # NOW instead of burning the RPC window per shard (the
+                # scrub engine holds the pg lock across this gather)
+                lost.append(shard)
                 continue
             if osd_id == self.osd.whoami:
                 c = be.read_local_chunk(oid, shard)
@@ -3093,7 +3135,7 @@ class PG:
                     metas[shard] = be.shard_meta(oid, shard)
             else:
                 full = self.osd.fetch_remote_chunk_full(
-                    self, osd_id, shard, oid)
+                    self, osd_id, shard, oid, timeout=rpc_timeout)
                 if full is None:
                     lost.append(shard)
                 else:
@@ -3136,7 +3178,25 @@ class PG:
             self._repair_replicated()
         return self.scrub()
 
-    def _repair_replicated(self) -> None:
+    def repair_objects(self, oids: List[str],
+                       rpc_timeout: float = 30.0) -> None:
+        """Targeted repair of a known-inconsistent object list (the
+        ScrubEngine auto-repair entry): same consensus + replace-
+        semantics write-back as repair(), without re-walking the whole
+        PG.  Verification is the caller's job.  `rpc_timeout` bounds
+        each repair push (the scrub engine shrinks it: a push to a
+        peer that died after the gather must not pin the pg lock for
+        the full RPC window — the chaos-matrix client-op-timeout
+        class)."""
+        with self.lock:
+            assert self.is_primary(), "repair runs on the primary"
+        if self.is_ec():
+            self._repair_ec(oids, rpc_timeout=rpc_timeout)
+        else:
+            self._repair_replicated(oids)
+
+    def _repair_replicated(self,
+                           only: Optional[List[str]] = None) -> None:
         """Authoritative state = majority vote over every copy's
         observation — a real digest, "absent" (None: a missed delete is
         a legitimate winner; resurrecting deleted objects from one
@@ -3153,6 +3213,8 @@ class PG:
         all_oids = set()
         for dm in maps.values():
             all_oids |= set(dm)
+        if only is not None:
+            all_oids &= set(only)
         for oid in sorted(all_oids):
             digests = {o: dm.get(oid) for o, dm in maps.items()}
             if len(set(digests.values())) <= 1:
@@ -3220,10 +3282,13 @@ class PG:
                         self.pgid, self.osd.epoch(), oid, self.log.head,
                         deleted=True, shard=-1))], timeout=30.0)
 
-    def _repair_ec(self) -> None:
+    def _repair_ec(self, only: Optional[List[str]] = None,
+                   rpc_timeout: float = 30.0) -> None:
         be: ECBackend = self.backend  # type: ignore[assignment]
         n = be.k + be.m
-        for oid in be.object_names():
+        oids = be.object_names() if only is None else \
+            [o for o in be.object_names() if o in set(only)]
+        for oid in oids:
             # the whole per-object gather->consensus->write-back runs
             # under the PG lock so client writes (which take it in
             # _do_write) cannot interleave and leave a mixed-generation
@@ -3233,7 +3298,8 @@ class PG:
             # already relies on this)
             with self.lock:
                 acting = list(self.acting[:n])
-                avail, metas, lost = self._ec_gather(oid)
+                avail, metas, lost = self._ec_gather(
+                    oid, rpc_timeout=rpc_timeout)
                 state, inconsistent = self._ec_consensus(oid, avail, metas)
                 if state is None:
                     continue  # clean PG has nothing in `lost` either
@@ -3246,7 +3312,8 @@ class PG:
                     if osd_id in (CRUSH_ITEM_NONE, -1):
                         continue
                     self._write_repaired_shard(oid, shard, osd_id,
-                                               chunks[shard], state)
+                                               chunks[shard], state,
+                                               rpc_timeout=rpc_timeout)
 
     def _ec_consensus(self, oid: str, avail: Dict[int, bytes],
                       metas: Dict[int, Tuple[Dict[str, bytes],
@@ -3308,9 +3375,16 @@ class PG:
         return best[1], best[2]
 
     def _write_repaired_shard(self, oid: str, shard: int, osd_id: int,
-                              chunk: bytes, state: ObjectState) -> None:
+                              chunk: bytes, state: ObjectState,
+                              rpc_timeout: float = 30.0) -> None:
         from ceph_tpu.osd.backend import _hinfo
 
+        omap_ = self.osd.osdmap
+        if (osd_id != self.osd.whoami and omap_ is not None
+                and not omap_.is_up(osd_id)):
+            # the holder died after the gather: recovery owns its
+            # catch-up; a push RPC would only burn the timeout window
+            return
         self._obc_invalidate(oid)
         if osd_id == self.osd.whoami:
             g = GHObject(oid, shard=shard)
@@ -3331,20 +3405,55 @@ class PG:
         attrs["_av"] = self._av_for(oid)
         self.osd.rpc([(osd_id, m.MPGPush(
             self.pgid, self.osd.epoch(), oid, self.log.head,
-            chunk, attrs, dict(state.omap), shard=shard))], timeout=30.0)
+            chunk, attrs, dict(state.omap), shard=shard))],
+            timeout=rpc_timeout)
 
-    def _local_object_digest(self, oid) -> Optional[int]:
-        """Digest of one local object's (data, xattrs, omap); None when
-        absent, SCRUB_UNREADABLE when the store refuses the read."""
+    def _local_object_digest(self, oid,
+                             deep: bool = True) -> Optional[int]:
+        """Digest of one local object; None when absent,
+        SCRUB_UNREADABLE when the store refuses the read.
+
+        deep=True digests (data, xattrs, omap) — the byte-reading map.
+        deep=False digests METADATA only — logical size, the ``_av``
+        attr-version stamp, user attrs and omap, with NO data read and
+        the per-shard fields (hinfo crc, recovery progress markers)
+        excluded so every shard/replica of one healthy object
+        fingerprints identically.  Silent data rot passes the shallow
+        digest by construction; that is deep scrub's job."""
         g = oid if isinstance(oid, GHObject) else GHObject(oid)
         if not self.osd.store.exists(self.coll, g):
             return None
-        try:
-            data = self.osd.store.read(self.coll, g)
-        except Exception:
-            return SCRUB_UNREADABLE
-        d = crc32c(data)
+        if deep:
+            try:
+                data = self.osd.store.read(self.coll, g)
+            except Exception:
+                return SCRUB_UNREADABLE
+            d = crc32c(data)
+        else:
+            # logical size: from hinfo for EC shards (the shard's stat
+            # is chunk-sized), from stat for replicas — no data read
+            try:
+                attrs0 = self.osd.store.getattrs(self.coll, g)
+            except Exception:
+                return SCRUB_UNREADABLE
+            size = None
+            if "hinfo" in attrs0:
+                from ceph_tpu.osd.backend import hinfo_decode
+
+                try:
+                    size, _, _ = hinfo_decode(attrs0["hinfo"])
+                except Exception:
+                    return SCRUB_UNREADABLE
+            if size is None:
+                try:
+                    size = self.osd.store.stat(self.coll, g)
+                except Exception:
+                    return SCRUB_UNREADABLE
+            d = crc32c(size.to_bytes(8, "little"))
+        skip = () if deep else ("hinfo", "_size_hint", "_rprogress")
         for k in sorted(self.osd.store.getattrs(self.coll, g)):
+            if k in skip:
+                continue
             d = crc32c(k.encode(), d)
             d = crc32c(self.osd.store.getattr(self.coll, g, k), d)
         om = self.osd.store.omap_get(self.coll, g)
@@ -3353,19 +3462,21 @@ class PG:
             d = crc32c(om[k], d)
         return d
 
-    def local_scrub_map(self) -> Tuple[Dict[str, int], List[str]]:
-        """(oid -> digest of (data, xattrs, omap), [unreadable oids]).
-        An object the store itself refuses to read (at-rest csum
-        failure) lands in the unreadable list: it still votes "exists"
-        during repair auth selection but can never be authoritative —
-        and a PG where EVERY copy is unreadable scrubs inconsistent,
-        not clean."""
+    def local_scrub_map(self, deep: bool = True
+                        ) -> Tuple[Dict[str, int], List[str]]:
+        """(oid -> digest, [unreadable oids]) — deep maps digest data
+        + metadata, shallow maps metadata only (see
+        _local_object_digest).  An object the store itself refuses to
+        read (at-rest csum failure) lands in the unreadable list: it
+        still votes "exists" during repair auth selection but can
+        never be authoritative — and a PG where EVERY copy is
+        unreadable scrubs inconsistent, not clean."""
         out: Dict[str, int] = {}
         unreadable: List[str] = []
         for o in self.osd.store.collection_list(self.coll):
             if o.name == "_pgmeta_":
                 continue
-            d = self._local_object_digest(o)
+            d = self._local_object_digest(o, deep=deep)
             if d == SCRUB_UNREADABLE:
                 unreadable.append(o.name)
             elif d is not None:

@@ -305,7 +305,10 @@ class QosScheduler:
         self._recovery_widened = 0
         self._recovery_clamped = 0
         self._recovery_granted = 0
-        self._snaptrim_bucket: Optional[_TokenBucket] = None
+        # background sweep pacing: one token bucket per paced class
+        # (snaptrim object trims, scrub chunk reads), each tracking
+        # its class limit
+        self._bg_buckets: Dict[str, _TokenBucket] = {}
         if perf is not None:
             perf.add_u64_counter("dequeue_reservation",
                                  "dequeues granted by a due "
@@ -449,15 +452,15 @@ class QosScheduler:
     def background_pause(self, cls: str, n: float = 1.0) -> float:
         """Charge `n` background work units to `cls` and return the
         seconds the sweep should pause to stay inside the class limit
-        (0.0 when unlimited).  The snaptrim grant discipline: the
-        sweep loop owns the interruptible wait."""
-        if cls != "snaptrim":
+        (0.0 when unlimited).  The snaptrim/scrub grant discipline:
+        the sweep loop owns the interruptible wait."""
+        if cls not in ("snaptrim", "scrub"):
             return 0.0
         limit = self.registry.info_for(cls).limit
         with self._lock:
-            b = self._snaptrim_bucket
+            b = self._bg_buckets.get(cls)
             if b is None or b.rate != limit:
-                b = self._snaptrim_bucket = _TokenBucket(
+                b = self._bg_buckets[cls] = _TokenBucket(
                     limit, clock=self.clock)
         return b.charge(n)
 

@@ -135,7 +135,13 @@ class PGStat:
     ``cl_*``/``rec_*`` are WINDOWED deltas since this osd's previous
     report (the reporting daemon differences its cumulative per-PG
     counters), so the mon's snapshot-ring can rate-derive client
-    IOPS/BW and recovery objects/s without daemon clock coupling."""
+    IOPS/BW and recovery objects/s without daemon clock coupling.
+
+    v2 tail (scrub attribution for the PG_DAMAGED /
+    PG_NOT_DEEP_SCRUBBED health checks): ``last_scrub`` /
+    ``last_deep_scrub`` wall stamps (0.0 = never) + the count of
+    inconsistent objects the PG's latest scrub left unrepaired.  v1
+    blobs decode with the tail defaulted."""
 
     pgid: PGId = (0, 0)
     state: str = ""
@@ -153,9 +159,12 @@ class PGStat:
     cl_rd_bytes: int = 0
     rec_ops: int = 0          # objects recovered since the last report
     rec_bytes: int = 0
+    last_scrub: float = 0.0       # v2: wall stamp of the last scrub
+    last_deep_scrub: float = 0.0  # v2: wall stamp of the last DEEP scrub
+    scrub_errors: int = 0         # v2: unrepaired scrub inconsistencies
 
     def encode(self, e: Encoder) -> None:
-        e.start(1, 1)
+        e.start(2, 1)
         e.s64(self.pgid[0]).u32(self.pgid[1])
         e.string(self.state)
         e.u8(1 if self.primary else 0)
@@ -165,11 +174,13 @@ class PGStat:
         e.u64(self.cl_wr_ops).u64(self.cl_wr_bytes)
         e.u64(self.cl_rd_ops).u64(self.cl_rd_bytes)
         e.u64(self.rec_ops).u64(self.rec_bytes)
+        e.f64(self.last_scrub).f64(self.last_deep_scrub)
+        e.u64(self.scrub_errors)
         e.finish()
 
     @classmethod
     def decode(cls, d: Decoder) -> "PGStat":
-        d.start(1)
+        v = d.start(2)
         out = cls(
             pgid=(d.s64(), d.u32()),
             state=d.string(),
@@ -188,6 +199,10 @@ class PGStat:
             rec_ops=d.u64(),
             rec_bytes=d.u64(),
         )
+        if v >= 2:
+            out.last_scrub = d.f64()
+            out.last_deep_scrub = d.f64()
+            out.scrub_errors = d.u64()
         d.end()
         return out
 

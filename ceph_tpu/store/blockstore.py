@@ -743,7 +743,9 @@ class BlockStore(ObjectStore):
                 e = min(end, lend)
                 chunk = self._blob_read(bid, boff + (s - loff), e - s)
                 buf[s - off: e - off] = chunk
-            return bytes(buf)
+        # silent-corruption seam AFTER the at-rest crc verify: exactly
+        # the rot a crc-at-rest store cannot see (objectstore filter)
+        return self._read_filter(bytes(buf), cid, oid)
 
     def stat(self, cid: Collection, oid: GHObject) -> int:
         with self._lock:
@@ -755,7 +757,7 @@ class BlockStore(ObjectStore):
             v = self._kv.get(P_XATTR, f"{_objkey(cid, oid)}/{name}")
             if v is None:
                 raise StoreError(f"no attr {name!r} on {oid.name}")
-            return v
+        return self._attr_filter(v, cid, oid, name)
 
     def getattrs(self, cid: Collection, oid: GHObject) -> Dict[str, bytes]:
         with self._lock:

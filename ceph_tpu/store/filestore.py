@@ -312,6 +312,7 @@ class FileStore(ObjectStore):
             return
         if code == os_.OP_WRITE:
             self._data_write(op.cid, op.oid, op.off, os_.op_payload(op))
+            self._note_data_write(op.cid, op.oid)
             return
         if code == os_.OP_ZERO:
             self._data_write(op.cid, op.oid, op.off, b"\0" * op.length)
@@ -347,6 +348,7 @@ class FileStore(ObjectStore):
                 os.unlink(self._datafile(op.cid, op.oid))
             except FileNotFoundError:
                 pass
+            self._note_data_write(op.cid, op.oid)
             return
         if code == os_.OP_SETATTRS:
             for name, val in op.attrs.items():
@@ -532,10 +534,13 @@ class FileStore(ObjectStore):
             if self._file_compressed(path):
                 content = self._load_file(path)
                 end = len(content) if length == 0 else off + length
-                return content[off:end]
-            with open(path, "rb") as f:
-                f.seek(off)
-                return f.read() if length == 0 else f.read(length)
+                data = content[off:end]
+            else:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    data = f.read() if length == 0 else f.read(length)
+        # silent-corruption seam (objectstore._read_filter)
+        return self._read_filter(data, cid, oid)
 
     def stat(self, cid: Collection, oid: GHObject) -> int:
         with self._lock:
@@ -557,7 +562,7 @@ class FileStore(ObjectStore):
             v = self._kv.get(P_XATTR, f"{_objkey(cid, oid)}/{name}")
             if v is None:
                 raise StoreError(f"no attr {name!r} on {oid.name}")
-            return v
+        return self._attr_filter(v, cid, oid, name)
 
     def getattrs(self, cid: Collection, oid: GHObject) -> Dict[str, bytes]:
         with self._lock:

@@ -137,6 +137,7 @@ class MemStore(ObjectStore):
             # here via their one sanctioned store-apply view; the
             # slice assignment below is the copy into owned memory
             o.data[op.off:end] = os_.op_payload(op)
+            self._note_data_write(op.cid, op.oid)
             return
         if code == os_.OP_ZERO:
             o = self._obj(op.cid, op.oid, create=True)
@@ -158,9 +159,11 @@ class MemStore(ObjectStore):
             if op.oid not in c:
                 raise NoSuchObject(op.oid.name)
             del c[op.oid]
+            self._note_data_write(op.cid, op.oid)
             return
         if code == os_.OP_TRY_REMOVE:
             self._coll(op.cid).pop(op.oid, None)
+            self._note_data_write(op.cid, op.oid)
             return
         if code == os_.OP_SETATTRS:
             self._obj(op.cid, op.oid, create=True).xattrs.update(op.attrs)
@@ -203,8 +206,12 @@ class MemStore(ObjectStore):
         with self._lock:
             o = self._obj(cid, oid)
             if length == 0:
-                return bytes(o.data[off:])
-            return bytes(o.data[off:off + length])
+                data = bytes(o.data[off:])
+            else:
+                data = bytes(o.data[off:off + length])
+        # silent-corruption seam (objectstore._read_filter): outside
+        # the lock — the filter only touches its own bytes
+        return self._read_filter(data, cid, oid)
 
     def stat(self, cid: Collection, oid: GHObject) -> int:
         with self._lock:
@@ -215,7 +222,8 @@ class MemStore(ObjectStore):
             o = self._obj(cid, oid)
             if name not in o.xattrs:
                 raise StoreError(f"no attr {name!r} on {oid.name}")
-            return o.xattrs[name]
+            val = o.xattrs[name]
+        return self._attr_filter(val, cid, oid, name)
 
     def getattrs(self, cid: Collection, oid: GHObject) -> Dict[str, bytes]:
         with self._lock:

@@ -507,6 +507,66 @@ class ObjectStore:
     # whole-object copy purely to re-verify an application-level crc.
     checksums_at_rest = False
 
+    # -- silent-corruption injection (the scrub/repair test seam) ---------
+    # Two routes corrupt the bytes a read SERVES without touching what
+    # is stored (silent at-rest rot, invisible to everything but a
+    # byte-reading deep scrub):
+    #   - the store.corrupt_chunk / store.corrupt_xattr failpoints
+    #     (seeded, match-scoped — the chaos-schedule route), and
+    #   - debug_inject_data_err marks (conf store_debug_inject_data_err
+    #     enables the mechanism, like the PR 7 read-err hook) — the
+    #     deterministic single-object route.  A REWRITE of a marked
+    #     object clears its mark (the bad media got overwritten), so
+    #     corrupt -> deep-scrub detect -> auto-repair -> clean re-scrub
+    #     is a closed deterministic loop.
+    debug_data_err_enabled = False
+
+    def debug_inject_data_err(self, cid: Collection, oid: GHObject) -> None:
+        if not hasattr(self, "_data_err_objs"):
+            self._data_err_objs: set = set()
+        self._data_err_objs.add((cid.name, oid.name, oid.shard))
+
+    def debug_clear_data_err(self) -> None:
+        if hasattr(self, "_data_err_objs"):
+            self._data_err_objs.clear()
+
+    def _note_data_write(self, cid: Collection, oid: GHObject) -> None:
+        """Called by backends when an object's DATA is rewritten or the
+        object removed: overwriting the media drops its data-err mark."""
+        marks = getattr(self, "_data_err_objs", None)
+        if marks:
+            marks.discard((cid.name, oid.name, oid.shard))
+
+    def _read_filter(self, data, cid: Collection, oid: GHObject):
+        """The read-boundary corruption seam: every backend routes its
+        read() return through here.  Disarmed cost is one enabled()
+        check + one class-attr load."""
+        from ceph_tpu.core import failpoint as fp
+
+        if fp.enabled("store.corrupt_chunk") and fp.failpoint(
+                "store.corrupt_chunk", oid=oid.name, coll=cid.name,
+                shard=str(oid.shard)) is fp.CORRUPT:
+            data = fp.corrupt_bytes(
+                data, f"{cid.name}/{oid.name}/{oid.shard}")
+        if self.debug_data_err_enabled:
+            marks = getattr(self, "_data_err_objs", None)
+            if marks and (cid.name, oid.name, oid.shard) in marks:
+                data = fp.corrupt_bytes(
+                    data, f"err/{cid.name}/{oid.name}/{oid.shard}")
+        return data
+
+    def _attr_filter(self, val, cid: Collection, oid: GHObject,
+                     name: str):
+        """getattr() twin of _read_filter (store.corrupt_xattr)."""
+        from ceph_tpu.core import failpoint as fp
+
+        if fp.enabled("store.corrupt_xattr") and fp.failpoint(
+                "store.corrupt_xattr", oid=oid.name, coll=cid.name,
+                shard=str(oid.shard), attr=name) is fp.CORRUPT:
+            val = fp.corrupt_bytes(
+                val, f"{cid.name}/{oid.name}/{oid.shard}/{name}")
+        return val
+
     # -- lifecycle --------------------------------------------------------
     def mkfs(self) -> None:
         raise NotImplementedError

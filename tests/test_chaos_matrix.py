@@ -1,0 +1,63 @@
+"""Deterministic chaos scenario matrix (the teuthology thrashosds +
+background-task analog): the EC rados-model sequence — with its
+acked-durability oracle — runs while seeded OSD kills AND a scenario's
+churn run concurrently:
+
+  scrub  always-on deep scrub + auto-repair over seeded silent
+         corruption (store.corrupt_chunk on a full-write rot namespace)
+  tier   cache-tier write/promote/flush/evict churn
+  snap   selfmanaged snap create / clone / trim churn
+  all    every churn at once (the acceptance chaos matrix)
+
+One fast representative per scenario runs in tier-1 (seconds each, one
+fixed seed); the multi-seed grids live behind -m slow.  The scenario
+machinery itself is tools/thrash_hunt.py::run_scenario — the same code
+an operator drives with `thrash_hunt.py --scenario ...`."""
+
+import os
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+sys.path.insert(0, os.path.abspath(TOOLS))
+
+import thrash_hunt  # noqa: E402
+
+
+def test_chaos_scenario_scrub_fast():
+    """Deep scrub + auto-repair over seeded rot, concurrent with kills
+    and the model oracle: repairs fire (the corruption schedule is
+    asserted to have fired), rot objects read clean at the end, and no
+    acked model data is harmed."""
+    assert thrash_hunt.run_scenario(0xC405, "scrub", rounds=40)
+
+
+def test_chaos_scenario_tier_fast():
+    assert thrash_hunt.run_scenario(0xC406, "tier", rounds=40)
+
+
+def test_chaos_scenario_snap_fast():
+    assert thrash_hunt.run_scenario(0xC407, "snap", rounds=40)
+
+
+def test_chaos_scenario_combined_fast():
+    """One combined (scrub+tier+snap churn concurrent with kills and
+    injected corruption) representative in tier-1."""
+    assert thrash_hunt.run_scenario(0xC408, "all", rounds=40)
+
+
+@pytest.mark.slow
+def test_chaos_matrix_ten_seeds_combined():
+    """The acceptance grid: >= 10 seeds of the combined scenario, all
+    green with the acked-durability oracle."""
+    assert thrash_hunt.run_scenario_matrix(
+        0xC408, ["all"], rounds=80, tries=10) == 0
+
+
+@pytest.mark.slow
+def test_chaos_matrix_per_scenario_seeds():
+    """Per-scenario seed sweeps (scrub/tier/snap), the
+    `thrash_hunt.py --scenario matrix` grid."""
+    assert thrash_hunt.run_scenario_matrix(
+        0xC410, ["scrub", "tier", "snap"], rounds=80, tries=4) == 0

@@ -327,6 +327,77 @@ def test_health_checks_from_pgmap_feed():
     assert any("peering" in d for d in checks["PG_STUCK"]["detail"])
 
 
+def test_digest_scrub_errors_and_pg_damaged_check():
+    """PGStat scrub_errors (the v2 tail) aggregates into the digest
+    and raises PG_DAMAGED (ERR) naming the pgs; clears when the stats
+    report clean again."""
+    mon = make_mon()
+    clk = Clock()
+    mon.pgmap = PGMapService(mon.ctx.conf, now_fn=clk)
+    mon.pgmap.ingest(0, 1, [
+        mkstat(ps=0, scrub_errors=2, last_scrub=900.0,
+               last_deep_scrub=900.0),
+        mkstat(ps=1)], 0, 0)
+    d = mon.pgmap.digest()
+    assert d["scrub_errors"] == 2 and d["damaged_pgs"] == 1
+    _status, checks = mon.services["health"].gather()
+    assert checks["PG_DAMAGED"]["severity"] == "HEALTH_ERR"
+    assert "2 scrub errors" in checks["PG_DAMAGED"]["summary"]
+    assert any("1.0" in line for line in checks["PG_DAMAGED"]["detail"])
+    # a replica's row must not double-count (primary rows only)
+    mon.pgmap.ingest(1, 1, [
+        mkstat(ps=0, primary=False, scrub_errors=2)], 0, 0)
+    assert mon.pgmap.digest()["scrub_errors"] == 2
+    # repaired: the next report clears the check
+    mon.pgmap.ingest(0, 1, [
+        mkstat(ps=0, scrub_errors=0, last_scrub=950.0,
+               last_deep_scrub=950.0),
+        mkstat(ps=1)], 0, 0)
+    assert mon.pgmap.digest()["scrub_errors"] == 0
+    _status, checks = mon.services["health"].gather()
+    assert "PG_DAMAGED" not in checks
+    # pg_rows carry the scrub fields for dump consumers
+    row = next(r for r in mon.pgmap.pg_rows() if r["pgid"] == "1.0")
+    assert row["last_deep_scrub"] == 950.0
+    assert row["scrub_errors"] == 0
+
+
+def test_not_deep_scrubbed_view_and_check():
+    """PG_NOT_DEEP_SCRUBBED: disabled at the conf default, raises for
+    primary PGs with old/never deep-scrub stamps once armed, clears
+    when the stamps refresh."""
+    mon = make_mon()
+    clk = Clock(t=10000.0)
+    mon.pgmap = PGMapService(mon.ctx.conf, now_fn=clk)
+    mon.pgmap.ingest(0, 1, [
+        mkstat(ps=0, last_deep_scrub=0.0),          # never
+        mkstat(ps=1, last_deep_scrub=9995.0),        # fresh
+        mkstat(ps=2, last_deep_scrub=9000.0),        # old
+        mkstat(ps=3, primary=False,
+               last_deep_scrub=0.0)], 0, 0)         # replica: ignored
+    assert mon.pgmap.not_deep_scrubbed() == []  # conf default 0 = off
+    _status, checks = mon.services["health"].gather()
+    assert "PG_NOT_DEEP_SCRUBBED" not in checks
+    mon.ctx.conf.set_val("mon_warn_not_deep_scrubbed_s", 100.0)
+    rows = mon.pgmap.not_deep_scrubbed()
+    assert {r["pgid"] for r in rows} == {"1.0", "1.2"}
+    assert next(r for r in rows
+                if r["pgid"] == "1.0")["age_s"] is None  # never
+    _status, checks = mon.services["health"].gather()
+    assert checks["PG_NOT_DEEP_SCRUBBED"]["severity"] == "HEALTH_WARN"
+    assert "2 pgs" in checks["PG_NOT_DEEP_SCRUBBED"]["summary"]
+    assert any("never" in d
+               for d in checks["PG_NOT_DEEP_SCRUBBED"]["detail"])
+    # deep scrubs land: the stamps refresh and the check clears
+    mon.pgmap.ingest(0, 1, [
+        mkstat(ps=0, last_deep_scrub=9990.0),
+        mkstat(ps=1, last_deep_scrub=9995.0),
+        mkstat(ps=2, last_deep_scrub=9990.0)], 0, 0)
+    assert mon.pgmap.not_deep_scrubbed() == []
+    _status, checks = mon.services["health"].gather()
+    assert "PG_NOT_DEEP_SCRUBBED" not in checks
+
+
 def test_health_stale_report_check_and_conf_cutoff():
     mon = make_mon()
     clk = Clock()
@@ -543,6 +614,41 @@ def test_progress_eta_converges_monotonically():
     assert code == 0 and out["events"] == []
     (done,) = out["completed"]
     assert done["duration_s"] == pytest.approx(10.0)
+    assert done["progress"] == 1.0
+
+
+def test_progress_repair_events_track_scrub_errors():
+    """A primary row reporting scrub_errors opens a repair progress
+    event; the event completes (with measured duration) when the PG's
+    report reads clean again — and repair events never complete
+    against the RECOVERY completion rule (disjoint id namespaces)."""
+    from ceph_tpu.mgr.manager import MgrDaemon
+
+    mgr = MgrDaemon(Context("test.repair_prog", {}))
+    prog = mgr.modules["progress"]
+    clk = Clock(0.0)
+    prog._now = clk
+    errs = {"v": 3}
+    mgr.pg_rows_fn = lambda: [{"pgid": "2.1", "primary": True,
+                               "degraded": 0,
+                               "scrub_errors": errs["v"]}]
+    prog.refresh()
+    ev = prog.events["repair-2.1"]
+    assert ev["baseline"] == 3 and "Repairing" in ev["message"]
+    # partially repaired: progress advances, the event stays open
+    clk.t = 2.0
+    errs["v"] = 1
+    prog.refresh()
+    assert prog.events["repair-2.1"]["progress"] == \
+        pytest.approx(2 / 3, abs=1e-3)
+    # clean report: completes with the measured duration
+    clk.t = 5.0
+    errs["v"] = 0
+    code, out = prog.handle_command({"prefix": "progress"})
+    assert code == 0 and out["events"] == []
+    (done,) = out["completed"]
+    assert done["id"] == "repair-2.1"
+    assert done["duration_s"] == pytest.approx(5.0)
     assert done["progress"] == 1.0
 
 

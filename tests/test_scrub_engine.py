@@ -1,0 +1,453 @@
+"""ScrubEngine acceptance: chunked deep scrub with device-coalesced
+decode verification, silent-corruption injection, shallow-vs-deep
+semantics, resumable cursor, auto-repair with replace semantics, QoS
+admission evidence, and the mon-side PG_DAMAGED raise/clear loop.
+
+Reference analogs: qa/standalone/scrub/ over the chunky scrubber +
+auto_repair, and the `ceph pg deep-scrub` command path."""
+
+import threading
+
+import pytest
+
+from ceph_tpu.core import failpoint as fp
+from ceph_tpu.osd import types as t_
+from ceph_tpu.store.objectstore import Collection, GHObject
+
+from tests.test_osd_cluster import (EC_POOL, N_OSDS, REP_POOL,
+                                    LibClient, MiniCluster)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    cl = LibClient(cluster)
+    yield cl
+    cl.shutdown()
+
+
+def _pg_of(cluster, pool, oid):
+    pgid, acting, primary = cluster.primary_of(pool, oid)
+    return pgid, acting, primary, cluster.osds[primary].pgs[pgid]
+
+
+def _victim(cluster, acting, primary):
+    shard = next(s for s, o in enumerate(acting)
+                 if o != primary and 0 <= o < N_OSDS)
+    return shard, acting[shard]
+
+
+def _mark_data_err(cluster, osd, pgid, oid, shard):
+    """Silently rot one shard: reads of it serve bit-flipped bytes
+    until something REWRITES the object (repair clears the mark)."""
+    cluster.ctx.conf.set_val("store_debug_inject_data_err", True)
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    cluster.osds[osd].store.debug_inject_data_err(
+        coll, GHObject(oid, shard=shard))
+
+
+def test_deep_scrub_clean_stamps_and_dump(cluster, client):
+    for i in range(4):
+        client.put(EC_POOL, f"dsc{i}", bytes([i + 1]) * 2500)
+    pgid, _a, primary, pg = _pg_of(cluster, EC_POOL, "dsc0")
+    eng = pg.scrub_engine()
+    assert eng.run(deep=True) == {}
+    assert pg.last_deep_scrub > 0 and pg.last_scrub > 0
+    assert pg.scrub_errors == 0
+    rows = cluster.osds[primary].dump_scrubs()["scrubs"]
+    row = next(r for r in rows if r["pgid"] == t_.pgid_str(pgid))
+    assert row["last_deep_scrub"] == pg.last_deep_scrub
+    assert row["running"] is False
+    # the PGStat feed carries the stamps (the PG_NOT_DEEP_SCRUBBED /
+    # PG_DAMAGED raw material)
+    stat = next(s for s in cluster.osds[primary].pg_stats()
+                if s.pgid == pgid)
+    assert stat.last_deep_scrub == pg.last_deep_scrub
+    assert stat.scrub_errors == 0
+
+
+def test_stamps_survive_daemon_restart(cluster, client):
+    client.put(EC_POOL, "persist_me", b"stamp" * 500)
+    pgid, _a, primary, pg = _pg_of(cluster, EC_POOL, "persist_me")
+    assert pg.scrub_engine().run(deep=True) == {}
+    stamp = pg.last_deep_scrub
+    assert stamp > 0
+    cluster.kill(primary)
+    cluster.revive(primary)
+    try:
+        pg2 = cluster.osds[primary].pgs[pgid]
+        assert pg2.last_deep_scrub == stamp  # loaded from pg meta
+    finally:
+        # leave the module cluster settled for the next test
+        for o in cluster.osds.values():
+            if o.up:
+                o.wait_pgs_settled(15.0)
+
+
+def test_shallow_misses_injected_flip_deep_detects_and_repairs(
+        cluster, client):
+    """The silent-corruption loop of the acceptance criteria, at
+    engine level: a read-boundary bit flip on one EC shard passes the
+    metadata-only shallow scrub, is found by the byte-reading deep
+    scrub, auto-repair rebuilds the shard with replace semantics and
+    the correct _av stamp, and the re-scrub is clean."""
+    payload = b"rot-target" * 400
+    client.put(EC_POOL, "rot0", payload)
+    pgid, acting, primary, pg = _pg_of(cluster, EC_POOL, "rot0")
+    shard, victim = _victim(cluster, acting, primary)
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    g = GHObject("rot0", shard=shard)
+    good_chunk = cluster.osds[victim].store.read(coll, g)
+    _mark_data_err(cluster, victim, pgid, "rot0", shard)
+    try:
+        eng = pg.scrub_engine()
+        # shallow scrub never reads data: the rot is invisible
+        assert "rot0" not in eng.run(deep=False)
+        assert pg.scrub_errors == 0
+        # deep scrub reads bytes: the flipped shard surfaces
+        errs = eng.run(deep=True, auto_repair=False)
+        assert "rot0" in errs, errs
+        assert any(str(shard) in e for e in errs["rot0"])
+        assert pg.scrub_errors >= 1
+        # auto-repair: rebuild, replace semantics, correct _av
+        assert eng.run(deep=True, auto_repair=True) == {}
+        assert pg.scrub_errors == 0
+        store = cluster.osds[victim].store
+        assert store.read(coll, g) == good_chunk  # mark cleared by the
+        # rewrite AND the rebuilt bytes are the authoritative chunk
+        assert store.getattr(coll, g, "_av") == pg._av_for("rot0")
+        assert client.get(EC_POOL, "rot0") == payload
+        assert eng.run(deep=True) == {}
+    finally:
+        cluster.ctx.conf.set_val("store_debug_inject_data_err", False)
+        for o in cluster.osds.values():
+            o.store.debug_clear_data_err()
+
+
+def test_corrupt_chunk_failpoint_is_seeded_and_scoped(cluster, client):
+    """The chaos-schedule route: store.corrupt_chunk armed with a
+    match scope flips ONLY the matched shard's reads, deterministically
+    per seed; deep scrub sees it, disarming restores clean reads."""
+    client.put(EC_POOL, "fprot", b"fp-rot" * 500)
+    pgid, acting, primary, pg = _pg_of(cluster, EC_POOL, "fprot")
+    shard, victim = _victim(cluster, acting, primary)
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    g = GHObject("fprot", shard=shard)
+    clean = cluster.osds[victim].store.read(coll, g)
+    fp.seed(0x15C)
+    fp.arm("store.corrupt_chunk", fp.CORRUPT_ACTION,
+           match={"oid": "fprot", "shard": str(shard)})
+    try:
+        rotten = cluster.osds[victim].store.read(coll, g)
+        assert rotten != clean
+        # seeded determinism: the same read rots identically
+        assert cluster.osds[victim].store.read(coll, g) == rotten
+        # an unmatched object is untouched
+        client.put(EC_POOL, "fpclean", b"x" * 100)
+        assert client.get(EC_POOL, "fpclean") == b"x" * 100
+        errs = pg.scrub_engine().run(deep=True, auto_repair=False)
+        assert "fprot" in errs, errs
+        assert fp.fired("store.corrupt_chunk") > 0
+    finally:
+        fp.disarm_all()
+    assert cluster.osds[victim].store.read(coll, g) == clean
+    assert pg.scrub_engine().run(deep=True) == {}
+
+
+def test_corrupt_xattr_failpoint(cluster, client):
+    client.put(REP_POOL, "xrot", b"meta")
+    client.op(REP_POOL, "xrot",
+              [t_.OSDOp(t_.OP_SETXATTR, name="user.k", data=b"value")])
+    pgid, acting, primary, pg = _pg_of(cluster, REP_POOL, "xrot")
+    replica = next(o for o in acting if o != primary)
+    coll = Collection(t_.pgid_str(pgid) + "_head")
+    fp.arm("store.corrupt_xattr", fp.CORRUPT_ACTION,
+           match={"oid": "xrot", "attr": "user.k"})
+    try:
+        got = cluster.osds[replica].store.getattr(
+            coll, GHObject("xrot"), "user.k")
+        assert got != b"value"
+        # unmatched attrs pass clean
+        assert cluster.osds[replica].store.getattrs(
+            coll, GHObject("xrot"))["user.k"] == b"value"
+    finally:
+        fp.disarm_all()
+    # xattr rot is METADATA rot: even the shallow scrub sees it — a
+    # count(1) arming flips exactly ONE member's digest read (the flip
+    # key is per-(coll, oid, attr), so an always-on arming would rot
+    # every member identically and the compare would agree)
+    fp.arm("store.corrupt_xattr", fp.CORRUPT_ACTION, count=1,
+           match={"oid": "xrot", "attr": "user.k"})
+    try:
+        errs = pg.scrub_engine().run(deep=False)
+        assert "xrot" in errs, errs
+    finally:
+        fp.disarm_all()
+    assert pg.scrub_engine().run(deep=False) == {}
+
+
+def test_deep_scrub_decode_coalesces(cluster, client):
+    """The device-coalesced verification evidence: a chunk's decodes
+    are all submitted before any is awaited, so objects sharing a
+    survivor signature verify in ONE wide recovery matmul (decode
+    batch width > 1 on the shared StripeBatchQueue)."""
+    from ceph_tpu.tpu.queue import default_queue
+
+    # find oids that land in one PG so a single chunk carries several
+    target = cluster.osdmap.object_to_pg(EC_POOL, "co_0")
+    oids, i = [], 0
+    while len(oids) < 6 and i < 500:
+        oid = f"co_{i}"
+        i += 1
+        if cluster.osdmap.object_to_pg(EC_POOL, oid) == target:
+            oids.append(oid)
+    assert len(oids) >= 4
+    for oid in oids:
+        client.put(EC_POOL, oid, oid.encode() * 300)
+    _u, _up, acting, primary = cluster.osdmap.pg_to_up_acting(target)
+    pg = cluster.osds[primary].pgs[target]
+    dq = default_queue()
+    before = dict(dq.dec_batch_jobs)
+    assert pg.scrub_engine().run(deep=True) == {}
+    widths = {w: n - before.get(w, 0)
+              for w, n in dq.dec_batch_jobs.items()
+              if n - before.get(w, 0) > 0}
+    assert widths, "deep scrub never used the decode queue"
+    assert max(widths) > 1, f"decodes never coalesced: {widths}"
+
+
+def test_mid_scrub_interrupt_resumes_from_cursor(cluster, client):
+    """Kill/interval-change mid-scrub RESUMES: the cursor persists per
+    chunk, so an interrupted deep scrub continues where it stopped
+    instead of restarting the walk (and the resume completes + stamps)."""
+    target = cluster.osdmap.object_to_pg(EC_POOL, "cur_0")
+    oids, i = [], 0
+    while len(oids) < 6 and i < 600:
+        oid = f"cur_{i}"
+        i += 1
+        if cluster.osdmap.object_to_pg(EC_POOL, oid) == target:
+            oids.append(oid)
+    assert len(oids) >= 6
+    for oid in oids:
+        client.put(EC_POOL, oid, oid.encode() * 200)
+    _u, _up, acting, primary = cluster.osdmap.pg_to_up_acting(target)
+    svc = cluster.osds[primary]
+    pg = svc.pgs[target]
+    eng = pg.scrub_engine()
+    names = sorted(pg.backend.object_names())
+    cluster.ctx.conf.set_val("osd_scrub_chunk_max", 2)
+    # park the scrub at its SECOND chunk (first chunk verified, cursor
+    # persisted), then abort the parked thread — the kill seam
+    fp.arm("scrub.chunk", fp.barrier("scrub-park"),
+           match={"first": names[2]})
+    out = []
+
+    def scrub_thread() -> None:
+        try:
+            out.append(eng.run(deep=True))
+        except fp.FailpointAborted:
+            pass  # the induced kill: cursor stays persisted
+
+    th = threading.Thread(target=scrub_thread, daemon=True)
+    try:
+        th.start()
+        assert fp.wait_hit("scrub-park", timeout=30.0)
+        deep, cursor = eng._load_cursor()
+        assert deep and cursor == names[1], (cursor, names)
+        objs0 = svc.scrub_perf.dump()["objects"]
+        fp.abort("scrub-park")
+        th.join(timeout=30.0)
+        assert not th.is_alive()
+    finally:
+        fp.disarm_all()
+        cluster.ctx.conf.set_val("osd_scrub_chunk_max", 16)
+    # the interrupted pass did NOT stamp (it never completed)
+    before_stamp = pg.last_deep_scrub
+    assert eng.run(deep=True) == {}
+    assert pg.last_deep_scrub > before_stamp
+    # the resume verified only the remainder of the walk
+    verified = svc.scrub_perf.dump()["objects"] - objs0
+    assert verified < len(names), (verified, len(names))
+    assert svc.scrub_perf.dump()["resumes"] >= 1
+    deep, cursor = eng._load_cursor()
+    assert cursor == ""  # completion reset the cursor
+
+
+def test_scrub_is_a_qos_tenant(cluster, client):
+    """Satellite: scrub chunk reads are charged to the mclock scrub
+    class (cost-tagged admission through the shard workqueue)."""
+    client.put(EC_POOL, "qos_scrub", b"q" * 4096)
+    _pgid, _a, primary, pg = _pg_of(cluster, EC_POOL, "qos_scrub")
+    qd0 = cluster.osds[primary].qos.perf.dump()
+    assert pg.scrub_engine().run(deep=True) == {}
+    qd = cluster.osds[primary].qos.perf.dump()
+    assert qd.get("admitted_scrub", 0) > qd0.get("admitted_scrub", 0)
+    assert isinstance(qd.get("wait_us_scrub"), dict)
+
+
+def test_scheduled_scrub_runs_deep_first():
+    """The always-on scheduler: a never-deep-scrubbed PG runs the
+    byte-verifying deep pass first (osd_deep_scrub_interval), catching
+    silent data rot the old shallow-only scheduler missed."""
+    c = MiniCluster()
+    cl = LibClient(c)
+    try:
+        cl.put(EC_POOL, "sched_rot", b"fresh" * 400)
+        pgid, acting, primary, pg = _pg_of(c, EC_POOL, "sched_rot")
+        shard, victim = _victim(c, acting, primary)
+        _mark_data_err(c, victim, pgid, "sched_rot", shard)
+        hits = []
+        ev = threading.Event()
+        psvc = c.osds[primary]
+        psvc.ctx.log.cluster_cb = lambda lvl, msg: (
+            hits.append((lvl, msg)),
+            ev.set() if "sched_rot" in msg else None)
+        psvc.start_scrub_scheduler(interval=0.2)
+        assert ev.wait(timeout=30.0), "deep scrub never found the rot"
+        assert any(lvl == "ERR" and "deep-scrub" in msg
+                   for lvl, msg in hits), hits
+        assert pg.scrub_errors >= 1
+    finally:
+        c.ctx.conf.set_val("store_debug_inject_data_err", False)
+        cl.shutdown()
+        c.shutdown()
+
+
+def test_pg_damaged_health_raises_and_clears_via_cli():
+    """End-to-end acceptance over vstart: seeded corruption -> the mon
+    `pg scrub` (shallow) misses it, `pg deep-scrub` (the previously
+    collapsed action) finds it -> PG_DAMAGED (ERR) raises with a
+    cluster-log transition -> auto-repair rebuilds -> the check clears
+    and a re-scrub is clean.  Bounded waits only, no sleeps in the
+    detect path."""
+    from ceph_tpu.vstart import VStartCluster
+
+    conf = {
+        "osd_pg_stats_interval": 0.25,
+        "mon_pg_stats_stale_s": 10.0,
+        "mon_tick_interval": 0.25,
+        "store_debug_inject_data_err": True,
+    }
+    with VStartCluster(n_mons=1, n_osds=3, conf=conf) as c:
+        pool = c.create_pool("scrubec", size=3, pool_type="erasure",
+                             ec_profile="k=2 m=1", pg_num=4)
+        io = c.client().ioctx(pool)
+        from ceph_tpu.osd.types import OSDOp
+
+        payload = b"damaged-pg" * 400
+        io.aio_operate("dmg0", [OSDOp(t_.OP_WRITEFULL,
+                                      data=payload)]).result(30.0)
+        mm = c.leader().osdmap
+        pgid = mm.object_to_pg(pool, "dmg0")
+        _u, _up, acting, primary = mm.pg_to_up_acting(pgid)
+        shard, victim = next((s, o) for s, o in enumerate(acting)
+                             if o != primary)
+        coll = Collection(t_.pgid_str(pgid) + "_head")
+        c.osds[victim].store.debug_inject_data_err(
+            coll, GHObject("dmg0", shard=shard))
+
+        def health():
+            code, out = c.command({"prefix": "health"})
+            assert code == 0
+            return out
+
+        # shallow `pg scrub` (the action the old mon sent for BOTH
+        # prefixes) does not read bytes: no damage reported
+        code, out = c.command({"prefix": "pg scrub",
+                               "pgid": f"{pgid[0]}.{pgid[1]}"})
+        assert code == 0 and out["action"] == "scrub"
+        pg = c.osds[primary].pgs[pgid]
+        c.wait_for(lambda: pg.last_scrub > 0, timeout=30.0,
+                   what="shallow scrub completion")
+        assert pg.scrub_errors == 0
+        assert "PG_DAMAGED" not in health()["checks"]
+
+        # deep-scrub plumbs the DISTINCT deep action and reads bytes
+        code, out = c.command({"prefix": "pg deep-scrub",
+                               "pgid": f"{pgid[0]}.{pgid[1]}"})
+        assert code == 0 and out["action"] == "deep-scrub"
+        c.wait_for(lambda: pg.scrub_errors > 0, timeout=30.0,
+                   what="deep scrub error detection")
+        c.wait_for(lambda: "PG_DAMAGED" in health()["checks"],
+                   timeout=30.0, what="PG_DAMAGED raised")
+        hc = health()
+        assert hc["status"] == "HEALTH_ERR"
+        assert "scrub errors" in hc["checks"]["PG_DAMAGED"]["summary"]
+
+        def _logged(needle):
+            def check():
+                code, log = c.command({"prefix": "log last"})
+                assert code == 0
+                return any(needle in line["msg"]
+                           for line in log["lines"])
+            return check
+
+        # the leader's next tick writes the transition edge via paxos
+        c.wait_for(_logged("PG_DAMAGED raised"), timeout=30.0,
+                   what="PG_DAMAGED raised cluster-log edge")
+
+        # auto-repair on re-issued deep scrub rebuilds (replace
+        # semantics, correct _av) and the check clears
+        c.ctx.conf.set_val("osd_scrub_auto_repair", True)
+        try:
+            code, _ = c.command({"prefix": "pg deep-scrub",
+                                 "pgid": f"{pgid[0]}.{pgid[1]}"})
+            assert code == 0
+            c.wait_for(lambda: pg.scrub_errors == 0, timeout=30.0,
+                       what="auto-repair clearing scrub_errors")
+            g = GHObject("dmg0", shard=shard)
+            assert c.osds[victim].store.getattr(coll, g, "_av") == \
+                pg._av_for("dmg0")
+            c.wait_for(
+                lambda: "PG_DAMAGED" not in health()["checks"],
+                timeout=30.0, what="PG_DAMAGED cleared")
+            c.wait_for(_logged("PG_DAMAGED cleared"), timeout=30.0,
+                       what="PG_DAMAGED cleared cluster-log edge")
+            assert pg.scrub_engine().run(deep=True) == {}
+        finally:
+            c.ctx.conf.set_val("osd_scrub_auto_repair", False)
+
+
+def test_pg_not_deep_scrubbed_health_check():
+    """PG_NOT_DEEP_SCRUBBED (WARN) names primary PGs whose deep-scrub
+    stamp is older than the conf age (never = infinitely old) and
+    clears once they deep-scrub."""
+    from ceph_tpu.vstart import VStartCluster
+
+    conf = {
+        "osd_pg_stats_interval": 0.25,
+        "mon_pg_stats_stale_s": 10.0,
+        "mon_tick_interval": 0.25,
+    }
+    with VStartCluster(n_mons=1, n_osds=3, conf=conf) as c:
+        pool = c.create_pool("nds", size=3, pg_num=2)
+        io = c.client().ioctx(pool)
+        from ceph_tpu.osd.types import OSDOp
+
+        io.aio_operate("o1", [OSDOp(t_.OP_WRITEFULL,
+                                    data=b"x" * 512)]).result(30.0)
+
+        def checks():
+            code, out = c.command({"prefix": "health"})
+            assert code == 0
+            return out["checks"]
+
+        # disabled by default: never-scrubbed PGs raise nothing
+        assert "PG_NOT_DEEP_SCRUBBED" not in checks()
+        c.ctx.conf.set_val("mon_warn_not_deep_scrubbed_s", 3600.0)
+        c.wait_for(lambda: "PG_NOT_DEEP_SCRUBBED" in checks(),
+                   timeout=30.0, what="not-deep-scrubbed warning")
+        # deep scrub every pg of the pool -> the check clears
+        mm = c.leader().osdmap
+        for ps in range(2):
+            _u, _up, _a, prim = mm.pg_to_up_acting((pool, ps))
+            pg = c.osds[prim].pgs[(pool, ps)]
+            assert pg.scrub_engine().run(deep=True) == {}
+        c.wait_for(lambda: "PG_NOT_DEEP_SCRUBBED" not in checks(),
+                   timeout=30.0, what="warning cleared after deep scrubs")

@@ -199,6 +199,317 @@ def run_matrix(seed: int, pool_kind: str, rounds: int, tries: int,
     return 1 if any(f for f, _t in cells.values()) else 0
 
 
+SCENARIOS = ("scrub", "tier", "snap", "all")
+
+
+def run_scenario(seed: int, name: str, rounds: int = 80,
+                 kills: bool = True) -> bool:
+    """One deterministic chaos scenario: the EC model sequence (the
+    acked-durability oracle) runs while a seeded thrasher bounces OSDs
+    AND the named churn runs concurrently — the scenarios where
+    production clusters actually diverge:
+
+      scrub  seeded store.corrupt_chunk rot on the EC pool's chunk
+             reads (hinfo crcs catch the flips on the data path, so
+             the oracle holds) + repeated deep scrubs with auto-repair
+      tier   cache-tier write/promote/flush/evict churn (REP cache
+             over the EC22 base pool, its own oid namespace)
+      snap   selfmanaged snap create / overwrite (clone) / remove
+             (trim) churn on the rep pool
+      all    every churn at once (the acceptance chaos matrix)
+
+    Seeded end to end: the model mix, the thrasher schedule, the
+    corruption draws, and every churn loop derive from `seed`."""
+    sys.path.insert(0, "tests")
+    from ceph_tpu.core import failpoint as fp
+    from test_rados_model import _run_model_sequence
+    from test_osd_cluster import (EC22_POOL, EC_POOL, N_OSDS, REP_POOL,
+                                  LibClient, MiniCluster)
+
+    assert name in SCENARIOS, name
+    c = MiniCluster()
+    cl = LibClient(c)
+    stop = threading.Event()
+    churn_errors: list = []
+    threads = []
+
+    fp.disarm_all()
+    fp.seed(seed)
+    rot_payloads: dict = {}
+    if name in ("scrub", "all"):
+        # seeded silent rot, scoped to a dedicated full-write rot_*
+        # namespace on the EC pool.  Scoping matters: full writes keep
+        # a VALID hinfo crc, so every flipped read is caught at the
+        # chunk-crc gate (reads reconstruct around it, scrub sees
+        # missing-or-crc-mismatch, auto-repair rewrites).  Objects
+        # after a partial overwrite (append/truncate) carry an
+        # INVALIDATED crc by design — rotting those serves flipped
+        # bytes straight to clients (no gate exists until deep scrub's
+        # parity check runs), so a schedule that rots the model's own
+        # RMW'd objects fails the oracle for reasons scrub cannot
+        # prevent; the model instead proves rot+repair never damages
+        # BYSTANDER acked data
+        # the rot namespace lives on the EC22 pool: the model owns
+        # the EC pool's whole object listing (its verify asserts set
+        # equality), so scrub's corruption targets must not share it
+        for i in range(5):
+            data = f"rot_{i}".encode() * 300
+            cl.put(EC22_POOL, f"rot_{i}", data)
+            rot_payloads[f"rot_{i}"] = data
+        fp.arm("store.corrupt_chunk", fp.CORRUPT_ACTION, prob=0.25,
+               match={"coll": f"{EC22_POOL}.", "oid": "rot_"})
+
+        def scrub_churn() -> None:
+            while not stop.is_set():
+                for svc in list(c.osds.values()):
+                    if not svc.up:
+                        continue
+                    for pg in list(svc.pgs.values()):
+                        if stop.is_set():
+                            return
+                        if (pg.pgid[0] not in (EC_POOL, EC22_POOL)
+                                or not pg.is_primary()
+                                or pg.state != "active"):
+                            # degraded/peering PGs legitimately lack
+                            # shards: scrubbing them reports phantom
+                            # damage (the scheduler gates the same way)
+                            continue
+                        if not pg.maintenance_guard.acquire(
+                                blocking=False):
+                            continue
+                        try:
+                            pg.scrub_engine().run(deep=True,
+                                                  auto_repair=True)
+                        # cephlint: disable=silent-except — churn
+                        # under deliberate kills: any transport/state
+                        # error is the thrash itself, the next sweep
+                        # retries
+                        except Exception:
+                            pass
+                        finally:
+                            pg.maintenance_guard.release()
+                # a measured cadence: each sweep's repairs hold pg
+                # locks briefly; back-to-back sweeps under kills would
+                # starve the very client ops the oracle asserts
+                stop.wait(1.0)
+
+        threads.append(threading.Thread(target=scrub_churn,
+                                        daemon=True))
+    tier = None
+    tier_truth: dict = {}
+    if name in ("tier", "all"):
+        from ceph_tpu.client.cache_tier import CacheTier
+
+        # only EXPLICIT per-oid tier ops in the churn: agent_work
+        # evicts across the whole cache POOL listing, and both candidate
+        # cache pools are shared (REP holds the snap heads, EC22 the
+        # rot targets) — an agent pass evicted a bystander object
+        # straight to ENOENT in early runs.  Capacity stays above the
+        # churn's oid count so the tier never self-evicts either.
+        tier = CacheTier(cl.rc.ioctx(REP_POOL),
+                         cl.rc.ioctx(EC22_POOL),
+                         hit_set_period=0.05,
+                         min_recency_for_promote=2,
+                         capacity_objects=16)
+
+        def tier_churn() -> None:
+            rng = random.Random(seed ^ 0x7E1)
+            v = 0
+            while not stop.is_set():
+                oid = f"t{rng.randrange(6)}"
+                op = rng.random()
+                try:
+                    if op < 0.5:
+                        v += 1
+                        data = f"{oid}:{v}".encode() * 40
+                        tier.write_full(oid, data)
+                        tier_truth[oid] = data
+                    elif op < 0.7 and oid in tier_truth:
+                        tier.read(oid)
+                    elif op < 0.8 and oid in tier_truth:
+                        tier.flush(oid)
+                    elif oid in tier_truth:
+                        tier.flush(oid)
+                        tier.evict(oid)  # next read re-promotes
+                except Exception:
+                    # kill-window timeout: a timed-out WRITE may still
+                    # have landed, so the oid's value is indeterminate
+                    # — drop it from the final truth check (the model
+                    # oracle owns acked-durability; churn verification
+                    # only holds what verifiably completed)
+                    if op < 0.5:
+                        tier_truth.pop(oid, None)
+                stop.wait(0.05)
+
+        threads.append(threading.Thread(target=tier_churn, daemon=True))
+    snap_truth: dict = {}
+    if name in ("snap", "all"):
+        iosnap = cl.rc.ioctx(REP_POOL)
+
+        def snap_churn() -> None:
+            rng = random.Random(seed ^ 0x54A9)
+            snaps: list = []
+            v = 0
+            while not stop.is_set():
+                oid = f"s{rng.randrange(5)}"
+                op = rng.random()
+                try:
+                    if op < 0.55:
+                        v += 1
+                        data = f"{oid}:{v}".encode() * 30
+                        iosnap.write_full(oid, data)  # clones under
+                        snap_truth[oid] = data        # the live snaps
+                    elif op < 0.75:
+                        snaps.append(iosnap.selfmanaged_snap_create())
+                    elif snaps:
+                        # trim: the snaptrim QoS tenant does the work
+                        iosnap.selfmanaged_snap_remove(
+                            snaps.pop(rng.randrange(len(snaps))))
+                except Exception:
+                    if op < 0.55:  # indeterminate write: drop the oid
+                        snap_truth.pop(oid, None)
+                stop.wait(0.05)
+
+        threads.append(threading.Thread(target=snap_churn, daemon=True))
+
+    def thrasher() -> None:
+        rng = random.Random(seed ^ 0x5A5A)
+        while not stop.is_set():
+            victim = rng.randrange(N_OSDS)
+            try:
+                c.kill(victim)
+                stop.wait(rng.uniform(0.3, 0.8))
+                c.revive(victim)
+                stop.wait(rng.uniform(0.5, 1.0))
+            # cephlint: disable=silent-except — the thrasher's whole
+            # job is surviving mid-teardown races (run_one's shape)
+            except Exception:
+                pass
+
+    if kills:
+        threads.append(threading.Thread(target=thrasher, daemon=True))
+    for th in threads:
+        th.start()
+    t0 = time.time()
+    ok = False
+    try:
+        ops = _run_model_sequence(cl.rc.ioctx(EC_POOL),
+                                  random.Random(seed),
+                                  rounds=rounds, oid_space=12)
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+        # post-churn settle, then hold the CHURN namespaces to their
+        # own truth (the model's oracle already verified the model's)
+        for svc in c.osds.values():
+            if svc.up:
+                svc.wait_pgs_settled(15.0)
+        if name in ("scrub", "all"):
+            # one guaranteed post-settle deep-scrub sweep over the rot
+            # pgs (the thrash window may never have caught them in an
+            # active state): detect-and-repair runs WITH the rot still
+            # armed, so the schedule deterministically fires
+            rot_pgids = {c.osdmap.object_to_pg(EC22_POOL, o)
+                         for o in rot_payloads}
+            for pgid in sorted(rot_pgids):
+                _u, _up, _a, prim = c.osdmap.pg_to_up_acting(pgid)
+                svc = c.osds.get(prim)
+                if svc is None or not svc.up:
+                    continue
+                pg = svc.pgs.get(pgid)
+                if pg is None or not pg.maintenance_guard.acquire(
+                        blocking=False):
+                    continue
+                try:
+                    pg.scrub_engine().run(deep=True, auto_repair=True)
+                # cephlint: disable=silent-except — the final sweep
+                # runs best-effort on a just-settled cluster; the
+                # fired() assert below is the real gate
+                except Exception:
+                    pass
+                finally:
+                    pg.maintenance_guard.release()
+            assert fp.fired("store.corrupt_chunk") > 0, \
+                "the corruption schedule never fired"
+        fp.disarm_all()  # final churn verification reads clean media
+        deadline = time.time() + 30.0
+        for oid, want in sorted(rot_payloads.items()):
+            while True:
+                try:
+                    got = cl.get(EC22_POOL, oid)
+                    assert got == want, \
+                        f"{oid}: rotted object diverged after repair"
+                    break
+                except AssertionError:
+                    raise
+                except Exception:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(1.0)
+        for oid, want in sorted({**tier_truth, **snap_truth}.items()):
+            src = tier if oid.startswith("t") else None
+            while True:
+                try:
+                    got = (src.read(oid) if src is not None
+                           else cl.get(REP_POOL, oid))
+                    assert got == want, \
+                        f"{oid}: churn data diverged " \
+                        f"({len(got)}B vs {len(want)}B)"
+                    break
+                except AssertionError:
+                    raise
+                except Exception:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(1.0)
+        print(f"OK   scenario={name} seed={seed:#x} "
+              f"ops={sum(ops.values())} tier={len(tier_truth)} "
+              f"snaps={len(snap_truth)} ({time.time() - t0:.0f}s)",
+              flush=True)
+        ok = True
+    except AssertionError as e:
+        print(f"FAIL scenario={name} seed={seed:#x}: {e}", flush=True)
+        traceback.print_exc()
+    except Exception as e:
+        print(f"FAIL scenario={name} seed={seed:#x}: {e!r}", flush=True)
+        traceback.print_exc()
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+        fp.disarm_all()
+        for obj in (cl, c):
+            try:
+                obj.shutdown()
+            # cephlint: disable=silent-except — best-effort teardown
+            # after a possibly half-dead cluster (run_one's shape)
+            except Exception:
+                pass
+    return ok
+
+
+def run_scenario_matrix(seed: int, names, rounds: int,
+                        tries: int) -> int:
+    """The chaos scenario matrix as one command: scenario x seed grid
+    (seeds derived seed, seed+1, ...), failures/runs cell table — the
+    PR 7 --matrix shape for the PR 15 scenarios."""
+    cells = {}
+    for nm in names:
+        fails = 0
+        print(f"--- scenario {nm} ({tries} seeds from {seed:#x}) ---",
+              flush=True)
+        for i in range(tries):
+            if not run_scenario(seed + i, nm, rounds):
+                fails += 1
+        cells[nm] = (fails, tries)
+    print(f"\nscenario matrix (base seed={seed:#x} rounds={rounds}):",
+          flush=True)
+    for nm in names:
+        f, t = cells[nm]
+        print(f"{nm:8s} {f}/{t} failed", flush=True)
+    return 1 if any(f for f, _t in cells.values()) else 0
+
+
 def run_one(seed: int, pool_kind: str, rounds: int = 200) -> bool:
     sys.path.insert(0, "tests")
     from test_rados_model import _run_model_sequence
@@ -279,7 +590,24 @@ def main(argv=None) -> int:
     p.add_argument("--matrix", action="store_true",
                    help="devpath on/off x unloaded/loaded replay "
                         "grid for --seed; prints failures/runs cells")
+    p.add_argument("--scenario", choices=SCENARIOS + ("matrix",),
+                   default=None,
+                   help="chaos scenario runs: the EC model + seeded "
+                        "kills concurrent with deep-scrub/corruption "
+                        "(scrub), cache-tier churn (tier), snap churn "
+                        "(snap), every churn at once (all), or the "
+                        "full scenario x seed failures/runs grid "
+                        "(matrix); --seed sets the base seed, --tries "
+                        "the seeds per scenario")
     args = p.parse_args(argv)
+
+    if args.scenario is not None:
+        base = int(args.seed, 0) if args.seed is not None else 0xC405
+        tries = args.tries if args.tries is not None else 3
+        names = (list(SCENARIOS) if args.scenario == "matrix"
+                 else [args.scenario])
+        return run_scenario_matrix(base, names, args.rounds
+                                   if args.rounds != 200 else 80, tries)
 
     if args.matrix:
         if args.seed is None:
