@@ -10,6 +10,7 @@ from ceph_tpu.analysis.checks.qos_classes import QosClassRegistry
 from ceph_tpu.analysis.checks.silent_except import SilentExcept
 from ceph_tpu.analysis.checks.sleep_poll import NoSleepPoll
 from ceph_tpu.analysis.checks.span_discipline import SpanDiscipline
+from ceph_tpu.analysis.checks.unverified_read import NoUnverifiedRead
 from ceph_tpu.analysis.checks.unwatched_jit import NoUnwatchedJit
 
 ALL_CHECKS = (
@@ -24,6 +25,7 @@ ALL_CHECKS = (
     QosClassRegistry(),
     SpanDiscipline(),
     NoUnwatchedJit(),
+    NoUnverifiedRead(),
 )
 
 CHECKS_BY_NAME = {c.name: c for c in ALL_CHECKS}

@@ -1,0 +1,123 @@
+"""no-unverified-read: every store read must pass the per-extent
+verify gate in ``ObjectStore.read`` (store/objectstore.py).
+
+The read-time integrity contract has exactly one enforcement point:
+the base-class ``read()`` fetches a covering span via the backend's
+``_read_span`` hook, applies the corruption seam, verifies the served
+extents against their at-rest seals, and only then slices.  Any path
+around it is a silent-corruption conduit — rotted media served to a
+client as if it were the acked bytes.  Three bypass shapes exist and
+all are flagged:
+
+  * calling a backend's raw ``_read_span`` hook anywhere outside
+    store/objectstore.py (the hook returns UNVERIFIED bytes by
+    contract; only the gate may consume it),
+  * an ObjectStore subclass overriding ``read`` (shadowing the gate:
+    the override's reads never verify unless it reimplements the
+    whole discipline — backends implement ``_read_span`` instead),
+  * hard-disabling the gate with a literal ``verify_reads = False``
+    in production code (ceph_tpu/) — the knob exists for the bench
+    comparison and the conf observer, both of which assign a
+    runtime-computed value, never a constant.
+
+Baseline-free from day one: the gate ships with this PR, so there is
+no accepted debt — every violation is a hard error and
+``--write-baseline`` refuses to record them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from ceph_tpu.analysis.framework import (
+    NEVER_BASELINE_PREFIXES, Check, SourceFile, Violation, call_name,
+    enclosing_scope,
+)
+
+_GATE_FILE = "store/objectstore.py"
+
+
+def _is_objectstore_subclass(node: ast.ClassDef) -> bool:
+    for b in node.bases:
+        name = (b.id if isinstance(b, ast.Name)
+                else b.attr if isinstance(b, ast.Attribute) else "")
+        if name.endswith("ObjectStore"):
+            return True
+    return False
+
+
+class NoUnverifiedRead(Check):
+    name = "no-unverified-read"
+    description = ("store reads must go through the ObjectStore.read "
+                   "verify gate — no raw _read_span calls, read() "
+                   "overrides, or literal verify_reads=False")
+    scopes = ("ceph_tpu", "tools")
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        out: List[Violation] = []
+        for f in files:
+            if f.rel.endswith(_GATE_FILE):
+                continue  # the gate itself
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Call):
+                    base = call_name(node).rsplit(".", 1)[-1]
+                    if base == "_read_span":
+                        out.append(Violation(
+                            check=self.name, path=f.rel,
+                            line=node.lineno,
+                            scope=enclosing_scope(f.tree, node.lineno),
+                            detail="_read_span(...)",
+                            message=("_read_span returns UNVERIFIED "
+                                     "bytes — only ObjectStore.read "
+                                     "(the verify gate) may call it; "
+                                     "use store.read()"),
+                        ))
+                elif isinstance(node, ast.ClassDef):
+                    if not _is_objectstore_subclass(node):
+                        continue
+                    for item in node.body:
+                        if (isinstance(item, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))
+                                and item.name == "read"):
+                            out.append(Violation(
+                                check=self.name, path=f.rel,
+                                line=item.lineno,
+                                scope=f"{node.name}.read",
+                                detail="def read(...) override",
+                                message=("overriding ObjectStore.read "
+                                         "shadows the extent verify "
+                                         "gate — implement _read_span "
+                                         "instead"),
+                            ))
+                elif (isinstance(node, (ast.Assign, ast.AnnAssign))
+                      and f.rel.startswith("ceph_tpu/")):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    named = any(
+                        (isinstance(t, ast.Attribute)
+                         and t.attr == "verify_reads")
+                        or (isinstance(t, ast.Name)
+                            and t.id == "verify_reads")
+                        for t in targets)
+                    v = node.value
+                    if (named and isinstance(v, ast.Constant)
+                            and not v.value):
+                        out.append(Violation(
+                            check=self.name, path=f.rel,
+                            line=node.lineno,
+                            scope=enclosing_scope(f.tree, node.lineno),
+                            detail="verify_reads = False",
+                            message=("hard-disabling the read verify "
+                                     "gate in production code serves "
+                                     "rotted media as acked bytes — "
+                                     "gate via conf "
+                                     "(store_verify_read) instead"),
+                        ))
+        return out
+
+
+# the read-integrity gate must stay correct-by-construction: refuse
+# to baseline ANY violation of this check, anywhere
+NEVER_BASELINE_PREFIXES.append((NoUnverifiedRead.name, ""))

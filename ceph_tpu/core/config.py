@@ -244,8 +244,17 @@ def _opts() -> List[Option]:
         O("store_debug_inject_data_err", bool, False,
           "fault injection: reads of objects marked via "
           "debug_inject_data_err serve seeded bit-flipped bytes "
-          "(silent corruption — the store itself never notices; a "
-          "rewrite of the object clears its mark)"),
+          "(silent corruption, injected BEFORE the read-verify gate — "
+          "with store_verify_read on the store catches it at read "
+          "time; a rewrite of the object clears its mark)"),
+        O("store_csum_extent_kib", int, 64,
+          "at-rest checksum granularity: one crc32c seal per this many "
+          "KiB of logical object space, sealed in the writing "
+          "transaction (BlueStore csum_order analog)"),
+        O("store_verify_read", bool, True,
+          "verify per-extent at-rest seals on every read; a mismatch "
+          "raises instead of serving flipped bytes (off = bench "
+          "comparison mode — the corruption seam still applies)"),
         # -- client ---------------------------------------------------------
         O("objecter_timeout", float, 30.0, "op resend timeout"),
         O("objecter_inflight_ops", int, 1024, "op throttle"),

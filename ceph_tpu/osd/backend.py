@@ -43,11 +43,24 @@ from ceph_tpu.core import failpoint as fp
 from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.osd import messages as m
 from ceph_tpu.osd.types import EVersion, LogEntry, PGId
-from ceph_tpu.store.objectstore import Collection, GHObject, Transaction
+from ceph_tpu.store.objectstore import (
+    ChecksumError,
+    Collection,
+    GHObject,
+    Transaction,
+)
 from ceph_tpu.tpu.queue import default_queue
 from ceph_tpu.tpu.staging import DeviceBuf
 
 CRUSH_ITEM_NONE = 0x7FFFFFFF
+
+# Local-read verdicts (read_local_chunk2 / read_local_chunk_extent2).
+# ECRC (EILSEQ) distinguishes "the bytes are HERE but failed at-rest
+# checksum verification" from a plain missing shard: both reconstruct
+# from peers, but a crc failure is silent corruption caught at read
+# time and must be counted, health-attributed and queued for repair.
+ECRC = -84
+EIO_MISSING = -5  # shard absent / unreadable (plain missing, no blame)
 
 
 # Process-wide fan-out lane: encode futures hand their fan-out
@@ -977,17 +990,24 @@ class ECBackend(PGBackend):
         self.store.queue_transaction(txn, on_commit=on_commit)
 
     # -- reads ------------------------------------------------------------
-    def read_local_chunk(self, oid: str, shard: int) -> Optional[bytes]:
+    def read_local_chunk2(self, oid: str,
+                          shard: int) -> Tuple[Optional[bytes], int]:
+        """Whole local shard chunk with a verdict: (data, 0) on success,
+        (None, ECRC) when bytes exist but fail checksum verification
+        (store extent seals or hinfo crc), (None, EIO_MISSING) when the
+        shard is absent/unreadable for any other reason."""
         g = GHObject(oid, shard=shard)
         if not self.store.exists(self.coll, g):
-            return None
+            return None, EIO_MISSING
         try:
             data = self.store.read(self.coll, g)
+        except ChecksumError:
+            # at-rest corruption caught by the store's read-verify gate
+            # (per-extent seals / BlockStore device crc): the shard
+            # reads as missing AND the failure is attributable
+            return None, ECRC
         except Exception:
-            # at-rest corruption surfaced by the store itself (BlockStore
-            # crc32c-at-rest raises): the shard reads as missing and is
-            # reconstructed / repaired from its peers
-            return None
+            return None, EIO_MISSING
         # verify the stored crc before serving (handle_sub_read's
         # HashInfo check, ECBackend.cc:955); overwritten chunks carry an
         # invalidated crc and are vetted by scrub's parity check instead
@@ -995,18 +1015,24 @@ class ECBackend(PGBackend):
             _, want, valid = hinfo_decode(
                 self.store.getattr(self.coll, g, "hinfo"))
         except Exception:
-            return None
+            return None, EIO_MISSING
         if valid and crc32c(data) != want:
-            return None  # corrupt shard reads as missing -> reconstruct
-        return data
+            return None, ECRC  # corrupt shard -> reconstruct + repair
+        return data, 0
 
-    def read_local_chunk_extent(self, oid: str, shard: int, off: int,
-                                length: int) -> Optional[bytes]:
+    def read_local_chunk(self, oid: str, shard: int) -> Optional[bytes]:
+        return self.read_local_chunk2(oid, shard)[0]
+
+    def read_local_chunk_extent2(self, oid: str, shard: int, off: int,
+                                 length: int) -> Tuple[Optional[bytes], int]:
         """Extent [off, off+length) of a shard chunk (ranged sub-reads:
-        the RMW old-stripe fetch, vec extent rows).
+        the RMW old-stripe fetch, vec extent rows), with the same
+        verdict contract as read_local_chunk2.
 
-        On stores with their own at-rest checksums (BlockStore) the
-        extent is read directly: every block the store returns is
+        On stores whose read path verifies the bytes it serves — the
+        base ObjectStore per-extent seal gate (verify_reads) or
+        BlockStore's own per-block device crc (checksums_at_rest) — the
+        extent is read directly: every byte the store returns is
         already crc-verified at rest, so materializing the WHOLE chunk
         just to re-verify the hinfo crc adds a copy without adding
         protection for the bytes served.  Other stores keep the
@@ -1014,23 +1040,30 @@ class ECBackend(PGBackend):
         semantics are unchanged either way: corrupt data is never
         served (it reads as missing and is reconstructed from peers).
         """
-        if not getattr(self.store, "checksums_at_rest", False):
-            data = self.read_local_chunk(oid, shard)
-            return None if data is None else data[off: off + length]
+        if not (getattr(self.store, "checksums_at_rest", False)
+                or getattr(self.store, "verify_reads", False)):
+            data, code = self.read_local_chunk2(oid, shard)
+            return (None, code) if data is None else (
+                data[off: off + length], 0)
         g = GHObject(oid, shard=shard)
         if not self.store.exists(self.coll, g):
-            return None
+            return None, EIO_MISSING
         try:
             # the hinfo attr must still parse (same "no/garbled hinfo
             # reads as missing" answer as the whole-chunk path)
             hinfo_decode(self.store.getattr(self.coll, g, "hinfo"))
         except Exception:
-            return None
+            return None, EIO_MISSING
         try:
-            return self.store.read(self.coll, g, off, length)
+            return self.store.read(self.coll, g, off, length), 0
+        except ChecksumError:
+            return None, ECRC  # extent failed verification at read time
         except Exception:
-            # at-rest csum failure (ChecksumError): reads as missing
-            return None
+            return None, EIO_MISSING
+
+    def read_local_chunk_extent(self, oid: str, shard: int, off: int,
+                                length: int) -> Optional[bytes]:
+        return self.read_local_chunk_extent2(oid, shard, off, length)[0]
 
     def local_size(self, oid: str,
                    want_av: Optional[bytes] = None) -> Optional[int]:

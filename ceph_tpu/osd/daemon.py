@@ -238,6 +238,9 @@ class OSDService(Dispatcher):
         scpc.add_u64_counter("deep_done", "completed deep scrub passes")
         scpc.add_u64_counter("shallow_done",
                              "completed shallow scrub passes")
+        scpc.add_u64_counter("hinfo_reseals",
+                             "partial-overwrite-invalidated hinfo crcs "
+                             "re-sealed after a clean deep-scrub decode")
         self.scrub_perf = scpc
         self._wr_inflight = 0
         self._wr_inflight_hw = 0
@@ -349,6 +352,12 @@ class OSDService(Dispatcher):
         # objects serve bit-flipped bytes instead of raising
         self.store.debug_data_err_enabled = bool(
             self.ctx.conf.get("store_debug_inject_data_err"))
+        # read-time integrity knobs (base ObjectStore verify gate)
+        self.store.verify_reads = bool(
+            self.ctx.conf.get("store_verify_read"))
+        _ext_kib = int(self.ctx.conf.get("store_csum_extent_kib"))
+        if _ext_kib > 0:
+            self.store.csum_extent_size = _ext_kib << 10
 
         def _observe(name, val) -> None:
             if (name == "filestore_debug_inject_read_err"
@@ -356,10 +365,13 @@ class OSDService(Dispatcher):
                 self.store.debug_read_err_enabled = bool(val)
             elif name == "store_debug_inject_data_err":
                 self.store.debug_data_err_enabled = bool(val)
+            elif name == "store_verify_read":
+                self.store.verify_reads = bool(val)
 
         self.ctx.conf.add_observer(
             ("filestore_debug_inject_read_err",
-             "store_debug_inject_data_err"), _observe)
+             "store_debug_inject_data_err", "store_verify_read"),
+            _observe)
 
     def init(self) -> None:
         self._apply_fault_conf()

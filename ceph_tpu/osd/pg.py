@@ -39,6 +39,7 @@ from ceph_tpu.osd import messages as m
 from ceph_tpu.osd import types as t_
 from ceph_tpu.osd.backend import (
     CRUSH_ITEM_NONE,
+    ECRC,
     ECBackend,
     ObjectState,
     PGBackend,
@@ -49,8 +50,8 @@ from ceph_tpu.osd.pglog import PGLog
 from ceph_tpu.osd.recovery import READ_RETRY, ChunkGather, ECRecoveryEngine
 from ceph_tpu.tpu.staging import DeviceBuf, devpath_enabled
 from ceph_tpu.osd.types import EVersion, LogEntry, OSDOp, PGId, PGInfo
-from ceph_tpu.store.objectstore import (Collection, GHObject, StoreError,
-                                        Transaction)
+from ceph_tpu.store.objectstore import (ChecksumError, Collection, GHObject,
+                                        StoreError, Transaction)
 
 EPERM, ENOENT, EIO, EAGAIN, EINVAL = -1, -2, -5, -11, -22
 # READ_RETRY (defined in osd/recovery.py, re-exported here): EC reads
@@ -302,6 +303,11 @@ class PG:
         self.last_deep_scrub = 0.0
         self.scrub_errors = 0
         self._scrub_engine = None
+        # objects whose read-time verify failure is already counted
+        # and queued for auto-repair (dedup: a hot object re-read
+        # before the repair lands must not re-bump scrub_errors or
+        # stack repair threads).  Guarded by self.lock.
+        self._read_repair_pending: set = set()
 
     # -- identity ---------------------------------------------------------
     def is_primary(self) -> bool:
@@ -642,7 +648,16 @@ class PG:
         if self.is_ec():
             self._ec_read_object(oid, fill)
         else:
-            self.backend.read_object(oid, self.acting, fill)
+            try:
+                self.backend.read_object(oid, self.acting, fill)
+            except ChecksumError:
+                # the primary's own replica failed read verification:
+                # never the flipped bytes, never a bare EIO — the
+                # client retries (EAGAIN) while targeted repair pulls
+                # the authoritative copy from a healthy replica
+                self._note_read_verify_fail(
+                    oid, [(0, self.osd.whoami)])
+                fill(READ_RETRY)
 
     # -- object-context cache ---------------------------------------------
     def _obc_put(self, oid: str, state: Optional[ObjectState],
@@ -825,7 +840,17 @@ class PG:
                 return
             st = state
             if getattr(msg, "snapid", 0) and not self.is_ec():
-                st = self._resolve_snap(msg.oid, msg.snapid, state)
+                try:
+                    st = self._resolve_snap(msg.oid, msg.snapid, state)
+                except ChecksumError:
+                    # a rotted snap clone: same no-flipped-bytes /
+                    # no-bare-EIO rule as the head read
+                    self._note_read_verify_fail(
+                        msg.oid, [(0, self.osd.whoami)])
+                    reply(m.MOSDOpReply(self.pgid, self.osd.epoch(),
+                                        msg.oid, msg.ops,
+                                        result=EAGAIN))
+                    return
             if st is not None and st.xattrs.get("whiteout") == b"1":
                 # whiteouts (deleted head / deleted-as-of-snap clone)
                 # read as nonexistent
@@ -2321,18 +2346,22 @@ class PG:
         assert isinstance(self.backend, ECBackend)
         if msg.length:
             # ranged sub-read (RMW old-stripe fetch): served without
-            # materializing the whole chunk where the store's own
-            # at-rest checksums cover the extent; elsewhere the
-            # whole-chunk crc verify + slice is unchanged
-            data = self.backend.read_local_chunk_extent(
+            # materializing the whole chunk where the store's read
+            # path verifies the extent; elsewhere the whole-chunk crc
+            # verify + slice is unchanged
+            data, code = self.backend.read_local_chunk_extent2(
                 msg.oid, msg.shard, msg.off, msg.length)
         else:
-            data = self.backend.read_local_chunk(msg.oid, msg.shard)
+            data, code = self.backend.read_local_chunk2(msg.oid, msg.shard)
         attrs, omap = self.backend.shard_meta(msg.oid, msg.shard)
+        # an ECRC verdict travels to the primary: "I HAVE the shard but
+        # its bytes failed verification" — the primary decodes around
+        # it and queues the object for repair (a plain EIO would read
+        # as an ordinary missing shard and lose the attribution)
         rep = m.MECSubReadReply(
             self.pgid, self.osd.epoch(), msg.shard, msg.oid,
             data if data is not None else b"",
-            0 if data is not None else EIO,
+            0 if data is not None else code,
             attrs, omap)
         rep.tid = msg.tid
         conn.send(rep)
@@ -2356,24 +2385,24 @@ class PG:
                                  parent=msg.trace_ctx())
         try:
             be = self.backend
-            chunks: Dict[Tuple[str, int], Optional[bytes]] = {}
+            chunks: Dict[Tuple[str, int], Tuple[Optional[bytes], int]] = {}
             metas: Dict[Tuple[str, int], Tuple] = {}
             rows = []
             for shard, oid, off, length in msg.reads:
                 key = (oid, shard)
                 if length:
-                    data = be.read_local_chunk_extent(oid, shard, off,
-                                                      length)
+                    data, code = be.read_local_chunk_extent2(
+                        oid, shard, off, length)
                 else:
                     if key not in chunks:
-                        chunks[key] = be.read_local_chunk(oid, shard)
-                    data = chunks[key]
+                        chunks[key] = be.read_local_chunk2(oid, shard)
+                    data, code = chunks[key]
                 if key not in metas:
                     metas[key] = be.shard_meta(oid, shard)
                 attrs, omap = metas[key]
                 rows.append((shard, oid,
                              data if data is not None else b"",
-                             0 if data is not None else EIO, attrs, omap))
+                             0 if data is not None else code, attrs, omap))
             rep = m.MECSubReadVecReply(self.pgid, self.osd.epoch(), rows)
             rep.tid = msg.tid
             conn.send(rep)
@@ -2402,6 +2431,11 @@ class PG:
         g = ChunkGather(self, oid)
 
         def conclude(timed_out: bool = False) -> None:
+            if g.crc_failed:
+                # shards whose bytes exist but failed verification:
+                # the decode routes around them; attribution + repair
+                # happen regardless of this read's own verdict
+                self._note_read_verify_fail(oid, g.crc_failed)
             avail, meta, retry = g.resolve(timed_out)
             if retry:
                 # a current holder never answered / was down / was
@@ -3177,6 +3211,62 @@ class PG:
         else:
             self._repair_replicated()
         return self.scrub()
+
+    def _note_read_verify_fail(self, oid: str, where) -> None:
+        """A read-path at-rest checksum failure (store extent seals or
+        hinfo crc) was decoded around: count it, attribute it to
+        health, and queue the object for targeted auto-repair.
+        `where` lists the (shard, holder-osd) pairs that answered
+        ECRC.  Runs on the primary's read path — the client already
+        got correct bytes via reconstruction; everything here is
+        attribution + healing.  Dedup per object: a hot object re-read
+        before the repair (or the next scrub) lands must not re-bump
+        scrub_errors or stack repair threads."""
+        with self.lock:
+            if oid in self._read_repair_pending:
+                return
+            self._read_repair_pending.add(oid)
+            # feeds the PGStat tail -> mon PG_DAMAGED, exactly like a
+            # deep-scrub finding; a successful auto-repair below (or
+            # the next scrub's ground-truth recount) takes it back down
+            self.scrub_errors += 1
+        who = ", ".join(f"shard {s} (osd.{o})" for s, o in sorted(set(where)))
+        self.osd.ctx.log.cluster(
+            "ERR", f"pg {self.pgid} read of {oid}: at-rest checksum "
+                   f"failure on {who}; served via reconstruction, "
+                   f"queued for repair")
+        if not bool(self.osd.ctx.conf.get("osd_scrub_auto_repair")):
+            # operator-driven repair policy: the object stays counted
+            # (PG_DAMAGED raised) until a repair or scrub settles it
+            return
+
+        def _run() -> None:
+            ok = False
+            got_guard = self.maintenance_guard.acquire(timeout=30.0)
+            if not got_guard:
+                # a scrub/repair pass owns the window: it will see the
+                # damage itself; stay counted, clear pending so a later
+                # read can retry the repair
+                with self.lock:
+                    self._read_repair_pending.discard(oid)
+                return
+            try:
+                self.repair_objects([oid], rpc_timeout=5.0)
+                ok = True
+            except Exception as e:  # noqa: BLE001 — healing is best-
+                # effort; the scrub pipeline remains the backstop
+                self.osd._log(1, f"pg {self.pgid}: read-repair of "
+                                 f"{oid} failed: {e!r}")
+            finally:
+                self.maintenance_guard.release()
+                with self.lock:
+                    self._read_repair_pending.discard(oid)
+                    if ok and self.scrub_errors > 0:
+                        self.scrub_errors -= 1
+
+        threading.Thread(
+            target=_run, daemon=True,
+            name=f"pg{t_.pgid_str(self.pgid)}-readrepair").start()
 
     def repair_objects(self, oids: List[str],
                        rpc_timeout: float = 30.0) -> None:

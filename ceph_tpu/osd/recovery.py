@@ -42,7 +42,7 @@ from ceph_tpu.core.failpoint import failpoint
 from ceph_tpu.core.lockdep import make_lock
 from ceph_tpu.osd import messages as m
 from ceph_tpu.osd import types as t_
-from ceph_tpu.osd.backend import CRUSH_ITEM_NONE, _av_stamp
+from ceph_tpu.osd.backend import CRUSH_ITEM_NONE, ECRC, _av_stamp
 
 # EC reads that could not assemble k CURRENT chunks answer with this
 # sentinel: "retry later", never "doesn't exist" (mixing a
@@ -89,6 +89,10 @@ class ChunkGather:
         self.prior_meta: List = [None]
         # any chunk version-rejected (local pre-scan or a reply)
         self.av_reject = False
+        # (shard, holder-osd) pairs whose bytes EXIST but failed at-rest
+        # checksum verification (ECRC verdicts): the decode treats them
+        # as missing, the pg layer counts/attributes/repairs them
+        self.crc_failed: List[Tuple[int, int]] = []
         if not local_stale:
             # a holder that hasn't recovered this object yet must not
             # feed its own stale chunk into the decode
@@ -97,10 +101,12 @@ class ChunkGather:
                 if not self._av_ok(attrs):
                     self.av_reject = True
                     continue
-                c = be.read_local_chunk(oid, shard)
+                c, code = be.read_local_chunk2(oid, shard)
                 if c is not None:
                     self.cur_avail[shard] = c
                     self._better_meta(self.cur_meta, attrs, omap)
+                elif code == ECRC:
+                    self.crc_failed.append((shard, pg.osd.whoami))
         omap_ = pg.osd.osdmap
 
         def _up(o: int) -> bool:
@@ -195,6 +201,10 @@ class ChunkGather:
         became ready to resolve."""
         is_cur = self.holder_of.get((shard, src), False)
         good = result == 0 and oid == self.oid
+        if result == ECRC and oid == self.oid:
+            # the peer HAS the shard but its bytes failed verification:
+            # decode around it, and let the pg layer attribute/repair
+            self.crc_failed.append((shard, src))
         if good and not self._av_ok(attrs):
             # version-mismatched chunk: a failed answer for the
             # pending bookkeeping, and the read must end RETRYABLE
@@ -620,6 +630,10 @@ class ECRecoveryEngine:
         g = rnd.gathers[oid]
         with rnd.lock:
             avail, meta, retry = g.resolve(timed_out)
+        if g.crc_failed:
+            # recovery decoded around a checksum-failed holder: same
+            # attribution + targeted-repair path as a client read
+            self.pg._note_read_verify_fail(oid, g.crc_failed)
         if retry:
             self._oid_resolved(rnd, oid, ok=False, retry=True)
             return

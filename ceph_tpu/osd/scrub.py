@@ -141,6 +141,10 @@ class ScrubEngine:
             if deep:
                 pg.last_deep_scrub = now
             pg.scrub_errors = n_errors
+            # the scrub just recounted ground truth: read-time verify
+            # attributions are folded into n_errors (or healed), so
+            # future failures on the same objects count afresh
+            pg._read_repair_pending.clear()
             stamps = encode_stamps(pg.last_scrub, pg.last_deep_scrub,
                                    pg.scrub_errors)
         e = Encoder()
@@ -503,9 +507,49 @@ class ScrubEngine:
                     for shard, have in sorted(avail.items()):
                         if enc[shard][: len(have)] != have:
                             bad.append(f"shard {shard}: parity mismatch")
+                    if not bad:
+                        # clean decode + parity compare: the scrub just
+                        # PROVED every stored chunk byte — local shards
+                        # whose hinfo crc a partial overwrite
+                        # invalidated get re-sealed, restoring the
+                        # whole-chunk crc for future reads
+                        self._reseal_hinfo(oid, avail, len(st.data))
             if bad:
                 errors[oid] = bad
         return errors
+
+    def _reseal_hinfo(self, oid: str, avail, obj_size: int) -> None:
+        """Re-stamp a VALID hinfo crc on local shards carrying an
+        invalidated one (partial-overwrite leftovers), from chunk bytes
+        a clean decode-and-reverify just vouched for.  hinfo-only
+        setattrs merge: data, _av and user attrs stay untouched, so
+        this is safe under the chunk's pg-lock window (the busy /
+        missing / mixed-stamp gates already excluded in-flight
+        objects)."""
+        from ceph_tpu.osd.backend import _hinfo, hinfo_decode
+        from ceph_tpu.store.objectstore import GHObject, Transaction
+
+        pg = self.pg
+        be = pg.backend
+        t = None
+        for shard in be.local_shards(pg.acting):
+            if shard not in avail:
+                continue
+            g = GHObject(oid, shard=shard)
+            try:
+                _, _, valid = hinfo_decode(
+                    self.osd.store.getattr(pg.coll, g, "hinfo"))
+            except Exception:
+                continue  # absent/garbled hinfo: repair's job, not ours
+            if valid:
+                continue
+            if t is None:
+                t = Transaction()
+            t.setattrs(pg.coll, g,
+                       {"hinfo": _hinfo(avail[shard], obj_size)})
+            self._perf("hinfo_reseals")
+        if t is not None:
+            self.osd.store.queue_transaction(t)
 
     def _resolve_state(self, oid: str, avail, metas, sig, fut):
         be = self.pg.backend

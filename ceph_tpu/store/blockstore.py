@@ -47,6 +47,7 @@ from ceph_tpu.core.perf import PerfCounters
 from ceph_tpu.store import objectstore as os_
 from ceph_tpu.store.kv import LogKV, WriteBatch
 from ceph_tpu.store.objectstore import (
+    ChecksumError,
     Collection,
     CommitPipeline,
     GHObject,
@@ -67,10 +68,7 @@ P_BLOB = "B"
 P_XATTR = "X"
 P_OMAP = "M"
 P_META = "S"
-
-
-class ChecksumError(StoreError):
-    """Stored data failed its at-rest crc32c (BlueStore EIO path)."""
+P_SEAL = "K"  # objkey -> encoded ExtentSeals (logical-extent crcs)
 
 
 def _objkey(cid: Collection, oid: GHObject) -> str:
@@ -261,6 +259,8 @@ class BlockStore(ObjectStore):
         pc.add_u64_counter("dev_fsyncs", "batched device fsyncs issued")
         pc.add_histogram("commit_batch", "transactions per commit batch")
         pc.add_time_avg("commit_lat", "batched sync+completion seconds")
+        pc.add_u64_counter("read_verify_fail",
+                           "reads failing at-rest extent verification")
         self.perf = pc
         self._pipeline = CommitPipeline(self._commit_sync, perf=pc)
 
@@ -402,6 +402,7 @@ class BlockStore(ObjectStore):
         with self._lock:
             assert self._mounted, "not mounted"
             self._validate(t)
+            plan = self._seal_plan(t, self._size_locked)
             batch = WriteBatch()
             ctx = _TxnCtx()
             try:
@@ -418,6 +419,10 @@ class BlockStore(ObjectStore):
             # the metadata batch that references them (fsync batched in
             # the commit thread under o_sync — see __init__)
             self._dev_fh.flush()
+            # extent seals join the SAME atomic KV batch as the onode
+            # and blob rows they describe: a commit either lands data,
+            # metadata, and seals together or none of them
+            self._reseal(plan, batch)
             for key in ctx.dirty_onodes:
                 on = self._onodes.get(key)
                 if on is None:
@@ -486,6 +491,29 @@ class BlockStore(ObjectStore):
 
     def _alloc_rollback(self, ctx: "_TxnCtx") -> None:
         self._alloc.release(ctx.fresh_allocs)
+
+    # -- extent seals ------------------------------------------------------
+    def _size_locked(self, cid: Collection, oid: GHObject):
+        on = self._onode(_objkey(cid, oid))
+        return None if on is None else on.size
+
+    def _reseal(self, plan, batch: WriteBatch) -> None:
+        """Post-apply half of the seal transaction: recompute each
+        planned object's dirty extents from post-apply blob content
+        (device pages flushed above; onode/blob caches hold the new
+        state) and stage the rows into the txn's atomic batch."""
+        for (cid, oid), mark in plan.items():
+            key = _objkey(cid, oid)
+            on = self._onodes.get(key)
+            if mark.drop or on is None:
+                batch.rmkey(P_SEAL, key)
+                continue
+            old = (None if (mark.full or mark.fresh)
+                   else self._kv.get(P_SEAL, key))
+            batch.set(P_SEAL, key, self._seal_rebuild(
+                mark, on.size,
+                lambda s, ln, o=on: self._onode_pread(o, s, ln),
+                old))
 
     def _validate(self, t: Transaction) -> None:
         kv, self_ = self._kv, self
@@ -598,6 +626,7 @@ class BlockStore(ObjectStore):
             # copy=True: blob extents RETAIN the buffer — a view into
             # a staging slot must not outlive the slot's release
             self._write(key, op.off, os_.op_payload(op, copy=True), ctx)
+            self._note_data_write(op.cid, op.oid)
             return
         if code == os_.OP_ZERO:
             on = self._onode(key) or Onode()
@@ -625,6 +654,7 @@ class BlockStore(ObjectStore):
             for space in (P_XATTR, P_OMAP):
                 for k, _ in self._iter_prefix_overlay(ctx, space, key + "/"):
                     self._kv_rm(ctx, b, space, k)
+            self._note_data_write(op.cid, op.oid)
             return
         if code == os_.OP_SETATTRS:
             self._write(key, 0, b"", ctx)  # ensure onode
@@ -725,27 +755,35 @@ class BlockStore(ObjectStore):
             return (self._kv.get(P_COLL, cid.name) is not None
                     and self._onode(_objkey(cid, oid)) is not None)
 
-    def read(self, cid: Collection, oid: GHObject, off: int = 0,
-             length: int = 0) -> bytes:
+    def _onode_pread(self, on: Onode, off: int, length: int) -> bytes:
+        """Extent-map walk (lock held): bytes [off, off+length) of the
+        object, clipped to EOF; length==0 reads to end.  Each blob read
+        re-verifies the per-block device crc (ChecksumError)."""
+        if off >= on.size:
+            return b""
+        if length == 0 or off + length > on.size:
+            length = on.size - off
+        buf = bytearray(length)
+        end = off + length
+        for loff, ln, bid, boff in on.extents:
+            lend = loff + ln
+            if lend <= off or loff >= end:
+                continue
+            s = max(off, loff)
+            e = min(end, lend)
+            chunk = self._blob_read(bid, boff + (s - loff), e - s)
+            buf[s - off: e - off] = chunk
+        return bytes(buf)
+
+    def _read_span(self, cid: Collection, oid: GHObject, off: int = 0,
+                   length: int = 0):
+        # the base-class read() gate runs the corruption seam AFTER the
+        # per-block device crc above, then verifies the logical extent
+        # seals — catching exactly the rot the device crc cannot see
         with self._lock:
             on = self._check(cid, oid)
-            if off >= on.size:
-                return b""
-            if length == 0 or off + length > on.size:
-                length = on.size - off
-            buf = bytearray(length)
-            end = off + length
-            for loff, ln, bid, boff in on.extents:
-                lend = loff + ln
-                if lend <= off or loff >= end:
-                    continue
-                s = max(off, loff)
-                e = min(end, lend)
-                chunk = self._blob_read(bid, boff + (s - loff), e - s)
-                buf[s - off: e - off] = chunk
-        # silent-corruption seam AFTER the at-rest crc verify: exactly
-        # the rot a crc-at-rest store cannot see (objectstore filter)
-        return self._read_filter(bytes(buf), cid, oid)
+            seals = self._kv.get(P_SEAL, _objkey(cid, oid))
+            return self._onode_pread(on, off, length), on.size, seals
 
     def stat(self, cid: Collection, oid: GHObject) -> int:
         with self._lock:

@@ -44,6 +44,7 @@ P_OBJ = "O"     # objkey -> b"1" (existence)
 P_XATTR = "X"   # objkey/attr -> value
 P_OMAP = "M"    # objkey/key -> value
 P_META = "S"    # store metadata (applied_seq)
+P_SEAL = "K"    # objkey -> encoded ExtentSeals (at-rest extent crcs)
 
 _WAL_HDR = struct.Struct("<QII")  # seq, body_len, crc
 
@@ -97,6 +98,8 @@ class FileStore(ObjectStore):
         pc.add_u64_counter("wal_fsyncs", "batched WAL fsyncs issued")
         pc.add_histogram("commit_batch", "transactions per commit batch")
         pc.add_time_avg("commit_lat", "batched sync+completion seconds")
+        pc.add_u64_counter("read_verify_fail",
+                           "reads failing at-rest extent verification")
         self.perf = pc
         self._pipeline = CommitPipeline(self._commit_sync, perf=pc)
 
@@ -256,6 +259,10 @@ class FileStore(ObjectStore):
             validate_op(op, ov)
 
     def _apply(self, t: Transaction, seq: int, replay: bool) -> None:
+        # extent-seal plan reads PRE-apply sizes; the seal rows land in
+        # the same final batch as applied_seq, so a torn apply replays
+        # the whole txn — data AND seals — from the WAL
+        plan = self._seal_plan(t, self._size_locked)
         # one KV submit per op: later ops in the same transaction (clone,
         # remove, rename) must see metadata written by earlier ones
         for op in t.ops:
@@ -264,8 +271,41 @@ class FileStore(ObjectStore):
             if b.ops:
                 self._kv.submit(b)
         b = WriteBatch()
+        self._reseal(plan, b, full=replay)
         b.set(P_META, "applied_seq", str(seq).encode())
         self._kv.submit(b)
+
+    def _reseal(self, plan, b: WriteBatch, full: bool) -> None:
+        """Post-apply half of the seal transaction.  On WAL replay the
+        pre-state the plan saw may itself be a torn partial apply, so
+        every planned object reseals in FULL from its actual bytes —
+        replay converges seals to file content no matter where the
+        crash landed."""
+        for (cid, oid), mark in plan.items():
+            key = _objkey(cid, oid)
+            size = self._size_locked(cid, oid)
+            if mark.drop or size is None:
+                b.rmkey(P_SEAL, key)
+                continue
+            if full:
+                mark.full = True
+            path = self._datafile(cid, oid)
+            if self._file_compressed(path):
+                content = self._load_file(path)
+
+                def read_fn(s, ln, c=content):
+                    return c[s:s + ln]
+            else:
+                def read_fn(s, ln, p=path):
+                    if not os.path.exists(p):
+                        return b""
+                    with open(p, "rb") as f:
+                        f.seek(s)
+                        return f.read(ln)
+            old = (None if (mark.full or mark.fresh)
+                   else self._kv.get(P_SEAL, key))
+            b.set(P_SEAL, key,
+                  self._seal_rebuild(mark, size, read_fn, old))
 
     def _coll_exists(self, cid: Collection) -> bool:
         return self._kv.get(P_COLL, cid.name) is not None
@@ -514,8 +554,8 @@ class FileStore(ObjectStore):
     def debug_clear_read_err(self) -> None:
         self._read_err_objs.clear()
 
-    def read(self, cid: Collection, oid: GHObject, off: int = 0,
-             length: int = 0) -> bytes:
+    def _read_span(self, cid: Collection, oid: GHObject, off: int = 0,
+                   length: int = 0):
         # hot path (every chunk read crosses here): pack no ctx while
         # disarmed — the enabled() guard is the whole disarmed cost
         if fp_enabled("store.filestore.read"):
@@ -526,35 +566,48 @@ class FileStore(ObjectStore):
             raise StoreError(
                 f"EIO (injected): {cid.name}/{oid.name} shard "
                 f"{oid.shard}")
+        # base-class read() routes this snapshot through the corruption
+        # seam + extent verification outside the lock
         with self._lock:
             self._check(cid, oid)
+            seals = self._kv.get(P_SEAL, _objkey(cid, oid))
             path = self._datafile(cid, oid)
             if not os.path.exists(path):
-                return b""
+                return b"", 0, seals
             if self._file_compressed(path):
                 content = self._load_file(path)
-                end = len(content) if length == 0 else off + length
+                size = len(content)
+                end = size if length == 0 else off + length
                 data = content[off:end]
             else:
                 with open(path, "rb") as f:
+                    f.seek(0, 2)
+                    size = f.tell()
                     f.seek(off)
                     data = f.read() if length == 0 else f.read(length)
-        # silent-corruption seam (objectstore._read_filter)
-        return self._read_filter(data, cid, oid)
+            return data, size, seals
+
+    def _size_locked(self, cid: Collection, oid: GHObject):
+        """Logical object size without the lock (callers hold it), or
+        None when the object is absent."""
+        if (self._kv.get(P_COLL, cid.name) is None
+                or not self._exists_kv(cid, oid)):
+            return None
+        path = self._datafile(cid, oid)
+        if not os.path.exists(path):
+            return 0
+        if self._file_compressed(path):
+            with open(path, "rb") as f:
+                raw = f.read(4 + 1 + 255 + 8)
+            alg_len = raw[4]
+            return int.from_bytes(
+                raw[5 + alg_len: 5 + alg_len + 8], "little")
+        return os.path.getsize(path)
 
     def stat(self, cid: Collection, oid: GHObject) -> int:
         with self._lock:
             self._check(cid, oid)
-            path = self._datafile(cid, oid)
-            if not os.path.exists(path):
-                return 0
-            if self._file_compressed(path):
-                with open(path, "rb") as f:
-                    raw = f.read(4 + 1 + 255 + 8)
-                alg_len = raw[4]
-                return int.from_bytes(
-                    raw[5 + alg_len: 5 + alg_len + 8], "little")
-            return os.path.getsize(path)
+            return self._size_locked(cid, oid) or 0
 
     def getattr(self, cid: Collection, oid: GHObject, name: str) -> bytes:
         with self._lock:

@@ -13,6 +13,7 @@ import threading
 from typing import Dict, List
 
 from ceph_tpu.core.lockdep import make_lock
+from ceph_tpu.core.perf import PerfCounters
 from ceph_tpu.store import objectstore as os_
 from ceph_tpu.store.objectstore import (
     Collection,
@@ -27,18 +28,20 @@ from ceph_tpu.store.objectstore import (
 
 
 class _Obj:
-    __slots__ = ("data", "xattrs", "omap")
+    __slots__ = ("data", "xattrs", "omap", "seals")
 
     def __init__(self) -> None:
         self.data = bytearray()
         self.xattrs: Dict[str, bytes] = {}
         self.omap: Dict[str, bytes] = {}
+        self.seals: bytes | None = None  # encoded ExtentSeals
 
     def clone(self) -> "_Obj":
         o = _Obj()
         o.data = bytearray(self.data)
         o.xattrs = dict(self.xattrs)
         o.omap = dict(self.omap)
+        o.seals = self.seals
         return o
 
 
@@ -48,6 +51,13 @@ class MemStore(ObjectStore):
         self._lock = make_lock("memstore")
         self._mounted = False
         self._seq = 0
+        # RAM can't rot, but the read gate still verifies: the
+        # injection seam (corrupt_chunk / data-err marks) models media
+        # rot on every backend, and the counter feeds osd.N.store
+        pc = PerfCounters("memstore")
+        pc.add_u64_counter("read_verify_fail",
+                           "reads failing at-rest extent verification")
+        self.perf = pc
 
     # -- lifecycle --------------------------------------------------------
     def mkfs(self) -> None:
@@ -68,8 +78,10 @@ class MemStore(ObjectStore):
         durability point, so `on_commit` fires inline on apply."""
         with self._lock:
             self._validate(t)
+            plan = self._seal_plan(t, self._size_locked)
             for op in t.ops:
                 self._apply(op)
+            self._reseal(plan)
             self._seq += 1
             seq = self._seq
         if on_commit is not None:
@@ -195,23 +207,43 @@ class MemStore(ObjectStore):
             return
         raise StoreError(f"unknown op {code}")
 
+    # -- extent seals ------------------------------------------------------
+    def _size_locked(self, cid: Collection, oid: GHObject):
+        c = self._colls.get(cid)
+        o = c.get(oid) if c is not None else None
+        return None if o is None else len(o.data)
+
+    def _reseal(self, plan) -> None:
+        """Post-apply half of the seal transaction (same lock as the
+        data mutation): recompute each planned object's dirty extents
+        from its now-current bytes."""
+        for (cid, oid), mark in plan.items():
+            c = self._colls.get(cid)
+            o = c.get(oid) if c is not None else None
+            if o is None:
+                continue  # removed: the record dies with the object
+            o.seals = self._seal_rebuild(
+                mark, len(o.data),
+                lambda s, ln, d=o.data: bytes(d[s:s + ln]),
+                o.seals)
+
     # -- reads ------------------------------------------------------------
     def exists(self, cid: Collection, oid: GHObject) -> bool:
         with self._lock:
             c = self._colls.get(cid)
             return c is not None and oid in c
 
-    def read(self, cid: Collection, oid: GHObject, off: int = 0,
-             length: int = 0) -> bytes:
+    def _read_span(self, cid: Collection, oid: GHObject, off: int = 0,
+                   length: int = 0):
+        # base-class read() routes this snapshot through the corruption
+        # seam + extent verification outside the lock
         with self._lock:
             o = self._obj(cid, oid)
             if length == 0:
                 data = bytes(o.data[off:])
             else:
                 data = bytes(o.data[off:off + length])
-        # silent-corruption seam (objectstore._read_filter): outside
-        # the lock — the filter only touches its own bytes
-        return self._read_filter(data, cid, oid)
+            return data, len(o.data), o.seals
 
     def stat(self, cid: Collection, oid: GHObject) -> int:
         with self._lock:

@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from ceph_tpu.core.crc import crc32c
 from ceph_tpu.core.encoding import Decoder, Encoder
 from ceph_tpu.core.failpoint import failpoint
 
@@ -34,6 +35,15 @@ class NoSuchObject(StoreError):
 
 class NoSuchCollection(StoreError):
     pass
+
+
+class ChecksumError(StoreError):
+    """Bytes a read would serve failed at-rest checksum verification.
+
+    Raised by the base-class read gate (per-extent seals, any backend)
+    and by BlockStore's per-block device crc.  Consumers must treat the
+    local copy as LOST — reconstruct/repair, never serve or EIO the
+    flipped bytes upward."""
 
 
 @dataclass(frozen=True, order=True)
@@ -490,6 +500,83 @@ class CommitPipeline:
                 self._perf.tinc("commit_lat", time.perf_counter() - t0)
 
 
+# extent-seal granularity (conf store_csum_extent_kib): the BlueStore
+# csum_order analog — one crc32c per DEFAULT_EXTENT_SIZE bytes of
+# logical object space, sealed at write time, verified at read time
+DEFAULT_EXTENT_SIZE = 64 * 1024
+
+
+class ExtentSeals:
+    """Per-extent at-rest checksum record for one object.
+
+    Extent i covers logical bytes [i*E, min((i+1)*E, size)) — the tail
+    extent seals only the bytes that exist, so the record pins the
+    object's extent count (and thereby its size class) as well as its
+    content.  Versioned encoding per the dencoder discipline: a v2 may
+    append fields; v1 decoders skip the unknown tail."""
+
+    __slots__ = ("extent_size", "crcs")
+
+    def __init__(self, extent_size: int = DEFAULT_EXTENT_SIZE,
+                 crcs: Optional[List[int]] = None) -> None:
+        self.extent_size = extent_size
+        self.crcs: List[int] = list(crcs) if crcs else []
+
+    def encode(self, e: Encoder) -> None:
+        e.start(1, 1)
+        e.u32(self.extent_size)
+        e.seq(self.crcs, lambda enc, c: enc.u32(c))
+        e.finish()
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "ExtentSeals":
+        d.start(1)
+        s = cls(d.u32(), d.seq(lambda dd: dd.u32()))
+        d.end()
+        return s
+
+    def to_bytes(self) -> bytes:
+        e = Encoder()
+        self.encode(e)
+        return e.bytes()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ExtentSeals":
+        return cls.decode(Decoder(data))
+
+
+class _SealMark:
+    """Seal work one Transaction implies for one object: the union of
+    dirtied logical byte ranges, or a whole-record verdict (full
+    recompute / record drop)."""
+
+    __slots__ = ("lo", "hi", "full", "drop", "fresh")
+
+    def __init__(self) -> None:
+        self.lo: Optional[int] = None
+        self.hi = 0
+        self.full = False   # recompute every extent from current bytes
+        self.drop = False   # object removed: delete the seal record
+        self.fresh = False  # pre-txn record is dead (remove+recreate)
+
+    def dirty(self, lo: int, hi: int) -> None:
+        if self.drop:
+            # removed then recreated within the txn: the old record
+            # describes a dead object — recompute from scratch
+            self.drop = False
+            self.fresh = True
+            self.full = True
+        self.lo = lo if self.lo is None else min(self.lo, lo)
+        self.hi = max(self.hi, hi)
+
+    def wipe(self) -> None:
+        self.lo = None
+        self.hi = 0
+        self.full = False
+        self.fresh = False
+        self.drop = True
+
+
 class ObjectStore:
     """Abstract backend. Writes go through queue_transaction; reads are
     direct.  `queue_transaction(t, on_commit)` validates and applies
@@ -501,11 +588,22 @@ class ObjectStore:
     sync with concurrent writers.  Returns the transaction's WAL/commit
     sequence number."""
 
-    # True on backends whose read path verifies data against at-rest
-    # checksums itself (BlockStore: crc32c per stored block, raises on
-    # mismatch).  Lets consumers serve ranged reads without a
-    # whole-object copy purely to re-verify an application-level crc.
+    # True on backends that ADDITIONALLY verify stored pages against
+    # device-level checksums inside _read_span (BlockStore: crc32c per
+    # 4KiB block — the disk-ECC analog).  Every backend now verifies
+    # the bytes it SERVES against per-extent seals in the base read()
+    # gate below, so this flag only records the extra device layer.
     checksums_at_rest = False
+
+    # -- per-extent at-rest checksums (the BlueStore csum discipline) ----
+    # Writes seal crc32c per csum_extent_size bytes of logical object
+    # space into object metadata WITHIN the writing transaction
+    # (partial overwrites re-seal only touched extents); every read
+    # verifies exactly the extents it serves and raises ChecksumError
+    # on mismatch.  Both knobs are daemon-wired from conf
+    # (store_csum_extent_kib / store_verify_read).
+    csum_extent_size = DEFAULT_EXTENT_SIZE
+    verify_reads = True
 
     # -- silent-corruption injection (the scrub/repair test seam) ---------
     # Two routes corrupt the bytes a read SERVES without touching what
@@ -594,8 +692,181 @@ class ObjectStore:
 
     def read(self, cid: Collection, oid: GHObject, off: int = 0,
              length: int = 0) -> bytes:
-        """length==0 → read to end."""
+        """length==0 → read to end.
+
+        Concrete: THE verified-read gate.  Backends implement
+        `_read_span` (one atomic snapshot of bytes + size + seal
+        record); this method widens the request to extent-aligned
+        coverage, routes the covering bytes through `_read_filter`
+        (the injection seam sits BEFORE verification, so injected rot
+        is caught here, at read time), verifies each covered extent
+        against its seal, and only then slices out the requested
+        range.  A mismatch bumps the store's `read_verify_fail`
+        counter and raises ChecksumError — flipped bytes never leave
+        the store."""
+        E = self.csum_extent_size
+        if not self.verify_reads:
+            data, _size, _blob = self._read_span(cid, oid, off, length)
+            return bytes(self._read_filter(data, cid, oid))
+        cov_lo = (off // E) * E
+        cov_len = (0 if length == 0
+                   else ((off + length + E - 1) // E) * E - cov_lo)
+        data, size, blob = self._read_span(cid, oid, cov_lo, cov_len)
+        data = self._read_filter(data, cid, oid)
+        if blob is not None:
+            try:
+                seals = ExtentSeals.from_bytes(blob)
+            except Exception:
+                self._verify_fail(cid, oid, "undecodable extent seals")
+            if seals.extent_size != E:
+                # sealed at a different granularity (extent-size conf
+                # changed since the last write): verify whole-object at
+                # the sealed granularity — rare, O(object) once
+                data, size, _ = self._read_span(cid, oid, 0, 0)
+                data = self._read_filter(data, cid, oid)
+                self._verify_extents(data, 0, size, seals, cid, oid)
+                end = size if length == 0 else min(size, off + length)
+                return bytes(data[off:end])
+            self._verify_extents(data, cov_lo, size, seals, cid, oid)
+        lo = off - cov_lo
+        if lo >= len(data):
+            return b""
+        return bytes(data[lo:] if length == 0 else data[lo:lo + length])
+
+    def _read_span(self, cid: Collection, oid: GHObject, off: int,
+                   length: int) -> Tuple[bytes, int, Optional[bytes]]:
+        """One atomic snapshot serving the read gate: (bytes of
+        [off, off+length) clipped to EOF — length==0 reads to end —,
+        object size, encoded seal record or None).  Unfiltered and
+        unverified; backends take their lock ONCE here so the bytes,
+        the size, and the seals can never be torn against each other."""
         raise NotImplementedError
+
+    def _verify_extents(self, data, base: int, size: int,
+                        seals: ExtentSeals, cid: Collection,
+                        oid: GHObject) -> None:
+        """Verify the extents `data` (object bytes starting at logical
+        offset `base`, extent-aligned) covers against their seals."""
+        E = seals.extent_size
+        n = len(seals.crcs)
+        expect = (size + E - 1) // E
+        if n != expect:
+            self._verify_fail(
+                cid, oid, f"seal count {n} != {expect} for size {size}")
+        mv = memoryview(data) if not isinstance(data, memoryview) else data
+        i0 = base // E
+        covered = (len(data) + E - 1) // E
+        for j in range(covered):
+            i = i0 + j
+            if i >= n:
+                break
+            if crc32c(mv[j * E:(j + 1) * E]) != seals.crcs[i]:
+                self._verify_fail(cid, oid, f"extent {i} crc mismatch")
+
+    def _verify_fail(self, cid: Collection, oid: GHObject,
+                     why: str) -> None:
+        pc = getattr(self, "perf", None)
+        if pc is not None:
+            pc.inc("read_verify_fail")
+        raise ChecksumError(
+            f"{cid.name}/{oid.name} shard {oid.shard}: {why}")
+
+    # -- seal maintenance (called by backends inside txn apply) ----------
+    def _seal_plan(self, t: Transaction, size_fn
+                   ) -> Dict[Tuple[Collection, GHObject], _SealMark]:
+        """Scan a validated Transaction for the seal work it implies.
+        `size_fn(cid, oid) -> Optional[int]` reports PRE-apply sizes
+        (None = absent); op-by-op size simulation keeps each dirty
+        range tight — a partial overwrite re-seals only the extents it
+        touches.  Backends call this BEFORE applying ops, apply, then
+        feed each mark to `_seal_rebuild` with post-apply bytes —
+        inside the same atomic scope as the data mutation."""
+        marks: Dict[Tuple[Collection, GHObject], _SealMark] = {}
+        sizes: Dict[Tuple[Collection, GHObject], int] = {}
+
+        def size_of(cid, oid):
+            k = (cid, oid)
+            if k not in sizes:
+                s = size_fn(cid, oid)
+                sizes[k] = 0 if s is None else s
+            return sizes[k]
+
+        def mk(cid, oid):
+            return marks.setdefault((cid, oid), _SealMark())
+
+        for op in t.ops:
+            code = op.op
+            if code in (OP_WRITE, OP_ZERO):
+                s = size_of(op.cid, op.oid)
+                end = op.off + op.length
+                # a write past EOF zero-fills the gap from old EOF
+                mk(op.cid, op.oid).dirty(min(op.off, s), end)
+                sizes[(op.cid, op.oid)] = max(s, end)
+            elif code == OP_TRUNCATE:
+                s = size_of(op.cid, op.oid)
+                mk(op.cid, op.oid).dirty(min(op.off, s), max(op.off, s))
+                sizes[(op.cid, op.oid)] = op.off
+            elif code in (OP_REMOVE, OP_TRY_REMOVE):
+                mk(op.cid, op.oid).wipe()
+                sizes[(op.cid, op.oid)] = 0
+            elif code == OP_CLONE:
+                m = mk(op.cid, op.dest_oid)
+                m.drop = False
+                m.fresh = True
+                m.full = True
+                sizes[(op.cid, op.dest_oid)] = size_of(op.cid, op.oid)
+            elif code == OP_COLL_MOVE_RENAME:
+                mk(op.cid, op.oid).wipe()
+                m = mk(op.dest_cid, op.dest_oid)
+                m.drop = False
+                m.fresh = True
+                m.full = True
+                sizes[(op.dest_cid, op.dest_oid)] = size_of(op.cid, op.oid)
+                sizes[(op.cid, op.oid)] = 0
+        return marks
+
+    def _seal_rebuild(self, mark: _SealMark, size: Optional[int],
+                      read_fn, old_blob: Optional[bytes]
+                      ) -> Optional[bytes]:
+        """New encoded seal record for one planned object, reading
+        post-apply bytes via `read_fn(off, length)`.  None => the
+        object is gone; delete its record.  Only extents intersecting
+        the dirty range (plus coverage-change casualties: the tail
+        extent when the size class moved, everything on a granularity
+        change) are recomputed."""
+        if mark.drop or size is None:
+            return None
+        E = self.csum_extent_size
+        old = None
+        if old_blob is not None and not mark.fresh and not mark.full:
+            try:
+                old = ExtentSeals.from_bytes(old_blob)
+            except Exception:
+                old = None
+            if old is not None and old.extent_size != E:
+                old = None  # granularity changed: full reseal
+        n = (size + E - 1) // E
+        old_n = len(old.crcs) if old is not None else 0
+        crcs = list(old.crcs[:n]) if old is not None else []
+        while len(crcs) < n:
+            crcs.append(0)
+        if old is None or mark.full or mark.lo is None:
+            redo = list(range(n))
+        else:
+            lo = min(mark.lo, size)
+            hi = min(mark.hi, size)
+            todo = set(range(lo // E, min(n, (hi + E - 1) // E)))
+            # the tail extent's coverage follows the object size: any
+            # size-class change re-seals it, and extent indexes the old
+            # record lacked are always computed fresh
+            if n and old_n != n:
+                todo.add(n - 1)
+            todo.update(range(old_n, n))
+            redo = sorted(todo)
+        for i in redo:
+            s = i * E
+            crcs[i] = crc32c(read_fn(s, min(size, s + E) - s))
+        return ExtentSeals(E, crcs).to_bytes()
 
     def stat(self, cid: Collection, oid: GHObject) -> int:
         """Returns size; raises NoSuchObject."""

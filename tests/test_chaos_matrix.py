@@ -4,9 +4,13 @@ acked-durability oracle — runs while seeded OSD kills AND a scenario's
 churn run concurrently:
 
   scrub  always-on deep scrub + auto-repair over seeded silent
-         corruption (store.corrupt_chunk on a full-write rot namespace)
+         corruption (store.corrupt_chunk, unrestricted rot namespace:
+         full-write AND partially-overwritten targets)
   tier   cache-tier write/promote/flush/evict churn
   snap   selfmanaged snap create / clone / trim churn
+  read   the same unrestricted rot under concurrent client reads:
+         read-time integrity (PR 16) must serve true bytes via
+         reconstruction, never flipped data
   all    every churn at once (the acceptance chaos matrix)
 
 One fast representative per scenario runs in tier-1 (seconds each, one
@@ -41,6 +45,15 @@ def test_chaos_scenario_snap_fast():
     assert thrash_hunt.run_scenario(0xC407, "snap", rounds=40)
 
 
+def test_chaos_scenario_read_integrity_fast():
+    """Seeded rot on full-write AND appended-to (invalid hinfo crc)
+    EC objects under concurrent client reads and kills: every read
+    serves true bytes via the extent-seal gate + reconstruction, the
+    detection is counted at READ time (read_verify_fail), and the
+    corruption schedule is asserted to have fired."""
+    assert thrash_hunt.run_scenario(0xC409, "read", rounds=40)
+
+
 def test_chaos_scenario_combined_fast():
     """One combined (scrub+tier+snap churn concurrent with kills and
     injected corruption) representative in tier-1."""
@@ -57,7 +70,8 @@ def test_chaos_matrix_ten_seeds_combined():
 
 @pytest.mark.slow
 def test_chaos_matrix_per_scenario_seeds():
-    """Per-scenario seed sweeps (scrub/tier/snap), the
+    """Per-scenario seed sweeps (scrub/tier/snap/read), the
     `thrash_hunt.py --scenario matrix` grid."""
     assert thrash_hunt.run_scenario_matrix(
-        0xC410, ["scrub", "tier", "snap"], rounds=80, tries=4) == 0
+        0xC410, ["scrub", "tier", "snap", "read"], rounds=80,
+        tries=4) == 0

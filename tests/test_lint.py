@@ -575,3 +575,45 @@ def test_failpoint_names_never_baseline(tmp_path):
                   path="ceph_tpu/osd/pg.py", line=1,
                   scope="PG.x", detail="failpoint('typo')", message="m")
     assert v.key not in violations_to_baseline([v])["entries"]
+
+
+def test_no_unverified_read_flags_every_bypass_shape(tmp_path):
+    code = (
+        "from ceph_tpu.store.objectstore import ObjectStore\n"
+        "class MyStore(ObjectStore):\n"
+        "    def read(self, cid, oid, off=0, length=0):\n"  # flagged:
+        "        pass\n"                       # shadows the verify gate
+        "    def _read_span(self, cid, oid, off, length):\n"  # ok: the
+        "        pass\n"                       # sanctioned backend hook
+        "def peek(store, cid, oid):\n"
+        "    return store._read_span(cid, oid, 0, 0)\n"  # flagged: raw
+        "def disable(store):\n"
+        "    store.verify_reads = False\n"     # flagged: hard-disable
+        "def conf_gate(store, ctx):\n"
+        "    store.verify_reads = bool(ctx)\n"  # ok: runtime-computed
+        "class Bystander:\n"
+        "    def read(self):\n"                # ok: not an ObjectStore
+        "        pass\n")
+    bad = _lint(tmp_path, code, "no-unverified-read")
+    assert [v.line for v in bad] == [3, 8, 10]
+
+
+def test_no_unverified_read_allows_the_gate_itself(tmp_path):
+    ok = _lint(tmp_path, (
+        "class ObjectStore:\n"
+        "    def read(self, cid, oid, off=0, length=0):\n"
+        "        data, size, seals = self._read_span(cid, oid, 0, 0)\n"
+        "        return data\n"),
+        "no-unverified-read", rel="ceph_tpu/store/objectstore.py")
+    assert not ok
+
+
+def test_no_unverified_read_never_baseline(tmp_path):
+    from ceph_tpu.analysis.framework import (Violation,
+                                             violations_to_baseline)
+
+    v = Violation(check="no-unverified-read",
+                  path="ceph_tpu/osd/backend.py", line=1,
+                  scope="ECBackend.x", detail="_read_span(...)",
+                  message="m")
+    assert v.key not in violations_to_baseline([v])["entries"]

@@ -12,7 +12,7 @@ import pytest
 
 from ceph_tpu.core import failpoint as fp
 from ceph_tpu.osd import types as t_
-from ceph_tpu.store.objectstore import Collection, GHObject
+from ceph_tpu.store.objectstore import ChecksumError, Collection, GHObject
 
 from tests.test_osd_cluster import (EC_POOL, N_OSDS, REP_POOL,
                                     LibClient, MiniCluster)
@@ -133,21 +133,36 @@ def test_shallow_misses_injected_flip_deep_detects_and_repairs(
 def test_corrupt_chunk_failpoint_is_seeded_and_scoped(cluster, client):
     """The chaos-schedule route: store.corrupt_chunk armed with a
     match scope flips ONLY the matched shard's reads, deterministically
-    per seed; deep scrub sees it, disarming restores clean reads."""
+    per seed.  The injection lands BEFORE the read-verify gate, so a
+    verifying read REFUSES the flipped bytes (ChecksumError, never
+    served); with verification off the rot is served and seeded-
+    deterministic; deep scrub sees it, disarming restores clean
+    reads."""
     client.put(EC_POOL, "fprot", b"fp-rot" * 500)
     pgid, acting, primary, pg = _pg_of(cluster, EC_POOL, "fprot")
     shard, victim = _victim(cluster, acting, primary)
     coll = Collection(t_.pgid_str(pgid) + "_head")
     g = GHObject("fprot", shard=shard)
-    clean = cluster.osds[victim].store.read(coll, g)
+    store = cluster.osds[victim].store
+    clean = store.read(coll, g)
+    fails0 = store.perf.value("read_verify_fail")
     fp.seed(0x15C)
     fp.arm("store.corrupt_chunk", fp.CORRUPT_ACTION,
            match={"oid": "fprot", "shard": str(shard)})
     try:
-        rotten = cluster.osds[victim].store.read(coll, g)
-        assert rotten != clean
-        # seeded determinism: the same read rots identically
-        assert cluster.osds[victim].store.read(coll, g) == rotten
+        # the verify gate catches the flip at read time: refused, not
+        # served — and the failure is counted on the store
+        with pytest.raises(ChecksumError):
+            store.read(coll, g)
+        assert store.perf.value("read_verify_fail") > fails0
+        store.verify_reads = False
+        try:
+            rotten = store.read(coll, g)
+            assert rotten != clean
+            # seeded determinism: the same read rots identically
+            assert store.read(coll, g) == rotten
+        finally:
+            store.verify_reads = True
         # an unmatched object is untouched
         client.put(EC_POOL, "fpclean", b"x" * 100)
         assert client.get(EC_POOL, "fpclean") == b"x" * 100
@@ -156,7 +171,7 @@ def test_corrupt_chunk_failpoint_is_seeded_and_scoped(cluster, client):
         assert fp.fired("store.corrupt_chunk") > 0
     finally:
         fp.disarm_all()
-    assert cluster.osds[victim].store.read(coll, g) == clean
+    assert store.read(coll, g) == clean
     assert pg.scrub_engine().run(deep=True) == {}
 
 

@@ -199,7 +199,7 @@ def run_matrix(seed: int, pool_kind: str, rounds: int, tries: int,
     return 1 if any(f for f, _t in cells.values()) else 0
 
 
-SCENARIOS = ("scrub", "tier", "snap", "all")
+SCENARIOS = ("scrub", "tier", "snap", "read", "all")
 
 
 def run_scenario(seed: int, name: str, rounds: int = 80,
@@ -210,12 +210,16 @@ def run_scenario(seed: int, name: str, rounds: int = 80,
     production clusters actually diverge:
 
       scrub  seeded store.corrupt_chunk rot on the EC pool's chunk
-             reads (hinfo crcs catch the flips on the data path, so
-             the oracle holds) + repeated deep scrubs with auto-repair
+             reads — full-write AND partially-overwritten targets
+             (the extent-seal gate catches both classes) + repeated
+             deep scrubs with auto-repair
       tier   cache-tier write/promote/flush/evict churn (REP cache
              over the EC22 base pool, its own oid namespace)
       snap   selfmanaged snap create / overwrite (clone) / remove
              (trim) churn on the rep pool
+      read   the same unrestricted rot under concurrent client READS:
+             every get must serve true bytes via reconstruction (the
+             read-time integrity gate), never flipped data or EIO
       all    every churn at once (the acceptance chaos matrix)
 
     Seeded end to end: the model mix, the thrasher schedule, the
@@ -236,28 +240,55 @@ def run_scenario(seed: int, name: str, rounds: int = 80,
     fp.disarm_all()
     fp.seed(seed)
     rot_payloads: dict = {}
-    if name in ("scrub", "all"):
-        # seeded silent rot, scoped to a dedicated full-write rot_*
-        # namespace on the EC pool.  Scoping matters: full writes keep
-        # a VALID hinfo crc, so every flipped read is caught at the
-        # chunk-crc gate (reads reconstruct around it, scrub sees
-        # missing-or-crc-mismatch, auto-repair rewrites).  Objects
-        # after a partial overwrite (append/truncate) carry an
-        # INVALIDATED crc by design — rotting those serves flipped
-        # bytes straight to clients (no gate exists until deep scrub's
-        # parity check runs), so a schedule that rots the model's own
-        # RMW'd objects fails the oracle for reasons scrub cannot
-        # prevent; the model instead proves rot+repair never damages
-        # BYSTANDER acked data
+    if name in ("scrub", "read", "all"):
+        from ceph_tpu.osd import types as t_
+
+        # seeded silent rot on a dedicated rot_* namespace.  The
+        # schedule is UNRESTRICTED within it: odd-numbered targets get
+        # a partial overwrite (append) after the full write, which
+        # invalidates their hinfo chunk crc — historically the blind
+        # spot where rot reached clients undetected until deep scrub's
+        # parity pass.  The per-extent at-rest seals close it: flips
+        # on BOTH classes are refused at read time (the read
+        # reconstructs around the bad shard, scrub/auto-repair rewrite
+        # it), so rotting RMW'd objects no longer breaks the oracle.
         # the rot namespace lives on the EC22 pool: the model owns
         # the EC pool's whole object listing (its verify asserts set
         # equality), so scrub's corruption targets must not share it
         for i in range(5):
             data = f"rot_{i}".encode() * 300
             cl.put(EC22_POOL, f"rot_{i}", data)
+            if i % 2:  # append: hinfo crc invalidated on the shards
+                tail = f"tail_{i}".encode() * 40
+                cl.op(EC22_POOL, f"rot_{i}",
+                      [t_.OSDOp(t_.OP_WRITE, off=len(data),
+                                data=tail)])
+                data += tail
             rot_payloads[f"rot_{i}"] = data
         fp.arm("store.corrupt_chunk", fp.CORRUPT_ACTION, prob=0.25,
                match={"coll": f"{EC22_POOL}.", "oid": "rot_"})
+
+    if name == "read":
+        def read_churn() -> None:
+            rng = random.Random(seed ^ 0x8EAD)
+            while not stop.is_set():
+                oid = f"rot_{rng.randrange(5)}"
+                try:
+                    got = cl.get(EC22_POOL, oid)
+                    if got != rot_payloads[oid]:
+                        churn_errors.append(
+                            f"{oid}: read served rotted bytes "
+                            f"({len(got)}B vs "
+                            f"{len(rot_payloads[oid])}B)")
+                # cephlint: disable=silent-except — kill-window
+                # timeouts retry on the next sweep; WRONG BYTES are
+                # the failure, recorded above, and asserted after the
+                # churn stops
+                except Exception:
+                    pass
+                stop.wait(0.05)
+
+        threads.append(threading.Thread(target=read_churn, daemon=True))
 
         def scrub_churn() -> None:
             while not stop.is_set():
@@ -431,6 +462,42 @@ def run_scenario(seed: int, name: str, rounds: int = 80,
                     pg.maintenance_guard.release()
             assert fp.fired("store.corrupt_chunk") > 0, \
                 "the corruption schedule never fired"
+        if name == "read":
+            # deterministic read-time detection: with the rot STILL
+            # armed, a store-path read of every target — including the
+            # appended-to ones whose hinfo crc is invalid — must serve
+            # true bytes (the extent-seal gate refuses the flip, the
+            # read decodes around it), never rotted data or a bare EIO
+            deadline_r = time.time() + 30.0
+            for oid, want in sorted(rot_payloads.items()):
+                pgid = c.osdmap.object_to_pg(EC22_POOL, oid)
+                _u, _up, _a, prim = c.osdmap.pg_to_up_acting(pgid)
+                svc = c.osds.get(prim)
+                if svc is not None and svc.up:
+                    pg = svc.pgs.get(pgid)
+                    if pg is not None:
+                        pg._obc_invalidate(oid)  # force a media read
+                while True:
+                    try:
+                        got = cl.get(EC22_POOL, oid)
+                        break
+                    # cephlint: disable=silent-except — a draw can rot
+                    # too many shards at once to decode (retryable by
+                    # design); the retry redraws
+                    except Exception:
+                        if time.time() > deadline_r:
+                            raise
+                        # cephlint: disable=no-sleep-poll — seeded
+                        # redraw pacing, nothing signals readiness
+                        time.sleep(0.5)
+                assert got == want, f"{oid}: rot reached the client"
+            assert fp.fired("store.corrupt_chunk") > 0, \
+                "the corruption schedule never fired"
+            vfails = sum(svc.store.perf.value("read_verify_fail")
+                         for svc in c.osds.values() if svc.up)
+            assert vfails > 0, \
+                "detection never happened at READ time"
+        assert not churn_errors, churn_errors[:3]
         fp.disarm_all()  # final churn verification reads clean media
         deadline = time.time() + 30.0
         for oid, want in sorted(rot_payloads.items()):
