@@ -7,6 +7,7 @@ from ceph_tpu.analysis.checks.failpoint_names import FailpointNameRegistry
 from ceph_tpu.analysis.checks.jax_purity import JaxPurity
 from ceph_tpu.analysis.checks.locks import NamedLocks
 from ceph_tpu.analysis.checks.qos_classes import QosClassRegistry
+from ceph_tpu.analysis.checks.shape_bucket import ShapeBucketDiscipline
 from ceph_tpu.analysis.checks.silent_except import SilentExcept
 from ceph_tpu.analysis.checks.sleep_poll import NoSleepPoll
 from ceph_tpu.analysis.checks.span_discipline import SpanDiscipline
@@ -26,6 +27,7 @@ ALL_CHECKS = (
     SpanDiscipline(),
     NoUnwatchedJit(),
     NoUnverifiedRead(),
+    ShapeBucketDiscipline(),
 )
 
 CHECKS_BY_NAME = {c.name: c for c in ALL_CHECKS}

@@ -1,0 +1,151 @@
+"""shape-bucket-discipline: every kernel family declares its buckets,
+every batch dispatch pads through the covering helper.
+
+PR 17's shape-bucket ABI (``ceph_tpu/tpu/shapebucket.py``) makes the
+compile surface of every devwatch kernel family FINITE: a family
+declares its bucket grammar, dispatch sites pad to the covering
+bucket, and any compile outside the declared set is a ``rogue`` —
+counted on ``osd.N.xla``, WARN'd by the storm detector, and asserted
+zero by the steady-state guard.  That contract only holds if
+
+1. every ``instrumented_jit`` / ``instrumented_pallas_call``
+   registration names a family that shapebucket DECLARES — a new
+   family registered without a :class:`BucketSpec` makes every one of
+   its compiles a false rogue (or forces the guard off), and
+
+2. the batch coalescer (``ceph_tpu/tpu/queue.py``) never dispatches a
+   batch at its raw width: a dispatch call in a function that never
+   references ``covering`` is the PR 8 unpadded bypass reborn — one
+   odd-width batch = one fresh XLA compile on the op path.
+
+Never baselineable: an undeclared family or an unpadded dispatch can
+never ship as accepted debt (the no-unwatched-jit shape).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from ceph_tpu.analysis.framework import (
+    Check, NEVER_BASELINE_PREFIXES, SourceFile, Violation, dotted,
+    enclosing_scope,
+)
+
+# registration entry points whose family= tag must be declared
+_REG_TAILS = ("instrumented_jit", "instrumented_pallas_call")
+
+# files where every device dispatch must flow through covering()
+_PAD_REQUIRED = ("ceph_tpu/tpu/queue.py",)
+
+# the dispatch calls that hand a batch to a kernel family
+_DISPATCH_TAILS = ("encode_array", "gf_matmul_bytes", "crc32c_rows",
+                   "encode_scatter", "recovery_gather")
+
+
+def _call_tail(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _family_literal(node: ast.Call):
+    """The family= string literal of a registration call (also the
+    functools.partial(instrumented_jit, family=...) spelling), or
+    None when absent / not a literal."""
+    for kw in node.keywords:
+        if kw.arg == "family" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _is_registration(node: ast.Call) -> bool:
+    tail = _call_tail(node)
+    if tail in _REG_TAILS:
+        return True
+    # functools.partial(instrumented_jit, family="...") decorators
+    if tail == "partial" and node.args:
+        a0 = node.args[0]
+        name = (a0.attr if isinstance(a0, ast.Attribute)
+                else a0.id if isinstance(a0, ast.Name) else "")
+        return name in _REG_TAILS
+    return False
+
+
+class ShapeBucketDiscipline(Check):
+    name = "shape-bucket-discipline"
+    description = ("kernel family registered without a declared "
+                   "BucketSpec, or a batch dispatch in the coalescer "
+                   "bypassing the covering() pad helper")
+    scopes = ("ceph_tpu",)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        from ceph_tpu.tpu import shapebucket
+
+        declared = set(shapebucket.declared_families())
+        out: List[Violation] = []
+        for f in files:
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not _is_registration(node):
+                    continue
+                fam = _family_literal(node)
+                if fam is None or fam in declared:
+                    continue
+                out.append(Violation(
+                    check=self.name, path=f.rel, line=node.lineno,
+                    scope=enclosing_scope(f.tree, node.lineno),
+                    detail=f"undeclared-family:{fam}",
+                    message=(
+                        f"family {fam!r} registered without a "
+                        "BucketSpec in tpu/shapebucket.py — every "
+                        "compile it triggers is a rogue to the "
+                        "steady-state guard; declare() its bucket "
+                        "grammar (small_max/odd_max/ceiling/"
+                        "free_args) next to the other families"),
+                ))
+            if f.rel in _PAD_REQUIRED:
+                out.extend(self._unpadded_dispatches(f))
+        return out
+
+    def _unpadded_dispatches(self, f: SourceFile) -> List[Violation]:
+        out: List[Violation] = []
+        for fn in ast.walk(f.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            # does this function route widths through covering()?
+            pads = any(
+                (isinstance(n, ast.Attribute) and n.attr == "covering")
+                or (isinstance(n, ast.Name) and n.id == "covering")
+                for n in ast.walk(fn))
+            if pads:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = _call_tail(node)
+                if tail not in _DISPATCH_TAILS:
+                    continue
+                out.append(Violation(
+                    check=self.name, path=f.rel, line=node.lineno,
+                    scope=enclosing_scope(f.tree, node.lineno),
+                    detail=f"unpadded-dispatch:{tail}",
+                    message=(
+                        f"{tail}() dispatched from {fn.name}() "
+                        "without a shapebucket.covering() pad — an "
+                        "arbitrary batch width here is a fresh XLA "
+                        "compile per distinct size (the PR 8 "
+                        "compile-contaminated queue wait); pad to "
+                        "the covering bucket and slice the result"),
+                ))
+        return out
+
+
+# an undeclared family / unpadded dispatch is never accepted debt
+NEVER_BASELINE_PREFIXES.append((ShapeBucketDiscipline.name, "ceph_tpu/"))

@@ -235,6 +235,32 @@ def _opts() -> List[Option]:
           "shape dimension); default calibrated so a pow2-padded "
           "cold start (~5 bounded shapes/family, ROUND10 measured) "
           "stays quiet while an unpadded dimension trips in seconds"),
+        O("tpu_recompile_storm_min_rogue_sigs", int, 3,
+          "distinct ROGUE (undeclared by the shape-bucket ABI, "
+          "tpu/shapebucket.py) compile signatures of one family "
+          "inside the storm window that raise the RECOMPILE_STORM "
+          "WARN; much tighter than the total-signature threshold "
+          "because a declared cold ladder never counts here — "
+          "undeclared shape churn is a bug regardless of volume"),
+        O("tpu_compile_cache_dir", str, "",
+          "persistent on-disk XLA compilation cache directory "
+          "(jax_compilation_cache_dir): a restarted/failed-over "
+          "daemon re-reads compiled executables instead of re-paying "
+          "the compile wall (osd.N.xla cache_persist_hits counts the "
+          "cross-process hits); empty disables (vstart defaults it "
+          "under the cluster run dir)", runtime=False),
+        O("tpu_warmup_budget_s", float, 30.0,
+          "wall-clock budget for the boot-time DeviceWarmup pass "
+          "that compiles every registered kernel family against its "
+          "declared shape buckets before the daemon answers ops; "
+          "buckets the budget cuts off stay pending and resume via "
+          "'ceph daemon osd.N device warmup'"),
+        O("tpu_boot_warmup", bool, False,
+          "run the DeviceWarmup pass at OSD init (before the "
+          "messenger serves ops) so restart/failover/backfill never "
+          "re-pay the compile wall mid-traffic; off by default so "
+          "short-lived test clusters skip it (vstart warmup= knob)",
+          runtime=False),
         # -- objectstore ----------------------------------------------------
         O("objectstore", str, "memstore", "backend", enum=("memstore", "filestore")),
         O("objectstore_path", str, "", "data directory for filestore"),

@@ -58,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ceph_tpu.crush import hashes, ln
+from ceph_tpu.tpu import shapebucket
 from ceph_tpu.tpu.devwatch import instrumented_jit
 from ceph_tpu.crush.map import (
     ALG_LIST,
@@ -1373,7 +1374,7 @@ def sweep(
         res = np.array(res)  # writable host copy
         bad = np.nonzero(~np.asarray(clean))[0]
         if bad.size:
-            n_pad = 1 << max(0, int(bad.size - 1).bit_length())
+            n_pad = shapebucket.covering(int(bad.size))
             n_pad = hw_mid = max(n_pad, hw_mid)
             padded = np.full(n_pad, sub[bad[0]], dtype=np.int32)
             padded[: bad.size] = sub[bad]
@@ -1381,7 +1382,7 @@ def sweep(
             res[bad] = np.asarray(res2)[: bad.size]
             bad2 = np.nonzero(~np.asarray(clean2)[: bad.size])[0]
             if bad2.size:
-                n_pad2 = 1 << max(0, int(bad2.size - 1).bit_length())
+                n_pad2 = shapebucket.covering(int(bad2.size))
                 n_pad2 = hw_slow = max(n_pad2, hw_slow)
                 padded2 = np.full(n_pad2, padded[bad2[0]], dtype=np.int32)
                 padded2[: bad2.size] = padded[bad2]

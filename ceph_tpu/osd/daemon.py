@@ -217,6 +217,12 @@ class OSDService(Dispatcher):
         pgpc.add_u64_counter("recover_on_read_hits",
                              "reads of missing objects served by a "
                              "promoted recovery instead of EAGAIN")
+        pgpc.add_u64_counter("read_verify_late",
+                             "remote-shard checksum-failure replies "
+                             "that landed AFTER their EC read gather "
+                             "resolved — rot detected late is still "
+                             "counted and fed to the scrub_errors/"
+                             "blamed-shard path (ROUND16 caveat 2)")
         self.pg_perf = pgpc
         # scrub-engine evidence (osd.N.scrub): chunk/object throughput,
         # damage found vs repaired, preemption + resume counts — the
@@ -290,17 +296,33 @@ class OSDService(Dispatcher):
         _dw.attach_log(ctx.log)
         _dw.configure(
             window_s=float(ctx.conf.get("tpu_recompile_storm_window")),
-            min_sigs=int(ctx.conf.get("tpu_recompile_storm_min_sigs")))
+            min_sigs=int(ctx.conf.get("tpu_recompile_storm_min_sigs")),
+            min_rogue_sigs=int(
+                ctx.conf.get("tpu_recompile_storm_min_rogue_sigs")))
 
         def _dw_conf(name, val, _dw=_dw) -> None:
             if name == "tpu_recompile_storm_window":
                 _dw.configure(window_s=float(val))
             elif name == "tpu_recompile_storm_min_sigs":
                 _dw.configure(min_sigs=int(val))
+            elif name == "tpu_recompile_storm_min_rogue_sigs":
+                _dw.configure(min_rogue_sigs=int(val))
 
         self._devwatch_observer = ctx.conf.add_observer(
             ("tpu_recompile_storm_window",
-             "tpu_recompile_storm_min_sigs"), _dw_conf)
+             "tpu_recompile_storm_min_sigs",
+             "tpu_recompile_storm_min_rogue_sigs"), _dw_conf)
+        # persistent on-disk XLA compile cache (shape-bucket ABI): a
+        # restarted daemon re-reads compiled executables instead of
+        # re-paying the compile wall; process-wide and idempotent like
+        # the watcher itself (empty conf disables)
+        from ceph_tpu.tpu import shapebucket as _sb
+
+        _sb.setup_compile_cache(
+            str(ctx.conf.get("tpu_compile_cache_dir") or ""))
+        # boot-time warmup pass (built lazily: the codec and crush
+        # items resolve against the osdmap, which arrives with boot)
+        self._warmup = None
 
     # -- QoS plumbing -----------------------------------------------------
     def _arm_client_gate(self) -> None:
@@ -373,9 +395,58 @@ class OSDService(Dispatcher):
              "store_debug_inject_data_err", "store_verify_read"),
             _observe)
 
+    # -- boot warmup (shape-bucket ABI) ------------------------------------
+    def _warmup_codec(self):
+        """First EC pool's codec, or None until the osdmap lands —
+        DeviceWarmup keeps the codec buckets pending and resumes."""
+        om = self.osdmap
+        if om is None or self.codec_factory is None:
+            return None
+        for pool in getattr(om, "pools", {}).values():
+            prof = getattr(pool, "erasure_code_profile", None)
+            if prof:
+                try:
+                    return self.codec_factory(prof)
+                except Exception:
+                    continue
+        return None
+
+    def _warmup_crush(self) -> bool:
+        """Compile every pool's rule program by sweeping its real pg
+        vector — exactly the shapes peering and the balancer hit."""
+        om = self.osdmap
+        if om is None or not getattr(om, "pools", None):
+            return False
+        for pool_id in list(om.pools):
+            om.map_pgs(pool_id)
+        return True
+
+    def device_warmup(self, budget_s: Optional[float] = None) -> dict:
+        """Run (or resume) the DeviceWarmup pass: compile each kernel
+        family against its declared buckets, bounded by
+        tpu_warmup_budget_s.  Called at init when tpu_boot_warmup is
+        set — BEFORE the messenger serves ops — and on demand via the
+        `ceph daemon osd.N device warmup` admin command."""
+        from ceph_tpu.tpu.shapebucket import DeviceWarmup
+
+        if self._warmup is None:
+            self._warmup = DeviceWarmup(
+                codec_fn=self._warmup_codec, crush=self._warmup_crush)
+        if budget_s is None:
+            budget_s = float(self.ctx.conf.get("tpu_warmup_budget_s"))
+        st = self._warmup.run(budget_s)
+        self._log(0, f"device warmup: {st['buckets_warmed']} buckets "
+                     f"({', '.join(st['families_warmed']) or 'none'}) "
+                     f"in {st['seconds']}s, pending={st['pending']}")
+        return st
+
     def init(self) -> None:
         self._apply_fault_conf()
         self.store.mount()
+        if bool(self.ctx.conf.get("tpu_boot_warmup")):
+            # pay the compile wall NOW, before the messenger answers
+            # a single op — restart/failover/backfill keep their p99
+            self.device_warmup()
         self.msgr.start()
         self.hb_msgr.start()
         self.wq.start()
@@ -425,6 +496,15 @@ class OSDService(Dispatcher):
                 lambda c: self.dump_scrubs(),
                 "per-PG scrub state: running/mode/cursor, "
                 "last_scrub/last_deep_scrub stamps, scrub_errors")
+            # shape-bucket ABI: run/resume the declared-bucket warmup
+            # (budget=<seconds> overrides tpu_warmup_budget_s)
+            self.ctx.admin.register(
+                f"osd.{self.whoami} device warmup",
+                lambda c: self.device_warmup(
+                    float(c["budget"]) if "budget" in c else None),
+                "compile declared kernel-family shape buckets now "
+                "(resumes a budget-cut boot warmup); "
+                "budget=<seconds> overrides tpu_warmup_budget_s")
 
     def _admin_bench(self, cmd: dict) -> dict:
         from ceph_tpu.store.objectstore import Collection, GHObject

@@ -2464,11 +2464,25 @@ class PG:
 
         def on_reply(rep: m.MECSubReadReply) -> None:
             with lock:
-                if fired[0]:
-                    return
-                src = rep.src.num if rep.src else -1
-                ready = g.feed(rep.shard, src, rep.result, rep.oid,
-                               rep.data, rep.attrs, rep.omap)
+                late = fired[0]
+                if not late:
+                    src = rep.src.num if rep.src else -1
+                    ready = g.feed(rep.shard, src, rep.result, rep.oid,
+                                   rep.data, rep.attrs, rep.omap)
+            if late:
+                # the gather already resolved (>=k fast shards won the
+                # race or the timer fired) — but an ECRC verdict in a
+                # straggler reply is still evidence of at-rest rot on
+                # that holder.  Dropping it here silently un-detects
+                # remote corruption; count it and feed the same dedup'd
+                # attribution/repair path conclude() uses.
+                if rep.result == ECRC and rep.oid == oid:
+                    perf = getattr(self.osd, "pg_perf", None)
+                    if perf is not None:
+                        perf.inc("read_verify_late")
+                    src = rep.src.num if rep.src else -1
+                    self._note_read_verify_fail(oid, [(rep.shard, src)])
+                return
             if ready:
                 finish()
 

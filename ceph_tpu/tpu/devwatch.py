@@ -68,13 +68,19 @@ _EVENT_RING = 256        # compile + batch events kept for dumps
 _SPAN_RING = 512         # finished compile spans kept for overlap math
 _SIGS_KEPT = 32          # distinct signatures listed per family dump
 
-# storm defaults, calibrated against a measured cold start (ROUND10):
-# a healthy pow2-padded process compiles ~5 distinct crc shapes and
-# ~2-3 mapper shapes in its first minute — bounded warmup, not churn.
-# 8 distinct signatures of ONE family inside a minute only happens
-# when a shape dimension is genuinely unpadded (each call novel).
+# storm defaults.  The 8/60s total-signature threshold was calibrated
+# against a measured cold start (ROUND10): a healthy pow2-padded
+# process compiles ~5 distinct crc shapes and ~2-3 mapper shapes in
+# its first minute — bounded warmup, not churn — so the detector had
+# to tolerate declared cold ladders heuristically.  With the shape
+# ABI (tpu/shapebucket.py) classifying every compile, DECLARED
+# signatures keep that loose threshold (a cold ladder is finite by
+# construction) while ROGUE signatures — undeclared, a bug by
+# definition — trip at a much tighter count: three distinct rogue
+# shapes of one family inside a minute is churn, never warmup.
 DEFAULT_STORM_WINDOW_S = 60.0
 DEFAULT_STORM_MIN_SIGS = 8
+DEFAULT_STORM_MIN_ROGUE_SIGS = 3
 
 
 def _sig_of(v: Any, static: bool = False) -> Tuple:
@@ -171,7 +177,7 @@ def _churn_dim(sigs: List[Tuple]) -> str:
 
 class _Family:
     __slots__ = ("sigs", "compiles", "compile_s", "hits", "dispatches",
-                 "traces")
+                 "traces", "warmup", "cold", "rogue", "persist_hits")
 
     def __init__(self) -> None:
         self.sigs: "collections.OrderedDict[Tuple, int]" = \
@@ -181,6 +187,16 @@ class _Family:
         self.hits = 0
         self.dispatches = 0
         self.traces = 0  # pallas_call trace re-entries
+        # compile classification against the declared shape-bucket ABI
+        # (tpu/shapebucket.py): warmup = declared bucket compiled
+        # inside a DeviceWarmup pass; cold = declared but first hit
+        # outside warmup; rogue = UNDECLARED signature (a bug)
+        self.warmup = 0
+        self.cold = 0
+        self.rogue = 0
+        # compiles this process resolved from the persistent on-disk
+        # XLA cache (a previous process paid the wall, we didn't)
+        self.persist_hits = 0
 
 
 class DeviceWatch:
@@ -199,6 +215,20 @@ class DeviceWatch:
             "cache_hits", "jit calls served by an existing compile")
         self.perf.add_u64_counter(
             "recompile_storms", "recompile-storm WARNs raised")
+        self.perf.add_u64_counter(
+            "rogue_compiles",
+            "compiles with a signature OUTSIDE the declared bucket "
+            "set (shape-bucket ABI violation)")
+        self.perf.add_u64_counter(
+            "warmup_compiles",
+            "declared-bucket compiles paid inside a warmup pass")
+        self.perf.add_u64_counter(
+            "cache_persist_hits",
+            "compiles served from the persistent on-disk XLA cache "
+            "(a previous process paid the wall)")
+        self.perf.add_u64_counter(
+            "cache_persist_misses",
+            "persistent-cache lookups that missed (wall paid here)")
         self._fams: Dict[str, _Family] = {}
         # flight recorder: (t_mono, kind, family, detail) —
         # kind in ("compile", "batch", "trace", "storm")
@@ -216,6 +246,16 @@ class DeviceWatch:
             collections.deque(maxlen=_SPAN_RING)
         self.storm_window_s = DEFAULT_STORM_WINDOW_S
         self.storm_min_sigs = DEFAULT_STORM_MIN_SIGS
+        self.storm_min_rogue_sigs = DEFAULT_STORM_MIN_ROGUE_SIGS
+        # warmup classification: >0 while a DeviceWarmup pass runs
+        self._warmup = 0
+        # last published DeviceWarmup stats (families warmed, seconds
+        # spent, buckets skipped) — the osd.N.xla dump's warmup section
+        self.warmup_stats: Optional[Dict[str, Any]] = None
+        # persistent-cache events (jax monitoring listener, installed
+        # by shapebucket.setup_compile_cache)
+        self._persist_hits = 0
+        self._persist_misses = 0
         # monotonic stamp of the last compile END (the blame fast
         # path's lock-free pre-check; 0.0 = never compiled)
         self.last_compile_end = 0.0
@@ -243,11 +283,14 @@ class DeviceWatch:
         self._queue = queue
 
     def configure(self, window_s: Optional[float] = None,
-                  min_sigs: Optional[int] = None) -> None:
+                  min_sigs: Optional[int] = None,
+                  min_rogue_sigs: Optional[int] = None) -> None:
         if window_s is not None and window_s > 0:
             self.storm_window_s = float(window_s)
         if min_sigs is not None and min_sigs > 0:
             self.storm_min_sigs = int(min_sigs)
+        if min_rogue_sigs is not None and min_rogue_sigs > 0:
+            self.storm_min_rogue_sigs = int(min_rogue_sigs)
 
     # -- per-family perf plumbing ------------------------------------------
     def _fam(self, family: str) -> _Family:
@@ -278,14 +321,22 @@ class DeviceWatch:
         with self._lock:
             self._live_seq += 1
             tok = self._live_seq
-            self._live[tok] = (family, t0)
+            # snapshot the persist-hit count: a delta over this
+            # compile's span attributes the on-disk cache hit to the
+            # family (the jax monitoring event itself is unlabeled)
+            self._live[tok] = (family, t0, self._persist_hits)
         return tok
 
     def compile_end(self, token: int, sig: Tuple,
                     error: bool = False) -> None:
         t1 = time.monotonic()
+        # classify against the declared shape-bucket ABI outside the
+        # lock (pure registry lookup; lazy import breaks the cycle —
+        # shapebucket imports this module at top level)
+        from ceph_tpu.tpu import shapebucket
+
         with self._lock:
-            family, t0 = self._live.pop(token, ("?", t1))
+            family, t0, persist0 = self._live.pop(token, ("?", t1, 0))
             self._spans.append((t0, t1))
             self.last_compile_end = t1
             if error:
@@ -302,19 +353,73 @@ class DeviceWatch:
             self.perf.tinc("compile_seconds", wall)
             self.perf.set("distinct_shapes",
                           sum(len(f.sigs) for f in self._fams.values()))
-            self._recent.append((t1, family, sig))
-            self._record("compile", family,
-                         f"sig=({sig_str(sig)}) wall_ms="
-                         f"{wall * 1e3:.1f}")
+            declared = shapebucket.sig_declared(family, sig)
+            if not declared:
+                klass = "rogue"
+                fam.rogue += 1
+                self.perf.inc("rogue_compiles")
+            elif self._warmup > 0:
+                klass = "warmup"
+                fam.warmup += 1
+                self.perf.inc("warmup_compiles")
+            else:
+                klass = "bucketed-cold"
+                fam.cold += 1
+            persist_d = self._persist_hits - persist0
+            if persist_d > 0:
+                fam.persist_hits += persist_d
+            # warmup-classified compiles never feed the storm window:
+            # a DeviceWarmup pass walks the whole declared ladder by
+            # design, and the detector no longer has to heuristically
+            # tolerate that burst (rogues are rogue even during
+            # warmup, so they still count)
+            if klass != "warmup":
+                self._recent.append((t1, family, sig, not declared))
+            self._record(
+                "compile", family,
+                f"[{klass}] sig=({sig_str(sig)}) wall_ms="
+                f"{wall * 1e3:.1f}"
+                + (" persist-hit" if persist_d > 0 else ""),
+                level=1 if klass == "rogue" else 10)
             if self._steady > 0:
                 GUARD_VIOLATIONS.append(
                     f"XLA compile inside a steady-state section: "
-                    f"family={family} sig=({sig_str(sig)}) "
+                    f"family={family} class={klass} "
+                    f"sig=({sig_str(sig)}) "
                     f"wall_ms={wall * 1e3:.1f} — warm this shape up "
                     "front or pad it into an already-compiled bucket")
             storm = self._check_storm(family, t1)
         if storm is not None:
             self._warn_storm(storm)
+
+    def note_persist(self, hit: bool) -> None:
+        """One persistent-compilation-cache event (jax monitoring
+        listener): a hit means THIS process skipped a compile some
+        previous process already paid for — the cross-process half of
+        killing the compile wall."""
+        with self._lock:
+            if hit:
+                self._persist_hits += 1
+                self.perf.inc("cache_persist_hits")
+            else:
+                self._persist_misses += 1
+                self.perf.inc("cache_persist_misses")
+
+    def persist_totals(self) -> Tuple[int, int]:
+        with self._lock:
+            return self._persist_hits, self._persist_misses
+
+    @contextlib.contextmanager
+    def warmup_scope(self):
+        """Mark compiles as warmup (declared-bucket compiles paid up
+        front by a DeviceWarmup pass, not charged as cold misses)."""
+        with self._lock:
+            self._warmup += 1
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._warmup -= 1
 
     def note_hit(self, family: str, dur_s: float) -> None:
         with self._lock:
@@ -346,31 +451,45 @@ class DeviceWatch:
     # -- storm detection ---------------------------------------------------
     def _check_storm(self, family: str,
                      now: float) -> Optional[Dict[str, Any]]:
-        # callers hold self._lock
+        # callers hold self._lock.  Two thresholds over the same
+        # window: ROGUE (undeclared) signatures trip at the tight
+        # count — undeclared churn is a bug regardless of volume —
+        # while declared signatures keep the loose ROUND10-calibrated
+        # total (a declared cold ladder is finite by construction and
+        # a warmup pass walks it fast).
         horizon = now - self.storm_window_s
-        sigs = [s for (t, f, s) in self._recent
-                if f == family and t >= horizon]
-        distinct = list(dict.fromkeys(sigs))
-        if len(distinct) < self.storm_min_sigs:
+        recent = [(s, r) for (t, f, s, r) in self._recent
+                  if f == family and t >= horizon]
+        distinct = list(dict.fromkeys(s for s, _r in recent))
+        rogue_distinct = list(dict.fromkeys(
+            s for s, r in recent if r))
+        if len(rogue_distinct) >= self.storm_min_rogue_sigs:
+            kind, storm_sigs = "rogue", rogue_distinct
+        elif len(distinct) >= self.storm_min_sigs:
+            kind, storm_sigs = "declared", distinct
+        else:
             return None
         last = self._storm_last.get(family, 0.0)
         if now - last < self.storm_window_s:
             return None  # one WARN per family per window
         self._storm_last[family] = now
-        dim = _churn_dim(distinct)
+        dim = _churn_dim(storm_sigs)
         storm = {
             "family": family,
-            "distinct_signatures": len(distinct),
+            "kind": kind,
+            "distinct_signatures": len(storm_sigs),
+            "rogue_signatures": len(rogue_distinct),
             "window_s": self.storm_window_s,
             "churning": dim,
-            "signatures": [sig_str(s) for s in distinct[-_SIGS_KEPT:]],
+            "signatures": [sig_str(s)
+                           for s in storm_sigs[-_SIGS_KEPT:]],
             "at": time.time(),
         }
         self.storms.append(storm)
         del self.storms[:-16]
         self.perf.inc("recompile_storms")
         self._record("storm", family,
-                     f"{len(distinct)} distinct sigs in "
+                     f"[{kind}] {len(storm_sigs)} distinct sigs in "
                      f"{self.storm_window_s:.0f}s, churning {dim}",
                      level=1)
         return storm
@@ -379,13 +498,17 @@ class DeviceWatch:
         # outside self._lock: the cluster callback may take arbitrary
         # locks (mon session)
         log = self._log
+        what = ("undeclared (rogue) shape signatures"
+                if storm.get("kind") == "rogue"
+                else "distinct shape signatures")
         msg = (f"RECOMPILE_STORM: kernel family "
                f"'{storm['family']}' compiled "
-               f"{storm['distinct_signatures']} distinct shape "
-               f"signatures within {storm['window_s']:.0f}s "
+               f"{storm['distinct_signatures']} {what} "
+               f"within {storm['window_s']:.0f}s "
                f"(churning dimension: {storm['churning']}) — pad the "
-               "churning dimension to a bounded bucket set "
-               "(pow2 high-water, the PR 3 CRUSH fix)")
+               "churning dimension to a declared bucket "
+               "(shapebucket.covering, the PR 3 CRUSH fix as the "
+               "repo-wide shape ABI)")
         if log is not None:
             log.cluster("WRN", msg)
 
@@ -423,7 +546,7 @@ class DeviceWatch:
         now = time.monotonic()
         with self._lock:
             spans = list(self._spans)
-            spans += [(s0, now) for (_f, s0) in self._live.values()]
+            spans += [(s0, now) for (_f, s0, _p) in self._live.values()]
         for s0, s1 in spans:
             lo, hi = max(t0, s0), min(t1, s1)
             if hi > lo:
@@ -431,13 +554,17 @@ class DeviceWatch:
         return min(total, t1 - t0)
 
     def compile_totals(self) -> Dict[str, float]:
-        """Cumulative (compiles, compile_seconds) — the bench's
-        per-phase delta source for the compile-vs-steady split."""
+        """Cumulative compile totals — the bench's per-phase delta
+        source for the compile-vs-steady split (now including the
+        shape-ABI classification and persistent-cache hits)."""
         with self._lock:
             return {
                 "compiles": sum(f.compiles for f in self._fams.values()),
                 "compile_seconds": round(
                     sum(f.compile_s for f in self._fams.values()), 6),
+                "rogue": sum(f.rogue for f in self._fams.values()),
+                "warmup": sum(f.warmup for f in self._fams.values()),
+                "persist_hits": self._persist_hits,
             }
 
     def family_stats(self, family: str) -> Dict[str, Any]:
@@ -446,12 +573,17 @@ class DeviceWatch:
             if f is None:
                 return {"compiles": 0, "compile_s": 0.0,
                         "distinct_signatures": 0, "cache_hits": 0,
-                        "dispatches": 0, "traces": 0}
+                        "dispatches": 0, "traces": 0,
+                        "warmup": 0, "cold": 0, "rogue": 0,
+                        "persist_hits": 0}
             return {"compiles": f.compiles,
                     "compile_s": round(f.compile_s, 6),
                     "distinct_signatures": len(f.sigs),
                     "cache_hits": f.hits, "dispatches": f.dispatches,
-                    "traces": f.traces}
+                    "traces": f.traces,
+                    "warmup": f.warmup, "cold": f.cold,
+                    "rogue": f.rogue,
+                    "persist_hits": f.persist_hits}
 
     def dump(self) -> Dict[str, Any]:
         """The ``device compile dump`` payload: the per-family compile
@@ -467,12 +599,16 @@ class DeviceWatch:
                     "cache_hits": f.hits,
                     "dispatches": f.dispatches,
                     "traces": f.traces,
+                    "warmup": f.warmup,
+                    "cold": f.cold,
+                    "rogue": f.rogue,
+                    "persist_hits": f.persist_hits,
                     "signatures": [
                         {"sig": sig_str(s), "compiles": n}
                         for s, n in list(f.sigs.items())[-_SIGS_KEPT:]],
                 }
             live = [{"family": fam, "age_s": round(now - t0, 3)}
-                    for fam, t0 in self._live.values()]
+                    for fam, t0, _p in self._live.values()]
             events = [
                 {"age_s": round(now - t, 3), "kind": k,
                  "family": fam, "detail": d}
@@ -489,7 +625,15 @@ class DeviceWatch:
                         len(x.sigs) for x in self._fams.values()),
                     "cache_hits": sum(x.hits
                                       for x in self._fams.values()),
+                    "rogue_compiles": sum(
+                        x.rogue for x in self._fams.values()),
+                    "warmup_compiles": sum(
+                        x.warmup for x in self._fams.values()),
+                    "cache_persist_hits": self._persist_hits,
+                    "cache_persist_misses": self._persist_misses,
                 },
+                "warmup": self.warmup_stats,
+                "compile_cache_dir": _cache_dir_for_dump(),
                 "storms": list(self.storms),
                 "live_compiles": live,
                 "recent_events": events,
@@ -521,7 +665,7 @@ class DeviceWatch:
         with self._lock:
             out["live_compiles"] = [
                 {"family": fam, "age_s": round(now - t0, 3)}
-                for fam, t0 in self._live.values()]
+                for fam, t0, _p in self._live.values()]
             out["last_compiles"] = [
                 {"age_s": round(now - t, 3), "family": fam,
                  "detail": d}
@@ -541,19 +685,22 @@ class DeviceWatch:
             if not fams:
                 return
             rows = [(name, f.compiles, round(f.compile_s, 6),
-                     len(f.sigs), f.hits) for name, f in fams]
+                     len(f.sigs), f.hits, f.rogue, f.persist_hits)
+                    for name, f in fams]
         for metric, idx, typ in (
                 ("ceph_xla_compile_total", 1, "counter"),
                 ("ceph_xla_compile_seconds", 2, "counter"),
                 ("ceph_xla_distinct_shapes", 3, "gauge"),
-                ("ceph_xla_cache_hits", 4, "counter")):
+                ("ceph_xla_cache_hits", 4, "counter"),
+                ("ceph_xla_rogue_compiles", 5, "counter"),
+                ("ceph_xla_cache_persist_hits", 6, "counter")):
             lines.append(f"# TYPE {metric} {typ}")
             for row in rows:
                 lines.append(
                     f'{metric}{{family="{row[0]}"}} {row[idx]}')
         hists = self.perf.dump()
         lines.append("# TYPE ceph_xla_exec_us histogram")
-        for name, _c, _s, _n, _h in rows:
+        for name, *_rest in rows:
             val = hists.get(f"exec_{name}_us")
             if not isinstance(val, dict):
                 continue
@@ -571,6 +718,15 @@ class DeviceWatch:
                 f'ceph_xla_exec_us_count{{{label}}} {val["count"]}')
             lines.append(
                 f'ceph_xla_exec_us_sum{{{label}}} {val["sum"]}')
+
+
+def _cache_dir_for_dump() -> Optional[str]:
+    try:
+        from ceph_tpu.tpu import shapebucket
+
+        return shapebucket.compile_cache_dir()
+    except Exception:  # pragma: no cover — torn import rig
+        return None
 
 
 _WATCH = DeviceWatch()

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ceph_tpu.tpu import shapebucket
 from ceph_tpu.tpu.devwatch import instrumented_jit
 
 
@@ -77,10 +78,16 @@ class MeshCompute:
         self._progs: Dict[tuple, object] = {}
 
     # -- helpers -----------------------------------------------------------
-    def _pad_cols(self, x: np.ndarray) -> Tuple[np.ndarray, int]:
-        """Pad columns to a multiple of dp so the stripe axis splits."""
+    def _pad_cols(self, x: np.ndarray,
+                  unit: Optional[int] = None) -> Tuple[np.ndarray, int]:
+        """Pad columns to the covering shape bucket: the smallest
+        ``unit * 2**j`` >= n (unit defaults to dp so the stripe axis
+        splits).  A bare multiple-of-unit pad made every distinct n a
+        fresh XLA compile of the mesh program — the shape-bucket ABI
+        (tpu/shapebucket.py) bounds the meshio family to O(log)
+        widths like every other dispatch site."""
         n = x.shape[1]
-        want = -(-n // self.dp) * self.dp
+        want = shapebucket.covering(n, unit or self.dp)
         if want != n:
             x = np.pad(x, ((0, 0), (0, want - n)))
         return x, n
@@ -146,14 +153,12 @@ class MeshCompute:
             prog = instrumented_jit(sm, family="meshio")
             self._progs[key] = prog
         if isinstance(x, np.ndarray):
-            xp, n = self._pad_cols(np.ascontiguousarray(x, dtype=np.uint8))
-            # SWAR packs 4 bytes/u32: cols must divide by 4*dp
-            if xp.shape[1] % (4 * self.dp):
-                extra = 4 * self.dp - xp.shape[1] % (4 * self.dp)
-                xp = np.pad(xp, ((0, 0), (0, extra)))
+            # SWAR packs 4 bytes/u32: bucket unit is 4*dp
+            xp, n = self._pad_cols(
+                np.ascontiguousarray(x, dtype=np.uint8), 4 * self.dp)
         else:  # device-resident producer: pad on device, no host hop
             n = x.shape[1]
-            want = -(-n // (4 * self.dp)) * (4 * self.dp)
+            want = shapebucket.covering(n, 4 * self.dp)
             xp = jnp.pad(x, ((0, 0), (0, want - n))) if want != n else x
         out = prog(xp)
         if keep_device:
@@ -196,13 +201,11 @@ class MeshCompute:
             self._progs[key] = prog
         if isinstance(survivors, np.ndarray):
             sp, n = self._pad_cols(
-                np.ascontiguousarray(survivors, dtype=np.uint8))
-            if sp.shape[1] % (4 * self.dp):
-                extra = 4 * self.dp - sp.shape[1] % (4 * self.dp)
-                sp = np.pad(sp, ((0, 0), (0, extra)))
+                np.ascontiguousarray(survivors, dtype=np.uint8),
+                4 * self.dp)
         else:
             n = survivors.shape[1]
-            want = -(-n // (4 * self.dp)) * (4 * self.dp)
+            want = shapebucket.covering(n, 4 * self.dp)
             sp = (jnp.pad(survivors, ((0, 0), (0, want - n)))
                   if want != n else survivors)
         out = prog(sp)

@@ -23,7 +23,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ceph_tpu.core.perf import PerfCounters
-from ceph_tpu.tpu import devwatch
+from ceph_tpu.tpu import devwatch, shapebucket
 from ceph_tpu.tpu.staging import DevPathStats, StagingPool
 
 
@@ -255,7 +255,16 @@ class StripeBatchQueue:
 
     def _apply_matrix(self, codec, batch: List[_Job],
                       stacked: np.ndarray) -> np.ndarray:
-        """One device matmul for the whole batch (encode or decode)."""
+        """One device matmul for the whole batch (encode or decode).
+
+        Contract: `stacked` arrives already covering-padded by
+        _dispatch_batch — a raw width here would be a fresh XLA
+        compile per distinct size (the shape-bucket ABI this helper
+        sits under)."""
+        gran = int(getattr(codec, "get_sub_chunk_count", lambda: 1)())
+        assert stacked.shape[1] == shapebucket.covering(
+            stacked.shape[1], gran), \
+            f"unbucketed dispatch width {stacked.shape[1]} (gran={gran})"
         if batch[0].kind == "dec":
             rec, _bits = codec.recovery_matrix(list(batch[0].sig))
             if self.mesh is not None:
@@ -304,69 +313,70 @@ class StripeBatchQueue:
                            (t_start - j.t_enq) * 1e6)
         t_compute = t_start
         try:
-            if len(batch) == 1 and batch[0].kind == "enc":
-                coding = batch[0].codec.encode_array(batch[0].planes)
-                t_compute = time.monotonic()
-                batch[0].future.set_result(np.asarray(coding))
+            widths = [j.planes.shape[1] for j in batch]
+            total = sum(widths)
+            # EVERY dispatch — single jobs included — pads the
+            # concatenated width up to its covering shape bucket
+            # (shapebucket.covering: (a power of two) x (the codec's
+            # column granularity)) so the device only ever sees the
+            # family's DECLARED shapes: each distinct shape is a fresh
+            # XLA compile, and an undeclared one is a rogue compile by
+            # definition.  Array codecs like clay keep their
+            # width-divisible-by-sub_chunk_count invariant via gran;
+            # results are sliced back to real job widths below, and
+            # the pad columns are zeros (EC codecs are column-local,
+            # so padding cannot perturb real columns — proven
+            # bit-identical in tier-1).
+            gran = 1
+            get_subs = getattr(
+                batch[0].codec, "get_sub_chunk_count", None)
+            if get_subs is not None:
+                gran = max(1, int(get_subs()))
+            padded = shapebucket.covering(total, gran)
+            k = batch[0].planes.shape[0]
+            stacked = np.zeros((k, padded), dtype=np.uint8)
+            off = 0
+            for j, w in zip(batch, widths):
+                stacked[:, off:off + w] = j.planes
+                off += w
+            codec = batch[0].codec
+            if gran == 1:
+                coding = self._apply_matrix(codec, batch, stacked)
             else:
-                widths = [j.planes.shape[1] for j in batch]
-                total = sum(widths)
-                # pad the concatenated width up to (a power of two) x
-                # (the codec's column granularity) so the device sees a
-                # handful of distinct shapes (each distinct shape is a
-                # fresh XLA compile) while array codecs like clay keep
-                # their width-divisible-by-sub_chunk_count invariant
-                gran = 1
-                get_subs = getattr(
-                    batch[0].codec, "get_sub_chunk_count", None)
-                if get_subs is not None:
-                    gran = max(1, int(get_subs()))
-                units = -(-total // gran)  # ceil
-                padded = gran * (1 << (units - 1).bit_length())
-                k = batch[0].planes.shape[0]
-                stacked = np.zeros((k, padded), dtype=np.uint8)
+                coding = np.asarray(codec.encode_array(stacked))
+            if batch[0].kind == "encp":
+                # fused per-shard crc32c: one more device pass over
+                # the SAME batch (data planes + fresh coding
+                # planes); only the [jobs, k+m] u32 digests cross
+                # back — the payload stays put.  NOTE (device-rig
+                # honesty): this np concat + the crc row relayout
+                # are host moves on CPU rigs, folded into the
+                # already-counted upload; a real device rig must do
+                # them as jnp ops on the resident batch or it pays
+                # an uncounted round-trip — that port is the
+                # device-rig follow-up, not a counter change
+                from ceph_tpu.ops.crc32c_device import crc32c_rows
+
+                full = np.concatenate(
+                    [stacked, np.asarray(coding)], axis=0)
+                offs: List[int] = []
+                o = 0
+                for w in widths:
+                    offs.append(o)
+                    o += w
+                crcs = crc32c_rows(full, offs, widths)
+                t_compute = time.monotonic()
+                off = 0
+                for i, (j, w) in enumerate(zip(batch, widths)):
+                    j.future.set_result(
+                        (coding[:, off:off + w], crcs[i]))
+                    off += w
+            else:
+                t_compute = time.monotonic()
                 off = 0
                 for j, w in zip(batch, widths):
-                    stacked[:, off:off + w] = j.planes
+                    j.future.set_result(coding[:, off:off + w])
                     off += w
-                codec = batch[0].codec
-                if gran == 1:
-                    coding = self._apply_matrix(codec, batch, stacked)
-                else:
-                    coding = np.asarray(codec.encode_array(stacked))
-                if batch[0].kind == "encp":
-                    # fused per-shard crc32c: one more device pass over
-                    # the SAME batch (data planes + fresh coding
-                    # planes); only the [jobs, k+m] u32 digests cross
-                    # back — the payload stays put.  NOTE (device-rig
-                    # honesty): this np concat + the crc row relayout
-                    # are host moves on CPU rigs, folded into the
-                    # already-counted upload; a real device rig must do
-                    # them as jnp ops on the resident batch or it pays
-                    # an uncounted round-trip — that port is the
-                    # device-rig follow-up, not a counter change
-                    from ceph_tpu.ops.crc32c_device import crc32c_rows
-
-                    full = np.concatenate(
-                        [stacked, np.asarray(coding)], axis=0)
-                    offs: List[int] = []
-                    o = 0
-                    for w in widths:
-                        offs.append(o)
-                        o += w
-                    crcs = crc32c_rows(full, offs, widths)
-                    t_compute = time.monotonic()
-                    off = 0
-                    for i, (j, w) in enumerate(zip(batch, widths)):
-                        j.future.set_result(
-                            (coding[:, off:off + w], crcs[i]))
-                        off += w
-                else:
-                    t_compute = time.monotonic()
-                    off = 0
-                    for j, w in zip(batch, widths):
-                        j.future.set_result(coding[:, off:off + w])
-                        off += w
             if batch[0].kind in ("encp", "dec"):
                 # the ONE h2d upload of the device-resident path: the
                 # whole coalesced batch crosses together (stripe-tail

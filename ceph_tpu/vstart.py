@@ -52,6 +52,7 @@ class VStartCluster:
                  store_kind: str = "filestore",
                  keyring: bool = False,
                  conf: Optional[dict] = None,
+                 warmup: bool = False,
                  wait: bool = True) -> None:
         self.n_mons = n_mons
         self.n_osds = n_osds
@@ -60,12 +61,21 @@ class VStartCluster:
         self._stop_evt = threading.Event()
         self.data_dir = data_dir
         self.store_kind = store_kind  # for data_dir: filestore|blockstore
-        self.ctx = Context("vstart", {
+        merged = {
             "osd_heartbeat_interval": 0.5,
             "osd_heartbeat_grace": 3.0,
             "mon_tick_interval": 0.5,
             **(conf or {}),
-        })
+        }
+        if warmup:
+            merged.setdefault("tpu_boot_warmup", True)
+        # durable clusters persist XLA binaries next to the object data:
+        # a SECOND process over the same dir pays ~zero compile wall
+        # (cache_persist_hits on osd.N.xla proves it)
+        if data_dir is not None:
+            merged.setdefault("tpu_compile_cache_dir",
+                              os.path.join(data_dir, "xla_cache"))
+        self.ctx = Context("vstart", merged)
         self.keyring = None
         if keyring:
             from ceph_tpu.auth.keyring import Keyring
@@ -309,6 +319,20 @@ class VStartCluster:
             return m is not None and pool_id in m.pools
 
         self.wait_for(visible, what=f"pool {name}")
+        if bool(self.ctx.conf.get("tpu_boot_warmup")):
+            # boot warmup ran codec-less (no pools existed yet); now
+            # that one does, resume the pending codec/CRUSH items so
+            # first ops against this pool hit warm kernels
+            def osdmaps_caught_up() -> bool:
+                e = self.leader().osdmap.epoch
+                return all(o.epoch() >= e for o in self.osds.values()
+                           if o.up)
+
+            self.wait_for(osdmaps_caught_up,
+                          what=f"osd maps for pool {name}")
+            for o in self.osds.values():
+                if o.up:
+                    o.device_warmup()
         return pool_id
 
     def client(self) -> RadosClient:

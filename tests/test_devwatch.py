@@ -29,9 +29,11 @@ def dw():
     """The process-wide watcher with config/wiring save-restored so
     tests can shrink storm thresholds and attach stub logs/queues."""
     w = watch()
-    saved = (w.storm_window_s, w.storm_min_sigs, w._log, w._queue)
+    saved = (w.storm_window_s, w.storm_min_sigs, w.storm_min_rogue_sigs,
+             w._log, w._queue)
     yield w
-    w.storm_window_s, w.storm_min_sigs, w._log, w._queue = saved
+    (w.storm_window_s, w.storm_min_sigs, w.storm_min_rogue_sigs,
+     w._log, w._queue) = saved
     GUARD_VIOLATIONS.clear()
 
 

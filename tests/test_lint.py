@@ -617,3 +617,65 @@ def test_no_unverified_read_never_baseline(tmp_path):
                   scope="ECBackend.x", detail="_read_span(...)",
                   message="m")
     assert v.key not in violations_to_baseline([v])["entries"]
+
+
+# -- shape-bucket-discipline (PR 17) ------------------------------------
+
+
+def test_shape_bucket_flags_undeclared_family(tmp_path):
+    bad = _lint(tmp_path, (
+        "from ceph_tpu.tpu.devwatch import instrumented_jit\n"
+        "import functools\n"
+        "f = instrumented_jit(lambda x: x, family='mystery_kernel')\n"
+        "@functools.partial(instrumented_jit, family='other_rogue')\n"
+        "def g(x):\n"
+        "    return x\n"), "shape-bucket-discipline")
+    assert sorted(v.detail for v in bad) == [
+        "undeclared-family:mystery_kernel",
+        "undeclared-family:other_rogue"]
+
+
+def test_shape_bucket_allows_declared_families(tmp_path):
+    ok = _lint(tmp_path, (
+        "from ceph_tpu.tpu.devwatch import instrumented_jit\n"
+        "f = instrumented_jit(lambda x: x, family='gf256_swar')\n"
+        "g = instrumented_jit(lambda x: x, family='crush_mapper')\n"),
+        "shape-bucket-discipline")
+    assert not ok
+
+
+def test_shape_bucket_flags_unpadded_queue_dispatch(tmp_path):
+    code = (
+        "def dispatch(codec, stacked):\n"
+        "    return codec.encode_array(stacked)\n"
+        "def padded(codec, stacked, covering):\n"
+        "    w = covering(stacked.shape[1])\n"
+        "    return codec.encode_array(stacked)\n")
+    bad = _lint(tmp_path, code, "shape-bucket-discipline",
+                rel="ceph_tpu/tpu/queue.py")
+    assert [v.detail for v in bad] == ["unpadded-dispatch:encode_array"]
+    # the same code outside the coalescer is not this check's business
+    assert not _lint(tmp_path, code, "shape-bucket-discipline",
+                     rel="ceph_tpu/osd/other.py")
+
+
+def test_shape_bucket_never_baseline(tmp_path):
+    from ceph_tpu.analysis.framework import (Violation,
+                                             violations_to_baseline)
+
+    v = Violation(check="shape-bucket-discipline",
+                  path="ceph_tpu/tpu/queue.py", line=1,
+                  scope="dispatch", detail="unpadded-dispatch:encode_array",
+                  message="m")
+    assert v.key not in violations_to_baseline([v])["entries"]
+
+
+def test_shape_bucket_clean_on_repo_tree():
+    """The real tree must carry zero violations: every registration
+    site's family is declared and every coalescer dispatch pads."""
+    from ceph_tpu.analysis.framework import discover_files, run_checks
+    from ceph_tpu.analysis.checks import CHECKS_BY_NAME as _BY_NAME
+
+    files = [f for f in discover_files(subdirs=("ceph_tpu",))]
+    vs = run_checks(files, [_BY_NAME["shape-bucket-discipline"]])
+    assert not vs, [v.message for v in vs]

@@ -418,3 +418,57 @@ def test_replicated_read_verify_fail_retries_and_heals(cluster, client):
         cluster.ctx.conf.set_val("osd_scrub_auto_repair", False)
         for o in cluster.osds.values():
             o.store.debug_clear_data_err()
+
+
+def test_late_ecrc_reply_is_counted_and_fed_to_repair(cluster, client):
+    """PR 17 satellite: a remote shard's checksum-failure (ECRC) reply
+    that lands AFTER its read gather resolved used to be silently
+    dropped — remote rot detected late was lost evidence.  It must be
+    counted (read_verify_late) and still feed the dedup'd
+    scrub_errors / read-repair attribution path."""
+    from ceph_tpu.osd import messages as m_
+    from ceph_tpu.osd.backend import ECRC
+
+    cluster.ctx.conf.set_val("osd_scrub_auto_repair", False)
+    payload = b"late-ecrc" * 300
+    client.put(EC_POOL, "ri_late", payload)
+    pgid, acting, primary, pg = _pg_of(cluster, EC_POOL, "ri_late")
+    osd = cluster.osds[primary]
+    captured = {}
+    orig = osd.track_reads
+
+    def spy(pgid_, cb, n):
+        captured["cb"] = cb
+        return orig(pgid_, cb, n)
+
+    osd.track_reads = spy
+    try:
+        pg._obc_invalidate("ri_late")
+        assert client.get(EC_POOL, "ri_late") == payload
+    finally:
+        osd.track_reads = orig
+    cb = captured.get("cb")
+    assert cb is not None, "EC read never gathered remotely"
+    perf = osd.pg_perf
+    late0 = perf.value("read_verify_late")
+    errs0 = pg.scrub_errors
+    # a healthy straggler (result=0) stays dropped: no counter motion
+    cb(m_.MECSubReadReply(pgid, 0, shard=1, oid="ri_late", result=0))
+    assert perf.value("read_verify_late") == late0
+    assert pg.scrub_errors == errs0
+    # an ECRC straggler is late rot evidence: counted + attributed
+    cb(m_.MECSubReadReply(pgid, 0, shard=1, oid="ri_late",
+                          result=ECRC))
+    assert perf.value("read_verify_late") == late0 + 1
+    assert pg.scrub_errors == errs0 + 1
+    assert "ri_late" in pg._read_repair_pending
+    # a second late verdict re-counts the REPLY but not the error
+    # (the per-object dedup _note_read_verify_fail already enforces)
+    cb(m_.MECSubReadReply(pgid, 0, shard=2, oid="ri_late",
+                          result=ECRC))
+    assert perf.value("read_verify_late") == late0 + 2
+    assert pg.scrub_errors == errs0 + 1
+    # don't leak damage state into the rest of the module
+    with pg.lock:
+        pg._read_repair_pending.discard("ri_late")
+        pg.scrub_errors = errs0

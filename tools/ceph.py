@@ -201,6 +201,28 @@ def main(argv=None) -> int:
             if tokens[:2] == ["osd", "tree"]:
                 print(json.dumps(_osd_tree(cluster), indent=1))
                 continue
+            # `ceph daemon osd.N device warmup [budget=S]` — the
+            # per-daemon admin surface (reference `ceph daemon`); the
+            # daemons live in-process here, so route directly instead
+            # of over an asok
+            if (tokens[:1] == ["daemon"] and len(tokens) >= 4
+                    and tokens[1].startswith("osd.")
+                    and tokens[2:4] == ["device", "warmup"]):
+                osd_id = int(tokens[1][4:])
+                budget = None
+                for extra in tokens[4:]:
+                    if extra.startswith("budget="):
+                        budget = float(extra.split("=", 1)[1])
+                svc = cluster.osds.get(osd_id)
+                if svc is None:
+                    print(f"no such daemon osd.{osd_id}",
+                          file=sys.stderr)
+                    rc = 2
+                    continue
+                print(json.dumps(
+                    {"rc": 0, **svc.device_warmup(budget)}, indent=1,
+                    default=str))
+                continue
             try:
                 cmd = _parse(tokens)
             except (ValueError, IndexError) as e:

@@ -128,19 +128,33 @@ def render_device(d: dict) -> str:
     if not fams:
         return "no device compile events yet"
     head = (f"{'family':<16} {'compiles':>9} {'compile_s':>10} "
-            f"{'shapes':>7} {'hits':>9} {'traces':>7}")
+            f"{'shapes':>7} {'hits':>9} {'traces':>7} "
+            f"{'warm':>5} {'rogue':>6} {'persist':>8}")
     lines = [head, "-" * len(head)]
     for name, f in sorted(fams.items()):
         lines.append(
             f"{name:<16} {f['compiles']:>9} {f['compile_s']:>10.3f} "
             f"{f['distinct_signatures']:>7} {f['cache_hits']:>9} "
-            f"{f['traces']:>7}")
+            f"{f['traces']:>7} {f.get('warmup', 0):>5} "
+            f"{f.get('rogue', 0):>6} {f.get('persist_hits', 0):>8}")
     tot = d.get("totals", {})
     lines.append(
         f"total: {tot.get('compiles', 0)} compiles, "
         f"{tot.get('compile_seconds', 0.0)}s compiling, "
         f"{tot.get('distinct_shapes', 0)} distinct shapes, "
-        f"{tot.get('cache_hits', 0)} cache hits")
+        f"{tot.get('cache_hits', 0)} cache hits, "
+        f"{tot.get('rogue_compiles', 0)} rogue, "
+        f"{tot.get('cache_persist_hits', 0)} persist hits")
+    if d.get("compile_cache_dir"):
+        lines.append(f"compile cache: {d['compile_cache_dir']}")
+    w = d.get("warmup")
+    if w:
+        lines.append(
+            f"warmup: {'done' if w.get('done') else 'pending'}, "
+            f"{w.get('buckets_warmed', 0)} buckets in "
+            f"{w.get('seconds', 0.0)}s "
+            f"({w.get('pending', 0)} pending, "
+            f"{w.get('runs', 0)} runs)")
     for s in d.get("storms", []):
         lines.append(
             f"STORM: {s['family']} x{s['distinct_signatures']} sigs "

@@ -27,12 +27,25 @@ LANES = 128
 
 
 def main():
+    import os
+
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     out = {"backend": jax.default_backend(),
            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+
+    # persistent XLA compile cache (PR 17): point CEPH_TPU_XLA_CACHE at
+    # a directory and the SECOND minibench run pays ~zero compile wall —
+    # the per-family table below reports persist_hits so the artifact
+    # proves it instead of asserting it
+    from ceph_tpu.tpu.shapebucket import setup_compile_cache
+
+    cache_dir = os.environ.get("CEPH_TPU_XLA_CACHE", "")
+    out["xla_cache_dir"] = cache_dir or None
+    if cache_dir:
+        setup_compile_cache(cache_dir)
 
     from ceph_tpu import _native
     from ceph_tpu.ec import matrices
@@ -135,14 +148,19 @@ def main():
 
     guarded("crush_1m_mplacements_per_s", crush_rate)
 
-    # the per-family compile table (PR 10): how much of this run's
-    # wall went to XLA compiles, per kernel family — the artifact
-    # carries its own warmup-skew evidence instead of guesswork
+    # the per-family compile table (PR 10, classified PR 17): how much
+    # of this run's wall went to XLA compiles per kernel family, split
+    # warmup / bucketed-cold / rogue, plus on-disk cache hits — the
+    # artifact carries its own warmup-skew evidence instead of
+    # guesswork
     from ceph_tpu.tpu.devwatch import watch
 
     out["xla_compile"] = {
         fam: watch().family_stats(fam)
         for fam in sorted(watch().dump()["families"])}
+    totals = watch().compile_totals()
+    totals["persist_misses"] = watch().persist_totals()[1]
+    out["xla_compile_totals"] = totals
 
     print(flush())
     return 0
