@@ -5,7 +5,10 @@ from ceph_tpu.analysis.checks.codec import CodecSymmetry
 from ceph_tpu.analysis.checks.d2h import NoD2HOnHotPath
 from ceph_tpu.analysis.checks.failpoint_names import FailpointNameRegistry
 from ceph_tpu.analysis.checks.jax_purity import JaxPurity
+from ceph_tpu.analysis.checks.lane_capability import LaneCapability
+from ceph_tpu.analysis.checks.lock_cycle import LockOrderCycle
 from ceph_tpu.analysis.checks.locks import NamedLocks
+from ceph_tpu.analysis.checks.shared_state import UnguardedSharedState
 from ceph_tpu.analysis.checks.qos_classes import QosClassRegistry
 from ceph_tpu.analysis.checks.shape_bucket import ShapeBucketDiscipline
 from ceph_tpu.analysis.checks.silent_except import SilentExcept
@@ -28,6 +31,9 @@ ALL_CHECKS = (
     NoUnwatchedJit(),
     NoUnverifiedRead(),
     ShapeBucketDiscipline(),
+    LaneCapability(),
+    LockOrderCycle(),
+    UnguardedSharedState(),
 )
 
 CHECKS_BY_NAME = {c.name: c for c in ALL_CHECKS}

@@ -7,19 +7,15 @@ no RPCs.  A handler that breaks the contract wedges the loop that must
 read every peer's replies, which presents as a cluster-wide liveness
 hang (the exact shape of the PR 1 EAGAIN storms).
 
-This check builds a call graph whose roots are
-
-  - every ``async def`` (they run on some event loop),
-  - ``ms_dispatch`` of every class whose ``ms_can_fast_dispatch`` is
-    not literally ``return False``,
-  - callbacks scheduled onto the loop via ``call_soon`` /
-    ``call_soon_threadsafe`` / ``call_later`` / ``_loop_call``,
-
-and flags blocking primitives reachable from them: ``time.sleep``,
-``.acquire()`` (without ``blocking=False``), ``with <lock>``,
-``.wait()`` / ``.wait_for()``, ``.result()``, ``.join()``, sync
-``open()``, sync socket ops, and ``apply_transaction``.  Calls
-directly under ``await`` are the loop doing its job and are exempt.
+Since PR 18 this is a view over the shared thread-role engine
+(``analysis/threadmodel.py``): the check is exactly the (loop,
+may-block) cell of the role/capability lattice.  Roots and call-graph
+propagation live in the engine; this module owns only the blocking
+primitives: ``time.sleep``, ``.acquire()`` (without
+``blocking=False``), ``with <lock>``, ``.wait()`` / ``.wait_for()``,
+``.result()``, ``.join()``, sync ``open()``, sync socket ops, and
+``apply_transaction``.  Calls directly under ``await`` are the loop
+doing its job and are exempt.
 
 Resolution is deliberately conservative (``self.m`` within the class
 and its same-repo bases, bare names within the module, ``mod.f``
@@ -33,100 +29,19 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+from typing import List, Sequence, Set, Tuple
 
 from ceph_tpu.analysis.framework import (
     Check, SourceFile, Violation, call_name, dotted,
+)
+from ceph_tpu.analysis.threadmodel import (
+    ROLE_LOOP, FuncInfo, ThreadModel, awaited_calls, body_walk,
 )
 
 _LOCKISH = re.compile(r"(^|_)(lock|rlock|lk|lck|mutex|guard|cond|cv)$",
                       re.IGNORECASE)
 _SLEEPS = {"time.sleep", "_time.sleep"}
 _SYNC_SOCKET = {"recv", "sendall", "accept"}
-_SCHED_ARG0 = {"call_soon", "call_soon_threadsafe", "_loop_call"}
-_SCHED_ARG1 = {"call_later", "call_at"}
-
-
-def _body_walk(fn: ast.AST) -> Iterator[ast.AST]:
-    """Walk a function body without descending into nested defs or
-    lambdas — those only block if somebody calls them, and then the
-    call site is the finding."""
-    stack = list(ast.iter_child_nodes(fn))
-    while stack:
-        n = stack.pop()
-        yield n
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                          ast.Lambda)):
-            continue
-        stack.extend(ast.iter_child_nodes(n))
-
-
-def _awaited_calls(fn: ast.AST) -> Set[int]:
-    return {id(n.value) for n in _body_walk(fn)
-            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)}
-
-
-def _returns_false_only(fn: ast.FunctionDef) -> bool:
-    body = [st for st in fn.body
-            if not (isinstance(st, ast.Expr)
-                    and isinstance(st.value, ast.Constant)
-                    and isinstance(st.value.value, str))]
-    return (len(body) == 1 and isinstance(body[0], ast.Return)
-            and isinstance(body[0].value, ast.Constant)
-            and body[0].value.value is False)
-
-
-class _Module:
-    def __init__(self, f: SourceFile) -> None:
-        self.file = f
-        self.modname = f.rel[:-3].replace("/", ".")
-        self.funcs: Dict[str, ast.AST] = {}       # module-level defs
-        self.classes: Dict[str, "_Class"] = {}
-        self.imports: Dict[str, str] = {}          # local -> module
-        self.from_imports: Dict[str, Tuple[str, str]] = {}
-        for node in f.tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.funcs[node.name] = node
-            elif isinstance(node, ast.ClassDef):
-                self.classes[node.name] = _Class(node)
-        for node in ast.walk(f.tree):
-            if isinstance(node, ast.Import):
-                for alias in node.names:
-                    self.imports[alias.asname
-                                 or alias.name.split(".")[0]] = alias.name
-            elif isinstance(node, ast.ImportFrom) and node.module:
-                for alias in node.names:
-                    self.from_imports[alias.asname or alias.name] = (
-                        node.module, alias.name)
-
-
-class _Class:
-    def __init__(self, node: ast.ClassDef) -> None:
-        self.node = node
-        self.bases = [dotted(b) for b in node.bases]
-        self.methods: Dict[str, ast.AST] = {
-            n.name: n for n in node.body
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-
-
-class _Func:
-    """One analyzable function with its lexical context."""
-
-    def __init__(self, mod: _Module, cls: Optional[str],
-                 name: str, node: ast.AST) -> None:
-        self.mod = mod
-        self.cls = cls
-        self.name = name
-        self.node = node
-
-    @property
-    def qual(self) -> str:
-        local = f"{self.cls}.{self.name}" if self.cls else self.name
-        return f"{self.mod.modname}:{local}"
-
-    @property
-    def local(self) -> str:
-        return f"{self.cls}.{self.name}" if self.cls else self.name
 
 
 class NoBlockingOnLoop(Check):
@@ -135,159 +50,43 @@ class NoBlockingOnLoop(Check):
                    "event loop or a fast-dispatched handler")
     scopes = ("ceph_tpu",)
 
+    # the (role, capability) cells this check owns
+    roles: Tuple[str, ...] = (ROLE_LOOP,)
+
     def run(self, files: Sequence[SourceFile]) -> List[Violation]:
-        mods = {m.modname: m for m in (_Module(f) for f in files)}
-        index: Dict[str, _Func] = {}
-        for mod in mods.values():
-            for name, node in mod.funcs.items():
-                fn = _Func(mod, None, name, node)
-                index[fn.qual] = fn
-            for cname, cls in mod.classes.items():
-                for mname, node in cls.methods.items():
-                    fn = _Func(mod, cname, mname, node)
-                    index[fn.qual] = fn
-
-        roots = self._find_roots(mods, index)
-        # BFS with parent pointers for example chains
-        parent: Dict[str, Optional[str]] = {q: None for q in roots}
-        frontier = list(roots)
-        while frontier:
-            q = frontier.pop()
-            for callee in self._edges(index[q], mods):
-                if callee.qual not in parent:
-                    parent[callee.qual] = q
-                    frontier.append(callee.qual)
-
+        tm = ThreadModel.of(files)
         out: List[Violation] = []
         reported: Set[Tuple[str, int]] = set()
-        for q in parent:
-            fn = index[q]
-            for line, prim in self._primitives(fn):
-                site = (fn.mod.file.rel, line)
-                if site in reported:
+        for role in self.roles:
+            for q in tm.reach[role]:
+                fn = tm.program.index.get(q)
+                if fn is None:
                     continue
-                reported.add(site)
-                chain: List[str] = []
-                cur: Optional[str] = q
-                while cur is not None:
-                    chain.append(index[cur].local)
-                    cur = parent[cur]
-                chain.reverse()
-                out.append(Violation(
-                    check=self.name, path=fn.mod.file.rel, line=line,
-                    scope=fn.local, detail=prim,
-                    message=self._message(prim, chain),
-                ))
+                for line, prim in self._primitives(fn):
+                    site = (fn.mod.file.rel, line)
+                    if site in reported:
+                        continue
+                    reported.add(site)
+                    out.append(Violation(
+                        check=self.name, path=fn.mod.file.rel,
+                        line=line, scope=fn.local, detail=prim,
+                        message=self._message(prim, tm.chain(role, q)),
+                    ))
         return out
 
     def _message(self, prim: str, chain: List[str]) -> str:
-        """Violation text hook — subclasses reusing the call-graph
-        machinery (no-d2h-on-hot-path) state their own contract."""
+        """Violation text hook — subclasses reusing the engine
+        (no-d2h-on-hot-path) state their own contract."""
         return (f"{prim} can block the event loop: reachable "
                 f"via {' -> '.join(chain)} (fast-dispatch/"
                 "loop contract: no store work, no lock "
                 "waits, no RPCs)")
 
-    # -- roots ------------------------------------------------------------
-    def _find_roots(self, mods: Dict[str, _Module],
-                    index: Dict[str, _Func]) -> Set[str]:
-        roots: Set[str] = set()
-        for fn in index.values():
-            if isinstance(fn.node, ast.AsyncFunctionDef):
-                roots.add(fn.qual)
-        # fast-dispatching classes: their ms_dispatch runs inline
-        for mod in mods.values():
-            for cname, cls in mod.classes.items():
-                can = cls.methods.get("ms_can_fast_dispatch")
-                if can is None or _returns_false_only(can):
-                    continue
-                disp = self._resolve_method(mod, cname, "ms_dispatch", mods)
-                if disp is not None:
-                    roots.add(disp.qual)
-        # loop-scheduled callbacks: call_soon(self.cb) etc.
-        for fn in list(index.values()):
-            for node in _body_walk(fn.node):
-                if not isinstance(node, ast.Call):
-                    continue
-                base = call_name(node).split(".")[-1]
-                arg = None
-                if base in _SCHED_ARG0 and node.args:
-                    arg = node.args[0]
-                elif base in _SCHED_ARG1 and len(node.args) > 1:
-                    arg = node.args[1]
-                if arg is None:
-                    continue
-                target = self._resolve_call(fn, dotted(arg), mods)
-                if target is not None:
-                    roots.add(target.qual)
-        return roots
-
-    # -- call graph -------------------------------------------------------
-    def _edges(self, fn: _Func, mods: Dict[str, _Module]) -> List[_Func]:
-        out: List[_Func] = []
-        for node in _body_walk(fn.node):
-            if isinstance(node, ast.Call):
-                target = self._resolve_call(fn, call_name(node), mods)
-                if target is not None:
-                    out.append(target)
-        return out
-
-    def _resolve_call(self, fn: _Func, cn: str,
-                      mods: Dict[str, _Module]) -> Optional[_Func]:
-        if not cn:
-            return None
-        parts = cn.split(".")
-        mod = fn.mod
-        if parts[0] == "self" and len(parts) == 2 and fn.cls:
-            return self._resolve_method(mod, fn.cls, parts[1], mods)
-        if len(parts) == 1:
-            if parts[0] in mod.funcs:
-                return _Func(mod, None, parts[0], mod.funcs[parts[0]])
-            fi = mod.from_imports.get(parts[0])
-            if fi:
-                src = mods.get(fi[0])
-                if src and fi[1] in src.funcs:
-                    return _Func(src, None, fi[1], src.funcs[fi[1]])
-            return None
-        if len(parts) == 2:
-            target_mod = mods.get(mod.imports.get(parts[0], ""))
-            if target_mod and parts[1] in target_mod.funcs:
-                return _Func(target_mod, None, parts[1],
-                             target_mod.funcs[parts[1]])
-        return None
-
-    def _resolve_method(self, mod: _Module, cname: str, mname: str,
-                        mods: Dict[str, _Module],
-                        depth: int = 0) -> Optional[_Func]:
-        if depth > 8:
-            return None
-        cls = mod.classes.get(cname)
-        if cls is None:
-            return None
-        if mname in cls.methods:
-            return _Func(mod, cname, mname, cls.methods[mname])
-        for base in cls.bases:
-            bname = base.split(".")[-1]
-            if bname in mod.classes and bname != cname:
-                hit = self._resolve_method(mod, bname, mname, mods,
-                                           depth + 1)
-                if hit is not None:
-                    return hit
-            fi = mod.from_imports.get(bname)
-            if fi:
-                src = mods.get(fi[0])
-                if src and fi[1] in src.classes:
-                    hit = self._resolve_method(src, fi[1], mname, mods,
-                                               depth + 1)
-                    if hit is not None:
-                        return hit
-        return None
-
     # -- blocking primitives ----------------------------------------------
-    def _primitives(self, fn: _Func) -> List[Tuple[int, str]]:
-        awaited = _awaited_calls(fn.node)
+    def _primitives(self, fn: FuncInfo) -> List[Tuple[int, str]]:
+        awaited = awaited_calls(fn.node)
         out: List[Tuple[int, str]] = []
-        for node in _body_walk(fn.node):
+        for node in body_walk(fn.node):
             if isinstance(node, ast.With):
                 for item in node.items:
                     name = dotted(item.context_expr)

@@ -8,12 +8,14 @@ messenger fast-dispatch path or the StripeBatchQueue worker quietly
 reintroduces the tunnel tax the whole refactor removed (the BENCH_r05
 shape: 276 GB/s on-device, ~0 end-to-end).
 
-This check reuses the PR-3 fast-dispatch call graph (every ``async
-def``, fast-dispatching ``ms_dispatch``, loop-scheduled callbacks) and
-adds ``StripeBatchQueue._worker`` as a root, then flags host-
-materialization primitives reachable from them: ``np.asarray`` /
-``np.array`` / ``jnp.asarray``, ``.tolist()``, ``.tobytes()``, and
-``bytes(...)`` applied to a value.
+Since PR 18 this is the (loop ∪ device_worker, may-d2h) cell of the
+shared thread-role engine: roots (every ``async def``, fast-dispatch
+``ms_dispatch``, loop-scheduled callbacks, ``StripeBatchQueue._worker``
+and future callbacks that resolve on it) come from
+``analysis/threadmodel.py``; this module owns only the host-
+materialization primitives: ``np.asarray`` / ``np.array`` /
+``jnp.asarray``, ``.tolist()``, ``.tobytes()``, and ``bytes(...)``
+applied to a value.
 
 Accepted legacy debt lives in the baseline like any other check —
 EXCEPT in the new pipeline modules themselves (``tpu/staging.py``,
@@ -27,12 +29,13 @@ batched d2h, 4-byte metadata digests) annotate the line with
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set, Tuple
+from typing import List, Tuple
 
-from ceph_tpu.analysis.checks.blocking import (
-    NoBlockingOnLoop, _Func, _Module, _body_walk,
-)
+from ceph_tpu.analysis.checks.blocking import NoBlockingOnLoop
 from ceph_tpu.analysis.framework import NEVER_BASELINE_PREFIXES, call_name
+from ceph_tpu.analysis.threadmodel import (
+    ROLE_DEVICE, ROLE_LOOP, FuncInfo, body_walk,
+)
 
 # host-materialization call names (module-qualified numpy/jax spellings
 # the repo actually uses)
@@ -51,14 +54,7 @@ class NoD2HOnHotPath(NoBlockingOnLoop):
                    "StripeBatchQueue._worker call graphs")
     scopes = ("ceph_tpu",)
 
-    # -- roots: fast-dispatch graph + the queue's device worker ----------
-    def _find_roots(self, mods: Dict[str, _Module],
-                    index: Dict[str, _Func]) -> Set[str]:
-        roots = super()._find_roots(mods, index)
-        worker = "ceph_tpu.tpu.queue:StripeBatchQueue._worker"
-        if worker in index:
-            roots.add(worker)
-        return roots
+    roles = (ROLE_LOOP, ROLE_DEVICE)
 
     def _message(self, prim: str, chain: List[str]) -> str:
         return (f"{prim} materializes a device buffer on host: "
@@ -68,9 +64,9 @@ class NoD2HOnHotPath(NoBlockingOnLoop):
                 "with a disable + rationale)")
 
     # -- primitives: host materializations --------------------------------
-    def _primitives(self, fn: _Func) -> List[Tuple[int, str]]:
+    def _primitives(self, fn: FuncInfo) -> List[Tuple[int, str]]:
         out: List[Tuple[int, str]] = []
-        for node in _body_walk(fn.node):
+        for node in body_walk(fn.node):
             if not isinstance(node, ast.Call):
                 continue
             cn = call_name(node)

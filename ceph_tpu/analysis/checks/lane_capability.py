@@ -1,0 +1,167 @@
+"""lane-capability: the role/capability lattice cells no older check
+owned, enforced.  NEVER baselineable.
+
+The thread-role engine (``analysis/threadmodel.py``) assigns every
+function the set of lanes that can execute it.  Each lane carries a
+capability set; ``DENIED_CAPS`` names what a lane must never do:
+
+  ==============  =========  ============  ========  ===========
+  role            may-block  may-pg-lock   may-d2h   may-compile
+  ==============  =========  ============  ========  ===========
+  loop            NO [PR3]   NO [PR5]      NO [PR6]  NO [PR17]
+  device_worker   yes        NO [PR5]      NO [PR6]  yes
+  shard_worker    yes        yes           yes       yes
+  fanout          yes        yes           yes       yes
+  commit          yes        yes           yes       yes
+  timer           yes        yes           yes       yes
+  thread          yes        yes           yes       yes
+  ==============  =========  ============  ========  ===========
+
+(loop, may-block) is ``no-blocking-on-loop`` and (loop|device,
+may-d2h) is ``no-d2h-on-hot-path`` — those keep their names and their
+baselines.  THIS check enforces the remaining denied cells:
+
+- **may-take-pg-lock** from ``loop`` or ``device_worker``: the PR 5
+  invariant as code.  A pg lock (``pg.lock`` / ``self.lock`` inside
+  ``PG`` / ``maintenance_guard``) acquired on the messenger loop or
+  the device worker deadlocks against lanes that hold the pg lock
+  while waiting on a stripe future or a peer reply — decode
+  completions were moved to fresh threads for exactly this reason.
+
+- **may-compile** from ``loop``: creating a jit/pallas entry point on
+  the event loop stalls every peer's frames behind an XLA compile
+  (PR 10 measured 89% of a workload's wall inside compiles).
+
+Both are structural deadlock/liveness lanes, so violations are NEVER
+baselineable anywhere under ceph_tpu/ — fix the lane handoff (spawn a
+fresh thread, enqueue to the shard queue) or prove the site safe and
+annotate it inline with a rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set, Tuple
+
+from ceph_tpu.analysis.framework import (
+    NEVER_BASELINE_PREFIXES, Check, SourceFile, Violation, call_name,
+    dotted,
+)
+from ceph_tpu.analysis.threadmodel import (
+    CAP_COMPILE, CAP_PG_LOCK, DENIED_CAPS, ROLE_DEVICE, ROLE_LOOP,
+    FuncInfo, ThreadModel, body_walk,
+)
+
+# compile entry points: creating (or invoking the creation of) a
+# traced callable — each distinct shape through one of these is an XLA
+# compile
+_COMPILE_CALLS = {"jax.jit", "pl.pallas_call", "pallas.pallas_call"}
+_COMPILE_BASES = {"instrumented_jit", "pallas_call"}
+
+
+def _nonblocking(call: ast.Call) -> bool:
+    """``.acquire(blocking=False)`` / ``.acquire(False)``: a
+    try-acquire returns instead of waiting — it cannot deadlock the
+    lane, so the capability rule does not apply."""
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value is False:
+        return True
+    return any(kw.arg == "blocking"
+               and isinstance(kw.value, ast.Constant)
+               and kw.value.value is False
+               for kw in call.keywords)
+
+
+def _is_pg_lock(name: str, fn: FuncInfo) -> bool:
+    """True when a dotted expression names a pg-lane lock: the PG's
+    own mutex or the maintenance guard."""
+    if not name:
+        return False
+    parts = name.split(".")
+    if parts[-1] == "maintenance_guard":
+        return True
+    if parts[-1] != "lock" or len(parts) < 2:
+        return False
+    owner = parts[-2]
+    if owner in ("pg", "_pg"):
+        return True
+    # self.lock inside the PG class itself
+    return owner == "self" and fn.cls == "PG"
+
+
+class LaneCapability(Check):
+    name = "lane-capability"
+    description = ("per-role capability lattice: pg locks unreachable "
+                   "from the loop/device lanes, compiles unreachable "
+                   "from the loop")
+    scopes = ("ceph_tpu",)
+
+    # (role, capability) cells enforced HERE (the rest belong to
+    # no-blocking-on-loop / no-d2h-on-hot-path)
+    CELLS: Tuple[Tuple[str, str], ...] = (
+        (ROLE_LOOP, CAP_PG_LOCK),
+        (ROLE_DEVICE, CAP_PG_LOCK),
+        (ROLE_LOOP, CAP_COMPILE),
+    )
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        tm = ThreadModel.of(files)
+        out: List[Violation] = []
+        reported: Set[Tuple[str, int, str]] = set()
+        for role, cap in self.CELLS:
+            assert cap in DENIED_CAPS.get(role, ()), \
+                f"lattice drift: {role} is not denied {cap}"
+            for q in tm.reach[role]:
+                fn = tm.program.index.get(q)
+                if fn is None:
+                    continue
+                finder = (self._pg_lock_sites if cap == CAP_PG_LOCK
+                          else self._compile_sites)
+                for line, prim in finder(fn):
+                    site = (fn.mod.file.rel, line, cap)
+                    if site in reported:
+                        continue
+                    reported.add(site)
+                    chain = " -> ".join(tm.chain(role, q))
+                    out.append(Violation(
+                        check=self.name, path=fn.mod.file.rel,
+                        line=line, scope=fn.local,
+                        detail=f"{role}:{cap}:{prim}",
+                        message=(
+                            f"{prim} on the {role} lane (reachable via "
+                            f"{chain}) — the {role} lane lacks the "
+                            f"{cap} capability; hand off to a thread "
+                            "or the shard queue instead"),
+                    ))
+        return out
+
+    # -- primitive finders -------------------------------------------------
+    def _pg_lock_sites(self, fn: FuncInfo) -> List[Tuple[int, str]]:
+        out: List[Tuple[int, str]] = []
+        for node in body_walk(fn.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    name = dotted(item.context_expr)
+                    if _is_pg_lock(name, fn):
+                        out.append((node.lineno, f"with {name}"))
+            elif isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn.endswith(".acquire") and _is_pg_lock(
+                        cn.rsplit(".", 1)[0], fn) and \
+                        not _nonblocking(node):
+                    out.append((node.lineno, f"{cn}()"))
+        return out
+
+    def _compile_sites(self, fn: FuncInfo) -> List[Tuple[int, str]]:
+        out: List[Tuple[int, str]] = []
+        for node in body_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            if cn in _COMPILE_CALLS or cn.split(".")[-1] in _COMPILE_BASES:
+                out.append((node.lineno, f"{cn}()"))
+        return out
+
+
+# structural deadlock lanes: debt here is never accepted, anywhere
+NEVER_BASELINE_PREFIXES.append((LaneCapability.name, "ceph_tpu/"))

@@ -1,0 +1,792 @@
+"""lock-order-cycle: static lock-order cycle detection.  NEVER
+baselineable.
+
+Runtime lockdep (``core/lockdep.py``, the src/common/lockdep.cc port)
+learns "held -> acquiring" edges only on paths that actually EXECUTE —
+a deadlock on the untested interleaving stays a production hang.  This
+check builds the whole-program acquisition graph statically:
+
+1. **Lock classes** — every ``make_lock(name)`` call defines one.
+   F-string names (``make_lock(f"osd{n}.pg{pgid}")``) become patterns
+   with ``{}`` placeholders (``osd{}.pg{}``): one static class covers
+   every runtime instance, and ``classify()`` maps a runtime instance
+   name back to its class for the runtime ⊆ static cross-check in
+   tier-1 (tests/test_lockdep.py).
+
+2. **Acquisition regions** — nested ``with <lock>:`` regions, where
+   ``<lock>`` resolves through ``self.attr`` assignments (including
+   ``threading.Condition(make_lock(...))`` wrappers), module globals,
+   function locals, locals constructed from known classes, and — as a
+   last resort — attributes whose name maps to exactly ONE lock class
+   program-wide.  Unresolvable lockish expressions are recorded (the
+   dump shows them) but create no edges: conservative, not guessed.
+
+3. **Edges across the call graph** — holding A while acquiring B adds
+   A -> B, whether B is taken in the same body or anywhere in the
+   transitive closure of calls made inside A's region.  Call
+   resolution layers, in order: the shared Program resolver
+   (``self.meth`` / module functions), a TYPE map for cross-object
+   calls (``self.backend.submit()`` follows ``self.backend:
+   PGBackend = ECBackend(...)`` — annotations, constructor calls, and
+   annotated ctor parameters all feed it, multi-valued where branches
+   assign different classes), annotated function parameters
+   (``store: MemStore``), nested defs (a closure's acquisitions
+   belong to whoever calls it — passing one as a callback argument
+   counts as a call, that's how ``reply_once`` reaches the commit
+   path), and finally a bounded fallback: a method name defined by at
+   most ``_FALLBACK_OWNERS`` classes program-wide resolves to ALL of
+   them (duck-typed seams like ``osd.send_to_osd`` stay modeled).
+
+A cycle in the class graph is a potential ABBA deadlock and fails the
+build (never baselineable); re-entrant same-class nesting is NOT an
+edge, matching runtime lockdep's re-entrancy rule.  The full graph
+dumps via ``tools/cephlint.py --lock-graph=dot|json``.
+
+The static graph over-approximates (context-insensitive closure, no
+path feasibility): it may contain edges no execution performs.  That
+is the correct direction — the tier-1 contract is *runtime-observed
+edges ⊆ static graph*, so a runtime edge the model cannot see means an
+unmodeled call path and fails the cross-check test loudly.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ceph_tpu.analysis.framework import (
+    NEVER_BASELINE_PREFIXES, Check, SourceFile, Violation, call_name,
+    dotted,
+)
+from ceph_tpu.analysis.threadmodel import FuncInfo, Module, Program
+
+_LOCKISH = re.compile(r"(^|_)(lock|rlock|lk|lck|mutex|guard|cond|cv)$",
+                      re.IGNORECASE)
+
+# plain (unnamed) sync-primitive constructors: a self.X assigned one
+# of these is a REAL lock but not a make_lock class — record it so no
+# name-based fallback binds the attr to a named class it isn't
+_PLAIN_SYNC_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+                     "BoundedSemaphore", "Event"}
+
+
+def _lock_name_from_call(node: ast.AST) -> Optional[str]:
+    """The lock-class pattern of a ``make_lock(...)`` call (possibly
+    wrapped in ``threading.Condition(...)``), else None.  F-string
+    fields become ``{}`` placeholders."""
+    if not isinstance(node, ast.Call):
+        return None
+    cn = call_name(node)
+    base = cn.split(".")[-1]
+    if base == "Condition" and node.args:
+        return _lock_name_from_call(node.args[0])
+    if base != "make_lock" or not node.args:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        parts: List[str] = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("{}")
+        return "".join(parts)
+    return None
+
+
+def _pattern_regex(pattern: str) -> "re.Pattern[str]":
+    out = []
+    for piece in pattern.split("{}"):
+        out.append(re.escape(piece))
+    return re.compile("^" + ".+?".join(out) + "$")
+
+
+class LockModel:
+    """The whole-program static acquisition graph."""
+
+    _CACHE: Dict[Tuple[int, ...], "LockModel"] = {}
+
+    def __init__(self, program: Program) -> None:
+        self.program = program
+        # class pattern -> "path:line" of a defining make_lock call
+        self.classes: Dict[str, str] = {}
+        # (modname, class-or-None, attr) -> pattern
+        self._attr: Dict[Tuple[str, Optional[str], str], str] = {}
+        # qual -> [(pattern, with-node)]
+        self._regions: Dict[str, List[Tuple[str, ast.With]]] = {}
+        # qual -> function-local var -> pattern
+        self._locals: Dict[str, Dict[str, str]] = {}
+        # lockish with-exprs we could not resolve: (path, line, expr)
+        self.unresolved: List[Tuple[str, int, str]] = []
+        # a -> b -> example site string
+        self.edges: Dict[str, Dict[str, str]] = {}
+        # (modname, class, attr) -> {(modname, class)} instance types
+        self._attr_types: Dict[Tuple[str, str, str],
+                               Set[Tuple[str, str]]] = {}
+        # method name -> {(modname, class)} every class defining it
+        self._method_owners: Dict[str, Set[Tuple[str, str]]] = {}
+        # module-level VAR = ClassName(...) singletons
+        self._mod_instances: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._ctor_cache: Dict[str, Dict[str, Tuple[Module, str]]] = {}
+        self._nested_cache: Dict[str, Dict[str, ast.AST]] = {}
+        # attrs assigned a PLAIN (unnamed) sync primitive — known
+        # locks that are NOT a make_lock class, so the attr-name
+        # fallback must never bind them to one
+        self._plain_lock_attrs: Set[Tuple[str, str, str]] = set()
+        self._collect_defs()
+        self._attr_by_name: Dict[str, Set[str]] = {}
+        for (_m, _c, attr), pat in self._attr.items():
+            self._attr_by_name.setdefault(attr, set()).add(pat)
+        self._collect_types()
+        self._collect_regions()
+        self._build_edges()
+
+    @classmethod
+    def of(cls, files: Sequence[SourceFile]) -> "LockModel":
+        key = tuple(id(f.tree) for f in files)
+        hit = cls._CACHE.get(key)
+        if hit is None:
+            hit = cls._CACHE[key] = cls(Program.of(files))
+        return hit
+
+    # -- definitions ------------------------------------------------------
+    def _note_class(self, pattern: str, mod: Module, line: int) -> None:
+        self.classes.setdefault(pattern, f"{mod.file.rel}:{line}")
+
+    def _collect_defs(self) -> None:
+        for mod in self.program.mods.values():
+            # module-level: VAR = make_lock(...)
+            for node in mod.file.tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    pat = _lock_name_from_call(node.value)
+                    if pat:
+                        self._attr[(mod.modname, None,
+                                    node.targets[0].id)] = pat
+                        self._note_class(pat, mod, node.lineno)
+            # attribute + local assignments anywhere in the module
+            for fn in self._functions(mod):
+                for node in ast.walk(fn.node):
+                    if not isinstance(node, ast.Assign) or \
+                            len(node.targets) != 1:
+                        continue
+                    pat = _lock_name_from_call(node.value)
+                    if not pat:
+                        tgt = node.targets[0]
+                        if (fn.cls and isinstance(node.value, ast.Call)
+                                and (call_name(node.value).split(".")[-1]
+                                     in _PLAIN_SYNC_CTORS)
+                                and isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            self._plain_lock_attrs.add(
+                                (mod.modname, fn.cls, tgt.attr))
+                        continue
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self" and fn.cls:
+                        self._attr[(mod.modname, fn.cls, tgt.attr)] = pat
+                        self._note_class(pat, mod, node.lineno)
+                    elif isinstance(tgt, ast.Name):
+                        self._locals.setdefault(fn.qual, {})[tgt.id] = pat
+                        self._note_class(pat, mod, node.lineno)
+
+    def _functions(self, mod: Module) -> List[FuncInfo]:
+        return [fn for fn in self.program.index.values()
+                if fn.mod is mod]
+
+    # -- instance types ----------------------------------------------------
+    def _resolve_class(self, mod: Module,
+                       name: str) -> Optional[Tuple[str, str]]:
+        """A class NAME visible from ``mod`` -> (modname, class)."""
+        if not name:
+            return None
+        parts = name.split(".")
+        if len(parts) == 1:
+            if parts[0] in mod.classes:
+                return (mod.modname, parts[0])
+            fi = mod.from_imports.get(parts[0])
+            if fi and fi[0] in self.program.mods \
+                    and fi[1] in self.program.mods[fi[0]].classes:
+                return (fi[0], fi[1])
+            return None
+        src = self.program.mods.get(mod.imports.get(parts[0], ""))
+        if src and parts[-1] in src.classes:
+            return (src.modname, parts[-1])
+        return None
+
+    def _ann_type(self, mod: Module,
+                  ann: Optional[ast.AST]) -> Optional[Tuple[str, str]]:
+        """``x: ClassName`` / ``Optional[ClassName]`` / ``"ClassName"``
+        -> the named class, when it resolves to a program class."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Subscript):
+            # Optional[X] / "X | None" style wrappers: the payload type
+            return self._ann_type(mod, ann.slice)
+        if isinstance(ann, ast.BinOp):  # X | None
+            return (self._ann_type(mod, ann.left)
+                    or self._ann_type(mod, ann.right))
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return self._resolve_class(mod, ann.value)
+        name = dotted(ann)
+        return self._resolve_class(mod, name) if name else None
+
+    def _param_types(self, fn: FuncInfo) -> Dict[str, Tuple[str, str]]:
+        a = fn.node.args
+        out: Dict[str, Tuple[str, str]] = {}
+        for arg in list(getattr(a, "posonlyargs", [])) + list(a.args) \
+                + list(a.kwonlyargs):
+            t = self._ann_type(fn.mod, arg.annotation)
+            if t:
+                out[arg.arg] = t
+        return out
+
+    def _collect_types(self) -> None:
+        prog = self.program
+        for mod in prog.mods.values():
+            for cname, ci in mod.classes.items():
+                for mname in ci.methods:
+                    self._method_owners.setdefault(mname, set()).add(
+                        (mod.modname, cname))
+            for node in mod.file.tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call):
+                    t = self._resolve_class(mod, call_name(node.value))
+                    if t:
+                        self._mod_instances[
+                            (mod.modname, node.targets[0].id)] = t
+        for fn in prog.index.values():
+            if not fn.cls:
+                continue
+            params = self._param_types(fn)
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt, val, ann = node.targets[0], node.value, None
+                elif isinstance(node, ast.AnnAssign):
+                    tgt, val, ann = node.target, node.value, node.annotation
+                else:
+                    continue
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                key = (fn.mod.modname, fn.cls, tgt.attr)
+                t = self._ann_type(fn.mod, ann)
+                if t:
+                    self._attr_types.setdefault(key, set()).add(t)
+                for t in self._value_types(fn.mod, val, params):
+                    self._attr_types.setdefault(key, set()).add(t)
+
+    def _value_types(self, mod, val, params) -> Set[Tuple[str, str]]:
+        """Possible instance types of an assigned value.  The attr map
+        is multi-valued, so conditional forms contribute EVERY branch:
+        ``kv if kv is not None else MemDB()`` types as both the param
+        and MemDB."""
+        out: Set[Tuple[str, str]] = set()
+        if isinstance(val, ast.Call):
+            t = self._resolve_class(mod, call_name(val))
+            if t:
+                out.add(t)
+        elif isinstance(val, ast.Name) and val.id in params:
+            out.add(params[val.id])
+        elif isinstance(val, ast.IfExp):
+            out |= self._value_types(mod, val.body, params)
+            out |= self._value_types(mod, val.orelse, params)
+        elif isinstance(val, ast.BoolOp):
+            for v in val.values:
+                out |= self._value_types(mod, v, params)
+        return out
+
+    def _attr_types_for(self, modname: str, cname: str,
+                        attr: str) -> Set[Tuple[str, str]]:
+        """Instance types of ``<cname>.<attr>``, walking bases."""
+        out: Set[Tuple[str, str]] = set()
+        seen: Set[Tuple[str, str]] = set()
+        stack = [(modname, cname)]
+        while stack:
+            m, c = stack.pop()
+            if (m, c) in seen:
+                continue
+            seen.add((m, c))
+            out |= self._attr_types.get((m, c, attr), set())
+            mod = self.program.mods.get(m)
+            ci = mod.classes.get(c) if mod else None
+            if ci is None:
+                continue
+            for base in ci.bases:
+                t = self._resolve_class(mod, base)
+                if t:
+                    stack.append(t)
+        return out
+
+    def _owner_types(self, fn: FuncInfo,
+                     owner: List[str]) -> Set[Tuple[str, str]]:
+        """Instance types of a dotted owner chain (``self.osd.msgr``)."""
+        base = owner[0]
+        cur: Set[Tuple[str, str]] = set()
+        if base == "self" and fn.cls:
+            cur = {(fn.mod.modname, fn.cls)}
+        else:
+            ctor = self._ctors(fn).get(base)
+            if ctor is not None:
+                cur = {(ctor[0].modname, ctor[1])}
+            else:
+                t = (self._param_types(fn).get(base)
+                     or self._mod_instances.get((fn.mod.modname, base)))
+                if t:
+                    cur = {t}
+        for attr in owner[1:]:
+            nxt: Set[Tuple[str, str]] = set()
+            for m, c in cur:
+                nxt |= self._attr_types_for(m, c, attr)
+            cur = nxt
+            if not cur:
+                break
+        return cur
+
+    def _ctors(self, fn: FuncInfo) -> Dict[str, Tuple[Module, str]]:
+        hit = self._ctor_cache.get(fn.qual)
+        if hit is None:
+            hit = self._ctor_cache[fn.qual] = self._ctor_classes(fn)
+        return hit
+
+    def _nested_defs(self, fn: FuncInfo) -> Dict[str, ast.AST]:
+        """Nested function defs inside ``fn``, by name."""
+        hit = self._nested_cache.get(fn.qual)
+        if hit is None:
+            hit = {n.name: n for n in ast.walk(fn.node)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))
+                   and n is not fn.node}
+            self._nested_cache[fn.qual] = hit
+        return hit
+
+    # a method name defined by at most this many classes program-wide
+    # resolves (to ALL of them) even with no type information — the
+    # duck-typed seams (pg.osd "host daemon", the 4-implementor store
+    # protocol) stay modeled without guessing on generic names like
+    # get/send/run (those have many more owners and stay unresolved)
+    _FALLBACK_OWNERS = 4
+
+    # names shared with stdlib containers / sync primitives: an
+    # untyped `x.append(...)` is a deque, not whatever program class
+    # happens to define `append` — the fallback never fires on these
+    _STDLIB_NAMES: Set[str] = (
+        set(dir(list)) | set(dir(dict)) | set(dir(set)) | set(dir(str))
+        | set(dir(bytes)) | {"appendleft", "popleft", "rotate",
+                             "extendleft", "maxlen",  # deque
+                             "acquire", "release", "locked", "wait",
+                             "wait_for", "notify", "notify_all",
+                             "is_set", "put", "put_nowait",
+                             "get_nowait", "task_done", "join",
+                             "submit", "result", "set_result",
+                             "add_done_callback", "cancel", "close"})
+
+    def _methodish_targets(self, fn: FuncInfo, owner: List[str],
+                           mname: str) -> List[FuncInfo]:
+        """``<owner chain>.<mname>`` -> candidate methods: typed chain
+        first, bounded program-wide name fallback second."""
+        out: List[FuncInfo] = []
+        if owner:
+            for m, c in sorted(self._owner_types(fn, owner)):
+                hit = self.program.resolve_method(
+                    self.program.mods[m], c, mname)
+                if hit is not None:
+                    out.append(hit)
+            if out:
+                return out
+        if mname in self._STDLIB_NAMES:
+            return out
+        owners = self._method_owners.get(mname, set())
+        if 0 < len(owners) <= self._FALLBACK_OWNERS:
+            for m, c in sorted(owners):
+                hit = self.program.resolve_method(
+                    self.program.mods[m], c, mname)
+                if hit is not None:
+                    out.append(hit)
+        return out
+
+    def _call_targets(self, fn: FuncInfo,
+                      call: ast.Call) -> List[FuncInfo]:
+        """Every function a call might reach: Program resolution,
+        typed cross-object chains, then the bounded name fallback.
+        ``getattr(obj, "meth")`` with a constant name counts as a
+        reference about to be invoked on this stack (the pipelined
+        write engine's duck-typed ``note_write_inflight`` hook)."""
+        cn = call_name(call)
+        if cn == "getattr" and len(call.args) >= 2 \
+                and isinstance(call.args[1], ast.Constant) \
+                and isinstance(call.args[1].value, str):
+            owner = dotted(call.args[0])
+            return self._methodish_targets(
+                fn, owner.split(".") if owner else [],
+                call.args[1].value)
+        t = self.program.resolve_call(fn, cn)
+        if t is not None:
+            return [t]
+        if not cn:
+            return []
+        parts = cn.split(".")
+        if len(parts) >= 2:
+            return self._methodish_targets(fn, parts[:-1], parts[-1])
+        return []
+
+    # -- region resolution -------------------------------------------------
+    def _attr_pattern(self, mod: Module, cls: Optional[str],
+                      attr: str) -> Optional[str]:
+        """self.<attr> lookup through the class and its resolvable
+        bases (same module or imported)."""
+        seen: Set[Tuple[str, str]] = set()
+        stack: List[Tuple[Module, Optional[str]]] = [(mod, cls)]
+        while stack:
+            m, c = stack.pop()
+            if c is None:
+                continue
+            if (m.modname, c) in seen:
+                continue
+            seen.add((m.modname, c))
+            hit = self._attr.get((m.modname, c, attr))
+            if hit:
+                return hit
+            ci = m.classes.get(c)
+            if ci is None:
+                continue
+            for base in ci.bases:
+                bname = base.split(".")[-1]
+                if bname in m.classes:
+                    stack.append((m, bname))
+                fi = m.from_imports.get(bname)
+                if fi and fi[0] in self.program.mods:
+                    stack.append((self.program.mods[fi[0]], fi[1]))
+        return None
+
+    def _ctor_classes(self, fn: FuncInfo) -> Dict[str, Tuple[Module, str]]:
+        """Function-local vars constructed from known classes:
+        ``op = InFlightOp(...)`` lets ``with op.lock:`` resolve."""
+        out: Dict[str, Tuple[Module, str]] = {}
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                cn = call_name(node.value).split(".")[-1]
+                if cn in fn.mod.classes:
+                    out[node.targets[0].id] = (fn.mod, cn)
+                else:
+                    fi = fn.mod.from_imports.get(cn)
+                    if fi and fi[0] in self.program.mods:
+                        src = self.program.mods[fi[0]]
+                        if fi[1] in src.classes:
+                            out[node.targets[0].id] = (src, fi[1])
+        return out
+
+    def resolve_lock_expr(self, fn: FuncInfo, expr: ast.AST
+                          ) -> Optional[str]:
+        name = dotted(expr)
+        if not name:
+            return None
+        parts = name.split(".")
+        attr = parts[-1]
+        if len(parts) == 1:
+            # bare local or module-level var
+            hit = self._locals.get(fn.qual, {}).get(attr)
+            if hit:
+                return hit
+            return self._attr.get((fn.mod.modname, None, attr))
+        owner = parts[-2]
+        if owner == "self" and len(parts) == 2 and fn.cls:
+            hit = self._attr_pattern(fn.mod, fn.cls, attr)
+            if hit:
+                return hit
+        ctor = self._ctor_classes(fn).get(owner)
+        if ctor is not None:
+            hit = self._attr_pattern(ctor[0], ctor[1], attr)
+            if hit:
+                return hit
+        # an attr the class assigns a PLAIN primitive is a known
+        # unnamed lock: resolving it to a named class would be wrong
+        if owner == "self" and fn.cls and \
+                (fn.mod.modname, fn.cls, attr) in self._plain_lock_attrs:
+            return None
+        # last resort: an attribute name used by exactly one lock
+        # class anywhere in the program is unambiguous
+        cands = self._attr_by_name.get(attr, set())
+        if len(cands) == 1:
+            return next(iter(cands))
+        return None
+
+    def _collect_regions(self) -> None:
+        for fn in self.program.index.values():
+            regions: List[Tuple[str, ast.With]] = []
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.With):
+                    continue
+                for item in node.items:
+                    expr = item.context_expr
+                    pat = self.resolve_lock_expr(fn, expr)
+                    if pat:
+                        regions.append((pat, node))
+                    else:
+                        name = dotted(expr)
+                        if name and _LOCKISH.search(name.split(".")[-1]):
+                            self.unresolved.append(
+                                (fn.mod.file.rel, node.lineno, name))
+            if regions:
+                self._regions[fn.qual] = regions
+
+    # -- edges -------------------------------------------------------------
+    def _nested_acquired(self, fn: FuncInfo,
+                         dnode: ast.AST) -> Set[str]:
+        """Lock classes acquired lexically inside a nested def —
+        charged to whoever CALLS the closure (or passes it onward as
+        a callback), not to its lexical position."""
+        out: Set[str] = set()
+        for node in ast.walk(dnode):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    pat = self.resolve_lock_expr(fn, item.context_expr)
+                    if pat:
+                        out.add(pat)
+        return out
+
+    def _may_acquire(self, fn: FuncInfo, call: ast.Call,
+                     closure: Dict[str, Set[str]]) -> Set[str]:
+        """Every lock class a call might acquire transitively: the
+        targets' closures, plus the acquisitions of any nested def
+        passed as a callback argument (the callee will invoke it on
+        this call stack — ``reply_once`` handed to the commit path)."""
+        out: Set[str] = set()
+        nested = self._nested_defs(fn)
+        cn = call_name(call)
+        if cn in nested:
+            out |= self._nested_acquired(fn, nested[cn])
+        for tgt in self._call_targets(fn, call):
+            out |= closure.get(tgt.qual, set())
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in nested:
+                out |= self._nested_acquired(fn, nested[arg.id])
+        return out
+
+    def _build_edges(self) -> None:
+        prog = self.program
+        # per-function direct acquisitions
+        local: Dict[str, Set[str]] = {
+            q: {pat for pat, _ in regs}
+            for q, regs in self._regions.items()}
+        # callee quals per function (full body including nested defs
+        # — their regions are charged to the encloser too)
+        callees: Dict[str, Set[str]] = {}
+        for q, fn in prog.index.items():
+            outs: Set[str] = set()
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call):
+                    for t in self._call_targets(fn, node):
+                        outs.add(t.qual)
+            callees[q] = outs
+        # fixpoint: closure[f] = local[f] U closure[callees]
+        closure: Dict[str, Set[str]] = {
+            q: set(local.get(q, ())) for q in prog.index}
+        changed = True
+        while changed:
+            changed = False
+            for q, cs in callees.items():
+                mine = closure[q]
+                before = len(mine)
+                for c in cs:
+                    mine |= closure.get(c, set())
+                if len(mine) != before:
+                    changed = True
+
+        def add_edge(a: str, b: str, site: str) -> None:
+            if a == b:
+                return  # re-entrancy is not an order edge
+            self.edges.setdefault(a, {}).setdefault(b, site)
+
+        for q, regions in self._regions.items():
+            fn = prog.index[q]
+            rel = fn.mod.file.rel
+            for pat, wnode in regions:
+                # everything lexically inside the region body
+                for node in ast.walk(wnode):
+                    if node is wnode:
+                        continue
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            inner = self.resolve_lock_expr(
+                                fn, item.context_expr)
+                            if inner:
+                                add_edge(pat, inner,
+                                         f"{rel}:{node.lineno} "
+                                         f"({fn.local})")
+                    elif isinstance(node, ast.Call):
+                        for inner in self._may_acquire(fn, node,
+                                                       closure):
+                            add_edge(pat, inner,
+                                     f"{rel}:{node.lineno} "
+                                     f"({fn.local})")
+
+    # -- queries -----------------------------------------------------------
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles via SCC decomposition: one representative
+        cycle per non-trivial SCC (deterministic order)."""
+        graph = {a: sorted(bs) for a, bs in self.edges.items()}
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            work = [(v, iter(graph.get(v, ())))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(graph.get(w, ()))))
+                        advanced = True
+                        break
+                    if w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        sccs.append(sorted(comp))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        out: List[List[str]] = []
+        for comp in sccs:
+            cyc = self._example_cycle(comp)
+            if cyc:
+                out.append(cyc)
+        return out
+
+    def _example_cycle(self, comp: List[str]) -> Optional[List[str]]:
+        """A concrete edge walk a -> ... -> a within one SCC."""
+        start = comp[0]
+        compset = set(comp)
+        path = [start]
+        seen = {start}
+        node = start
+        while True:
+            nxts = [b for b in sorted(self.edges.get(node, ()))
+                    if b in compset]
+            if not nxts:
+                return None
+            nxt = nxts[0]
+            for b in nxts:
+                if b == start and len(path) > 1:
+                    return path + [start]
+                if b not in seen:
+                    nxt = b
+                    break
+            else:
+                if nxts[0] == start:
+                    return path + [start]
+                return None
+            path.append(nxt)
+            seen.add(nxt)
+            node = nxt
+
+    def classify(self, runtime_name: str) -> Optional[str]:
+        """Map a runtime lock instance name to its static class."""
+        if runtime_name in self.classes:
+            return runtime_name
+        best: Optional[str] = None
+        best_lit = -1
+        for pat in self.classes:
+            if "{}" not in pat:
+                continue
+            if _pattern_regex(pat).match(runtime_name):
+                lit = len(pat.replace("{}", ""))
+                if lit > best_lit:
+                    best, best_lit = pat, lit
+        return best
+
+    # -- dumps -------------------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "classes": dict(sorted(self.classes.items())),
+            "edges": {a: {b: site for b, site in sorted(bs.items())}
+                      for a, bs in sorted(self.edges.items())},
+            "cycles": self.cycles(),
+            "unresolved": [f"{p}:{ln}: {expr}"
+                           for p, ln, expr in sorted(self.unresolved)],
+        }
+
+    def to_dot(self) -> str:
+        cyc_edges: Set[Tuple[str, str]] = set()
+        for cyc in self.cycles():
+            for a, b in zip(cyc, cyc[1:]):
+                cyc_edges.add((a, b))
+        lines = ["digraph lockorder {"]
+        for a in sorted(self.edges):
+            for b in sorted(self.edges[a]):
+                attr = " [color=red]" if (a, b) in cyc_edges else ""
+                lines.append(f'  "{a}" -> "{b}"{attr};')
+        lines.append("}")
+        return "\n".join(lines)
+
+
+class LockOrderCycle(Check):
+    name = "lock-order-cycle"
+    description = ("static lock acquisition graph over make_lock "
+                   "names must be acyclic (ABBA deadlock freedom)")
+    scopes = ("ceph_tpu",)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        model = LockModel.of(files)
+        out: List[Violation] = []
+        for cyc in model.cycles():
+            edge_sites = []
+            for a, b in zip(cyc, cyc[1:]):
+                edge_sites.append(
+                    f"{a} -> {b} at {self_edge_site(model, a, b)}")
+            first_site = self_edge_site(model, cyc[0], cyc[1])
+            path, _, line = first_site.partition(":")
+            lineno = int(line.split(" ")[0]) if line else 1
+            out.append(Violation(
+                check=self.name, path=path, line=lineno,
+                scope="<lock-graph>",
+                detail="cycle:" + "->".join(cyc),
+                message=("static lock-order cycle (potential ABBA "
+                         "deadlock): " + "; ".join(edge_sites) +
+                         " — break the cycle or hand one side off to "
+                         "another lane"),
+            ))
+        return out
+
+
+def self_edge_site(model: LockModel, a: str, b: str) -> str:
+    return model.edges.get(a, {}).get(b, "?:1")
+
+
+# deadlock freedom is structural: never accepted as debt
+NEVER_BASELINE_PREFIXES.append((LockOrderCycle.name, "ceph_tpu/"))

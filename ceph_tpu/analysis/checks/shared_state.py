@@ -1,0 +1,360 @@
+"""unguarded-shared-state: cross-lane data races on instance state.
+
+For every class the check infers, per ``self._x`` attribute, which
+named-lock regions its writes happen under.  An attribute that is
+written under a lock on one path has a de-facto guard contract; an
+access (read OR write) that touches the same attribute while holding
+none of its guard locks, from a method that a DIFFERENT thread role
+can execute (per the shared thread-role engine), is the classic
+half-guarded race: the locked path paid for atomicity the unlocked
+path silently voids.  This is exactly the PR 13 recovery-counter bug
+shape (``note_recovery_grant`` mutating QoS counters with and without
+``qos.recovery`` held) found by machine instead of by bench anomaly.
+
+Mechanics, deliberately conservative:
+
+- Guard tracking is lexical: ``with self.X:`` (where ``X`` is a lock
+  attribute — constructed from ``make_lock``/``threading.Lock``-family
+  calls, or lockish-named) extends the held set for the region body.
+- Caller-held inference: a private method (``self._m``) called ONLY
+  from regions that hold lock L is analyzed as holding L — this is the
+  ``_locked``-suffix convention, inferred instead of trusted, computed
+  to fixpoint over in-class call chains.  Public methods get no such
+  credit: external callers owe no locks.
+- Writes are assignments, augmented assignments, subscript stores, and
+  mutator calls (``append``/``add``/``pop``/``update``/...) on the
+  attribute.  Everything else is a read.
+- ``__init__`` is construction-time single-threaded and exempt.
+- Roles come from ``ThreadModel.roles_of``: the violation fires only
+  when the unguarded accessor's role set differs from the guarded
+  writers' — same-lane sequential access is not a race.
+
+One violation per (class, attribute): the baseline key is line-free
+and survives refactors.  True positives get fixed; benign patterns
+(monotonic flags read for shutdown hints, GIL-atomic snapshots for
+stats) annotate the site inline with a rationale or live in the
+baseline — the point is every NEW half-guarded attribute gets a
+machine review.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set
+
+from ceph_tpu.analysis.framework import (
+    Check, SourceFile, Violation, call_name,
+)
+from ceph_tpu.analysis.threadmodel import ThreadModel
+
+_LOCKISH = re.compile(r"(^|_)(lock|rlock|lk|lck|mutex|guard|cond|cv)$",
+                      re.IGNORECASE)
+_LOCK_CTORS = {"make_lock", "Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_MUTATORS = {"append", "appendleft", "add", "pop", "popleft", "update",
+             "discard", "remove", "clear", "extend", "setdefault",
+             "insert", "rotate"}
+
+
+class _Access(NamedTuple):
+    meth: str           # local method name
+    qual: str           # mod:Class.meth for role lookup
+    line: int
+    write: bool
+    held: frozenset     # lock attr names held at the access
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _wait_for_lock(call: ast.Call, locks: Set[str]) -> Optional[str]:
+    """``self.X.wait_for(pred)`` with X a lock attr: the predicate
+    runs with X held (threading.Condition contract)."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "wait_for":
+        owner = _self_attr(f.value)
+        if owner is not None and owner in locks:
+            return owner
+    return None
+
+
+class UnguardedSharedState(Check):
+    name = "unguarded-shared-state"
+    description = ("instance attributes written under a lock on one "
+                   "path but accessed lock-free from a different "
+                   "thread role on another")
+    scopes = ("ceph_tpu",)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Violation]:
+        tm = ThreadModel.of(files)
+        out: List[Violation] = []
+        for mod in tm.program.mods.values():
+            for cname in sorted(mod.classes):
+                out.extend(self._check_class(tm, mod, cname))
+        return out
+
+    # -- per-class analysis ------------------------------------------------
+    def _check_class(self, tm: ThreadModel, mod, cname: str
+                     ) -> List[Violation]:
+        methods = [fn for fn in tm.program.index.values()
+                   if fn.mod is mod and fn.cls == cname]
+        if not methods:
+            return []
+        locks = self._lock_attrs(methods)
+        caller_held = self._caller_held(methods, locks)
+        accesses: Dict[str, List[_Access]] = {}
+        for fn in methods:
+            if fn.local.endswith("__init__"):
+                continue
+            extra = caller_held.get(fn.local, frozenset())
+            writes = self._write_nodes(fn.node)
+            for attr, line, held in self._held_accesses(fn.node, locks):
+                if attr in locks:
+                    continue
+                accesses.setdefault(attr, []).append(_Access(
+                    meth=fn.local, qual=fn.qual, line=line,
+                    write=(line, attr) in writes, held=held | extra))
+        out: List[Violation] = []
+        for attr in sorted(accesses):
+            out.extend(self._judge(tm, mod, cname, attr, accesses[attr]))
+        return out
+
+    def _judge(self, tm: ThreadModel, mod, cname: str, attr: str,
+               accs: List[_Access]) -> List[Violation]:
+        guarded_writes = [a for a in accs if a.write and a.held]
+        if not guarded_writes:
+            return []
+        guard_locks: Set[str] = set()
+        writer_roles: Set[str] = set()
+        for a in guarded_writes:
+            guard_locks |= a.held
+            writer_roles |= tm.roles_of(a.qual)
+        out: List[Violation] = []
+        seen: Set = set()
+        for a in accs:
+            if a.held & guard_locks:
+                continue
+            aroles = tm.roles_of(a.qual)
+            if aroles == writer_roles:
+                continue  # same lane end to end: sequential
+            if (a.meth, a.line) in seen:
+                continue
+            seen.add((a.meth, a.line))
+            w = guarded_writes[0]
+            kind = "written" if a.write else "read"
+            out.append(Violation(
+                check=self.name, path=mod.file.rel, line=a.line,
+                scope=cname, detail=attr,
+                message=(
+                    f"self.{attr} is written under "
+                    f"{'/'.join(sorted(guard_locks))} in {w.meth} "
+                    f"(lanes: {','.join(sorted(writer_roles))}) but "
+                    f"{kind} lock-free in {a.meth} (lanes: "
+                    f"{','.join(sorted(aroles))}) at line {a.line} — "
+                    "take the guard lock, or annotate why the "
+                    "unguarded access is safe"),
+            ))
+        return out
+
+    # -- caller-held inference ---------------------------------------------
+    def _caller_held(self, methods, locks: Set[str]
+                     ) -> Dict[str, frozenset]:
+        """The ``_locked``-suffix convention, inferred: locks held at
+        EVERY in-class ``self._m(...)`` call site accrue to the private
+        method ``_m``.  Fixpoint over call chains (a private helper
+        called only from other lock-holding private helpers inherits
+        through them).  Public methods always resolve to the empty set
+        — callers outside the class owe nothing."""
+        names = {fn.local.rsplit(".", 1)[-1] for fn in methods}
+        private = {n for n in names
+                   if n.startswith("_") and not n.startswith("__")}
+        # method -> [(caller short name, lexical held at call site)]
+        sites: Dict[str, List] = {}
+        for fn in methods:
+            short = fn.local.rsplit(".", 1)[-1]
+            for callee, held in self._self_call_sites(fn.node, locks):
+                if callee in private:
+                    sites.setdefault(callee, []).append((short, held))
+        held_of: Dict[str, frozenset] = {
+            n: frozenset(locks) if n in sites else frozenset()
+            for n in private}
+
+        def resolve(name: str) -> frozenset:
+            return held_of.get(name, frozenset())
+
+        changed = True
+        while changed:
+            changed = False
+            for n, ss in sites.items():
+                eff = None
+                for caller, held in ss:
+                    h = held | resolve(caller)
+                    eff = h if eff is None else (eff & h)
+                eff = eff or frozenset()
+                if eff != held_of[n]:
+                    held_of[n] = eff
+                    changed = True
+        return {fn.local: held_of.get(fn.local.rsplit(".", 1)[-1],
+                                      frozenset())
+                for fn in methods}
+
+    def _self_call_sites(self, fn_node: ast.AST, locks: Set[str]):
+        """(callee short name, lexical held frozenset) for every
+        ``self._m(...)`` call in the method."""
+        out: List = []
+
+        def rec(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    rec(item.context_expr, held)
+                grabbed = {a for item in node.items
+                           for a in [_self_attr(item.context_expr)]
+                           if a and a in locks}
+                inner = held | frozenset(grabbed)
+                for b in node.body:
+                    rec(b, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # closures run later with NO inherited locks, but
+                # their own with-regions still guard their calls
+                for b in node.body:
+                    rec(b, frozenset())
+                return
+            if isinstance(node, ast.Lambda):
+                rec(node.body, frozenset())
+                return
+            if isinstance(node, ast.Call):
+                waiter = _wait_for_lock(node, locks)
+                if waiter is not None:
+                    # Condition.wait_for runs its predicate HOLDING
+                    # the condition's lock
+                    inner = held | frozenset({waiter})
+                    for a in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        rec(a.body if isinstance(a, ast.Lambda) else a,
+                            inner)
+                    return
+                attr = _self_attr(node.func)
+                if attr:
+                    out.append((attr, held))
+            for child in ast.iter_child_nodes(node):
+                rec(child, held)
+
+        for stmt in getattr(fn_node, "body", []):
+            rec(stmt, frozenset())
+        return out
+
+    # -- lock attribute discovery ------------------------------------------
+    def _lock_attrs(self, methods) -> Set[str]:
+        out: Set[str] = set()
+        for fn in methods:
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1:
+                    attr = _self_attr(node.targets[0])
+                    if attr is None:
+                        continue
+                    if isinstance(node.value, ast.Call) and \
+                            call_name(node.value).split(".")[-1] in \
+                            _LOCK_CTORS:
+                        out.add(attr)
+                    elif _LOCKISH.search(attr):
+                        out.add(attr)
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr and _LOCKISH.search(attr):
+                            out.add(attr)
+        return out
+
+    # -- write classification ----------------------------------------------
+    def _write_nodes(self, fn_node: ast.AST) -> Set:
+        """(line, attr) pairs that are WRITES (assign / augassign /
+        subscript store / mutator call)."""
+        out: Set = set()
+
+        def note(expr: ast.AST) -> None:
+            attr = _self_attr(expr)
+            if attr:
+                out.add((expr.lineno, attr))
+
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for el in ast.walk(t):
+                        if isinstance(el, ast.Subscript):
+                            note(el.value)
+                        else:
+                            note(el)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                note(node.target)
+                if isinstance(node.target, ast.Subscript):
+                    note(node.target.value)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                    note(f.value)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        note(t.value)
+                    else:
+                        note(t)
+        return out
+
+    # -- held-set tracking -------------------------------------------------
+    def _held_accesses(self, fn_node: ast.AST, locks: Set[str]):
+        """Yield (attr, line, held frozenset) for every ``self.X``
+        touch, with the lexical set of held lock attrs."""
+        out: List = []
+
+        def rec(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, ast.With):
+                grabbed = set()
+                for item in node.items:
+                    rec(item.context_expr, held)
+                    attr = _self_attr(item.context_expr)
+                    if attr and attr in locks:
+                        grabbed.add(attr)
+                inner = held | frozenset(grabbed)
+                for b in node.body:
+                    rec(b, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a closure executes later holding NONE of the locks
+                # lexically around its definition; its accesses still
+                # belong to this attribute's access inventory
+                for b in node.body:
+                    rec(b, frozenset())
+                return
+            if isinstance(node, ast.Lambda):
+                rec(node.body, frozenset())
+                return
+            if isinstance(node, ast.Call):
+                waiter = _wait_for_lock(node, locks)
+                if waiter is not None:
+                    # Condition.wait_for runs its predicate HOLDING
+                    # the condition's lock
+                    inner = held | frozenset({waiter})
+                    rec(node.func, held)
+                    for a in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        rec(a.body if isinstance(a, ast.Lambda) else a,
+                            inner)
+                    return
+            attr = _self_attr(node)
+            if attr is not None:
+                out.append((attr, node.lineno, held))
+            for child in ast.iter_child_nodes(node):
+                rec(child, held)
+
+        # start at the statements, not the FunctionDef itself (the
+        # nested-def bail-out would otherwise eat the whole method)
+        for stmt in getattr(fn_node, "body", []):
+            rec(stmt, frozenset())
+        return out

@@ -156,9 +156,17 @@ class Objecter(Dispatcher):
     def _calc_target(self, pool: int, oid: str):
         """object -> pg -> acting primary (reference Objecter.cc:2794
         _calc_target over OSDMap.cc:2149,2417)."""
-        assert self.osdmap is not None
-        pgid = self.osdmap.object_to_pg(pool, oid)
-        _up, _up_p, _acting, primary = self.osdmap.pg_to_up_acting(pgid)
+        # ONE reference read: the resend timer races handle_osdmap's
+        # swap, and dereferencing self.osdmap twice could compute the
+        # pgid from epoch N but the primary from epoch N+1.  OSDMap
+        # objects are immutable once published, so a single snapshot
+        # is coherent without the lock.
+        # cephlint: disable=unguarded-shared-state — single GIL-atomic
+        # reference read of an immutable-once-published map
+        omap = self.osdmap
+        assert omap is not None
+        pgid = omap.object_to_pg(pool, oid)
+        _up, _up_p, _acting, primary = omap.pg_to_up_acting(pgid)
         return pgid, primary
 
     def op_submit(self, pool: int, oid: str, ops: List[OSDOp],

@@ -14,13 +14,19 @@ the same way).
 
 from __future__ import annotations
 
+import json
+import os
 import threading
+import traceback
 from typing import Dict, List, Optional, Set
 
 _enabled = False
 _graph_lock = threading.Lock()
 # edges[a][b]: b was acquired while a was held (a precedes b)
 _edges: Dict[str, Set[str]] = {}
+# first-seen acquisition site per edge, captured on the cold path
+# only (once per distinct edge): "file:line in func" innermost-first
+_edge_sites: Dict[tuple, str] = {}
 _local = threading.local()
 
 
@@ -40,6 +46,7 @@ def enabled() -> bool:
 def reset() -> None:
     with _graph_lock:
         _edges.clear()
+        _edge_sites.clear()
 
 
 def _held() -> List[str]:
@@ -102,6 +109,17 @@ def _check_order(held: List[str], name: str) -> None:
                     f"{' -> '.join(cycle)} was recorded earlier"
                 )
             g.setdefault(h, set()).add(name)
+            _edge_sites[(h, name)] = _acquire_site()
+
+
+def _acquire_site() -> str:
+    """The innermost non-lockdep frame of the current acquisition —
+    cold path only (runs once per distinct edge)."""
+    here = os.path.abspath(__file__)
+    for fr in reversed(traceback.extract_stack()):
+        if os.path.abspath(fr.filename) != here:
+            return f"{fr.filename}:{fr.lineno} in {fr.name}"
+    return "<unknown>"
 
 
 def locked(name: str) -> None:
@@ -221,3 +239,40 @@ def make_lock(name: str):
     if _enabled:
         return DMutex(name)
     return threading.RLock()
+
+
+# -- graph export (PR 18: static/runtime cross-validation) ----------------
+
+def edge_graph() -> Dict[str, Dict[str, str]]:
+    """Snapshot of the runtime-observed order graph:
+    ``{held: {acquired: first_seen_site}}``.  Each edge carries the
+    acquisition site recorded the FIRST time that order was seen —
+    when the static model (analysis/checks/lock_cycle.py) is missing
+    an edge, the site names the unmodeled call path."""
+    with _graph_lock:
+        return {a: {b: _edge_sites.get((a, b), "<unknown>")
+                    for b in sorted(bs)}
+                for a, bs in sorted(_edges.items())}
+
+
+def dump(path: str) -> None:
+    """Write the observed graph as JSON (the CEPH_TPU_LOCKDEP_DUMP
+    hook and the vstart cross-check both consume this shape)."""
+    payload = {"enabled": _enabled, "edges": edge_graph()}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+_DUMP_ENV = "CEPH_TPU_LOCKDEP_DUMP"
+if os.environ.get(_DUMP_ENV):
+    import atexit
+
+    atexit.register(lambda: dump(os.environ[_DUMP_ENV]))
+
+# arm from the environment so a CLI/vstart run can record edges
+# without the test conftest (which arms explicitly and still wins):
+# CEPH_TPU_LOCKDEP=1 tools/ceph.py --vstart ... dumps a live graph
+if os.environ.get("CEPH_TPU_LOCKDEP") == "1":
+    enable(True)

@@ -184,7 +184,11 @@ class Monitor(Dispatcher):
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
         self.kv.open()
-        self._load()
+        # boot load holds the mon lock: the paxos counters it seeds
+        # are guarded state everywhere else, and the tick/election
+        # threads start a few lines down
+        with self.lock:
+            self._load()
         self.msgr.start()
         self._tick_thread = threading.Thread(
             target=self._tick_loop, daemon=True, name=f"mon{self.rank}-tick")
@@ -813,11 +817,16 @@ class Monitor(Dispatcher):
                     # still missing a map base: keep asking (see _learn)
                     self._send_catchup_req()
             if state == STATE_LEADER:
-                msg = mm.MMonPaxos(mm.MMonPaxos.LEASE, self.accepted_pn,
-                                   version=self.last_committed)
+                # snapshot pn/version/value under ONE lock hold: the
+                # old code read last_committed once for the header and
+                # again for the kv fetch, so a commit landing between
+                # the two sent a lease whose value belonged to a
+                # different version than its header claimed
                 with self.lock:
-                    data = self.kv.get("paxos_values",
-                                       str(self.last_committed))
+                    pn = self.accepted_pn
+                    ver = self.last_committed
+                    data = self.kv.get("paxos_values", str(ver))
+                msg = mm.MMonPaxos(mm.MMonPaxos.LEASE, pn, version=ver)
                 msg.value = data or b""
                 for r in self._peers():
                     self._send_mon(r, msg)
@@ -829,17 +838,20 @@ class Monitor(Dispatcher):
                 except Exception as e:
                     self._log(1, f"health tick failed: {e!r}")
             elif state == STATE_PEON:
-                if time.monotonic() - self._last_lease > 2 * lease:
+                with self.lock:
+                    expired = (time.monotonic() - self._last_lease
+                               > 2 * lease)
+                if expired:
                     self._log(1, f"mon.{self.rank}: leader lease expired")
                     self.start_election()
 
     def _osd_tick(self) -> None:
         """down -> out aging (reference tick_osds / down_out_interval)."""
-        if self.osdmap is None:
-            return
         interval = self.ctx.conf.get("mon_osd_down_out_interval")
         now = time.time()
         with self.lock:
+            if self.osdmap is None:
+                return
             stale = [osd for osd, stamp in self.down_stamp.items()
                      if (not self.osdmap.is_up(osd)
                          and self.osdmap.osd_weight[osd] != 0
@@ -884,7 +896,8 @@ class Monitor(Dispatcher):
 
     def _propose_map(self, newmap: OSDMap) -> None:
         # legacy single-shot path (commands built on _mutate_map now)
-        newmap.epoch = (self.osdmap.epoch if self.osdmap else 0) + 1
+        with self.lock:
+            newmap.epoch = (self.osdmap.epoch if self.osdmap else 0) + 1
         self.propose(map_inc.encode_full_value(newmap))
 
     def _handle_boot(self, msg: mm.MOSDBoot) -> None:

@@ -159,8 +159,14 @@ def encode_planes(matrix: np.ndarray, words3, seed=None, *,
         interpret = jax.default_backend() != "tpu"
     if seed is None:
         seed = jnp.zeros((1,), jnp.uint32)
+    # cephlint: disable=no-d2h-on-hot-path — `matrix` is the k x m
+    # COEFFICIENT matrix (metadata-scale, host numpy by construction
+    # two lines up); tobytes() keys the jit cache, no device buffer
+    # is touched
     fn = _compiled(matrix.tobytes(), matrix.shape, tile, interpret,
                    mul_shift, donate, dimsem)
+    # sanctioned h2d upload of the pre-packed words, not a payload
+    # fetch back to host  # cephlint: disable=no-d2h-on-hot-path
     return fn(jnp.asarray(words3, dtype=jnp.uint32), seed)
 
 

@@ -1480,9 +1480,10 @@ class OSDService(Dispatcher):
                 # them keeps a backfill consumer from treating our
                 # incomplete store listing as the authoritative object
                 # set and deleting live objects (EC thrash-hunt find)
-                # cephlint: disable=no-blocking-on-loop — MScrub
-                # is not fast-dispatched (see ms_can_fast_dispatch):
-                # this branch always runs on the thread pool
+                # cephlint: disable=no-blocking-on-loop,lane-capability
+                # — MScrub is not fast-dispatched (see
+                # ms_can_fast_dispatch): this branch always runs on
+                # the thread pool, never the messenger loop
                 with pg.lock:
                     for oid in pg.missing:
                         if oid not in digests and oid not in unreadable:

@@ -311,6 +311,10 @@ class PG:
 
     # -- identity ---------------------------------------------------------
     def is_primary(self) -> bool:
+        # cephlint: disable=unguarded-shared-state — advisory
+        # GIL-atomic snapshot: callers on the dispatch path use this
+        # as a fast pre-check; a stale answer is re-judged under
+        # pg.lock by peering/requeue before any state changes
         return self.primary == self.osd.whoami
 
     def is_ec(self) -> bool:
@@ -341,13 +345,21 @@ class PG:
 
     # -- lifecycle --------------------------------------------------------
     def create_onstore(self) -> None:
-        if not self.osd.store.collection_exists(self.coll):
-            t = Transaction()
-            t.create_collection(self.coll)
-            self.osd.store.queue_transaction(t)
-        self._persist_meta()
+        with self.lock:
+            if not self.osd.store.collection_exists(self.coll):
+                t = Transaction()
+                t.create_collection(self.coll)
+                self.osd.store.queue_transaction(t)
+            self._persist_meta()
 
     def load_from_store(self) -> None:
+        # boot load holds the pg lock: info/log/scrub stamps are
+        # lock-guarded state everywhere else, and a heartbeat-driven
+        # peering round can reach this PG before load completes
+        with self.lock:
+            self._load_from_store_locked()
+
+    def _load_from_store_locked(self) -> None:
         g = GHObject("_pgmeta_")
         if self.osd.store.exists(self.coll, g):
             try:
@@ -628,6 +640,9 @@ class PG:
         copy is read-your-writes even with commits still in flight."""
         # the copy happens INSIDE the lru lock; `done` runs without it
         # (it may execute ops and send replies — never under a mutex)
+        # cephlint: disable=unguarded-shared-state — ObcCache is
+        # internally locked; the generation tag below rejects stale
+        # reinsertions, so no pg.lock is needed around cache traffic
         cached = self._obc.get(oid, copy=lambda s: ObjectState(
             s.data, dict(s.xattrs), dict(s.omap)))
         if cached is not None:
@@ -635,6 +650,7 @@ class PG:
             return
         # generation tag: an EC read completing on a network/timer
         # thread AFTER an invalidation must not reinsert stale state
+        # cephlint: disable=unguarded-shared-state — see above
         gen = self._obc.generation()
 
         def fill(state: Optional[ObjectState]) -> None:
@@ -649,6 +665,10 @@ class PG:
             self._ec_read_object(oid, fill)
         else:
             try:
+                # cephlint: disable=unguarded-shared-state — acting is
+                # swapped wholesale under pg.lock; this single
+                # reference read targets a coherent (possibly stale)
+                # set, and a stale read times out into client retry
                 self.backend.read_object(oid, self.acting, fill)
             except ChecksumError:
                 # the primary's own replica failed read verification:
@@ -669,10 +689,13 @@ class PG:
                                        dict(state.omap)), gen=gen)
 
     def _obc_invalidate(self, oid: Optional[str] = None) -> None:
+        # ObcCache is internally locked and clear/pop bump its
+        # generation, so racing fills from other lanes are rejected
+        # on reinsert — no pg.lock needed around cache traffic
         if oid is None:
-            self._obc.clear()
+            self._obc.clear()  # cephlint: disable=unguarded-shared-state
         else:
-            self._obc.pop(oid)
+            self._obc.pop(oid)  # cephlint: disable=unguarded-shared-state
 
     # -- hit-set tracking --------------------------------------------------
     def record_hit(self, oid: str) -> None:
@@ -757,6 +780,9 @@ class PG:
         degrade to the survivors instead of waiting out its read
         timeout per object (the daemon calls this alongside failing
         RPC waiters)."""
+        # cephlint: disable=unguarded-shared-state — GIL-atomic
+        # reference snapshot, None-checked; an engine created after
+        # the snapshot starts from the new map and needs no nudge
         eng = self._recovery
         if eng is not None:
             eng.peer_down(dead)
@@ -1774,6 +1800,9 @@ class PG:
                 for s in range(n)
                 if s not in extents
                 and acting[s] not in (self.osd.whoami, CRUSH_ITEM_NONE)
+                # cephlint: disable=unguarded-shared-state — advisory
+                # membership probe: a racing activate() only shrinks
+                # the set, and a wasted sub-read times out into retry
                 and acting[s] >= 0 and acting[s] not in self.stale_peers
                 and (omap_ is None or omap_.is_up(acting[s]))  # down:
             ]   # can never answer — don't burn the read window on it
@@ -2128,6 +2157,9 @@ class PG:
                 and len(slots) == n
                 and all(o >= 0 and o != CRUSH_ITEM_NONE for o in slots)
                 and all(o in acked for o in set(slots))
+                # cephlint: disable=unguarded-shared-state — see the
+                # docstring: read without the pg lock, a racing
+                # interval change only widens toward the gated side
                 and self.state == STATE_ACTIVE)
         if full:
             with self._ct_lock:

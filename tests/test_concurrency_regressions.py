@@ -1,0 +1,180 @@
+"""Targeted regressions for the cross-thread races the PR-18
+``unguarded-shared-state`` check surfaced and this round fixed.
+
+Each test pins the FIXED behavior, not the bug: the structural
+pattern (torn multi-read of shared state, lock-free boot-time
+mutation) is also permanently gated by the static check itself in
+tests/test_lint.py, so these are the behavioral half of the contract.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+from ceph_tpu.client.objecter import Objecter
+from ceph_tpu.mon.monitor import STATE_LEADER, Monitor
+
+
+# -- Objecter._calc_target: torn osdmap double-read --------------------------
+
+class _TaggedMap:
+    """An osdmap stub that DETECTS tearing: pg_to_up_acting refuses a
+    pgid computed by a different epoch's map."""
+
+    def __init__(self, tag: str, primary: int) -> None:
+        self.tag = tag
+        self.primary = primary
+
+    def object_to_pg(self, pool, oid):
+        return (self.tag, pool, oid)
+
+    def pg_to_up_acting(self, pgid):
+        assert pgid[0] == self.tag, (
+            f"torn read: pgid from map {pgid[0]!r} resolved against "
+            f"map {self.tag!r} — _calc_target must snapshot self.osdmap "
+            "ONCE (pgid from epoch N, primary from epoch N+1 is the bug)")
+        return ([self.primary], self.primary, [self.primary], self.primary)
+
+
+def test_calc_target_uses_one_map_snapshot():
+    obj = object.__new__(Objecter)
+    m1, m2 = _TaggedMap("e1", 1), _TaggedMap("e2", 2)
+    obj.osdmap = m1
+    stop = threading.Event()
+
+    def flip():
+        while not stop.is_set():
+            obj.osdmap = m2
+            obj.osdmap = m1
+
+    th = threading.Thread(target=flip, daemon=True)
+    th.start()
+    try:
+        for _ in range(5000):
+            pgid, primary = obj._calc_target(3, "oid")
+            # the pair must be coherent with a SINGLE map
+            assert (pgid[0], primary) in (("e1", 1), ("e2", 2))
+    finally:
+        stop.set()
+        th.join()
+
+
+# -- Monitor lease: pn/version/value snapshot --------------------------------
+
+class _Conf:
+    def __init__(self, vals):
+        self._v = vals
+
+    def get(self, key):
+        return self._v[key]
+
+
+class _KV:
+    """paxos_values table keyed by stringified version."""
+
+    def __init__(self):
+        self.vals = {"0": b"v0"}
+
+    def get(self, table, key):
+        assert table == "paxos_values"
+        return self.vals.get(key)
+
+
+def _lease_mon(captured):
+    mon = object.__new__(Monitor)
+    mon.ctx = SimpleNamespace(conf=_Conf({
+        "mon_tick_interval": 0.0005,
+        "mon_lease": 1.0,
+        "mon_osd_down_out_interval": 600.0,
+    }))
+    mon._stop = threading.Event()
+    mon.lock = threading.RLock()
+    mon.state = STATE_LEADER
+    mon._catchup_want = 0
+    mon.rank = 0
+    mon.accepted_pn = 1
+    mon.last_committed = 0
+    mon.kv = _KV()
+    mon.osdmap = None  # _osd_tick returns early (under the lock)
+    mon.services = {"health": SimpleNamespace(tick=lambda: None)}
+    mon._peers = lambda: [1]
+    mon._send_mon = lambda rank, msg: captured.append(
+        (msg.version, bytes(msg.value)))
+    mon._log = lambda *a, **kw: None
+    return mon
+
+
+def test_leader_lease_is_coherent_under_concurrent_commits():
+    """The lease message's (version, value) pair must come from ONE
+    lock hold: the old code read last_committed for the header and
+    again for the kv fetch, so a commit landing between the two sent
+    a lease whose value belonged to a different version than its
+    header claimed."""
+    captured = []
+    mon = _lease_mon(captured)
+    ticker = threading.Thread(target=mon._tick_loop, daemon=True)
+    ticker.start()
+
+    stop = threading.Event()
+
+    def commit_loop():
+        while not stop.is_set():
+            with mon.lock:
+                ver = mon.last_committed + 1
+                mon.kv.vals[str(ver)] = f"v{ver}".encode()
+                mon.last_committed = ver
+
+    bumper = threading.Thread(target=commit_loop, daemon=True)
+    bumper.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while len(captured) < 50 and time.monotonic() < deadline:
+            time.sleep(0.005)
+    finally:
+        stop.set()
+        mon._stop.set()
+        bumper.join()
+        ticker.join(timeout=5)
+    assert len(captured) >= 50, "leader never ticked enough leases"
+    for ver, value in captured:
+        assert value == f"v{ver}".encode(), (
+            f"torn lease: header says version {ver} but payload is "
+            f"{value!r} — snapshot pn/version/value under one hold")
+
+
+# -- PG boot-time loads hold the pg lock -------------------------------------
+
+def _probe_store(real, pg, calls):
+    class Probe:
+        def __getattr__(self, name):
+            attr = getattr(real, name)
+            if not callable(attr):
+                return attr
+
+            def wrapped(*a, **kw):
+                calls.append((name, pg.lock._is_owned()))
+                return attr(*a, **kw)
+            return wrapped
+    return Probe()
+
+
+def test_pg_boot_loads_hold_the_pg_lock():
+    """load_from_store()/create_onstore() mutate info/log/acting that
+    every other lane reads under pg.lock — boot is concurrent with
+    the messenger (a peer's query can land mid-load), so the loads
+    must hold the lock too."""
+    import sys as _sys
+    import os as _os
+    _sys.path.insert(0, _os.path.dirname(__file__))
+    from test_recovery_pipeline import _stub_pg
+
+    pg, osd = _stub_pg("plugin=isa k=2 m=1 technique=reed_sol_van",
+                       acting=[0, 1, 2])
+    calls = []
+    osd.store = _probe_store(osd.store, pg, calls)
+    pg.create_onstore()
+    pg.load_from_store()
+    assert calls, "probe saw no store traffic during boot load"
+    unlocked = [name for name, owned in calls if not owned]
+    assert not unlocked, (
+        f"store accessed WITHOUT pg.lock during boot load: {unlocked}")

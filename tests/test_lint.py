@@ -679,3 +679,221 @@ def test_shape_bucket_clean_on_repo_tree():
     files = [f for f in discover_files(subdirs=("ceph_tpu",))]
     vs = run_checks(files, [_BY_NAME["shape-bucket-discipline"]])
     assert not vs, [v.message for v in vs]
+
+
+# -- lane-capability (PR 18) --------------------------------------------
+
+
+def test_lane_capability_flags_pg_lock_from_fast_dispatch(tmp_path):
+    code = (
+        "class Svc:\n"
+        "    def ms_can_fast_dispatch(self, m):\n"
+        "        return True\n"
+        "    def ms_dispatch(self, m, pg):\n"
+        "        self._apply(pg)\n"
+        "    def _apply(self, pg):\n"
+        "        with pg.lock:\n"
+        "            pass\n")
+    bad = _lint(tmp_path, code, "lane-capability")
+    assert len(bad) == 1
+    v = bad[0]
+    assert v.line == 7 and v.detail.startswith("loop:may-take-pg-lock")
+    # the message names the propagation chain, not just the site
+    assert "ms_dispatch" in v.message
+    # a try-acquire cannot deadlock the lane: exempt
+    ok = _lint(tmp_path, code.replace(
+        "with pg.lock:\n            pass",
+        "pg.lock.acquire(blocking=False)"), "lane-capability")
+    assert not ok
+
+
+def test_lane_capability_flags_compile_on_loop(tmp_path):
+    bad = _lint(tmp_path, (
+        "import jax\n"
+        "async def handle(fn):\n"
+        "    return jax.jit(fn)\n"), "lane-capability")
+    assert [v.detail for v in bad] == ["loop:may-compile:jax.jit()"]
+    # the same compile from a plain thread target is fine
+    ok = _lint(tmp_path, (
+        "import jax\n"
+        "import threading\n"
+        "def warm(fn):\n"
+        "    return jax.jit(fn)\n"
+        "def boot(fn):\n"
+        "    threading.Thread(target=warm).start()\n"), "lane-capability")
+    assert not ok
+
+
+def test_lane_capability_never_baseline():
+    from ceph_tpu.analysis.framework import (Violation,
+                                             violations_to_baseline)
+
+    v = Violation(check="lane-capability", path="ceph_tpu/osd/osd.py",
+                  line=1, scope="Svc._apply",
+                  detail="loop:may-take-pg-lock:with pg.lock",
+                  message="m")
+    assert v.key not in violations_to_baseline([v])["entries"]
+
+
+# -- lock-order-cycle (PR 18) -------------------------------------------
+
+
+_CYCLE_MODULE = (
+    "from ceph_tpu.core.lockdep import make_lock\n"
+    "class S:\n"
+    "    def __init__(self):\n"
+    "        self.a = make_lock('A')\n"
+    "        self.b = make_lock('B')\n"
+    "        self.c = make_lock('C')\n"
+    "    def ab(self):\n"
+    "        with self.a:\n"
+    "            with self.b:\n"
+    "                pass\n"
+    "    def bc(self):\n"
+    "        with self.b:\n"
+    "            with self.c:\n"
+    "                pass\n"
+    "    def ca(self):\n"
+    "        with self.c:\n"
+    "            with self.a:\n"
+    "                pass\n")
+
+
+def test_lock_cycle_flags_three_lock_cycle(tmp_path):
+    bad = _lint(tmp_path, _CYCLE_MODULE, "lock-order-cycle")
+    assert len(bad) == 1
+    assert bad[0].detail.startswith("cycle:")
+    for name in ("A", "B", "C"):
+        assert name in bad[0].detail
+    # breaking one edge (ca takes them in the global order) is clean
+    ok = _lint(tmp_path, _CYCLE_MODULE.replace(
+        "        with self.c:\n            with self.a:",
+        "        with self.a:\n            with self.c:"),
+        "lock-order-cycle")
+    assert not ok
+
+
+def test_lock_cycle_never_baseline():
+    from ceph_tpu.analysis.framework import (Violation,
+                                             violations_to_baseline)
+
+    v = Violation(check="lock-order-cycle", path="ceph_tpu/osd/pg.py",
+                  line=0, scope="<lock-graph>", detail="cycle:A->B->A",
+                  message="m")
+    assert v.key not in violations_to_baseline([v])["entries"]
+
+
+def test_lock_graph_dump_round_trip(tmp_path):
+    import json
+
+    from ceph_tpu.analysis.checks.lock_cycle import LockModel
+
+    p = tmp_path / "mod.py"
+    p.write_text(_CYCLE_MODULE)
+    model = LockModel.of([SourceFile(str(p), "ceph_tpu/mod.py")])
+    data = json.loads(json.dumps(model.to_json()))
+    assert data["edges"]["A"].keys() == {"B"}
+    assert data["cycles"] and sorted(data["cycles"][0][:-1]) == \
+        ["A", "B", "C"]
+    dot = model.to_dot()
+    assert '"A" -> "B"' in dot
+    # cycle edges are highlighted for the graphviz eye
+    assert "[color=red]" in dot
+
+
+# -- unguarded-shared-state (PR 18) -------------------------------------
+
+
+def test_shared_state_flags_cross_role_unguarded_read(tmp_path):
+    code = (
+        "import threading\n"
+        "from ceph_tpu.core.lockdep import make_lock\n"
+        "class Stats:\n"
+        "    def __init__(self):\n"
+        "        self._lock = make_lock('stats')\n"
+        "        self._count = 0\n"
+        "        threading.Thread(target=self._tick_loop).start()\n"
+        "    def _tick_loop(self):\n"
+        "        with self._lock:\n"
+        "            self._count += 1\n"
+        "    async def handle(self):\n"
+        "        return self._count\n")
+    bad = _lint(tmp_path, code, "unguarded-shared-state")
+    assert [(v.scope, v.detail) for v in bad] == [("Stats", "_count")]
+    assert "handle" in bad[0].message and "_tick_loop" in bad[0].message
+    # the guarded read variant is clean
+    ok = _lint(tmp_path, code.replace(
+        "        return self._count",
+        "        with self._lock:\n"
+        "            return self._count"), "unguarded-shared-state")
+    assert not ok
+
+
+def test_shared_state_same_lane_is_sequential(tmp_path):
+    # writer and reader on the SAME lane: no race, no violation
+    ok = _lint(tmp_path, (
+        "from ceph_tpu.core.lockdep import make_lock\n"
+        "class Seq:\n"
+        "    def __init__(self):\n"
+        "        self._lock = make_lock('seq')\n"
+        "        self._n = 0\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+        "    def peek(self):\n"
+        "        return self._n\n"), "unguarded-shared-state")
+    assert not ok
+
+
+# -- CLI: --changed / --write-baseline / --lock-graph (PR 18) -----------
+
+
+def test_cli_changed_scopes_reporting():
+    import contextlib
+    import io
+    import json
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cephlint.main(["--json", "--changed",
+                            "--checks", "no-sleep-poll"])
+    out = json.loads(buf.getvalue())
+    assert rc == 0
+    assert out["changed_vs"] == "HEAD"
+    assert out["new"] == []
+
+
+def test_cli_write_baseline_prunes_stale_keys(tmp_path):
+    import contextlib
+    import io
+    import json
+
+    stale = "no-sleep-poll::ceph_tpu/gone.py::nobody::deleted"
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(
+        {"comment": "test", "entries": {stale: 3}}))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cephlint.main(["--write-baseline", "--baseline", str(bl),
+                            "--checks", "no-sleep-poll"])
+    out = buf.getvalue()
+    assert rc == 0
+    assert f"- {stale}" in out, out
+    rewritten = json.loads(bl.read_text())["entries"]
+    assert stale not in rewritten
+
+
+def test_cli_lock_graph_json():
+    import contextlib
+    import io
+    import json
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cephlint.main(["--lock-graph", "json"])
+    out = json.loads(buf.getvalue())
+    assert rc == 0
+    assert out["cycles"] == [], out["cycles"]
+    # the real tree's graph is non-trivial: the PG lock orders ahead
+    # of per-subsystem locks
+    assert out["edges"], "static graph is empty"

@@ -119,3 +119,87 @@ def test_cluster_runs_clean_under_lockdep():
     finally:
         cl.shutdown()
         c.shutdown()
+
+
+# -- graph export + static/runtime cross-validation (PR 18) ------------------
+
+def test_edge_graph_records_first_seen_sites(tmp_path):
+    a, b = DMutex("A"), DMutex("B")
+    with a:
+        with b:
+            pass
+    g = lockdep.edge_graph()
+    assert list(g) == ["A"] and list(g["A"]) == ["B"]
+    # the first-seen site names THIS file (the unmodeled-call-path hint)
+    assert "test_lockdep.py" in g["A"]["B"]
+
+    out = tmp_path / "edges.json"
+    lockdep.dump(str(out))
+    import json
+
+    payload = json.loads(out.read_text())
+    assert payload["enabled"] is True
+    assert list(payload["edges"]["A"]) == ["B"]
+
+    lockdep.reset()
+    assert lockdep.edge_graph() == {}
+
+
+def test_runtime_edges_subset_of_static_graph():
+    """Cross-validate the two lockdeps: every lock-order edge OBSERVED
+    at runtime during a representative cluster workload must exist in
+    the STATIC acquisition graph (analysis/checks/lock_cycle.py).  The
+    static graph deliberately over-approximates — runtime ⊆ static is
+    the contract that makes its cycle check trustworthy.  A miss names
+    the first-seen acquisition site: that is the call path the static
+    resolver failed to model."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_osd_cluster import MiniCluster, LibClient, REP_POOL, EC_POOL
+
+    c = MiniCluster()
+    cl = LibClient(c)
+    try:
+        cl.put(REP_POOL, "xv1", b"a" * 2000)
+        assert cl.get(REP_POOL, "xv1") == b"a" * 2000
+        cl.put(EC_POOL, "xv2", b"b" * 4096)
+        assert cl.get(EC_POOL, "xv2") == b"b" * 4096
+        _, acting, primary = c.primary_of(REP_POOL, "xv1")
+        victim = next(o for o in acting if o != primary)
+        c.kill(victim)
+        cl.put(REP_POOL, "xv1", b"c" * 100)
+        c.revive(victim)
+        assert cl.get(REP_POOL, "xv1") == b"c" * 100
+    finally:
+        cl.shutdown()
+        c.shutdown()
+
+    runtime = lockdep.edge_graph()
+    assert runtime, "workload took no nested locks — probe is dead"
+
+    from ceph_tpu.analysis.checks.lock_cycle import LockModel
+    from ceph_tpu.analysis.framework import discover_files
+
+    model = LockModel.of([f for f in discover_files()
+                          if f.rel.startswith("ceph_tpu/")])
+    problems = []
+    for held, acquired in runtime.items():
+        ca = model.classify(held)
+        if ca is None:
+            problems.append(f"runtime lock {held!r} matches no static "
+                            "make_lock class")
+            continue
+        for nxt, site in acquired.items():
+            cb = model.classify(nxt)
+            if cb is None:
+                problems.append(f"runtime lock {nxt!r} matches no static "
+                                f"make_lock class (acquired at {site})")
+            elif ca != cb and cb not in model.edges.get(ca, {}):
+                problems.append(
+                    f"unmodeled call path: runtime edge {held} -> {nxt} "
+                    f"(class {ca} -> {cb}) first acquired at {site}")
+    assert not problems, (
+        "runtime lock-order edges missing from the static graph — the "
+        "static resolver does not model these call paths:\n  "
+        + "\n  ".join(problems))

@@ -2,13 +2,23 @@
 """cephlint — run the repo-native AST analysis suite.
 
     python tools/cephlint.py                 # human output, baseline applied
-    python tools/cephlint.py --json          # machine output
+    python tools/cephlint.py --format=json   # machine output (stable schema)
     python tools/cephlint.py --no-baseline   # full debt view
     python tools/cephlint.py --checks named-locks,no-sleep-poll
+    python tools/cephlint.py --changed       # report only files changed vs HEAD
+    python tools/cephlint.py --changed=main  # ... vs a ref
+    python tools/cephlint.py --lock-graph=dot   # static lock-order graph (DOT)
+    python tools/cephlint.py --lock-graph=json  # ... as JSON
     python tools/cephlint.py --write-baseline  # accept current state as debt
 
 Exit status: 0 = no violations beyond the committed baseline
 (tools/cephlint_baseline.json), 1 = new violations, 2 = usage error.
+
+``--changed`` narrows REPORTING, not analysis: the whole program is
+still parsed and analyzed (the checks are cross-module — a changed
+caller can introduce a violation whose site is an unchanged callee,
+and those still count when the SITE file changed), then only
+violations in changed files are shown and gate the exit status.
 
 Intentional one-off exceptions annotate the offending line with
 ``# cephlint: disable=<check-name>`` and a reason; the baseline is for
@@ -21,6 +31,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -40,24 +51,49 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "cephlint_baseline.json")
 
 
+def changed_paths(ref: str) -> set:
+    """Repo-relative paths changed vs ``ref``: committed diffs,
+    staged/unstaged edits, and untracked files."""
+    root = repo_root()
+    out = set()
+    for cmd in (["git", "diff", "--name-only", ref],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        res = subprocess.run(cmd, cwd=root, capture_output=True,
+                             text=True, check=True)
+        out.update(ln.strip() for ln in res.stdout.splitlines()
+                   if ln.strip())
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="cephlint", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("paths", nargs="*", default=None,
                    help="top-level dirs to lint (default: ceph_tpu tools)")
+    p.add_argument("--format", choices=("text", "json"), default=None,
+                   dest="fmt", help="output format (default: text)")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit one JSON document instead of text")
+                   help="alias for --format=json")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="suppressions baseline file")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore the baseline: report all violations")
     p.add_argument("--write-baseline", action="store_true",
                    help="rewrite the baseline from the current state "
-                        "(intentionally accepting today's debt) and exit 0")
+                        "(intentionally accepting today's debt), report "
+                        "pruned stale keys, and exit 0")
     p.add_argument("--checks", default="",
                    help="comma-separated check names (default: all)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="report only violations in files changed vs REF "
+                        "(default HEAD); analysis stays whole-program")
+    p.add_argument("--lock-graph", choices=("dot", "json"), default=None,
+                   help="dump the static lock-order graph and exit")
     args = p.parse_args(argv)
+
+    fmt = args.fmt or ("json" if args.as_json else "text")
 
     if args.checks:
         try:
@@ -72,27 +108,65 @@ def main(argv=None) -> int:
 
     subdirs = tuple(args.paths) if args.paths else ("ceph_tpu", "tools")
     files = discover_files(subdirs=subdirs)
+
+    if args.lock_graph:
+        from ceph_tpu.analysis.checks.lock_cycle import LockModel
+        model = LockModel.of([f for f in files
+                              if f.rel.startswith("ceph_tpu/")])
+        if args.lock_graph == "dot":
+            print(model.to_dot())
+        else:
+            json.dump(model.to_json(), sys.stdout, indent=1)
+            print()
+        return 0
+
     violations = run_checks(files, checks)
 
     if args.write_baseline:
+        old = load_baseline(args.baseline)
+        payload = violations_to_baseline(violations)
         with open(args.baseline, "w", encoding="utf-8") as f:
-            json.dump(violations_to_baseline(violations), f, indent=1,
-                      sort_keys=False)
+            json.dump(payload, f, indent=1, sort_keys=False)
             f.write("\n")
-        print(f"cephlint: wrote {len(violations)} suppressions "
-              f"({len({v.key for v in violations})} keys) to "
+        entries = payload["entries"]
+        pruned = sorted(k for k in old if k not in entries)
+        added = sorted(k for k in entries if k not in old)
+        print(f"cephlint: wrote {sum(entries.values())} suppressions "
+              f"({len(entries)} keys) to "
               f"{os.path.relpath(args.baseline, repo_root())}")
+        if added:
+            print(f"cephlint: {len(added)} new debt key(s) accepted:")
+            for k in added:
+                print(f"  + {k}")
+        if pruned:
+            print(f"cephlint: {len(pruned)} stale key(s) pruned "
+                  "(debt paid down):")
+            for k in pruned:
+                print(f"  - {k}")
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     new = new_violations(violations, baseline)
 
-    if args.as_json:
+    scope_note = ""
+    if args.changed is not None:
+        try:
+            touched = changed_paths(args.changed)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            print(f"cephlint: --changed failed: {e}", file=sys.stderr)
+            return 2
+        new = [v for v in new if v.path in touched]
+        scope_note = (f" (changed vs {args.changed}: "
+                      f"{len(touched)} file(s))")
+
+    if fmt == "json":
         json.dump({
             "files_scanned": len(files),
             "checks": [c.name for c in checks],
+            "changed_vs": args.changed,
             "total_violations": len(violations),
-            "baselined": len(violations) - len(new),
+            "baselined": len(violations) - len(new_violations(
+                violations, baseline)),
             "new": [v.to_dict() for v in new],
         }, sys.stdout, indent=1)
         print()
@@ -100,7 +174,8 @@ def main(argv=None) -> int:
         for v in new:
             print(f"{v.path}:{v.line}: [{v.check}] {v.message}")
         print(f"cephlint: {len(files)} files, {len(violations)} violations "
-              f"({len(violations) - len(new)} baselined, {len(new)} new)")
+              f"({len(violations) - len(new_violations(violations, baseline))}"
+              f" baselined, {len(new)} new){scope_note}")
     return 1 if new else 0
 
 
