@@ -120,8 +120,11 @@ def _class_version(cls: ast.ClassDef) -> int:
 
 
 def _tolerates_old_versions(fn: ast.FunctionDef) -> bool:
-    """Decoder gates a tail: calls remaining_in_frame(), or compares a
-    variable assigned from .start()."""
+    """Decoder gates a tail: calls remaining_in_frame(), compares a
+    variable assigned from .start(), or compares a struct_v attribute
+    (Message.struct_v — the decode harness stores the SENDER's
+    d.start() result there, the sanctioned gate when a message carries
+    both a versioned tail and the bare trace tail)."""
     version_vars = set()
     for node in ast.walk(fn):
         if isinstance(node, ast.Call):
@@ -134,12 +137,13 @@ def _tolerates_old_versions(fn: ast.FunctionDef) -> bool:
             for t in node.targets:
                 if isinstance(t, ast.Name):
                     version_vars.add(t.id)
-    if not version_vars:
-        return False
     for node in ast.walk(fn):
         if isinstance(node, ast.Compare):
             for sub in ast.walk(node):
                 if isinstance(sub, ast.Name) and sub.id in version_vars:
+                    return True
+                if (isinstance(sub, ast.Attribute)
+                        and sub.attr == "struct_v"):
                     return True
     return False
 

@@ -38,9 +38,12 @@ _REG_TAILS = ("instrumented_jit", "instrumented_pallas_call")
 # files where every device dispatch must flow through covering()
 _PAD_REQUIRED = ("ceph_tpu/tpu/queue.py",)
 
-# the dispatch calls that hand a batch to a kernel family
+# the dispatch calls that hand a batch to a kernel family (PR 19 adds
+# the clay array-codec kernels: their coupled-layer matmuls run in the
+# gf256_clay family and are just as compile-sensitive to raw widths)
 _DISPATCH_TAILS = ("encode_array", "gf_matmul_bytes", "crc32c_rows",
-                   "encode_scatter", "recovery_gather")
+                   "encode_scatter", "recovery_gather",
+                   "repair_planes", "decode_planes")
 
 
 def _call_tail(node: ast.Call) -> str:

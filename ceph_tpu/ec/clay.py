@@ -85,6 +85,8 @@ class ClayCodec(ErasureCode):
             raise ErasureCodeError(
                 f"clay: only d = k+m-1 supported (got d={d}, k={k}, m={m})"
             )
+        if k < 2:
+            raise ErasureCodeError("k must be >= 2")
         if m < 2:
             raise ErasureCodeError("clay needs m >= 2")
         if self.gamma in (0, 1):
@@ -111,6 +113,9 @@ class ClayCodec(ErasureCode):
         self._uncouple_M = _gf_pair(inv_det, int(gf.mul(inv_det, g)))
         self._couple_M = _gf_pair(1, g)
         self._repair_M = _gf_pair(int(gf.mul(det, inv_g)), inv_g)
+        # recover stored C from own U + KNOWN partner C:
+        #   C1 = det*U1 + g*C2  (derived in the module docstring)
+        self._c_from_U_M = _gf_pair(det, g)
         self._pair_tables()
         self._solve_cache: Dict[Tuple[Tuple[int, ...], Tuple[int, ...]],
                                 np.ndarray] = {}
@@ -165,28 +170,32 @@ class ClayCodec(ErasureCode):
             [np.ascontiguousarray(a).ravel(),
              np.ascontiguousarray(b).ravel()]
         ).astype(np.uint8)
-        out = np.asarray(gf256_swar.gf_matmul_bytes(M, stacked))
+        out = np.asarray(gf256_swar.gf_matmul_bytes(
+            M, stacked, family="gf256_clay"))
         return out.reshape(np.shape(a))
-
-    def _gather_partner(self, planes: np.ndarray,
-                        nodes: np.ndarray) -> np.ndarray:
-        """planes[pnode[i,z], pz[i,z], :] for each node i in nodes."""
-        return planes[self.pnode[nodes], self.pz[nodes], :]
 
     def _uncouple_nodes(self, C: np.ndarray,
                         nodes: np.ndarray) -> np.ndarray:
         """U[i] = C[i] where dot else (C[i] + g*C[partner])/det."""
         own = C[nodes]
-        partner = self._gather_partner(C, nodes)
-        coupled = self._apply_pair(self._uncouple_M, own, partner)
-        return np.where(self.dot[nodes][..., None], own, coupled)
+        nd = ~self.dot[nodes]  # pair transform only off the diagonal
+        out = own.copy()
+        if nd.any():
+            out[nd] = self._apply_pair(
+                self._uncouple_M, own[nd],
+                C[self.pnode[nodes][nd], self.pz[nodes][nd]])
+        return out
 
     def _couple_nodes(self, U: np.ndarray, nodes: np.ndarray) -> np.ndarray:
         """C[i] = U[i] where dot else U[i] + g*U[partner]."""
         own = U[nodes]
-        partner = self._gather_partner(U, nodes)
-        coupled = self._apply_pair(self._couple_M, own, partner)
-        return np.where(self.dot[nodes][..., None], own, coupled)
+        nd = ~self.dot[nodes]
+        out = own.copy()
+        if nd.any():
+            out[nd] = self._apply_pair(
+                self._couple_M, own[nd],
+                U[self.pnode[nodes][nd], self.pz[nodes][nd]])
+        return out
 
     # -- encode ------------------------------------------------------------
     def encode_array(self, data: np.ndarray) -> np.ndarray:
@@ -206,7 +215,8 @@ class ClayCodec(ErasureCode):
         # per-layer MDS: U_parity = coding @ U_data, all layers at once
         U_flat = U_data.reshape(self.kk, Z * s)
         U_par = np.asarray(
-            gf256_swar.gf_matmul_bytes(self.coding, U_flat)
+            gf256_swar.gf_matmul_bytes(self.coding, U_flat,
+                                       family="gf256_clay")
         ).reshape(self._m, Z, s)
         # couple the parity column back to stored symbols
         U_all = np.concatenate([U_data, U_par])
@@ -262,9 +272,7 @@ class ClayCodec(ErasureCode):
         concatenated in layer order.
         """
         (l0,) = lost
-        l0n = self._node(l0)
-        x0, y0 = l0n % self.q, l0n // self.q
-        q, Z = self.q, self.sub_count
+        Z = self.sub_count
         layers = self.repair_layers(l0)
         L = len(layers)
         helpers = sorted(h for h in chunks.keys() if h != l0)
@@ -279,15 +287,46 @@ class ClayCodec(ErasureCode):
         size = sizes.pop()
         full = not layers_only
         s = size // Z if full else size // L
+        planes = np.empty((self.d, L, s), dtype=np.uint8)
+        for hi, h in enumerate(helpers):
+            arr = np.asarray(chunks[h], dtype=np.uint8).ravel()
+            planes[hi] = (
+                arr.reshape(Z, s)[layers] if full else arr.reshape(L, s)
+            )
+        out = self.repair_planes(l0, helpers, planes)
+        return {l0: out.reshape(-1)}
+
+    def repair_planes(self, lost: int, helpers: Sequence[int],
+                      planes: np.ndarray) -> np.ndarray:
+        """Batched single-erasure repair kernel: ``planes`` [d, L, S]
+        holds each helper's repair-layer sub-chunks (row order =
+        ``helpers``, layer order = ``repair_layers(lost)``); returns the
+        rebuilt chunk as [Z, S].
+
+        Every transform here is elementwise over the S axis — the
+        coupled-pair index j never mixes byte positions within a
+        sub-chunk — so the StripeBatchQueue concatenates many objects'
+        repairs along S and runs the whole batch as ONE set of device
+        matmuls (the repair twin of the write path's encode batching).
+        """
+        l0n = self._node(lost)
+        x0, y0 = l0n % self.q, l0n // self.q
+        q, Z = self.q, self.sub_count
+        layers = self.repair_layers(lost)
+        L = len(layers)
+        planes = np.asarray(planes, dtype=np.uint8)
+        if planes.ndim != 3 or planes.shape[:2] != (len(helpers), L):
+            raise ErasureCodeError(
+                f"clay repair_planes: bad planes {planes.shape} "
+                f"(want ({len(helpers)}, {L}, S))"
+            )
+        s = planes.shape[2]
         n_total = self.kk + self._m
         # read planes [n_total, L, s], indexed by INTERNAL node id;
         # virtual nodes stay zero (their reads are free)
         Cr = np.zeros((n_total, L, s), dtype=np.uint8)
-        for h in helpers:
-            arr = np.asarray(chunks[h], dtype=np.uint8).ravel()
-            Cr[self._node(h)] = (
-                arr.reshape(Z, s)[layers] if full else arr.reshape(L, s)
-            )
+        for hi, h in enumerate(helpers):
+            Cr[self._node(h)] = planes[hi]
         # map a global layer index to its position in `layers`
         lpos = np.full(Z, -1)
         lpos[layers] = np.arange(L)
@@ -298,10 +337,16 @@ class ClayCodec(ErasureCode):
         own = Cr[nodes_other]
         pn = self.pnode[nodes_other][:, layers]
         pzl = lpos[self.pz[nodes_other][:, layers]]
-        partner = Cr[pn, pzl]
-        coupled = self._apply_pair(self._uncouple_M, own, partner)
         dot = self.dot[nodes_other][:, layers]
-        U_known = np.where(dot[..., None], own, coupled)
+        # dot positions pass C through untouched — gather partners and
+        # run the pair transform ONLY where coupling happens (1/q of
+        # the grid is dot, so this trims the matmul width by ~25% for
+        # q=4 and skips the partner gather at those positions)
+        nd = ~dot
+        U_known = own.copy()
+        if nd.any():
+            U_known[nd] = self._apply_pair(
+                self._uncouple_M, own[nd], Cr[pn[nd], pzl[nd]])
 
         # 2. MDS-solve the q column-y0 U rows in every repair layer at
         #    once (q == m unknowns per layer, one cached matrix)
@@ -318,16 +363,23 @@ class ClayCodec(ErasureCode):
         # 3b. other layers: C(A) = (det*U(B) + C(B)) / g where B is the
         #     partner (surviving column-y0 node, repair layer)
         pw_y0 = q ** (self.t - 1 - y0)
+        # one _repair_M transform serves every partner column: batch
+        # the q-1 per-column slices into a single wide matmul instead
+        # of q-1 narrow dispatches
+        zs_cat, ub_cat, cb_cat = [], [], []
         for xb in range(q):
             if xb == x0:
                 continue
             zs_a = np.nonzero(self.digits[y0] == xb)[0]  # lost-node layers
             zb = lpos[zs_a + (x0 - xb) * pw_y0]
             assert (zb >= 0).all()
-            U_B = U_col[xb, zb]
-            C_B = Cr[y0 * q + xb, zb]
-            out[zs_a] = self._apply_pair(self._repair_M, U_B, C_B)
-        return {l0: out.reshape(-1)}
+            zs_cat.append(zs_a)
+            ub_cat.append(U_col[xb, zb])
+            cb_cat.append(Cr[y0 * q + xb, zb])
+        out[np.concatenate(zs_cat)] = self._apply_pair(
+            self._repair_M, np.concatenate(ub_cat),
+            np.concatenate(cb_cat))
+        return out
 
     def _solve_unknowns(self, unknown: List[int], known: List[int],
                         U_known: np.ndarray) -> np.ndarray:
@@ -344,7 +396,8 @@ class ClayCodec(ErasureCode):
             M = gf.matmul(rows, R)
             self._solve_cache[key] = M
         return np.asarray(
-            gf256_swar.gf_matmul_bytes(M, U_known[: self.kk])
+            gf256_swar.gf_matmul_bytes(M, U_known[: self.kk],
+                                       family="gf256_clay")
         )
 
     # -- general decode (multi-erasure, layered IS ordering) ---------------
@@ -388,46 +441,47 @@ class ClayCodec(ErasureCode):
             IS += self.dot[e].astype(np.int64)
         U = np.zeros_like(C)
         have_U = np.zeros((n_total, Z), dtype=bool)
-        g = self.gamma
+        ka = np.asarray(known_n)
         for level in range(int(IS.max()) + 1):
             zs = np.nonzero(IS == level)[0]
             if len(zs) == 0:
                 continue
-            for i in known_n:
-                for z in zs:
-                    if self.dot[i, z]:
-                        U[i, z] = C[i, z]
-                    else:
-                        j, z2 = int(self.pnode[i, z]), int(self.pz[i, z])
-                        if known_mask[j]:
-                            U[i, z] = _pair_scalar(
-                                self._uncouple_M, C[i, z], C[j, z2]
-                            )
-                        else:
-                            # partner erased: its U was solved at IS-1
-                            assert have_U[j, z2], "IS ordering violated"
-                            U[i, z] = C[i, z] ^ _gfc(g, U[j, z2])
-                    have_U[i, z] = True
-            U_known = U[np.asarray(known_n)][:, zs].reshape(len(known_n), -1)
+            # batched U of every known node at this level's layers —
+            # three cases masked together, each ONE wide pair matmul
+            # over the full (known x layers x s) volume:
+            #   dot:            U = C
+            #   partner known:  U = uncouple(C_own, C_partner)
+            #   partner erased: U = C_own + g*U_partner (its U solved
+            #                   at IS level-1; same [[1,g]] as couple)
+            own = C[ka][:, zs]
+            pn = self.pnode[ka][:, zs]
+            pzz = self.pz[ka][:, zs]
+            assert have_U[pn, pzz][~known_mask[pn]].all(), \
+                "IS ordering violated"
+            unc = self._apply_pair(self._uncouple_M, own, C[pn, pzz])
+            via_U = self._apply_pair(self._couple_M, own, U[pn, pzz])
+            dotm = self.dot[ka][:, zs][..., None]
+            pk = known_mask[pn][..., None]
+            U[ka[:, None], zs[None, :]] = np.where(
+                dotm, own, np.where(pk, unc, via_U))
+            have_U[ka[:, None], zs[None, :]] = True
+            U_known = U[ka][:, zs].reshape(len(known_n), -1)
             solved = self._solve_unknowns(erased_n, known_n, U_known)
             solved = solved.reshape(len(erased_n), len(zs), s)
             for ei, e in enumerate(erased_n):
                 U[e, zs] = solved[ei]
                 have_U[e, zs] = True
-        # recover the stored C of erased nodes
-        for e in erased_n:
-            for z in range(Z):
-                if self.dot[e, z]:
-                    C[e, z] = U[e, z]
-                else:
-                    j, z2 = int(self.pnode[e, z]), int(self.pz[e, z])
-                    if known_mask[j]:
-                        # C1 = det*U1 + g*C2 (derived in module docstring)
-                        C[e, z] = _gfc(self._det, U[e, z]) ^ _gfc(g, C[j, z2])
-                    else:
-                        C[e, z] = _pair_scalar(
-                            self._couple_M, U[e, z], U[j, z2]
-                        )
+        # recover the stored C of erased nodes — all layers at once
+        # (partner known: C1 = det*U1 + g*C2; partner erased: couple)
+        er = np.asarray(erased_n)
+        own_U = U[er]
+        pn = self.pnode[er]
+        pzz = self.pz[er]
+        from_C = self._apply_pair(self._c_from_U_M, own_U, C[pn, pzz])
+        from_U = self._apply_pair(self._couple_M, own_U, U[pn, pzz])
+        pk = known_mask[pn][..., None]
+        C[er] = np.where(self.dot[er][..., None], own_U,
+                         np.where(pk, from_C, from_U))
         out: Dict[int, np.ndarray] = {}
         for w in want:
             if w in avail:
@@ -436,6 +490,29 @@ class ClayCodec(ErasureCode):
                 i = w if w < self._k else w + self.nu
                 out[w] = C[i].reshape(-1)
         return out
+
+    def decode_planes(self, avail_ids: Sequence[int],
+                      planes: np.ndarray) -> np.ndarray:
+        """Batched data decode kernel for the StripeBatchQueue: ``planes``
+        [A, n] stacks the surviving chunks (row order = ``avail_ids``,
+        n a multiple of sub_count); returns the k data chunks [k, n].
+        Like repair_planes, every step is elementwise over the intra-
+        sub-chunk byte axis, so multi-object batches concatenated along
+        that axis decode in one pass."""
+        planes = np.asarray(planes, dtype=np.uint8)
+        available = {a: planes[i] for i, a in enumerate(avail_ids)}
+        out = self.decode_array(
+            available, list(range(self._k)), planes.shape[1])
+        return np.stack([np.asarray(out[i]) for i in range(self._k)])
+
+    def supports_partial_writes(self) -> bool:
+        """False: clay couples layers across the whole chunk.  A byte at
+        sub-chunk z of any data chunk feeds, via the pairwise coupling,
+        the uncoupled symbol at the PARTNER layer z(y->x) of another
+        node — so the only write sets closed under the coupling are
+        full chunks, and extent-local parity deltas cannot exist (the
+        reference likewise refuses ec_overwrites on clay pools)."""
+        return False
 
     # -- bench conveniences -------------------------------------------------
     def encode_bytes(self, data: bytes) -> Dict[int, np.ndarray]:

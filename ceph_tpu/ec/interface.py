@@ -82,6 +82,14 @@ class ErasureCode:
         # array codes (clay) override; reference: ErasureCodeInterface.h:259
         return 1
 
+    def supports_partial_writes(self) -> bool:
+        """Whether extent-local parity updates exist for this code — the
+        partial-stripe RMW precondition.  True for flat coefficient
+        codes (a parity byte depends only on the SAME byte offset of
+        each data chunk); array codes that couple bytes across the
+        chunk (clay) override to False."""
+        return self.get_sub_chunk_count() == 1
+
     def get_alignment(self) -> int:
         return SIMD_ALIGN
 

@@ -57,9 +57,8 @@ class ErasureCodePluginRegistry:
         "clay": "ceph_tpu.ec.clay",
     }
 
-    # clay joins the default preload set once its sub-chunk MSR
-    # implementation lands (tracked in ceph_tpu/ec/clay.py)
-    def preload(self, names=("jerasure", "isa", "lrc", "shec")) -> None:
+    def preload(self, names=("jerasure", "isa", "lrc", "shec",
+                             "clay")) -> None:
         """Eagerly import the default plugin set at daemon start so a
         broken plugin fails boot, not the first request (the reference's
         dlopen + version check, ErasureCodePlugin.cc:126-186; qa asserts

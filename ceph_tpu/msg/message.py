@@ -70,6 +70,23 @@ class Message:
                               # a fresh space, while reconnects of the SAME
                               # logical session (same Connection) keep theirs
 
+    @property
+    def struct_v(self) -> int:
+        """Encoded struct version seen on decode (from_bytes sets it):
+        lets a decode_payload key OPTIONAL tails on the SENDER's
+        version instead of frame remainder — required once a message
+        carries BOTH a versioned tail and the bare trace tail
+        (_enc_trace), which are ambiguous under remaining_in_frame
+        gating.  Encoder-side instances answer their own VERSION; a
+        property (not an __init__ field) so the roundtrip harness's
+        mutate-every-scalar sweep doesn't treat decode metadata as a
+        wire field."""
+        return getattr(self, "_struct_v", self.VERSION)
+
+    @struct_v.setter
+    def struct_v(self, v: int) -> None:
+        self._struct_v = int(v)
+
     # -- subclass hooks ---------------------------------------------------
     def encode_payload(self, e: Encoder) -> None:
         pass
@@ -104,7 +121,9 @@ class Message:
             raise ValueError(f"unknown message type {code}")
         msg = cls.__new__(cls)
         Message.__init__(msg)
-        d.start(cls.VERSION)  # we understand encodings up to our VERSION
+        # we understand encodings up to our VERSION; the SENDER's
+        # struct version is kept for decode_payload tail gating
+        msg.struct_v = d.start(cls.VERSION)
         msg.seq = d.u64()
         msg.tid = d.u64()
         msg.priority = d.u8()

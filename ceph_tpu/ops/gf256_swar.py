@@ -105,10 +105,11 @@ def _build_network(matrix: np.ndarray) -> Callable[[jax.Array], jax.Array]:
 _cache: Dict[Tuple[bytes, Tuple[int, int]], Callable] = {}
 
 
-def _compiled(matrix: np.ndarray, donate: bool = False) -> Callable:
+def _compiled(matrix: np.ndarray, donate: bool = False,
+              family: str = "gf256_swar") -> Callable:
     # cephlint: disable=no-d2h-on-hot-path — coefficient-matrix cache
     # key: `matrix` is metadata-scale host numpy, not a device buffer
-    key = (matrix.tobytes(), matrix.shape, donate)
+    key = (matrix.tobytes(), matrix.shape, donate, family)
     fn = _cache.get(key)
     if fn is None:
         net = _build_network(matrix)
@@ -128,27 +129,33 @@ def _compiled(matrix: np.ndarray, donate: bool = False) -> Callable:
         # batch instead of two.  Only for callers handing over a fresh
         # per-batch buffer (the StripeBatchQueue pipeline) — a donated
         # buffer cannot be reused by the caller afterwards.
-        fn = (instrumented_jit(run, family="gf256_swar",
+        # the caller's devwatch family (default "gf256_swar") tags the
+        # compile so shape-bucket discipline and the steady guard
+        # attribute it to the right kernel class (clay's coupled-layer
+        # matmuls run under "gf256_clay")
+        fn = (instrumented_jit(run, family=family,
                                donate_argnums=(0,)) if donate
-              else instrumented_jit(run, family="gf256_swar"))
+              else instrumented_jit(run, family=family))
         _cache[key] = fn
     return fn
 
 
-def _compiled_words(matrix: np.ndarray) -> Callable:
+def _compiled_words(matrix: np.ndarray,
+                    family: str = "gf256_swar") -> Callable:
     """jit of the network over PRE-PACKED u32 words [k, W] -> [R, W]
     (no device-side bitcasts — see gf_matmul_bytes' CPU path)."""
     # cephlint: disable=no-d2h-on-hot-path — coefficient-matrix cache
     # key: `matrix` is metadata-scale host numpy, not a device buffer
-    key = (matrix.tobytes(), matrix.shape, "words")
+    key = (matrix.tobytes(), matrix.shape, "words", family)
     fn = _cache.get(key)
     if fn is None:
         fn = _cache[key] = instrumented_jit(
-            _build_network(matrix), family="gf256_swar")
+            _build_network(matrix), family=family)
     return fn
 
 
-def gf_matmul_bytes(matrix: np.ndarray, x, donate: bool = False):
+def gf_matmul_bytes(matrix: np.ndarray, x, donate: bool = False,
+                    family: str = "gf256_swar"):
     """Apply a GF(2^8) coefficient matrix (R x k) to byte rows [k, n].
 
     n is padded to a word multiple internally; returns uint8 [R, n]
@@ -192,7 +199,7 @@ def gf_matmul_bytes(matrix: np.ndarray, x, donate: bool = False):
         # the data never left host memory, np.asarray is a view
         # materialization, not a device fetch
         # cephlint: disable=no-d2h-on-hot-path
-        out32 = np.asarray(_compiled_words(matrix)(words))
+        out32 = np.asarray(_compiled_words(matrix, family)(words))
         out = out32.view(np.uint8)
         return out[:, :n] if pad else out
     # sanctioned h2d upload of the encode input, not a fetch
@@ -243,7 +250,7 @@ def gf_matmul_bytes(matrix: np.ndarray, x, donate: bool = False):
     pad = (-n) % 4
     if pad:
         x = jnp.pad(x, ((0, 0), (0, pad)))
-    out = _compiled(matrix, donate)(x)
+    out = _compiled(matrix, donate, family)(x)
     if pad:
         out = out[:, :n]
     return out

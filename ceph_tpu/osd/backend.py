@@ -1065,6 +1065,46 @@ class ECBackend(PGBackend):
                                 length: int) -> Optional[bytes]:
         return self.read_local_chunk_extent2(oid, shard, off, length)[0]
 
+    def read_local_chunk_runs2(
+            self, oid: str, shard: int,
+            runs: Sequence[Tuple[int, int]]
+    ) -> Tuple[Optional[bytes], int, int]:
+        """Sub-chunk runs of a local shard chunk for the clay repair
+        plan: (data, code, served).  served=1 -> `data` is the
+        requested runs' bytes concatenated in run order, read through
+        the extent-sealed read_local_chunk_extent2 path (runs arrive
+        in SUB-CHUNK units — the primary does not know this peer's
+        chunk size, so the scaling by the stored chunk length happens
+        here).  served=0 -> the runs could not be mapped onto the
+        stored chunk (absent shard, geometry that does not divide into
+        sub-chunks, out-of-range runs): the caller serves the whole
+        chunk instead, exactly like a legacy peer.  A mapped extent
+        that fails to read returns (None, code, 1) with the usual
+        ECRC/EIO verdict contract."""
+        Z = int(self.codec.get_sub_chunk_count())
+        if Z <= 1 or not runs:
+            return None, 0, 0
+        g = GHObject(oid, shard=shard)
+        try:
+            clen = self.store.stat(self.coll, g)
+        except Exception:
+            return None, 0, 0  # absent: whole-chunk path answers EIO
+        if clen <= 0 or clen % Z:
+            return None, 0, 0
+        sub = clen // Z
+        if any(so < 0 or cnt <= 0 or so + cnt > Z for so, cnt in runs):
+            return None, 0, 0
+        parts: List[bytes] = []
+        for so, cnt in runs:
+            data, code = self.read_local_chunk_extent2(
+                oid, shard, so * sub, cnt * sub)
+            if data is None:
+                return None, code, 1
+            if len(data) != cnt * sub:
+                return None, 0, 0  # short read: geometry lied
+            parts.append(data)
+        return b"".join(parts), 0, 1
+
     def local_size(self, oid: str,
                    want_av: Optional[bytes] = None) -> Optional[int]:
         """Logical object size from a local shard's HashInfo.  With
@@ -1183,13 +1223,19 @@ class ECBackend(PGBackend):
 
             spawn(assemble)
             return
-        if not hasattr(self.codec, "recovery_matrix"):
-            # array codecs (clay) couple bytes across the chunk: no
-            # flat recovery matmul — full decode on a worker thread
+        self._note_decode_job()
+        if hasattr(self.codec, "recovery_matrix"):
+            fut = self.queue.decode_data_async(self.codec, arrs)
+        elif hasattr(self.codec, "decode_planes"):
+            # array codec (clay): the batched coupled-layer decode
+            # kind — coalesces by survivor signature exactly like
+            # "dec" (this replaces the old full-decode-on-a-worker-
+            # thread host bypass, the last codec path that dodged the
+            # device queue)
+            fut = self.queue.clay_decode_async(self.codec, arrs)
+        else:  # pragma: no cover — codec with neither kernel
             spawn(lambda: done(self.reconstruct(oid, avail, meta)))
             return
-        self._note_decode_job()
-        fut = self.queue.decode_data_async(self.codec, arrs)
 
         def finish(f) -> None:
             def complete() -> None:
@@ -1202,6 +1248,51 @@ class ECBackend(PGBackend):
                     return
                 planes = np.stack([data[i] for i in data_ids])
                 done(self._state_from_planes(oid, planes, avail, meta))
+
+            spawn(complete)
+
+        fut.add_done_callback(finish)
+
+    def repair_chunk_async(self, oid: str, lost: int,
+                           layers: Dict[int, bytes],
+                           done: Callable[[Optional[bytes]], None]) -> None:
+        """Clay single-shard repair from layers-only helper bytes: each
+        ``layers[h]`` holds helper h's repair-layer sub-chunks
+        concatenated in layer order (the sub-chunk read plan's wire
+        payload — d/(k*q) of a whole-chunk gather).  Rides the
+        StripeBatchQueue "crep" kind so concurrent single-shard repairs
+        sharing a (lost, helpers) signature coalesce into one batched
+        coupled-layer matmul; `done(chunk_bytes)` runs on a fresh
+        thread like reconstruct_async's completions."""
+        def spawn(fn) -> None:
+            threading.Thread(target=fn, daemon=True,
+                             name="ec-repair-done").start()
+
+        codec = self.codec
+        helpers = sorted(layers)
+        L = len(codec.repair_layers(lost))
+        width = len(layers[helpers[0]]) if helpers else 0
+        if (L == 0 or width == 0 or width % L
+                or any(len(layers[h]) != width for h in helpers)):
+            spawn(lambda: done(None))
+            return
+        s = width // L
+        planes = np.stack([
+            np.frombuffer(layers[h], dtype=np.uint8).reshape(L, s)
+            for h in helpers])
+        self._note_decode_job()
+        fut = self.queue.clay_repair_async(codec, lost, helpers, planes)
+
+        def finish(f) -> None:
+            def complete() -> None:
+                try:
+                    out = np.asarray(f.result())
+                except Exception as e:  # noqa: BLE001 — device/codec
+                    self.log(0, f"pg {self.pgid}: clay repair of {oid} "
+                                f"shard {lost} failed: {e!r}")
+                    done(None)
+                    return
+                done(out.tobytes())
 
             spawn(complete)
 
@@ -1223,6 +1314,14 @@ class ECBackend(PGBackend):
         if not all(i in arrs for i in data_ids):
             if len(arrs) < self.k:
                 return None
+            if self.codec.get_sub_chunk_count() != 1:
+                # array codecs (clay): a chunk EXTENT has no standalone
+                # sub-chunk structure, so extents of survivors cannot
+                # be decoded — the caller falls back to the whole-chunk
+                # reconstruct path.  (Unreachable today: clay reports
+                # supports_partial_writes() == False, so the RMW path
+                # that feeds this helper never engages.)
+                return None
             if hasattr(self.codec, "recovery_matrix"):
                 # batched recovery matmul: concurrent degraded reads
                 # sharing a survivor signature coalesce into one device
@@ -1230,7 +1329,7 @@ class ECBackend(PGBackend):
                 self._note_decode_job()
                 data = self.queue.decode_data(self.codec, arrs)
                 arrs.update({i: data[i] for i in data_ids})
-            else:
+            else:  # flat codec without a recovery matrix (bit-matrix)
                 decoded = self.codec.decode_array(arrs, data_ids, L)
                 arrs.update({i: np.asarray(decoded[i]) for i in data_ids})
         planes = np.stack([arrs[i] for i in data_ids])
@@ -1240,11 +1339,13 @@ class ECBackend(PGBackend):
 
     def can_partial(self, oid: str, off: int, length: int,
                     want_av: Optional[bytes] = None) -> bool:
-        """Partial-stripe fast path precondition: flat codec (array
-        codecs couple bytes across the whole chunk), locally known
-        size — from a CURRENT-stamped shard when `want_av` is given —
-        and no size change."""
-        if self.codec.get_sub_chunk_count() != 1:
+        """Partial-stripe fast path precondition: a codec whose parity
+        admits extent-local updates (a CODEC capability — clay's
+        coupled layers make extent-local parity deltas mathematically
+        impossible, see ClayCodec.supports_partial_writes), locally
+        known size — from a CURRENT-stamped shard when `want_av` is
+        given — and no size change."""
+        if not self.codec.supports_partial_writes():
             return False
         size = self.local_size(oid, want_av)
         return size is not None and off + length <= size

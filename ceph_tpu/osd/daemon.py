@@ -210,6 +210,20 @@ class OSDService(Dispatcher):
         pgpc.add_u64_counter("subread_ops",
                              "objects fanned out through recovery "
                              "window sub-reads")
+        pgpc.add_u64_counter("subread_bytes",
+                             "chunk payload bytes recovery gathers "
+                             "pulled over the wire (sub-chunk run "
+                             "plans count only the layers served)")
+        pgpc.add_u64_counter("subread_full_bytes",
+                             "bytes the same recoveries would read as "
+                             "whole-chunk flat-RS rebuilds (k chunks "
+                             "per object) — repair_read_frac's "
+                             "denominator")
+        pgpc.add_u64_gauge("repair_read_frac",
+                           "running subread_bytes/subread_full_bytes "
+                           "in PERMILLE: clay sub-chunk repair plans "
+                           "land ~d*1000/(k*q), whole-chunk gathers "
+                           ">= 1000")
         pgpc.add_u64_counter("decode_batch_jobs",
                              "decode jobs handed to the "
                              "StripeBatchQueue by degraded reads and "

@@ -393,28 +393,54 @@ class MECSubReadVec(_PGMessage):
     `reads` rows are (shard, oid, off, length); length==0 means the
     whole chunk.  The scalar MECSubRead stays registered and served
     for mixed-version peers: an old primary's per-shard sub-reads must
-    keep decoding and answering byte-for-byte."""
+    keep decoding and answering byte-for-byte.
+
+    v2 appends per-row SUB-CHUNK runs (`runs[i]` = [(sub_off, count)]
+    in sub-chunk units — the primary does not know the peer's chunk
+    size, so the peer scales by its local hinfo): the clay MSR repair
+    plan, where a single-shard rebuild reads only the d/(k*q) repair
+    layers of each helper.  An empty run list means the whole chunk
+    (every v1 row, and every flat-codec row).  The tail is keyed on
+    struct_v, NOT remaining_in_frame: this message also carries the
+    bare trace tail, and a frame-remainder gate could not tell a runs
+    tail from a trace context.  Rows keep (off=0, len=0), so a legacy
+    peer that ignores the tail still serves the whole chunk — its
+    reply's served flag (v1 default 0) tells the primary which layout
+    came back."""
 
     TYPE = 50
-    VERSION = 1
+    VERSION = 2
 
     def __init__(self, pgid=(0, 0), epoch=0,
-                 reads: Optional[List[Tuple[int, str, int, int]]] = None
+                 reads: Optional[List[Tuple[int, str, int, int]]] = None,
+                 runs: Optional[List[List[Tuple[int, int]]]] = None
                  ) -> None:
         super().__init__(pgid, epoch)
         self.reads = reads or []  # [(shard, oid, off, length), ...]
+        # per-row [(sub_chunk_off, count)] runs; [] = whole chunk
+        self.runs = runs if runs is not None else [
+            [] for _ in self.reads]
         self._init_trace()
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
         e.seq(self.reads, lambda enc, r: enc.s32(r[0]).string(r[1])
               .u64(r[2]).u64(r[3]))
+        runs = self.runs if len(self.runs) == len(self.reads) else [
+            [] for _ in self.reads]
+        e.seq(runs, lambda enc, rr: enc.seq(
+            rr, lambda ee, p: ee.u32(p[0]).u32(p[1])))
         self._enc_trace(e)  # recovery-round span context when tracing
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
         self.reads = d.seq(lambda dd: (dd.s32(), dd.string(), dd.u64(),
                                        dd.u64()))
+        if self.struct_v >= 2:
+            self.runs = d.seq(lambda dd: dd.seq(
+                lambda x: (x.u32(), x.u32())))
+        else:  # v1 sender: every row is a whole-chunk read
+            self.runs = [[] for _ in self.reads]
         self._dec_trace(d)
 
 
@@ -426,16 +452,27 @@ class MECSubReadVecReply(_PGMessage):
     the request rows in order: (shard, oid, data, result, attrs,
     omap); a shard this peer can't serve answers its row with EIO
     instead of going silent (the sender's gather bookkeeping needs
-    every row accounted)."""
+    every row accounted).
+
+    v2 appends a per-row served flag: 1 = the data blob is exactly the
+    REQUESTED sub-chunk runs concatenated in run order, 0 = the whole
+    chunk.  A v1 (or run-ignorant) peer's replies default every flag
+    to 0, so the primary can always tell which layout it got — the
+    explicit disambiguator that makes the legacy whole-chunk fallback
+    safe without guessing from blob sizes."""
 
     TYPE = 51
-    VERSION = 1
+    VERSION = 2
 
     def __init__(self, pgid=(0, 0), epoch=0,
-                 rows: Optional[List[Tuple]] = None) -> None:
+                 rows: Optional[List[Tuple]] = None,
+                 served: Optional[List[int]] = None) -> None:
         super().__init__(pgid, epoch)
         # [(shard, oid, data, result, attrs, omap), ...]
         self.rows = rows or []
+        # per-row flag: 1 = blob holds the requested runs, 0 = whole
+        self.served = served if served is not None else [
+            0 for _ in self.rows]
 
     def encode_payload(self, e: Encoder) -> None:
         self._enc_head(e)
@@ -448,6 +485,9 @@ class MECSubReadVecReply(_PGMessage):
                         lambda ee, v: ee.blob(v))
 
         e.seq(self.rows, _row)
+        served = self.served if len(self.served) == len(self.rows) else [
+            0 for _ in self.rows]
+        e.seq(served, lambda enc, f: enc.u8(1 if f else 0))
 
     def decode_payload(self, d: Decoder) -> None:
         self._dec_head(d)
@@ -458,6 +498,10 @@ class MECSubReadVecReply(_PGMessage):
                     dd.mapping(lambda x: x.string(), lambda x: x.blob()))
 
         self.rows = d.seq(_row)
+        if self.struct_v >= 2:
+            self.served = d.seq(lambda dd: dd.u8())
+        else:  # v1 sender: whole-chunk rows
+            self.served = [0 for _ in self.rows]
 
 
 @register

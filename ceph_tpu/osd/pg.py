@@ -2420,9 +2420,23 @@ class PG:
             chunks: Dict[Tuple[str, int], Tuple[Optional[bytes], int]] = {}
             metas: Dict[Tuple[str, int], Tuple] = {}
             rows = []
-            for shard, oid, off, length in msg.reads:
+            served: List[int] = []
+            run_plans = (msg.runs if len(msg.runs) == len(msg.reads)
+                         else [[] for _ in msg.reads])
+            for (shard, oid, off, length), rr in zip(msg.reads,
+                                                     run_plans):
                 key = (oid, shard)
-                if length:
+                sv = 0
+                if rr and not length:
+                    # sub-chunk run plan (clay repair): serve only the
+                    # requested repair layers through the extent-sealed
+                    # read path; an unmappable plan falls back to the
+                    # whole chunk, exactly like a legacy peer would
+                    data, code, sv = be.read_local_chunk_runs2(
+                        oid, shard, rr)
+                if sv:
+                    pass
+                elif length:
                     data, code = be.read_local_chunk_extent2(
                         oid, shard, off, length)
                 else:
@@ -2435,7 +2449,9 @@ class PG:
                 rows.append((shard, oid,
                              data if data is not None else b"",
                              0 if data is not None else code, attrs, omap))
-            rep = m.MECSubReadVecReply(self.pgid, self.osd.epoch(), rows)
+                served.append(sv)
+            rep = m.MECSubReadVecReply(self.pgid, self.osd.epoch(), rows,
+                                       served=served)
             rep.tid = msg.tid
             conn.send(rep)
             if span is not None:

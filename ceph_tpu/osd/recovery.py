@@ -59,7 +59,7 @@ class ChunkGather:
     feed()/fail_peer()/resolve() run under the OWNER's lock — the read
     path's per-read gather lock or the engine's round lock."""
 
-    def __init__(self, pg, oid: str) -> None:
+    def __init__(self, pg, oid: str, plan_repair: bool = False) -> None:
         be = pg.backend
         self.oid = oid
         self.k = be.k
@@ -132,18 +132,113 @@ class ChunkGather:
                     and (s, o, True) not in remote):
                 remote.append((s, o, False))
         self.remote: List[Tuple[int, int, bool]] = remote
+        # sub-chunk read plan (clay MSR single-shard repair): when the
+        # codec plans fractional reads and exactly our one local shard
+        # is missing, the gather asks only the d helper shards for
+        # their repair-layer sub-chunks — d/(k*q) of a whole-chunk
+        # gather's bytes.  None = whole-chunk gather (flat codecs,
+        # multi-shard damage, replan attempts).
+        self.sub_plan: Optional[Tuple[int, Tuple[int, ...],
+                                      List[Tuple[int, int]], int]] = None
+        self.sub_avail: Dict[int, bytes] = {}  # helper -> layer bytes
+        self.sub_count = int(be.codec.get_sub_chunk_count()) \
+            if hasattr(be, "codec") else 1
+        self.wire_bytes = 0  # chunk payload bytes received from peers
+        if plan_repair and not self.av_reject:
+            self._plan_sub_reads(be, acting)
         # outstanding CURRENT-holder requests per shard: a prior
         # holder's data for s is usable only when this drops to 0
         self.pending_cur: Dict[int, int] = {}
         self.pending_any: Dict[int, int] = {}
         self.holder_of: Dict[Tuple[int, int], bool] = {}
         self._open: Set[Tuple[int, int]] = set()
-        for s, o, is_cur in remote:
+        for s, o, is_cur in self.remote:
             self.holder_of[(s, o)] = is_cur
             self._open.add((s, o))
             self.pending_any[s] = self.pending_any.get(s, 0) + 1
             if is_cur:
                 self.pending_cur[s] = self.pending_cur.get(s, 0) + 1
+
+    def _plan_sub_reads(self, be, acting) -> None:
+        """Install the clay sub-chunk repair plan when it applies:
+        sub-chunked codec, exactly ONE local shard to rebuild, and the
+        codec's minimum_to_decode names a strict-subset run plan over
+        enough CURRENT holders.  The plan trims the remote ask to the
+        helper shards; any failure mode (helper EIO / hung / version-
+        rejected) resolves retryable and the engine's replan attempt
+        rebuilds with a whole-chunk gather, so planning can only save
+        bytes, never lose an object."""
+        codec = getattr(be, "codec", None)
+        if (codec is None or self.sub_count <= 1
+                or not hasattr(codec, "repair_layers")):
+            return
+        mine = [s for s in be.local_shards(acting)
+                if s not in self.cur_avail]
+        if len(mine) != 1:
+            return
+        lost = mine[0]
+        cur_remote = {s for s, _o, is_cur in self.remote if is_cur}
+        plan = codec.minimum_to_decode(
+            [lost], sorted(set(self.cur_avail) | cur_remote))
+        helpers = tuple(sorted(plan))
+        if not helpers or lost in helpers:
+            return
+        runs = [(int(a), int(b)) for a, b in plan[helpers[0]]]
+        layer_cnt = sum(c for _o, c in runs)
+        if (layer_cnt <= 0 or layer_cnt >= self.sub_count
+                or any(list(plan[h]) != list(plan[helpers[0]])
+                       for h in helpers)):
+            return  # whole-chunk (or degenerate) plan: no savings
+        if not all(h in self.cur_avail or h in cur_remote
+                   for h in helpers):
+            return  # a helper only a prior-interval holder has: the
+            #         version discipline wants the whole-chunk gather
+        self.sub_plan = (lost, helpers, runs, layer_cnt)
+        # ask ONLY the helpers, each for its repair layers; drop the
+        # prior-holder fallback rows (plan helpers are all current)
+        self.remote = [(s, o, is_cur) for s, o, is_cur in self.remote
+                       if is_cur and s in set(helpers)
+                       and s not in self.cur_avail]
+
+    def repair_ready(self) -> bool:
+        """Every planned helper's repair layers (or its whole chunk,
+        for helpers served by legacy peers) arrived."""
+        sp = self.sub_plan
+        if sp is None:
+            return False
+        return all(h in self.sub_avail or h in self.cur_avail
+                   for h in sp[1])
+
+    def repair_layer_bytes(self) -> Optional[Dict[int, bytes]]:
+        """helper -> repair-layer bytes for the planned single-shard
+        rebuild; whole chunks from legacy peers are sliced down to the
+        planned runs host-side.  None when widths disagree (mixed
+        chunk generations never co-repair — the _av check already
+        screened, this is the belt)."""
+        sp = self.sub_plan
+        if sp is None:
+            return None
+        _lost, helpers, runs, layer_cnt = sp
+        out: Dict[int, bytes] = {}
+        for h in helpers:
+            if h in self.sub_avail:
+                out[h] = self.sub_avail[h]
+            elif h in self.cur_avail:
+                c = self.cur_avail[h]
+                if len(c) % self.sub_count:
+                    return None
+                sub = len(c) // self.sub_count
+                out[h] = b"".join(c[so * sub: (so + cnt) * sub]
+                                  for so, cnt in runs)
+            else:
+                return None
+        widths = {len(b) for b in out.values()}
+        if len(widths) != 1 or 0 in widths:
+            return None
+        (w,) = widths
+        if w % layer_cnt:
+            return None
+        return out
 
     def _av_ok(self, attrs) -> bool:
         return self.want_av is None or attrs.get("_av") == self.want_av
@@ -196,11 +291,16 @@ class ChunkGather:
         return True
 
     def feed(self, shard: int, src: int, result: int, oid: str,
-             data: bytes, attrs, omap) -> bool:
+             data: bytes, attrs, omap, served: int = 0) -> bool:
         """Account one sub-read answer; returns True when the gather
-        became ready to resolve."""
+        became ready to resolve.  `served` mirrors the vec reply's
+        per-row flag: 1 = `data` is the requested sub-chunk runs
+        concatenated in run order (NOT a whole chunk), 0 = whole chunk
+        (every legacy reply)."""
         is_cur = self.holder_of.get((shard, src), False)
         good = result == 0 and oid == self.oid
+        if good:
+            self.wire_bytes += len(data)
         if result == ECRC and oid == self.oid:
             # the peer HAS the shard but its bytes failed verification:
             # decode around it, and let the pg layer attribute/repair
@@ -211,14 +311,25 @@ class ChunkGather:
             # (the shard exists, recovery will bring it forward)
             self.av_reject = True
         if good and self._av_ok(attrs):
-            if is_cur:
-                self.cur_avail[shard] = data
+            sp = self.sub_plan
+            if served and sp is not None and shard in sp[1] and is_cur:
+                # layers-only payload: usable ONLY by the repair plan —
+                # it must never enter cur_avail, where the whole-chunk
+                # decode/merge logic would treat it as a full chunk
+                self.sub_avail[shard] = data
                 if "hinfo" in attrs:
                     self._better_meta(self.cur_meta, attrs, omap)
-            else:
-                self.prior_avail.setdefault(shard, data)
-                if "hinfo" in attrs:
-                    self._better_meta(self.prior_meta, attrs, omap)
+            elif not served:
+                if is_cur:
+                    self.cur_avail[shard] = data
+                    if "hinfo" in attrs:
+                        self._better_meta(self.cur_meta, attrs, omap)
+                else:
+                    self.prior_avail.setdefault(shard, data)
+                    if "hinfo" in attrs:
+                        self._better_meta(self.prior_meta, attrs, omap)
+            # served payload with no matching plan: settle the request
+            # without feeding either pool (can't be interpreted safely)
         self._settle(shard, src)
         return self.ready()
 
@@ -235,7 +346,8 @@ class ChunkGather:
         return self.ready()
 
     def ready(self) -> bool:
-        return (not self.pending_any or len(self.cur_avail) >= self.k
+        return (not self.pending_any or self.repair_ready()
+                or len(self.cur_avail) >= self.k
                 or (len(self._merged()) >= self.k
                     and not any(v > 0 for v in self.pending_cur.values())))
 
@@ -483,7 +595,11 @@ class ECRecoveryEngine:
                 continue
             pg._obc_invalidate(oid)  # local shards rewritten on success
             self._attempts[oid] = self._attempts.get(oid, 0) + 1
-            g = ChunkGather(pg, oid)
+            # first attempt plans sub-chunk reads (clay: d helpers x
+            # repair layers only); the replan attempt after any
+            # failure falls back to the whole-chunk gather
+            g = ChunkGather(pg, oid,
+                            plan_repair=self._attempts[oid] == 1)
             with rnd.lock:
                 rnd.gathers[oid] = g
                 if not g.remote:
@@ -502,20 +618,25 @@ class ECRecoveryEngine:
             src = rep.src.num if rep.src else -1
             if isinstance(rep, m.MECSubReadVecReply):
                 rows = rep.rows
+                served = (rep.served
+                          if len(rep.served) == len(rows)
+                          else [0] * len(rows))
             elif isinstance(rep, m.MECSubReadReply):
                 rows = [(rep.shard, rep.oid, rep.data, rep.result,
                          rep.attrs, rep.omap)]
+                served = [0]
             else:
                 return
             fresh: List[str] = []
             with rnd.lock:
                 rnd.replied.add(src)
-                for shard, oid, data, result, attrs, omap in rows:
+                for (shard, oid, data, result, attrs, omap), sv in zip(
+                        rows, served):
                     g = rnd.gathers.get(oid)
                     if g is None or oid in rnd.concluded:
                         continue
                     if g.feed(shard, src, result, oid, data, attrs,
-                              omap):
+                              omap, served=sv):
                         rnd.concluded.add(oid)
                         fresh.append(oid)
             for oid in fresh:
@@ -594,9 +715,24 @@ class ECRecoveryEngine:
                     self.osd.send_to_osd(osd_id, rd)
                     msgs += 1
             else:
+                # per-row sub-chunk run plans (clay repair): runs from
+                # the object's gather when this shard is one of its
+                # planned helpers, else [] (whole chunk).  Rows keep
+                # (off=0, len=0) so a legacy peer ignoring the v2 tail
+                # still serves the whole chunk — its reply's served
+                # flag tells feed() which layout came back.
+                runs: List[List[Tuple[int, int]]] = []
+                with rnd.lock:
+                    for shard, oid in rows:
+                        g = rnd.gathers.get(oid)
+                        sp = g.sub_plan if g is not None else None
+                        runs.append(list(sp[2])
+                                    if sp is not None and shard in sp[1]
+                                    else [])
                 vec = m.MECSubReadVec(
                     pg.pgid, epoch,
-                    [(shard, oid, 0, 0) for shard, oid in rows])
+                    [(shard, oid, 0, 0) for shard, oid in rows],
+                    runs=runs)
                 vec.tid = tid
                 if rnd.span is not None:
                     # the peer opens its sub_read child off this round
@@ -629,11 +765,28 @@ class ECRecoveryEngine:
                       timed_out: bool) -> None:
         g = rnd.gathers[oid]
         with rnd.lock:
-            avail, meta, retry = g.resolve(timed_out)
+            lay = (g.repair_layer_bytes() if g.repair_ready() else None)
+            meta_r = g.cur_meta[0]
+            if lay is not None and meta_r is not None \
+                    and "hinfo" in meta_r[0]:
+                # sub-chunk repair plan satisfied: rebuild the ONE lost
+                # chunk from the helpers' repair layers on the batched
+                # coupled-layer kernel — no full decode, no re-encode
+                lost = g.sub_plan[0]
+            else:
+                lay = None
+                avail, meta, retry = g.resolve(timed_out)
         if g.crc_failed:
             # recovery decoded around a checksum-failed holder: same
             # attribution + targeted-repair path as a client read
             self.pg._note_read_verify_fail(oid, g.crc_failed)
+        if lay is not None:
+            self.pg.backend.repair_chunk_async(
+                oid, lost, lay,
+                lambda chunk: self._commit_repaired(
+                    rnd, oid, lost, chunk, meta_r, g.av_version,
+                    g.wire_bytes))
+            return
         if retry:
             self._oid_resolved(rnd, oid, ok=False, retry=True)
             return
@@ -648,13 +801,17 @@ class ECRecoveryEngine:
                     self.pg.unfound.add(oid)
             self._oid_resolved(rnd, oid, ok=False)
             return
+        chunk_len = len(next(iter(avail.values())))
+        wire = g.wire_bytes
         self.pg.backend.reconstruct_async(
             oid, avail, meta,
             lambda state: self._commit_recovered(rnd, oid, state,
-                                                 g.av_version))
+                                                 g.av_version,
+                                                 wire, chunk_len))
 
     def _commit_recovered(self, rnd: _Round, oid: str, state,
-                          av_version) -> None:
+                          av_version, wire_bytes: int = 0,
+                          chunk_len: int = 0) -> None:
         """Decode done (runs on a decode-completion thread): persist
         the rebuilt local shard(s) with the recovery stamp discipline
         and drop the object from pg.missing — individually, so reads
@@ -671,7 +828,91 @@ class ECRecoveryEngine:
                              f"{oid} failed: {e!r}")
             self._oid_resolved(rnd, oid, ok=False)
             return
+        self._note_repair_frac(wire_bytes, chunk_len)
         self._oid_resolved(rnd, oid, ok=True)
+
+    def _commit_repaired(self, rnd: _Round, oid: str, lost: int,
+                         chunk: Optional[bytes], meta, av_version,
+                         wire_bytes: int) -> None:
+        """Sub-chunk repair kernel done: land the ONE rebuilt chunk.
+        A kernel/width failure resolves retryable — the engine's
+        replan attempt re-gathers whole chunks, so the plan can only
+        save bytes, never lose the object."""
+        if not chunk:
+            self._oid_resolved(rnd, oid, ok=False, retry=True)
+            return
+        try:
+            self._store_repaired(oid, lost, chunk, meta, av_version)
+        except Exception as e:  # noqa: BLE001 — same non-wedging
+            # contract as _commit_recovered
+            self.osd._log(1, f"pg {self.pg.pgid}: repair commit of "
+                             f"{oid} failed: {e!r}")
+            self._oid_resolved(rnd, oid, ok=False)
+            return
+        self._note_repair_frac(wire_bytes, len(chunk))
+        self._oid_resolved(rnd, oid, ok=True)
+
+    def _note_repair_frac(self, wire_bytes: int, chunk_len: int) -> None:
+        """Recovery read-amplification accounting: numerator = chunk
+        payload bytes this object's gather pulled over the wire,
+        denominator = the k whole chunks a flat-RS rebuild reads.  The
+        repair_read_frac gauge publishes the running ratio in PERMILLE
+        (integer counters): clay sub-chunk plans land ~d*1000/(k*q)."""
+        perf = getattr(self.osd, "pg_perf", None)
+        if perf is None or chunk_len <= 0:
+            return
+        perf.inc("subread_bytes", wire_bytes)
+        perf.inc("subread_full_bytes", self.pg.backend.k * chunk_len)
+        full = perf.value("subread_full_bytes")
+        if full > 0:
+            perf.set("repair_read_frac",
+                     perf.value("subread_bytes") * 1000 // full)
+
+    def _store_repaired(self, oid: str, shard: int, chunk: bytes,
+                        meta, av_version) -> None:
+        """Persist ONE repaired chunk (the sub-chunk plan's landing):
+        same REPLACE + recovery-stamp + _av-fence discipline as
+        _store_recovered, but the payload is the repaired chunk itself
+        — no object decode, no re-encode of k+m chunks."""
+        from ceph_tpu.osd.backend import _av_stamp, _hinfo, hinfo_decode
+        from ceph_tpu.store.objectstore import GHObject, Transaction
+
+        pg = self.pg
+        pg._obc_invalidate(oid)
+        attrs_src, omap = meta
+        size, _, _ = hinfo_decode(attrs_src["hinfo"])
+        av = (_av_stamp(av_version) if av_version is not None
+              else pg._av_for(oid))
+        # same schedulable seam as the full-decode landing: thrash
+        # tooling that races superseding writes hooks both paths
+        failpoint("recovery.store_recovered", oid=oid,
+                  av=str(av_version))
+        t = Transaction()
+        g = GHObject(oid, shard=shard)
+        t.try_remove(pg.coll, g)
+        t.write(pg.coll, g, 0, chunk)
+        attrs = {k: v for k, v in attrs_src.items()
+                 if k not in ("hinfo", "_av")}
+        attrs["hinfo"] = _hinfo(chunk, size)
+        attrs["_av"] = av
+        t.setattrs(pg.coll, g, attrs)
+        if omap:
+            t.omap_setkeys(pg.coll, g, dict(omap))
+        with pg.lock:
+            if oid not in pg.missing:
+                # a superseding write (or a push) resolved this object
+                # mid-repair: its shards are NEWER than our chunk
+                return
+            if (av_version is not None
+                    and pg.missing[oid] != av_version):
+                # the fence moved while we repaired (same rule as
+                # _store_recovered): the newer round owns the object
+                return
+            self.osd.store.queue_transaction(t)
+            pg.missing.pop(oid, None)
+            pg.unfound.discard(oid)
+        self.osd.perf.inc("recovery_pushes")
+        pg.note_recovery_io(1, len(chunk))
 
     def _store_recovered(self, oid: str, state, av_version) -> None:
         from ceph_tpu.osd.backend import ECBackend, _av_stamp, _hinfo

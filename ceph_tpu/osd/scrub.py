@@ -488,13 +488,23 @@ class ScrubEngine:
             fut = None
             if sig:
                 widths = {len(avail[i]) for i in sig}
+                flat = hasattr(be.codec, "recovery_matrix")
+                clay = hasattr(be.codec, "decode_planes")
                 if (queue is not None and len(widths) == 1
-                        and hasattr(be.codec, "recovery_matrix")
+                        and (flat or clay)
                         and sig != tuple(range(k))):
                     arrs = {i: np.frombuffer(avail[i], dtype=np.uint8)
                             for i in sig}
                     be._note_decode_job()
-                    fut = queue.decode_data_async(be.codec, arrs)
+                    if flat:
+                        fut = queue.decode_data_async(be.codec, arrs)
+                    else:
+                        # array codec (clay): the batched coupled-layer
+                        # decode kind — scrub's parity-preferring k-
+                        # survivor signature makes this a TRUE decode,
+                        # and objects sharing a signature still
+                        # coalesce into one device pass
+                        fut = queue.clay_decode_async(be.codec, arrs)
             jobs.append((oid, avail, metas, errs, sig, fut))
         for oid, avail, metas, errs, sig, fut in jobs:
             bad = list(errs)

@@ -36,8 +36,10 @@ class _Job:
                  trop=None) -> None:
         self.codec = codec
         self.planes = planes
-        self.kind = kind      # "enc" | "encp" (fused crc) | "dec"
-        self.sig = sig        # decode: sorted survivor ids
+        # "enc" | "encp" (fused crc) | "dec" (flat recovery matmul) |
+        # "cdec" (array-codec decode) | "crep" (clay sub-chunk repair)
+        self.kind = kind
+        self.sig = sig        # dec/cdec: survivor ids; crep: (lost, *helpers)
         self.size = size or planes.nbytes  # real payload bytes (h2d
         # accounting: stripe-tail zeros are device-side fill, not
         # transferred bytes)
@@ -214,6 +216,49 @@ class StripeBatchQueue:
     def decode_data(self, codec, available) -> np.ndarray:
         return self.decode_data_async(codec, available).result()
 
+    def clay_repair_async(self, codec, lost: int, helpers,
+                          planes: np.ndarray, trop=None) -> Future:
+        """Layers-only survivor planes [d, L, s] -> Future of the
+        rebuilt chunk bytes [Z*s] (row order = sorted helpers, layer
+        order = codec.repair_layers(lost)).
+
+        The MSR-repair twin of encode_async: concurrent single-shard
+        repairs of the SAME lost shard (a recovery window draining one
+        dead OSD is exactly this) coalesce along the intra-sub-chunk
+        byte axis into one set of coupled-layer matmuls."""
+        self.start()
+        planes = np.ascontiguousarray(planes, dtype=np.uint8)
+        d, L, s = planes.shape
+        job = _Job(codec, planes.reshape(d * L, s), kind="crep",
+                   sig=(int(lost),) + tuple(int(h) for h in helpers),
+                   trop=trop)
+        self._q.put(job)
+        return job.future
+
+    def clay_repair(self, codec, lost: int, helpers,
+                    planes: np.ndarray) -> np.ndarray:
+        return self.clay_repair_async(codec, lost, helpers,
+                                      planes).result()
+
+    def clay_decode_async(self, codec,
+                          available: "Dict[int, np.ndarray]",
+                          trop=None) -> Future:
+        """Survivor chunks {shard: [n]} -> Future of data planes [k, n]
+        for an array codec (clay).  Jobs sharing a survivor signature
+        coalesce like "dec", but along the intra-sub-chunk byte axis
+        (see _dispatch_array) and keep EVERY survivor: with > k
+        available the codec's single-erasure fast path reads d helpers
+        instead of running the general multi-erasure decode."""
+        self.start()
+        sig = tuple(sorted(available))
+        Z = int(codec.get_sub_chunk_count())
+        stacked = np.ascontiguousarray(np.concatenate(
+            [np.asarray(available[i], dtype=np.uint8).reshape(Z, -1)
+             for i in sig]))
+        job = _Job(codec, stacked, kind="cdec", sig=sig, trop=trop)
+        self._q.put(job)
+        return job.future
+
     # -- worker -----------------------------------------------------------
     def _worker(self) -> None:
         while True:
@@ -284,6 +329,86 @@ class StripeBatchQueue:
                 np.asarray(coding_mat, dtype=np.uint8), stacked)
         return np.asarray(codec.encode_array(stacked))
 
+    def _dispatch_array(self, codec, batch: List[_Job],
+                        widths: List[int]):
+        """Array-codec (clay) batch: jobs concatenate along the INTRA-
+        sub-chunk byte axis, not the raw column axis — the coupled-
+        layer transforms are elementwise over that axis (each byte
+        position within a sub-chunk is independent), while a raw byte
+        concat (or a raw tail pad) would let the layer axis absorb a
+        neighbour's bytes and corrupt every job in the batch.  The
+        per-layer width is covering-padded to a pow2 so the flattened
+        pair/solve matmul widths inside the codec stay in the declared
+        gf256_clay buckets.  Returns (per-job outputs, per-job crcs or
+        None)."""
+        Z = int(codec.get_sub_chunk_count())
+        kind = batch[0].kind
+        rows = batch[0].planes.shape[0]
+        # enc/encp planes are [k, Z*s]; crep/cdec arrive pre-reshaped
+        # with sub-chunk rows ([d*L, s] / [A*Z, s]), widths already s
+        per_row = Z if kind in ("enc", "encp") else 1
+        svec = [w // per_row for w in widths]
+        s_pad = shapebucket.covering(sum(svec), 1)
+        stacked = np.zeros((rows, per_row, s_pad), dtype=np.uint8)
+        off = 0
+        for j, s in zip(batch, svec):
+            stacked[:, :, off:off + s] = j.planes.reshape(
+                rows, per_row, s)
+            off += s
+        offs: List[int] = []
+        o = 0
+        for s in svec:
+            offs.append(o)
+            o += s
+        outs: List[np.ndarray] = []
+        crcs = None
+        if kind == "crep":
+            lost = batch[0].sig[0]
+            helpers = list(batch[0].sig[1:])
+            layers = rows // len(helpers)
+            out = np.asarray(codec.repair_planes(
+                lost, helpers,
+                stacked.reshape(len(helpers), layers, s_pad)))
+            outs = [
+                np.ascontiguousarray(out[:, o:o + s]).reshape(-1)
+                for o, s in zip(offs, svec)]
+        elif kind == "cdec":
+            avail = list(batch[0].sig)
+            data = np.asarray(codec.decode_planes(
+                avail, stacked.reshape(len(avail), Z * s_pad)))
+            d3 = data.reshape(codec.k, Z, s_pad)
+            outs = [
+                np.ascontiguousarray(d3[:, :, o:o + s]).reshape(
+                    codec.k, -1)
+                for o, s in zip(offs, svec)]
+        else:
+            coding = np.asarray(codec.encode_array(
+                stacked.reshape(rows, per_row * s_pad)))
+            c3 = coding.reshape(codec.m, Z, s_pad)
+            outs = [
+                np.ascontiguousarray(c3[:, :, o:o + s]).reshape(
+                    codec.m, -1)
+                for o, s in zip(offs, svec)]
+            if kind == "encp":
+                # fused per-shard crc32c over the ORIGINAL per-job
+                # chunk layout (crc is a byte stream over each chunk,
+                # so the relayout from the s-axis batch is rebuilt
+                # host-side; same device-rig honesty note as the flat
+                # encp path)
+                from ceph_tpu.ops.crc32c_device import crc32c_rows
+
+                full = np.zeros((rows + codec.m, sum(widths)),
+                                dtype=np.uint8)
+                bo = 0
+                boffs: List[int] = []
+                for i, (j, w) in enumerate(zip(batch, widths)):
+                    full[:rows, bo:bo + w] = j.planes
+                    full[rows:, bo:bo + w] = outs[i]
+                    boffs.append(bo)
+                    bo += w
+                crcs = crc32c_rows(full, boffs, widths)
+        return outs, crcs
+
     def _run_batch(self, batch: List[_Job]) -> None:
         # publish the in-flight batch BEFORE any dispatch work (incl.
         # the failpoint: a barrier'd/stalled dispatch must show up in
@@ -321,63 +446,68 @@ class StripeBatchQueue:
             # column granularity)) so the device only ever sees the
             # family's DECLARED shapes: each distinct shape is a fresh
             # XLA compile, and an undeclared one is a rogue compile by
-            # definition.  Array codecs like clay keep their
-            # width-divisible-by-sub_chunk_count invariant via gran;
-            # results are sliced back to real job widths below, and
-            # the pad columns are zeros (EC codecs are column-local,
-            # so padding cannot perturb real columns — proven
-            # bit-identical in tier-1).
+            # definition.  Flat codecs concatenate along the raw
+            # column axis (column-local: padding cannot perturb real
+            # columns — proven bit-identical in tier-1); array codecs
+            # like clay take _dispatch_array, which concatenates along
+            # the INTRA-sub-chunk byte axis instead (a raw byte concat
+            # would let the layer axis absorb a neighbour's bytes).
             gran = 1
             get_subs = getattr(
                 batch[0].codec, "get_sub_chunk_count", None)
             if get_subs is not None:
                 gran = max(1, int(get_subs()))
-            padded = shapebucket.covering(total, gran)
-            k = batch[0].planes.shape[0]
-            stacked = np.zeros((k, padded), dtype=np.uint8)
-            off = 0
-            for j, w in zip(batch, widths):
-                stacked[:, off:off + w] = j.planes
-                off += w
             codec = batch[0].codec
-            if gran == 1:
-                coding = self._apply_matrix(codec, batch, stacked)
-            else:
-                coding = np.asarray(codec.encode_array(stacked))
-            if batch[0].kind == "encp":
-                # fused per-shard crc32c: one more device pass over
-                # the SAME batch (data planes + fresh coding
-                # planes); only the [jobs, k+m] u32 digests cross
-                # back — the payload stays put.  NOTE (device-rig
-                # honesty): this np concat + the crc row relayout
-                # are host moves on CPU rigs, folded into the
-                # already-counted upload; a real device rig must do
-                # them as jnp ops on the resident batch or it pays
-                # an uncounted round-trip — that port is the
-                # device-rig follow-up, not a counter change
-                from ceph_tpu.ops.crc32c_device import crc32c_rows
-
-                full = np.concatenate(
-                    [stacked, np.asarray(coding)], axis=0)
-                offs: List[int] = []
-                o = 0
-                for w in widths:
-                    offs.append(o)
-                    o += w
-                crcs = crc32c_rows(full, offs, widths)
+            if gran > 1:
+                outs, crcs = self._dispatch_array(codec, batch, widths)
                 t_compute = time.monotonic()
-                off = 0
-                for i, (j, w) in enumerate(zip(batch, widths)):
+                for i, j in enumerate(batch):
                     j.future.set_result(
-                        (coding[:, off:off + w], crcs[i]))
-                    off += w
+                        (outs[i], crcs[i]) if batch[0].kind == "encp"
+                        else outs[i])
             else:
-                t_compute = time.monotonic()
+                padded = shapebucket.covering(total, gran)
+                k = batch[0].planes.shape[0]
+                stacked = np.zeros((k, padded), dtype=np.uint8)
                 off = 0
                 for j, w in zip(batch, widths):
-                    j.future.set_result(coding[:, off:off + w])
+                    stacked[:, off:off + w] = j.planes
                     off += w
-            if batch[0].kind in ("encp", "dec"):
+                coding = self._apply_matrix(codec, batch, stacked)
+                if batch[0].kind == "encp":
+                    # fused per-shard crc32c: one more device pass over
+                    # the SAME batch (data planes + fresh coding
+                    # planes); only the [jobs, k+m] u32 digests cross
+                    # back — the payload stays put.  NOTE (device-rig
+                    # honesty): this np concat + the crc row relayout
+                    # are host moves on CPU rigs, folded into the
+                    # already-counted upload; a real device rig must do
+                    # them as jnp ops on the resident batch or it pays
+                    # an uncounted round-trip — that port is the
+                    # device-rig follow-up, not a counter change
+                    from ceph_tpu.ops.crc32c_device import crc32c_rows
+
+                    full = np.concatenate(
+                        [stacked, np.asarray(coding)], axis=0)
+                    offs: List[int] = []
+                    o = 0
+                    for w in widths:
+                        offs.append(o)
+                        o += w
+                    crcs = crc32c_rows(full, offs, widths)
+                    t_compute = time.monotonic()
+                    off = 0
+                    for i, (j, w) in enumerate(zip(batch, widths)):
+                        j.future.set_result(
+                            (coding[:, off:off + w], crcs[i]))
+                        off += w
+                else:
+                    t_compute = time.monotonic()
+                    off = 0
+                    for j, w in zip(batch, widths):
+                        j.future.set_result(coding[:, off:off + w])
+                        off += w
+            if batch[0].kind in ("encp", "dec", "cdec", "crep"):
                 # the ONE h2d upload of the device-resident path: the
                 # whole coalesced batch crosses together (stripe-tail
                 # and pow2 padding are device-side zero-fill, not
@@ -389,7 +519,7 @@ class StripeBatchQueue:
             self.jobs += len(batch)
             self.batch_jobs[len(batch)] = (
                 self.batch_jobs.get(len(batch), 0) + 1)
-            if batch[0].kind == "dec":
+            if batch[0].kind in ("dec", "cdec", "crep"):
                 self.dec_batch_jobs[len(batch)] = (
                     self.dec_batch_jobs.get(len(batch), 0) + 1)
             self.bytes_in += sum(j.planes.nbytes for j in batch)

@@ -177,6 +177,13 @@ declare("gf256_pallas",
              "cols; 128-lane axis static")
 declare("gf2_matmul",
         note="bit-matrix tiles: tile_n static, batch cols queue-padded")
+declare("gf256_clay",
+        note="coupled-layer pair/solve matmuls: rows are 1x2 pair "
+             "transforms or q x kk solve matrices (static geometry); "
+             "cols = (pairs or layers) * S with S the per-layer byte "
+             "width, covering-padded at sub-chunk granularity by the "
+             "StripeBatchQueue clay kinds — odd parts bounded by the "
+             "grid constants (<= q^t <= 63 for supported profiles)")
 declare("crc32c_device",
         note="(J, C) row batches: J pow2, C pow2 with 64 floor "
              "(crc32c_rows/_round_up_pow2)")
@@ -382,8 +389,24 @@ class DeviceWarmup:
         if codec is None:
             return False
         get_subs = getattr(codec, "get_sub_chunk_count", None)
-        if (get_subs is not None and int(get_subs()) > 1) or \
-                getattr(codec, "recovery_matrix", None) is None:
+        gran = max(1, int(get_subs())) if get_subs is not None else 1
+        if gran > 1 and hasattr(codec, "repair_planes"):
+            # array codec (clay): warm the batched single-erasure
+            # repair AND the general decode at the queue's covering
+            # width (the sub-chunk-granular ladder), so steady-state
+            # recovery/scrub pay zero compiles
+            n = codec.k + codec.m
+            w = covering(cols, gran)
+            s = w // gran
+            L = len(codec.repair_layers(0))
+            codec.repair_planes(
+                0, list(range(1, codec.d + 1)),
+                np.zeros((codec.d, L, s), np.uint8))
+            avail = list(range(codec.m, n))  # first m erased
+            codec.decode_planes(
+                avail, np.zeros((len(avail), w), np.uint8))
+            return True
+        if gran > 1 or getattr(codec, "recovery_matrix", None) is None:
             return True  # no flat decode matmul to warm
         n = codec.k + codec.m
         # one representative survivor signature: first m shards

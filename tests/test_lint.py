@@ -218,6 +218,26 @@ def test_codec_symmetry_version_tolerance(tmp_path):
     assert not _lint(tmp_path, tolerant, "codec-symmetry")
 
 
+def test_codec_symmetry_struct_v_gated_ok(tmp_path):
+    """PR 19: a decode_payload keying an optional tail on the sender's
+    struct_v (Message.struct_v, set from d.start() by the decode
+    harness) is version-tolerant — the sanctioned gate when a message
+    carries both a versioned tail and the bare trace tail."""
+    ok = _lint(tmp_path, (
+        "class T:\n"
+        "    VERSION = 2\n"
+        "    def encode_payload(self, e):\n"
+        "        e.u32(self.a)\n"
+        "        e.u32(self.b)\n"
+        "    def decode_payload(self, d):\n"
+        "        self.a = d.u32()\n"
+        "        if self.struct_v >= 2:\n"
+        "            self.b = d.u32()\n"
+        "        else:\n"
+        "            self.b = 0\n"), "codec-symmetry")
+    assert not ok
+
+
 def test_codec_symmetry_start_gated_struct_ok(tmp_path):
     ok = _lint(tmp_path, (
         "class S:\n"
@@ -657,6 +677,34 @@ def test_shape_bucket_flags_unpadded_queue_dispatch(tmp_path):
     # the same code outside the coalescer is not this check's business
     assert not _lint(tmp_path, code, "shape-bucket-discipline",
                      rel="ceph_tpu/osd/other.py")
+
+
+def test_shape_bucket_flags_unpadded_clay_dispatch(tmp_path):
+    """PR 19: the clay array-codec kernels (repair_planes /
+    decode_planes) are dispatch tails too — an unpadded coupled-layer
+    batch is the same fresh-compile-per-width hazard as the flat
+    matmul."""
+    code = (
+        "def dispatch_array(codec, stacked):\n"
+        "    out = codec.repair_planes(0, [1, 2], stacked)\n"
+        "    return codec.decode_planes([1, 2, 3], stacked)\n"
+        "def padded(codec, stacked, covering):\n"
+        "    w = covering(stacked.shape[2], 1)\n"
+        "    return codec.repair_planes(0, [1, 2], stacked)\n")
+    bad = _lint(tmp_path, code, "shape-bucket-discipline",
+                rel="ceph_tpu/tpu/queue.py")
+    assert sorted(v.detail for v in bad) == [
+        "unpadded-dispatch:decode_planes",
+        "unpadded-dispatch:repair_planes"]
+
+
+def test_shape_bucket_gf256_clay_family_declared():
+    """The clay kernel family registered by gf256_swar must be in the
+    declared bucket set — otherwise every crep/cdec compile counts as
+    a rogue and the steady guard can never arm on a clay pool."""
+    from ceph_tpu.tpu import shapebucket
+
+    assert "gf256_clay" in set(shapebucket.declared_families())
 
 
 def test_shape_bucket_never_baseline(tmp_path):

@@ -25,8 +25,13 @@ N_OSDS = 6
 REP_POOL = 1
 EC_POOL = 2
 EC22_POOL = 3
+CLAY_POOL = 4
 EC_PROFILE = "plugin=isa k=2 m=1 technique=reed_sol_van"
 EC22_PROFILE = "plugin=isa k=2 m=2 technique=reed_sol_van"
+# coupled-layer MSR pool (PR 19): k=4 m=2 d=5 over all six osds —
+# single-shard recovery pulls d sub-chunk RUNS (5/8 of a whole-chunk
+# read) through the same windowed pull the RS pools use
+CLAY_PROFILE = "plugin=clay k=4 m=2"
 
 
 def build_map() -> OSDMap:
@@ -44,6 +49,9 @@ def build_map() -> OSDMap:
     osdmap.add_pool(PGPool(EC22_POOL, POOL_ERASURE, size=4, min_size=3,
                            pg_num=8, pgp_num=8, crush_rule=1,
                            erasure_code_profile=EC22_PROFILE))
+    osdmap.add_pool(PGPool(CLAY_POOL, POOL_ERASURE, size=6, min_size=5,
+                           pg_num=8, pgp_num=8, crush_rule=1,
+                           erasure_code_profile=CLAY_PROFILE))
     return osdmap
 
 

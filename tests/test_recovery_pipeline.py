@@ -51,6 +51,9 @@ class _Perf:
     def set(self, name, v):
         self.vals[name] = v
 
+    def value(self, name, default=0):
+        return self.vals.get(name, default)
+
 
 class _StubMap:
     def __init__(self, down=()):

@@ -580,10 +580,10 @@ def run_scenario_matrix(seed: int, names, rounds: int,
 def run_one(seed: int, pool_kind: str, rounds: int = 200) -> bool:
     sys.path.insert(0, "tests")
     from test_rados_model import _run_model_sequence
-    from test_osd_cluster import (EC_POOL, N_OSDS, LibClient,
+    from test_osd_cluster import (CLAY_POOL, EC_POOL, N_OSDS, LibClient,
                                   MiniCluster, REP_POOL)
 
-    pool = EC_POOL if pool_kind == "ec" else REP_POOL
+    pool = {"ec": EC_POOL, "clay": CLAY_POOL}.get(pool_kind, REP_POOL)
     c = MiniCluster()
     cl = LibClient(c)
     stop = threading.Event()
@@ -645,7 +645,7 @@ def main(argv=None) -> int:
     p.add_argument("--seconds", type=float, default=600.0)
     p.add_argument("--seed", default=None,
                    help="replay ONE seed instead of sweeping")
-    p.add_argument("--pool", choices=("rep", "ec"), default="ec")
+    p.add_argument("--pool", choices=("rep", "ec", "clay"), default="ec")
     p.add_argument("--tries", type=int, default=None,
                    help="runs per replay (default 4) / per matrix "
                         "cell (default 6)")
